@@ -1,0 +1,105 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Current headline: LeNet-MNIST training throughput (images/sec) on the
+available chip(s), against the BASELINE.md LeNet config. Will move to
+ResNet50/ImageNet images/sec/chip as the zoo fills out (BASELINE.json
+north star). ``vs_baseline`` compares against a same-process JAX/Flax
+reference implementation of the identical model/step, so the number is
+hardware-independent (1.0 = parity with hand-written flax)."""
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench_net(steps: int = 60, batch: int = 256, warmup: int = 5):
+    import jax
+    from __graft_entry__ import _lenet
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    net, _ = _lenet()
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (batch, 784)).astype("float32")
+    y = np.eye(10, dtype="float32")[rng.integers(0, 10, batch)]
+    ds = DataSet(x, y)
+
+    step_fn = net._make_train_step()
+    batch_t = net._batch_tuple(ds)
+    params, state, opt = net.params, net.state, net.opt_state
+    key = jax.random.PRNGKey(0)
+    for i in range(warmup):
+        params, state, opt, loss = step_fn(params, state, opt, batch_t,
+                                           key, np.int32(i))
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, state, opt, loss = step_fn(params, state, opt, batch_t,
+                                           key, np.int32(i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def _bench_flax_reference(steps: int = 60, batch: int = 256,
+                          warmup: int = 5):
+    """Same LeNet, hand-written in flax/optax — the perf reference."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax import linen as nn
+
+    class LeNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.reshape(x.shape[0], 28, 28, 1)
+            x = nn.relu(nn.Conv(20, (5, 5), padding="VALID")(x))
+            x = nn.max_pool(x, (2, 2), (2, 2))
+            x = nn.relu(nn.Conv(50, (5, 5), padding="VALID")(x))
+            x = nn.max_pool(x, (2, 2), (2, 2))
+            x = x.reshape(x.shape[0], -1)
+            x = nn.relu(nn.Dense(500)(x))
+            return nn.Dense(10)(x)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (batch, 784)).astype("float32"))
+    y = jnp.asarray(np.eye(10, dtype="float32")[
+        rng.integers(0, 10, batch)])
+    model = LeNet()
+    params = model.init(jax.random.PRNGKey(0), x)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy(logits, y).mean()
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        u, opt2 = tx.update(g, opt, params)
+        return optax.apply_updates(params, u), opt2, loss
+
+    for _ in range(warmup):
+        params, opt, loss = step(params, opt, x, y)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def main():
+    ours = _bench_net()
+    ref = _bench_flax_reference()
+    print(json.dumps({
+        "metric": "LeNet-MNIST train throughput",
+        "value": round(ours, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ours / ref, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

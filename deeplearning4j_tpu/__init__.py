@@ -1,0 +1,43 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the Deeplearning4j capability surface
+(reference: xiazemin/deeplearning4j @ 0.9.2-SNAPSHOT) on JAX/XLA:
+
+- declarative, JSON/YAML-serializable network configuration DSL
+  (reference: deeplearning4j-nn nn/conf/NeuralNetConfiguration.java)
+- two executors: ``MultiLayerNetwork`` (sequential) and
+  ``ComputationGraph`` (DAG)  (reference: nn/multilayer, nn/graph)
+- full layer library (dense/conv/pool/norm/recurrent/embedding/VAE/YOLO)
+- training infrastructure: updaters, listeners, early stopping,
+  transfer learning, gradient checking, checkpointing
+- data pipelines + evaluation suites
+- Keras HDF5 import, model zoo
+- parallelism: DP/TP/PP/SP over a ``jax.sharding.Mesh`` (replaces
+  ParallelWrapper threads + Spark + Aeron parameter server with XLA
+  collectives over ICI/DCN)
+
+Unlike the reference (per-layer manual backprop + cuDNN helper SPI +
+memory workspaces), the compute core is *functional*: a network config
+compiles to a pure ``apply`` function; backprop is ``jax.grad``; the
+whole train step (forward + grad + optimizer) is one jitted XLA program.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn.conf import (
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+
+__all__ = [
+    "dtypes",
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+    "ComputationGraphConfiguration",
+    "MultiLayerNetwork",
+    "ComputationGraph",
+]

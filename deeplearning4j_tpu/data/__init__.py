@@ -1,0 +1,14 @@
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    DataSetIterator, ListDataSetIterator, ArrayDataSetIterator,
+    AsyncDataSetIterator, MultipleEpochsIterator,
+    EarlyTerminationDataSetIterator, SamplingDataSetIterator,
+    BenchmarkDataSetIterator,
+)
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "ArrayDataSetIterator", "AsyncDataSetIterator", "MultipleEpochsIterator",
+    "EarlyTerminationDataSetIterator", "SamplingDataSetIterator",
+    "BenchmarkDataSetIterator",
+]

@@ -1,0 +1,247 @@
+"""DataSet iterators.
+
+Mirrors the reference's iterator kit (deeplearning4j-nn
+datasets/iterator/**): AsyncDataSetIterator (background prefetch
+thread, AsyncDataSetIterator.java:30), MultipleEpochsIterator,
+EarlyTerminationDataSetIterator, SamplingDataSetIterator,
+BenchmarkDataSetIterator (cached-batch replay for isolating compute).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+__all__ = ["DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
+           "AsyncDataSetIterator", "MultipleEpochsIterator",
+           "EarlyTerminationDataSetIterator", "SamplingDataSetIterator",
+           "BenchmarkDataSetIterator"]
+
+
+class DataSetIterator:
+    """Base: restartable iterator over DataSet minibatches."""
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self._iterate()
+
+    def _iterate(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def batch_size(self) -> Optional[int]:
+        return None
+
+    # parity helper with reference API
+    def num_examples(self) -> Optional[int]:
+        return None
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Over a pre-batched list (reference ListDataSetIterator)."""
+
+    def __init__(self, batches: Sequence[DataSet]):
+        self._batches = list(batches)
+
+    def reset(self):
+        pass
+
+    def _iterate(self):
+        yield from self._batches
+
+    def batch_size(self):
+        return self._batches[0].num_examples() if self._batches else None
+
+    def num_examples(self):
+        return sum(b.num_examples() for b in self._batches)
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batches dense arrays, with optional per-epoch shuffle."""
+
+    def __init__(self, features, labels=None, batch_size: int = 32,
+                 shuffle: bool = False, seed: int = 0,
+                 features_mask=None, labels_mask=None,
+                 drop_last: bool = False):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+        self._bs = batch_size
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._drop_last = drop_last
+
+    def reset(self):
+        self._epoch += 1
+
+    def _iterate(self):
+        n = self.features.shape[0]
+        idx = np.arange(n)
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            rng.shuffle(idx)
+        for i in range(0, n, self._bs):
+            sel = idx[i:i + self._bs]
+            if self._drop_last and len(sel) < self._bs:
+                return
+            yield DataSet(
+                self.features[sel],
+                None if self.labels is None else self.labels[sel],
+                None if self.features_mask is None
+                else self.features_mask[sel],
+                None if self.labels_mask is None else self.labels_mask[sel])
+
+    def batch_size(self):
+        return self._bs
+
+    def num_examples(self):
+        return int(self.features.shape[0])
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (reference AsyncDataSetIterator.java:30,
+    wrapped around every fit() iterator at MultiLayerNetwork.java:1172).
+    Keeps up to ``prefetch`` batches ready so host ETL overlaps device
+    compute — the JAX analog of the reference's ETL thread + workspaces.
+    """
+
+    _END = object()
+
+    def __init__(self, base: DataSetIterator, prefetch: int = 2):
+        self.base = base
+        self.prefetch = prefetch
+
+    def reset(self):
+        self.base.reset()
+
+    def _iterate(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        exc: List[BaseException] = []
+
+        def producer():
+            try:
+                for ds in self.base._iterate():
+                    q.put(ds)
+            except BaseException as e:        # propagate to consumer
+                exc.append(e)
+            finally:
+                q.put(self._END)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._END:
+                if exc:
+                    raise exc[0]
+                return
+            yield item
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def num_examples(self):
+        return self.base.num_examples()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """(reference MultipleEpochsIterator)."""
+
+    def __init__(self, base: DataSetIterator, epochs: int):
+        self.base = base
+        self.epochs = epochs
+
+    def reset(self):
+        self.base.reset()
+
+    def _iterate(self):
+        for _ in range(self.epochs):
+            self.base.reset()
+            yield from self.base._iterate()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Caps the number of minibatches (reference
+    EarlyTerminationDataSetIterator)."""
+
+    def __init__(self, base: DataSetIterator, max_batches: int):
+        self.base = base
+        self.max_batches = max_batches
+
+    def reset(self):
+        self.base.reset()
+
+    def _iterate(self):
+        for i, ds in enumerate(self.base._iterate()):
+            if i >= self.max_batches:
+                return
+            yield ds
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random with-replacement sampling from a full DataSet (reference
+    SamplingDataSetIterator)."""
+
+    def __init__(self, data: DataSet, batch_size: int, batches_per_epoch: int,
+                 seed: int = 0):
+        self.data = data
+        self._bs = batch_size
+        self._n = batches_per_epoch
+        self._seed = seed
+        self._epoch = 0
+
+    def reset(self):
+        self._epoch += 1
+
+    def _iterate(self):
+        rng = np.random.default_rng(self._seed + self._epoch)
+        n = self.data.num_examples()
+        for _ in range(self._n):
+            sel = rng.integers(0, n, size=self._bs)
+            yield DataSet(
+                self.data.features[sel],
+                None if self.data.labels is None else self.data.labels[sel],
+                None if self.data.features_mask is None
+                else self.data.features_mask[sel],
+                None if self.data.labels_mask is None
+                else self.data.labels_mask[sel])
+
+    def batch_size(self):
+        return self._bs
+
+
+class BenchmarkDataSetIterator(DataSetIterator):
+    """Replays one cached batch N times to isolate compute from ETL
+    (reference datasets/iterator/impl/BenchmarkDataSetIterator.java)."""
+
+    def __init__(self, batch: DataSet, n_batches: int):
+        self.batch = batch
+        self.n_batches = n_batches
+
+    def reset(self):
+        pass
+
+    def _iterate(self):
+        for _ in range(self.n_batches):
+            yield self.batch
+
+    def batch_size(self):
+        return self.batch.num_examples()
+
+    def num_examples(self):
+        return self.batch.num_examples() * self.n_batches

@@ -1,0 +1,10 @@
+from deeplearning4j_tpu.evaluation.classification import (
+    Evaluation, EvaluationBinary, ConfusionMatrix,
+)
+from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+from deeplearning4j_tpu.evaluation.roc import ROC, ROCBinary, ROCMultiClass
+from deeplearning4j_tpu.evaluation.calibration import EvaluationCalibration
+
+__all__ = ["Evaluation", "EvaluationBinary", "ConfusionMatrix",
+           "RegressionEvaluation", "ROC", "ROCBinary", "ROCMultiClass",
+           "EvaluationCalibration"]

@@ -1,0 +1,57 @@
+"""EvaluationCalibration (eval/EvaluationCalibration.java): reliability
+diagram bins, residual plot and probability histograms for classifier
+calibration analysis."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["EvaluationCalibration"]
+
+
+class EvaluationCalibration:
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 50):
+        self.n_bins = reliability_bins
+        self.hist_bins = histogram_bins
+        self._bin_counts = None       # (classes, bins)
+        self._bin_pos = None
+        self._bin_prob_sum = None
+        self._prob_hist = None
+        self._label_counts = None
+
+    def eval(self, labels, predictions, mask=None):
+        l = np.asarray(labels)
+        p = np.asarray(predictions)
+        if l.ndim == 3:
+            c = l.shape[-1]
+            l = l.reshape(-1, c)
+            p = p.reshape(-1, c)
+        c = p.shape[-1]
+        if self._bin_counts is None:
+            self._bin_counts = np.zeros((c, self.n_bins), np.int64)
+            self._bin_pos = np.zeros((c, self.n_bins), np.int64)
+            self._bin_prob_sum = np.zeros((c, self.n_bins), np.float64)
+            self._prob_hist = np.zeros((c, self.hist_bins), np.int64)
+            self._label_counts = np.zeros(c, np.int64)
+        bins = np.clip((p * self.n_bins).astype(int), 0, self.n_bins - 1)
+        hbins = np.clip((p * self.hist_bins).astype(int), 0,
+                        self.hist_bins - 1)
+        for i in range(c):
+            np.add.at(self._bin_counts[i], bins[:, i], 1)
+            np.add.at(self._bin_pos[i], bins[:, i], (l[:, i] >= 0.5))
+            np.add.at(self._bin_prob_sum[i], bins[:, i], p[:, i])
+            np.add.at(self._prob_hist[i], hbins[:, i], 1)
+        self._label_counts += (l >= 0.5).sum(axis=0)
+
+    def reliability_diagram(self, cls: int):
+        """Returns (mean_predicted_prob, observed_frequency) per bin."""
+        counts = np.maximum(self._bin_counts[cls], 1)
+        mean_pred = self._bin_prob_sum[cls] / counts
+        observed = self._bin_pos[cls] / counts
+        return mean_pred, observed
+
+    def expected_calibration_error(self, cls: int) -> float:
+        counts = self._bin_counts[cls]
+        total = max(int(counts.sum()), 1)
+        mean_pred, observed = self.reliability_diagram(cls)
+        return float(np.sum(counts / total * np.abs(mean_pred - observed)))

@@ -1,0 +1,337 @@
+"""ComputationGraph: the DAG executor.
+
+TPU rewrite of nn/graph/ComputationGraph.java (3350 LoC): forward walks
+the cached topological order (reference :1187, fan-out at :817);
+training is one jitted step over the whole DAG — multi-input,
+multi-output, summed output losses (reference computeGradientAndScore
+:1295 sums output-layer scores).
+
+Params/state are dicts keyed by vertex name (the reference keeps a
+params view array per vertex; a name-keyed pytree is the JAX-native
+equivalent and checkpoint-stable).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf import updaters as updaters_mod
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.nn.conf.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.train.constraints import apply_layer_constraints
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["ComputationGraph"]
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params: Optional[Dict[str, dict]] = None
+        self.state: Optional[Dict[str, dict]] = None
+        self.opt_state = None
+        self.listeners = []
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.score_value = float("nan")
+        self._rng_key = None
+        self._optimizer = None
+        self._jit_train_step = None
+        self._jit_output = None
+        self._rnn_state: Optional[Dict[str, object]] = None
+
+    # ------------------------------------------------------------------
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        seed = self.conf.conf.seed if seed is None else seed
+        key = jax.random.PRNGKey(seed)
+        self._rng_key = jax.random.fold_in(key, 0xC6)
+        order = self.conf.topological_order()
+        params, states = {}, {}
+        keys = jax.random.split(key, max(len(order), 1))
+        for k, name in zip(keys, order):
+            obj, ins = self.conf.vertices[name]
+            if isinstance(obj, Layer):
+                it = self.conf.vertex_input_type(name)
+                p, s = obj.initialize(k, it)
+                params[name] = p
+                states[name] = s
+        self.params = params
+        self.state = states
+        self._build_optimizer()
+        return self
+
+    def _build_optimizer(self):
+        global_cfg = self.conf.conf.updater_cfg or updaters_mod.sgd()
+        overrides = {name: getattr(obj, "updater", None)
+                     for name, (obj, _) in self.conf.vertices.items()
+                     if isinstance(obj, Layer)
+                     and getattr(obj, "updater", None) is not None}
+        if overrides:
+            transforms = {"__global__": updaters_mod.to_optax(global_cfg)}
+            labels = {}
+            for name in self.params:
+                if name in overrides:
+                    transforms[name] = updaters_mod.to_optax(overrides[name])
+                    tag = name
+                else:
+                    tag = "__global__"
+                labels[name] = jax.tree_util.tree_map(lambda _: tag,
+                                                      self.params[name])
+            self._optimizer = optax.multi_transform(transforms, labels)
+        else:
+            self._optimizer = updaters_mod.to_optax(global_cfg)
+        clip = self.conf.conf.gradient_clip
+        if clip is not None:
+            pre = (optax.clip_by_global_norm(clip["v"])
+                   if clip["type"] == "norm" else optax.clip(clip["v"]))
+            self._optimizer = optax.chain(pre, self._optimizer)
+        self.opt_state = self._optimizer.init(self.params)
+        self._jit_train_step = None
+
+    # ------------------------------------------------------------------
+    def _forward(self, params, state, inputs: Sequence, *, training, rng,
+                 fmasks=None, exclude_outputs: bool = False):
+        """Topo-order interpreter (reference ComputationGraph.java
+        :793-817). Returns (activations dict, new state dict)."""
+        acts: Dict[str, jnp.ndarray] = dict(
+            zip(self.conf.network_inputs, inputs))
+        masks: Dict[str, Optional[jnp.ndarray]] = {}
+        if fmasks is not None:
+            masks.update(zip(self.conf.network_inputs, fmasks))
+        new_state = {}
+        for vidx, name in enumerate(self.conf.topological_order()):
+            obj, ins = self.conf.vertices[name]
+            xs = [acts[i] for i in ins]
+            in_mask = next((masks.get(i) for i in ins
+                            if masks.get(i) is not None), None)
+            if isinstance(obj, Layer):
+                if exclude_outputs and name in self.conf.network_outputs \
+                        and obj.has_loss():
+                    # leave the loss layer's input available instead
+                    acts[name] = xs[0]
+                    new_state[name] = state[name]
+                    masks[name] = in_mask
+                    continue
+                # stable per-vertex rng: topo index, NOT hash(name)
+                # (python hash is per-process randomized)
+                lrng = (jax.random.fold_in(rng, vidx)
+                        if rng is not None else None)
+                y, s = obj.apply(params[name], state[name], xs[0],
+                                 training=training, rng=lrng, mask=in_mask)
+                new_state[name] = s
+                acts[name] = y
+            else:
+                acts[name] = obj.apply(xs, mask=in_mask)
+            masks[name] = in_mask
+        return acts, new_state
+
+    def _loss(self, params, state, batch, rng, *, training=True):
+        inputs, labels, fmasks, lmasks = batch
+        acts, new_state = self._forward(params, state, inputs,
+                                        training=training, rng=rng,
+                                        fmasks=fmasks, exclude_outputs=True)
+        total = jnp.zeros(())
+        topo = self.conf.topological_order()
+        for i, out_name in enumerate(self.conf.network_outputs):
+            obj, ins = self.conf.vertices[out_name]
+            if isinstance(obj, Layer) and obj.has_loss():
+                lrng = (jax.random.fold_in(rng, 1000 + topo.index(out_name))
+                        if rng is not None else None)
+                lmask = lmasks[i] if lmasks is not None else None
+                total = total + obj.loss_from_input(
+                    params[out_name], acts[out_name], labels[i],
+                    training=training, rng=lrng, mask=lmask)
+            else:
+                raise ValueError(f"Output vertex '{out_name}' has no loss")
+        for name, (obj, _) in self.conf.vertices.items():
+            if isinstance(obj, Layer):
+                total = total + obj.regularization_loss(params[name])
+        return total, new_state
+
+    def _make_train_step(self):
+        optimizer = self._optimizer
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step(params, state, opt_state, batch, base_rng, step):
+            rng = jax.random.fold_in(base_rng, step)
+
+            def loss_fn(p):
+                return self._loss(p, state, batch, rng, training=True)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            from deeplearning4j_tpu.train.gradnorm import (
+                apply_gradient_normalization)
+            layer_cfgs = {n: v[0] for n, v in self.conf.vertices.items()
+                          if n in params}
+            grads = apply_gradient_normalization(layer_cfgs, grads)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            constrained = {}
+            for name, p in new_params.items():
+                obj, _ = self.conf.vertices[name]
+                constrained[name] = apply_layer_constraints(obj, p)
+            return constrained, new_state, new_opt, loss
+
+        return train_step
+
+    # ------------------------------------------------------------------
+    def _as_multi(self, ds) -> MultiDataSet:
+        if isinstance(ds, MultiDataSet):
+            return ds
+        if isinstance(ds, DataSet):
+            return MultiDataSet(
+                [ds.features], [ds.labels],
+                [ds.features_mask] if ds.features_mask is not None else None,
+                [ds.labels_mask] if ds.labels_mask is not None else None)
+        raise TypeError(type(ds))
+
+    def _batch_tuple(self, mds: MultiDataSet):
+        inputs = tuple(jnp.asarray(f) for f in mds.features)
+        labels = tuple(jnp.asarray(l) for l in mds.labels)
+        fm = (tuple(None if m is None else jnp.asarray(m)
+                    for m in mds.features_masks)
+              if mds.features_masks is not None else None)
+        lm = (tuple(None if m is None else jnp.asarray(m)
+                    for m in mds.labels_masks)
+              if mds.labels_masks is not None else None)
+        return (inputs, labels, fm, lm)
+
+    def fit(self, data, *, epochs: int = 1):
+        """data: iterable of DataSet/MultiDataSet, or a single one."""
+        if self.params is None:
+            self.init()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = [data]
+        elif not isinstance(data, (list, tuple)) and \
+                not hasattr(data, "reset"):
+            # one-shot generators would be exhausted after epoch 1;
+            # materialize so every epoch actually trains
+            data = list(data)
+        if self._jit_train_step is None:
+            self._jit_train_step = self._make_train_step()
+        step_fn = self._jit_train_step
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self)
+            for ds in data:
+                mds = self._as_multi(ds)
+                batch = self._batch_tuple(mds)
+                self.params, self.state, self.opt_state, loss = step_fn(
+                    self.params, self.state, self.opt_state, batch,
+                    self._rng_key, np.int32(self.iteration_count))
+                self.score_value = loss
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count, loss,
+                                       mds.num_examples())
+                self.iteration_count += 1
+            for lst in self.listeners:
+                lst.on_epoch_end(self)
+            self.epoch_count += 1
+        return self
+
+    # ------------------------------------------------------------------
+    def output(self, *inputs, training: bool = False):
+        if self.params is None:
+            self.init()
+        xs = tuple(jnp.asarray(x) for x in inputs)
+        if self._jit_output is None:
+            @jax.jit
+            def fwd(params, state, xs):
+                acts, _ = self._forward(params, state, xs, training=False,
+                                        rng=None)
+                return tuple(acts[o] for o in self.conf.network_outputs)
+            self._jit_output = fwd
+        outs = self._jit_output(self.params, self.state, xs)
+        return outs if len(outs) > 1 else outs[0]
+
+    def feed_forward(self, *inputs, training: bool = False):
+        xs = tuple(jnp.asarray(x) for x in inputs)
+        acts, _ = self._forward(self.params, self.state, xs,
+                                training=training,
+                                rng=self._rng_key if training else None)
+        return acts
+
+    def score(self, ds) -> float:
+        mds = self._as_multi(ds)
+        loss, _ = self._loss(self.params, self.state,
+                             self._batch_tuple(mds), None, training=False)
+        return float(loss)
+
+    def evaluate(self, data):
+        from deeplearning4j_tpu.evaluation.classification import Evaluation
+        ev = Evaluation()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = [data]
+        for ds in data:
+            mds = self._as_multi(ds)
+            preds = self.output(*mds.features)
+            if isinstance(preds, tuple):
+                preds = preds[0]
+            ev.eval(mds.labels[0], np.asarray(preds))
+        return ev
+
+    # ------------------------------------------------------------------
+    def rnn_time_step(self, *inputs):
+        """Stateful streaming inference (reference rnnTimeStep :2358)."""
+        xs = [jnp.asarray(x) for x in inputs]
+        squeeze = xs[0].ndim == 2
+        if squeeze:
+            xs = [x[:, None, :] for x in xs]
+        if self._rnn_state is None:
+            self._rnn_state = {}
+        acts = dict(zip(self.conf.network_inputs, xs))
+        for name in self.conf.topological_order():
+            obj, ins = self.conf.vertices[name]
+            xin = [acts[i] for i in ins]
+            if isinstance(obj, BaseRecurrentLayer):
+                carry = self._rnn_state.get(name)
+                if carry is None:
+                    carry = obj.zero_state(xin[0].shape[0])
+                y, carry = obj.apply_rnn(self.params[name], xin[0], carry,
+                                         training=False)
+                self._rnn_state[name] = carry
+                acts[name] = y
+            elif isinstance(obj, Layer):
+                acts[name], _ = obj.apply(self.params[name],
+                                          self.state[name], xin[0],
+                                          training=False)
+            else:
+                acts[name] = obj.apply(xin)
+        outs = tuple(acts[o] for o in self.conf.network_outputs)
+        if squeeze:
+            outs = tuple(o[:, -1, :] if o.ndim == 3 else o for o in outs)
+        return outs if len(outs) > 1 else outs[0]
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
+
+    # ------------------------------------------------------------------
+    def num_params(self) -> int:
+        return sum(int(p.size)
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def summary(self) -> str:
+        lines = ["name                 type                      inputs"]
+        for name in self.conf.topological_order():
+            obj, ins = self.conf.vertices[name]
+            lines.append(f"{name:<20} {type(obj).__name__:<25} {ins}")
+        if self.params:
+            lines.append(f"total params: {self.num_params()}")
+        return "\n".join(lines)

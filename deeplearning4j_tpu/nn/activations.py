@@ -1,0 +1,73 @@
+"""Activation function registry.
+
+Mirrors the reference's activation vocabulary (ND4J ``Activation`` enum,
+referenced from nn/conf/layers/*.java builder ``activation(...)``), as a
+name → pure-jax function table. All functions are elementwise (softmax
+excepted) and jit/grad-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get", "register", "ACTIVATIONS", "softmax"]
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def _rational_tanh(x):
+    # Rational tanh approximation (ND4J RationalTanh):
+    # f(x) = 1.7159 * tanh_approx(2x/3), tanh_approx via a Padé-like form.
+    a = 2.0 * x / 3.0
+    aa = jnp.abs(a)
+    approx = jnp.sign(a) * (1.0 - 1.0 / (1.0 + aa + a * a + 1.41645 * a ** 4))
+    return 1.7159 * approx
+
+
+def _rectified_tanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+def _hard_sigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+ACTIVATIONS = {
+    "identity": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.silu,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": _hard_sigmoid,
+    "tanh": jnp.tanh,
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "rationaltanh": _rational_tanh,
+    "rectifiedtanh": _rectified_tanh,
+    "softmax": softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "cube": lambda x: x ** 3,
+    "threshold": lambda x: (x > 0).astype(x.dtype),
+}
+
+
+def register(name: str, fn) -> None:
+    ACTIVATIONS[name.lower()] = fn
+
+
+def get(name):
+    """Resolve an activation by name (or pass through a callable)."""
+    if callable(name):
+        return name
+    key = str(name).lower().replace("_", "")
+    if key not in ACTIVATIONS:
+        raise ValueError(
+            f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}"
+        )
+    return ACTIVATIONS[key]

@@ -1,0 +1,11 @@
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+
+__all__ = [
+    "InputType",
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+    "ComputationGraphConfiguration",
+]

@@ -1,0 +1,304 @@
+"""Graph vertex configs for ComputationGraph DAGs.
+
+Mirrors nn/conf/graph/*.java (ElementWiseVertex, MergeVertex,
+SubsetVertex, StackVertex, UnstackVertex, ScaleVertex, ShiftVertex,
+L2NormalizeVertex, L2Vertex, PreprocessorVertex, ReshapeVertex,
+PoolHelperVertex, rnn/LastTimeStepVertex, rnn/DuplicateToTimeSeriesVertex)
+and their impls under nn/graph/vertex/impl/ (14 classes).
+
+A vertex is a (possibly multi-input) pure function without trainable
+params; layers are wrapped in :class:`LayerVertex` by the graph builder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+__all__ = ["GraphVertex", "vertex_from_dict", "ElementWiseVertex",
+           "MergeVertex", "SubsetVertex", "StackVertex", "UnstackVertex",
+           "ScaleVertex", "ShiftVertex", "L2NormalizeVertex", "L2Vertex",
+           "PreprocessorVertex", "ReshapeVertex", "PoolHelperVertex",
+           "LastTimeStepVertex", "DuplicateToTimeSeriesVertex"]
+
+_VERTEX_REGISTRY: Dict[str, type] = {}
+
+
+def register_vertex(cls):
+    _VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def vertex_from_dict(d: dict):
+    d = dict(d)
+    t = d.pop("@type")
+    cls = _VERTEX_REGISTRY[t]
+    return cls.from_dict(d)
+
+
+@dataclasses.dataclass
+class GraphVertex:
+    def apply(self, inputs, *, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        return input_types[0]
+
+    def to_dict(self) -> dict:
+        d = {"@type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = list(v) if isinstance(v, tuple) else v
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict):
+        kw = {}
+        for f in dataclasses.fields(cls):
+            if f.name in d:
+                v = d[f.name]
+                kw[f.name] = tuple(v) if isinstance(v, list) else v
+        return cls(**kw)
+
+
+@register_vertex
+@dataclasses.dataclass
+class ElementWiseVertex(GraphVertex):
+    """(nn/conf/graph/ElementWiseVertex.java:42-43). op ∈ {add,
+    subtract, product, average, max}."""
+
+    op: str = "add"
+
+    def apply(self, inputs, *, mask=None):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("subtract requires exactly 2 inputs")
+            return inputs[0] - inputs[1]
+        if op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op == "average":
+            return sum(inputs) / len(inputs)
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown ElementWise op '{self.op}'")
+
+
+@register_vertex
+@dataclasses.dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature (last) axis
+    (nn/conf/graph/MergeVertex.java — reference concatenates on dim 1 =
+    channels under NCHW; channel-last here)."""
+
+    def apply(self, inputs, *, mask=None):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def output_type(self, *ts: InputType) -> InputType:
+        t0 = ts[0]
+        if t0.kind == "cnn":
+            return InputType.convolutional(t0.height, t0.width,
+                                           sum(t.channels for t in ts))
+        if t0.kind == "rnn":
+            return InputType.recurrent(sum(t.size for t in ts), t0.timesteps)
+        return InputType.feed_forward(sum(t.flat_size() for t in ts))
+
+
+@register_vertex
+@dataclasses.dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-range slice [from_, to_] inclusive
+    (nn/conf/graph/SubsetVertex.java)."""
+
+    from_: int = 0
+    to_: int = 0
+
+    def apply(self, inputs, *, mask=None):
+        return inputs[0][..., self.from_:self.to_ + 1]
+
+    def output_type(self, *ts: InputType) -> InputType:
+        n = self.to_ - self.from_ + 1
+        t = ts[0]
+        if t.kind == "rnn":
+            return InputType.recurrent(n, t.timesteps)
+        if t.kind == "cnn":
+            return InputType.convolutional(t.height, t.width, n)
+        return InputType.feed_forward(n)
+
+
+@register_vertex
+@dataclasses.dataclass
+class StackVertex(GraphVertex):
+    """Stack along batch axis (nn/conf/graph/StackVertex.java)."""
+
+    def apply(self, inputs, *, mask=None):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register_vertex
+@dataclasses.dataclass
+class UnstackVertex(GraphVertex):
+    """Take slice ``from_`` of ``stack_size`` along batch
+    (nn/conf/graph/UnstackVertex.java)."""
+
+    from_: int = 0
+    stack_size: int = 1
+
+    def apply(self, inputs, *, mask=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_ * step:(self.from_ + 1) * step]
+
+
+@register_vertex
+@dataclasses.dataclass
+class ScaleVertex(GraphVertex):
+    """(nn/conf/graph/ScaleVertex.java)."""
+
+    scale: float = 1.0
+
+    def apply(self, inputs, *, mask=None):
+        return inputs[0] * self.scale
+
+
+@register_vertex
+@dataclasses.dataclass
+class ShiftVertex(GraphVertex):
+    """(nn/conf/graph/ShiftVertex.java)."""
+
+    shift: float = 0.0
+
+    def apply(self, inputs, *, mask=None):
+        return inputs[0] + self.shift
+
+
+@register_vertex
+@dataclasses.dataclass
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 over feature axes (nn/conf/graph/L2NormalizeVertex.java)."""
+
+    eps: float = 1e-8
+
+    def apply(self, inputs, *, mask=None):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True))
+        return x / (n + self.eps)
+
+
+@register_vertex
+@dataclasses.dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs
+    (nn/conf/graph/L2Vertex.java) → (B,1)."""
+
+    eps: float = 1e-8
+
+    def apply(self, inputs, *, mask=None):
+        a, b = inputs
+        axes = tuple(range(1, a.ndim))
+        return jnp.sqrt(jnp.sum((a - b) ** 2, axis=axes, keepdims=False)
+                        + self.eps)[:, None]
+
+    def output_type(self, *ts: InputType) -> InputType:
+        return InputType.feed_forward(1)
+
+
+@register_vertex
+@dataclasses.dataclass
+class PreprocessorVertex(GraphVertex):
+    """Wraps an InputPreProcessor (nn/conf/graph/PreprocessorVertex.java)."""
+
+    preprocessor: Optional[dict] = None
+
+    def _pp(self):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            preprocessor_from_dict)
+        return preprocessor_from_dict(self.preprocessor)
+
+    def apply(self, inputs, *, mask=None):
+        return self._pp()(inputs[0])
+
+    def output_type(self, *ts: InputType) -> InputType:
+        return self._pp().output_type(ts[0])
+
+
+@register_vertex
+@dataclasses.dataclass
+class ReshapeVertex(GraphVertex):
+    """(nn/conf/graph/ReshapeVertex.java). Shape excludes batch dim."""
+
+    shape: Tuple[int, ...] = ()
+
+    def apply(self, inputs, *, mask=None):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+
+@register_vertex
+@dataclasses.dataclass
+class PoolHelperVertex(GraphVertex):
+    """Strips the first row/col of a CNN activation — GoogLeNet
+    compatibility shim (nn/conf/graph/PoolHelperVertex.java)."""
+
+    def apply(self, inputs, *, mask=None):
+        return inputs[0][:, 1:, 1:, :]
+
+    def output_type(self, *ts: InputType) -> InputType:
+        t = ts[0]
+        return InputType.convolutional(t.height - 1, t.width - 1, t.channels)
+
+
+@register_vertex
+@dataclasses.dataclass
+class LastTimeStepVertex(GraphVertex):
+    """Last unmasked timestep of a (B,T,C) input
+    (nn/conf/graph/rnn/LastTimeStepVertex.java). ``mask_input`` names
+    the graph input whose mask applies."""
+
+    mask_input: Optional[str] = None
+
+    def apply(self, inputs, *, mask=None):
+        x = inputs[0]
+        if mask is None:
+            return x[:, -1, :]
+        lengths = jnp.sum(mask, axis=1).astype(jnp.int32)
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+
+    def output_type(self, *ts: InputType) -> InputType:
+        return InputType.feed_forward(ts[0].size)
+
+
+@register_vertex
+@dataclasses.dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """Broadcast a (B,C) vector across T timesteps of a reference input
+    (nn/conf/graph/rnn/DuplicateToTimeSeriesVertex.java). The second
+    input supplies T."""
+
+    ts_input: Optional[str] = None
+
+    def apply(self, inputs, *, mask=None):
+        x, ref = inputs[0], inputs[1]
+        return jnp.broadcast_to(x[:, None, :],
+                                (x.shape[0], ref.shape[1], x.shape[1]))
+
+    def output_type(self, *ts: InputType) -> InputType:
+        return InputType.recurrent(ts[0].flat_size(),
+                                   ts[1].timesteps if len(ts) > 1 else None)

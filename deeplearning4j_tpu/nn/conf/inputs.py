@@ -1,0 +1,106 @@
+"""InputType: symbolic activation shapes for config-time inference.
+
+Mirrors the reference's ``nn/conf/inputs/InputType.java`` +
+``InputTypeUtil.java``: each layer config maps an input type to an
+output type, letting the network builder infer nIn/nOut, validate
+shapes, and auto-insert preprocessors between layer families
+(CNN⇄FF⇄RNN) the way ``MultiLayerConfiguration.Builder`` does.
+
+Unlike the reference (NCHW, channels-first, after DL4J's CNN format),
+convolutional activations are **NHWC** — the TPU-native layout that XLA
+tiles best. ``CNNFlat`` mirrors ``InputType.convolutionalFlat`` for
+flattened image rows (e.g. MNIST 784).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+__all__ = ["InputType"]
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    kind: str                       # 'ff' | 'rnn' | 'cnn' | 'cnnflat' | 'cnn3d'
+    size: Optional[int] = None      # ff/rnn feature size
+    timesteps: Optional[int] = None          # rnn sequence length (may be None)
+    height: Optional[int] = None
+    width: Optional[int] = None
+    channels: Optional[int] = None
+    depth: Optional[int] = None     # cnn3d
+
+    # ---- constructors (match InputType.feedForward/recurrent/... names) ----
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType("ff", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType("rnn", size=int(size),
+                         timesteps=None if timesteps is None else int(timesteps))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnn", height=int(height), width=int(width),
+                         channels=int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnnflat", height=int(height), width=int(width),
+                         channels=int(channels))
+
+    @staticmethod
+    def convolutional3d(depth: int, height: int, width: int,
+                        channels: int) -> "InputType":
+        return InputType("cnn3d", depth=int(depth), height=int(height),
+                         width=int(width), channels=int(channels))
+
+    # ---- geometry ----
+    def flat_size(self) -> int:
+        if self.kind == "ff" or self.kind == "rnn":
+            return self.size
+        if self.kind in ("cnn", "cnnflat"):
+            return self.height * self.width * self.channels
+        if self.kind == "cnn3d":
+            return self.depth * self.height * self.width * self.channels
+        raise ValueError(self.kind)
+
+    def array_shape(self, batch: int = -1) -> Tuple[int, ...]:
+        """Concrete array shape (batch leading; NHWC for conv; NTC for rnn)."""
+        if self.kind == "ff":
+            return (batch, self.size)
+        if self.kind == "rnn":
+            return (batch, self.timesteps or -1, self.size)
+        if self.kind == "cnn":
+            return (batch, self.height, self.width, self.channels)
+        if self.kind == "cnnflat":
+            return (batch, self.height * self.width * self.channels)
+        if self.kind == "cnn3d":
+            return (batch, self.depth, self.height, self.width, self.channels)
+        raise ValueError(self.kind)
+
+    # ---- serde ----
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind}
+        for f in ("size", "timesteps", "height", "width", "channels", "depth"):
+            v = getattr(self, f)
+            if v is not None:
+                d[f] = v
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputType":
+        return InputType(**d)
+
+    def __repr__(self):
+        if self.kind == "ff":
+            return f"InputType.ff({self.size})"
+        if self.kind == "rnn":
+            return f"InputType.rnn({self.size}, t={self.timesteps})"
+        if self.kind == "cnn":
+            return f"InputType.cnn({self.height}x{self.width}x{self.channels})"
+        if self.kind == "cnnflat":
+            return (f"InputType.cnnflat({self.height}x{self.width}"
+                    f"x{self.channels})")
+        return f"InputType({self.to_dict()})"

@@ -1,0 +1,180 @@
+"""Base layer config classes + serde registry.
+
+Mirrors nn/conf/layers/Layer.java / BaseLayer.java /
+FeedForwardLayer.java: common hyperparameters (activation, weight init,
+regularization, dropout, updater override, constraints) live on the
+base class; subclasses add geometry. JSON round-trip uses a
+``@register_layer`` type registry, the analog of Jackson's
+``@JsonSubTypes`` on the reference's Layer class hierarchy.
+
+Functional protocol (replaces nn/api/Layer.activate/backpropGradient):
+
+- ``output_type(input_type)``: config-time shape inference
+  (reference: Layer.getOutputType, InputTypeUtil)
+- ``initialize(key, input_type)``: returns ``(params, state)`` — both
+  dicts of arrays; ``params`` is trained, ``state`` carries
+  non-trained buffers (e.g. batchnorm running stats)
+- ``apply(params, state, x, *, training, rng, mask)``: pure forward,
+  returns ``(out, new_state)``. jit/vmap/grad-safe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.weights import init_weight
+
+__all__ = ["Layer", "BaseLayer", "FeedForwardLayer", "register_layer",
+           "layer_from_dict", "LAYER_REGISTRY"]
+
+LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    """Class decorator: register for JSON round-trip by type name."""
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_from_dict(d: dict) -> "Layer":
+    d = dict(d)
+    tname = d.pop("@type")
+    if tname not in LAYER_REGISTRY:
+        raise ValueError(f"Unknown layer type '{tname}' "
+                         f"(known: {sorted(LAYER_REGISTRY)})")
+    return LAYER_REGISTRY[tname].from_dict(d)
+
+
+@dataclasses.dataclass
+class Layer:
+    """Root of the layer-config hierarchy (nn/conf/layers/Layer.java)."""
+
+    name: Optional[str] = None
+    # Probability of DROPPING an input activation (inverted-dropout scaling).
+    # NOTE: the reference's dropOut(x) is the probability of *retaining*
+    # (nn/conf/layers/Layer.java dropOut javadoc); Keras import converts.
+    dropout: float = 0.0
+    constraints: Tuple[dict, ...] = ()
+
+    # ---- shape inference ----
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type: InputType) -> None:
+        """Infer nIn-style geometry from the incoming type (override)."""
+
+    # ---- params ----
+    def initialize(self, key, input_type: InputType):
+        return {}, {}
+
+    def num_params(self, input_type: InputType) -> int:
+        params, _ = self.initialize(jax.random.PRNGKey(0), input_type)
+        return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
+
+    # ---- forward ----
+    def apply(self, params, state, x, *, training: bool = False, rng=None,
+              mask=None):
+        raise NotImplementedError
+
+    def has_loss(self) -> bool:
+        return False
+
+    def regularization_loss(self, params) -> jnp.ndarray:
+        return jnp.zeros(())
+
+    # ---- dropout on input (DL4J applies a layer's dropout to its input,
+    #      BaseLayer.preOutputWithPreNorm -> Dropout.applyDropout) ----
+    def apply_input_dropout(self, x, *, training, rng):
+        if not training or self.dropout <= 0.0 or rng is None:
+            return x
+        keep = 1.0 - self.dropout
+        m = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(m, x / keep, 0.0)
+
+    # ---- serde ----
+    def to_dict(self) -> dict:
+        d = {"@type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, tuple):
+                v = list(v)
+            d[f.name] = v
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Layer":
+        kw = {}
+        for f in dataclasses.fields(cls):
+            if f.name in d:
+                v = d[f.name]
+                if isinstance(v, list):
+                    v = tuple(tuple(e) if isinstance(e, list) else e for e in v)
+                kw[f.name] = v
+        return cls(**kw)
+
+
+@dataclasses.dataclass
+class BaseLayer(Layer):
+    """Layers with weights (nn/conf/layers/BaseLayer.java): activation,
+    weight init, L1/L2, per-layer updater overrides."""
+
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    weight_distribution: Optional[dict] = None
+    bias_init: float = 0.0
+    l1: float = 0.0
+    l2: float = 0.0
+    l1_bias: float = 0.0
+    l2_bias: float = 0.0
+    updater: Optional[dict] = None        # per-layer optimizer override
+    bias_updater: Optional[dict] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+
+    def activation_fn(self):
+        return activations.get(self.activation)
+
+    def _sample_w(self, key, shape, fan_in, fan_out):
+        return init_weight(key, shape, self.weight_init, fan_in, fan_out,
+                           distribution=self.weight_distribution,
+                           dtype=dtypes.policy().param_dtype)
+
+    def regularization_loss(self, params) -> jnp.ndarray:
+        reg = jnp.zeros(())
+        for k, p in params.items():
+            is_bias = k == "b"
+            l1 = self.l1_bias if is_bias else self.l1
+            l2 = self.l2_bias if is_bias else self.l2
+            if l1:
+                reg = reg + l1 * jnp.sum(jnp.abs(p))
+            if l2:
+                # DL4J convention: 0.5 * l2 * ||w||^2
+                reg = reg + 0.5 * l2 * jnp.sum(p * p)
+        return reg
+
+
+@dataclasses.dataclass
+class FeedForwardLayer(BaseLayer):
+    """Adds nIn/nOut geometry (nn/conf/layers/FeedForwardLayer.java)."""
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    has_bias: bool = True
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if self.n_out is None:
+            raise ValueError(f"{type(self).__name__} requires n_out")
+        if input_type.kind == "rnn":
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)

@@ -1,0 +1,422 @@
+"""Convolutional layers — NHWC, lowered to XLA conv_general_dilated.
+
+Replaces the reference's im2col-GEMM path
+(nn/layers/convolution/ConvolutionLayer.java:52) AND its cuDNN helper
+(deeplearning4j-cuda CudnnConvolutionHelper.java:54): on TPU, XLA tiles
+``lax.conv_general_dilated`` directly onto the MXU, so there is no
+helper SPI — the compiler *is* the helper. Kernel layout is HWIO.
+
+Padding modes mirror ConvolutionMode (nn/conf/ConvolutionMode.java):
+'truncate' (valid-with-explicit-pad, DL4J default), 'same'.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    BaseLayer, Layer, register_layer,
+)
+
+__all__ = ["ConvolutionLayer", "Convolution1DLayer", "Deconvolution2DLayer",
+           "SeparableConvolution2DLayer", "DepthwiseConvolution2DLayer",
+           "ZeroPaddingLayer", "ZeroPadding1DLayer", "UpsamplingLayer",
+           "CroppingLayer", "SpaceToDepthLayer", "SpaceToBatchLayer"]
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _out_dim(size, k, s, p, mode, dilation=1):
+    keff = k + (k - 1) * (dilation - 1)
+    if mode == "same":
+        return -(-size // s)
+    return (size + 2 * p - keff) // s + 1
+
+
+def _conv_padding(mode, pad, kernel, dilation=(1, 1)):
+    if mode == "same":
+        return "SAME"
+    return [(p, p) for p in pad]
+
+
+@register_layer
+@dataclasses.dataclass
+class ConvolutionLayer(BaseLayer):
+    """2-d convolution (nn/conf/layers/ConvolutionLayer.java)."""
+
+    n_in: Optional[int] = None        # channels in (inferred)
+    n_out: Optional[int] = None       # filters
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+    activation: str = "identity"
+
+    def __post_init__(self):
+        self.kernel = _pair(self.kernel)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.dilation = _pair(self.dilation)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.channels
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind not in ("cnn", "cnnflat"):
+            raise ValueError(f"ConvolutionLayer needs CNN input, got "
+                             f"{input_type}")
+        h = _out_dim(input_type.height, self.kernel[0], self.stride[0],
+                     self.padding[0], self.convolution_mode, self.dilation[0])
+        w = _out_dim(input_type.width, self.kernel[1], self.stride[1],
+                     self.padding[1], self.convolution_mode, self.dilation[1])
+        return InputType.convolutional(h, w, self.n_out)
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        kh, kw = self.kernel
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        p = {"W": self._sample_w(key, (kh, kw, self.n_in, self.n_out),
+                                 fan_in, fan_out)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init,
+                              dtypes.policy().param_dtype)
+        return p, {}
+
+    def _conv(self, x, w):
+        pol = dtypes.policy()
+        return lax.conv_general_dilated(
+            pol.cast_to_compute(x), pol.cast_to_compute(w),
+            window_strides=self.stride,
+            padding=_conv_padding(self.convolution_mode, self.padding,
+                                  self.kernel, self.dilation),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=pol.output_dtype,
+        )
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        y = self._conv(x, params["W"])
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """1-d convolution over sequences (nn/conf/layers/Convolution1DLayer
+    .java). Input (B,T,C) treated as width-1 2-d conv on time axis."""
+
+    kernel: Tuple[int, int] = (3, 1)
+
+    def __post_init__(self):
+        k = self.kernel[0] if isinstance(self.kernel, (tuple, list)) \
+            else self.kernel
+        s = self.stride[0] if isinstance(self.stride, (tuple, list)) \
+            else self.stride
+        p = self.padding[0] if isinstance(self.padding, (tuple, list)) \
+            else self.padding
+        d = self.dilation[0] if isinstance(self.dilation, (tuple, list)) \
+            else self.dilation
+        self.kernel = (int(k), 1)
+        self.stride = (int(s), 1)
+        self.padding = (int(p), 0)
+        self.dilation = (int(d), 1)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        if t is not None:
+            t = _out_dim(t, self.kernel[0], self.stride[0], self.padding[0],
+                         self.convolution_mode, self.dilation[0])
+        return InputType.recurrent(self.n_out, t)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        y = self._conv(x[:, :, None, :], params["W"])[:, :, 0, :]
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Deconvolution2DLayer(ConvolutionLayer):
+    """Transposed convolution (capability parity with later-DL4J
+    Deconvolution2D; Keras Conv2DTranspose import target)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        def _od(size, k, s, p):
+            if self.convolution_mode == "same":
+                return size * s
+            return s * (size - 1) + k - 2 * p
+        h = _od(input_type.height, self.kernel[0], self.stride[0],
+                self.padding[0])
+        w = _od(input_type.width, self.kernel[1], self.stride[1],
+                self.padding[1])
+        return InputType.convolutional(h, w, self.n_out)
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        kh, kw = self.kernel
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        p = {"W": self._sample_w(key, (kh, kw, self.n_out, self.n_in),
+                                 fan_in, fan_out)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init,
+                              dtypes.policy().param_dtype)
+        return p, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        pad = ("SAME" if self.convolution_mode == "same"
+               else [(p, p) for p in self.padding])
+        y = lax.conv_transpose(
+            x, params["W"], strides=self.stride, padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            transpose_kernel=True)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class DepthwiseConvolution2DLayer(ConvolutionLayer):
+    """Depthwise conv (Keras DepthwiseConv2D target)."""
+
+    depth_multiplier: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        base = super().output_type(input_type)
+        return InputType.convolutional(base.height, base.width,
+                                       self.n_in * self.depth_multiplier)
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        self.n_out = self.n_in * self.depth_multiplier
+        kh, kw = self.kernel
+        p = {"W": self._sample_w(key, (kh, kw, 1, self.n_out),
+                                 kh * kw, kh * kw * self.depth_multiplier)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init,
+                              dtypes.policy().param_dtype)
+        return p, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride,
+            padding=_conv_padding(self.convolution_mode, self.padding,
+                                  self.kernel),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SeparableConvolution2DLayer(ConvolutionLayer):
+    """Depthwise-separable conv (reference SeparableConvolution2D /
+    Keras SeparableConv2D): depthwise then 1x1 pointwise."""
+
+    depth_multiplier: int = 1
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        kd, kp = jax.random.split(key)
+        kh, kw = self.kernel
+        mult = self.depth_multiplier
+        p = {
+            "dW": self._sample_w(kd, (kh, kw, 1, self.n_in * mult),
+                                 kh * kw, kh * kw * mult),
+            "pW": self._sample_w(kp, (1, 1, self.n_in * mult, self.n_out),
+                                 self.n_in * mult, self.n_out),
+        }
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init,
+                              dtypes.policy().param_dtype)
+        return p, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        y = lax.conv_general_dilated(
+            x, params["dW"], window_strides=self.stride,
+            padding=_conv_padding(self.convolution_mode, self.padding,
+                                  self.kernel),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in)
+        y = lax.conv_general_dilated(
+            y, params["pW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    """(nn/conf/layers/ZeroPaddingLayer.java). pad = ((top,bottom),
+    (left,right)) or a single int."""
+
+    pad: Tuple[Tuple[int, int], Tuple[int, int]] = ((0, 0), (0, 0))
+
+    def __post_init__(self):
+        p = self.pad
+        if isinstance(p, int):
+            self.pad = ((p, p), (p, p))
+        elif len(p) == 2 and all(isinstance(e, int) for e in p):
+            self.pad = ((p[0], p[0]), (p[1], p[1]))
+        else:
+            self.pad = tuple(tuple(int(x) for x in e) for e in p)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        (t, b), (l, r) = self.pad
+        return InputType.convolutional(input_type.height + t + b,
+                                       input_type.width + l + r,
+                                       input_type.channels)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        (t, b), (l, r) = self.pad
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register_layer
+@dataclasses.dataclass
+class ZeroPadding1DLayer(Layer):
+    """(nn/conf/layers/ZeroPadding1DLayer.java)."""
+
+    pad: Tuple[int, int] = (0, 0)
+
+    def __post_init__(self):
+        if isinstance(self.pad, int):
+            self.pad = (self.pad, self.pad)
+        else:
+            self.pad = tuple(int(x) for x in self.pad)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        return InputType.recurrent(
+            input_type.size,
+            None if t is None else t + self.pad[0] + self.pad[1])
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        return jnp.pad(x, ((0, 0), self.pad, (0, 0))), state
+
+
+@register_layer
+@dataclasses.dataclass
+class UpsamplingLayer(Layer):
+    """Nearest-neighbor 2-d upsampling (reference Upsampling2D)."""
+
+    size: Tuple[int, int] = (2, 2)
+
+    def __post_init__(self):
+        self.size = _pair(self.size)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(input_type.height * self.size[0],
+                                       input_type.width * self.size[1],
+                                       input_type.channels)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        y = jnp.repeat(jnp.repeat(x, self.size[0], axis=1),
+                       self.size[1], axis=2)
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass
+class CroppingLayer(Layer):
+    """2-d cropping (reference Cropping2D)."""
+
+    crop: Tuple[Tuple[int, int], Tuple[int, int]] = ((0, 0), (0, 0))
+
+    def __post_init__(self):
+        c = self.crop
+        if isinstance(c, int):
+            self.crop = ((c, c), (c, c))
+        elif len(c) == 2 and all(isinstance(e, int) for e in c):
+            self.crop = ((c[0], c[0]), (c[1], c[1]))
+        else:
+            self.crop = tuple(tuple(int(x) for x in e) for e in c)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        (t, b), (l, r) = self.crop
+        return InputType.convolutional(input_type.height - t - b,
+                                       input_type.width - l - r,
+                                       input_type.channels)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        (t, b), (l, r) = self.crop
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b or None, l:w - r or None, :], state
+
+
+@register_layer
+@dataclasses.dataclass
+class SpaceToDepthLayer(Layer):
+    """(reference SpaceToDepthLayer; used by YOLO9000-style nets)."""
+
+    block_size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        b = self.block_size
+        return InputType.convolutional(input_type.height // b,
+                                       input_type.width // b,
+                                       input_type.channels * b * b)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        n, h, w, c = x.shape
+        b = self.block_size
+        y = x.reshape(n, h // b, b, w // b, b, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b,
+                                                  b * b * c)
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass
+class SpaceToBatchLayer(Layer):
+    """(reference SpaceToBatchLayer)."""
+
+    block_size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        b = self.block_size
+        return InputType.convolutional(input_type.height // b,
+                                       input_type.width // b,
+                                       input_type.channels)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        n, h, w, c = x.shape
+        b = self.block_size
+        y = x.reshape(n, h // b, b, w // b, b, c)
+        y = y.transpose(2, 4, 0, 1, 3, 5).reshape(n * b * b, h // b,
+                                                  w // b, c)
+        return y, state

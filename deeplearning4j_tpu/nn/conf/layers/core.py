@@ -1,0 +1,174 @@
+"""Core feed-forward layers: Dense, Activation, Dropout, Embedding,
+AutoEncoder.
+
+Reference: nn/conf/layers/DenseLayer.java + nn/layers/feedforward/**.
+Dense on an RNN input applies time-distributed (the reference routes
+through an RnnToFeedForwardPreProcessor; here a 3-d input just works —
+the matmul contracts the last axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    FeedForwardLayer, BaseLayer, Layer, register_layer,
+)
+
+__all__ = ["DenseLayer", "ActivationLayer", "DropoutLayer",
+           "EmbeddingLayer", "EmbeddingSequenceLayer", "AutoEncoder"]
+
+
+@register_layer
+@dataclasses.dataclass
+class DenseLayer(FeedForwardLayer):
+    """Fully connected layer (reference nn/conf/layers/DenseLayer.java,
+    impl nn/layers/feedforward/dense/DenseLayer.java)."""
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        p = {"W": self._sample_w(key, (self.n_in, self.n_out),
+                                 self.n_in, self.n_out)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init,
+                              dtypes.policy().param_dtype)
+        return p, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        if x.ndim > 2 and x.shape[-1] != params["W"].shape[0]:
+            x = x.reshape(x.shape[0], -1)   # cnn -> flatten
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if self.n_out is None:
+            raise ValueError("DenseLayer requires n_out")
+        if input_type.kind == "rnn":
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+
+@register_layer
+@dataclasses.dataclass
+class ActivationLayer(BaseLayer):
+    """Activation-only layer (nn/conf/layers/ActivationLayer.java)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        return self.activation_fn()(x), state
+
+
+@register_layer
+@dataclasses.dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout (nn/conf/layers/DropoutLayer.java). Identity at
+    inference; inverted-dropout scaling at train time."""
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        return self.apply_input_dropout(x, training=training, rng=rng), state
+
+
+@register_layer
+@dataclasses.dataclass
+class EmbeddingLayer(FeedForwardLayer):
+    """Index → vector lookup (nn/conf/layers/EmbeddingLayer.java, impl
+    nn/layers/feedforward/embedding/EmbeddingLayer.java). Input: int ids
+    of shape (B,) or (B,1); a one-hot-equivalent gather — MXU-friendly
+    when XLA lowers to take()."""
+
+    def initialize(self, key, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.flat_size()
+        p = {"W": self._sample_w(key, (self.n_in, self.n_out),
+                                 self.n_in, self.n_out)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init,
+                              dtypes.policy().param_dtype)
+        return p, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        y = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+
+@register_layer
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(FeedForwardLayer):
+    """Sequence of ids (B,T) → (B,T,n_out) (reference added this in
+    later versions; capability parity with Keras Embedding import)."""
+
+    def initialize(self, key, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.size
+        return {"W": self._sample_w(key, (self.n_in, self.n_out),
+                                    self.n_in, self.n_out)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        return jnp.take(params["W"], idx, axis=0), state
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+
+@register_layer
+@dataclasses.dataclass
+class AutoEncoder(FeedForwardLayer):
+    """Denoising autoencoder layer (nn/conf/layers/AutoEncoder.java,
+    impl nn/layers/feedforward/autoencoder/AutoEncoder.java).
+
+    Supervised forward = encode only. Unsupervised pretraining
+    (corrupt → encode → decode → reconstruction loss) is exposed via
+    ``pretrain_loss`` and driven by MultiLayerNetwork.pretrain, the
+    analog of BasePretrainNetwork.
+    """
+
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: str = "mse"
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        k1, k2 = jax.random.split(key)
+        pd = dtypes.policy().param_dtype
+        return {
+            "W": self._sample_w(k1, (self.n_in, self.n_out),
+                                self.n_in, self.n_out),
+            "b": jnp.full((self.n_out,), self.bias_init, pd),
+            "vb": jnp.zeros((self.n_in,), pd),     # visible bias (decode)
+        }, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        return self.activation_fn()(x @ params["W"] + params["b"]), state
+
+    def pretrain_loss(self, params, x, rng):
+        from deeplearning4j_tpu.nn import losses as losses_mod
+        act = self.activation_fn()
+        if self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level,
+                                        x.shape)
+            xc = jnp.where(keep, x, 0.0)
+        else:
+            xc = x
+        h = act(xc @ params["W"] + params["b"])
+        recon = act(h @ params["W"].T + params["vb"])
+        return jnp.mean(losses_mod.get(self.loss)(x, recon, None))

@@ -1,0 +1,105 @@
+"""Normalization layers.
+
+BatchNormalization replaces both the reference's Java impl
+(nn/layers/normalization/BatchNormalization.java) and its cuDNN helper
+(CudnnBatchNormalizationHelper.java). Running mean/var live in the
+layer *state* pytree and are updated functionally at train time — the
+executor threads state through the jitted train step (no mutation, no
+workspaces).
+
+LocalResponseNormalization mirrors
+nn/layers/normalization/LocalResponseNormalization.java /
+CudnnLocalResponseNormalizationHelper.java (AlexNet-era LRN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    BaseLayer, Layer, register_layer,
+)
+
+__all__ = ["BatchNormalization", "LocalResponseNormalization"]
+
+
+@register_layer
+@dataclasses.dataclass
+class BatchNormalization(BaseLayer):
+    """(nn/conf/layers/BatchNormalization.java). Normalizes over batch
+    (+spatial for CNN input); gamma/beta trainable unless ``lock_gamma_beta``.
+    ``decay`` matches the reference's running-average decay (default 0.9)."""
+
+    n_out: Optional[int] = None      # inferred from input type
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    gamma: float = 1.0
+    beta: float = 0.0
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_out is None:
+            if input_type.kind == "cnn":
+                self.n_out = input_type.channels
+            else:
+                self.n_out = input_type.flat_size()
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        pd = dtypes.policy().param_dtype
+        n = self.n_out
+        params = {}
+        if not self.lock_gamma_beta:
+            params = {"gamma": jnp.full((n,), self.gamma, pd),
+                      "beta": jnp.full((n,), self.beta, pd)}
+        state = {"mean": jnp.zeros((n,), jnp.float32),
+                 "var": jnp.ones((n,), jnp.float32)}
+        return params, state
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))   # all but channel/feature axis
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if not self.lock_gamma_beta:
+            y = y * params["gamma"] + params["beta"]
+        else:
+            y = y * self.gamma + self.beta
+        return self.activation_fn()(y), new_state
+
+
+@register_layer
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    """Across-channel LRN (nn/conf/layers/LocalResponseNormalization.java):
+    y = x / (k + alpha * sum_{j in window} x_j^2)^beta."""
+
+    k: float = 2.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+    n: int = 5
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        # channel-last; windowed sum of squares over channel axis
+        sq = x * x
+        half = self.n // 2
+        window = (1,) * (x.ndim - 1) + (self.n,)
+        strides = (1,) * x.ndim
+        pad = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, pad)
+        return x / (self.k + self.alpha * ssum) ** self.beta, state

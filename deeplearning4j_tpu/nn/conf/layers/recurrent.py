@@ -1,0 +1,361 @@
+"""Recurrent layers.
+
+Replaces the reference's hand-written LSTM math
+(nn/layers/recurrent/LSTMHelpers.java:69 activateHelper, :400
+backpropGradientHelper — 793 LoC of manual forward/backward) and the
+cuDNN RNN binding (CudnnLSTMHelper.java) with a single ``lax.scan``
+forward; the backward pass is ``jax.grad`` through the scan. The
+per-timestep cell is one fused (B, n_in+n_out) x (n_in+n_out, 4*n_out)
+matmul — MXU-shaped.
+
+Gate packing order on the 4*n_out axis: [input, forget, output, cell(g)].
+
+Stateful streaming inference (reference ``rnnTimeStep``,
+MultiLayerNetwork.java:2656) is supported via ``apply_rnn`` which takes
+and returns the carried (h, c); executors keep a per-layer state map.
+
+Masking (reference: Layer.feedForwardMaskArray, MaskedReductionUtil):
+at masked timesteps the carried state does not advance and the output
+is zeroed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    FeedForwardLayer, Layer, register_layer,
+)
+from deeplearning4j_tpu.nn.conf.layers.base import layer_from_dict
+from deeplearning4j_tpu.nn.conf.layers.output import LossLayer
+from deeplearning4j_tpu.nn import activations
+
+__all__ = ["LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "Bidirectional",
+           "SimpleRnn", "LastTimeStep", "RnnLossLayer"]
+
+
+@dataclasses.dataclass
+class BaseRecurrentLayer(FeedForwardLayer):
+    activation: str = "tanh"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.size
+
+    def zero_state(self, batch: int):
+        z = jnp.zeros((batch, self.n_out), jnp.float32)
+        return (z, z)
+
+    def apply_rnn(self, params, x, carry, *, training=False, rng=None,
+                  mask=None):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        out, _ = self.apply_rnn(params, x, self.zero_state(x.shape[0]),
+                                training=training, rng=rng, mask=mask)
+        return out, state
+
+
+@register_layer
+@dataclasses.dataclass
+class LSTM(BaseRecurrentLayer):
+    """Standard LSTM, no peepholes (nn/conf/layers/LSTM.java).
+
+    ``forget_gate_bias_init`` mirrors the reference's
+    forgetGateBiasInit (default 1.0, GravesLSTM.java builder).
+    """
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        k1, k2 = jax.random.split(key)
+        pd = dtypes.policy().param_dtype
+        n, m = self.n_in, self.n_out
+        b = jnp.zeros((4 * m,), pd)
+        # forget-gate block is [m:2m] in the packed order [i,f,o,g]
+        b = b.at[m:2 * m].set(self.forget_gate_bias_init)
+        return {
+            "Wx": self._sample_w(k1, (n, 4 * m), n + m, m),
+            "Wh": self._sample_w(k2, (m, 4 * m), n + m, m),
+            "b": b,
+        }, {}
+
+    def _gates(self, params, xt, h):
+        return xt @ params["Wx"] + h @ params["Wh"] + params["b"]
+
+    def _cell(self, params, xt, h, c):
+        m = self.n_out
+        z = self._gates(params, xt, h)
+        gate = activations.get(self.gate_activation)
+        act = self.activation_fn()
+        i = gate(z[:, 0 * m:1 * m])
+        f = gate(z[:, 1 * m:2 * m])
+        o = gate(z[:, 2 * m:3 * m])
+        g = act(z[:, 3 * m:4 * m])
+        c_new = f * c + i * g
+        h_new = o * act(c_new)
+        return h_new, c_new
+
+    def apply_rnn(self, params, x, carry, *, training=False, rng=None,
+                  mask=None):
+        h0, c0 = carry
+
+        def step(carry, inp):
+            h, c = carry
+            if mask is not None:
+                xt, mt = inp
+            else:
+                xt = inp
+            h_new, c_new = self._cell(params, xt, h, c)
+            if mask is not None:
+                mt = mt[:, None]
+                h_new = jnp.where(mt > 0, h_new, h)
+                c_new = jnp.where(mt > 0, c_new, c)
+                out = h_new * mt
+            else:
+                out = h_new
+            return (h_new, c_new), out
+
+        xs = jnp.swapaxes(x, 0, 1)                    # (T,B,C)
+        inputs = (xs, jnp.swapaxes(mask, 0, 1)) if mask is not None else xs
+        (h, c), ys = lax.scan(step, (h0, c0), inputs)
+        return jnp.swapaxes(ys, 0, 1), (h, c)
+
+
+@register_layer
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (nn/conf/layers/GravesLSTM.java,
+    math in LSTMHelpers.java — peepholes w_ci, w_cf on pre-state, w_co
+    on post-state, per Graves 2013)."""
+
+    def initialize(self, key, input_type: InputType):
+        params, state = super().initialize(key, input_type)
+        pd = dtypes.policy().param_dtype
+        m = self.n_out
+        params["wc"] = jnp.zeros((3 * m,), pd)   # [ci, cf, co]
+        return params, state
+
+    def _cell(self, params, xt, h, c):
+        m = self.n_out
+        z = self._gates(params, xt, h)
+        gate = activations.get(self.gate_activation)
+        act = self.activation_fn()
+        wci = params["wc"][0 * m:1 * m]
+        wcf = params["wc"][1 * m:2 * m]
+        wco = params["wc"][2 * m:3 * m]
+        i = gate(z[:, 0 * m:1 * m] + c * wci)
+        f = gate(z[:, 1 * m:2 * m] + c * wcf)
+        g = act(z[:, 3 * m:4 * m])
+        c_new = f * c + i * g
+        o = gate(z[:, 2 * m:3 * m] + c_new * wco)
+        h_new = o * act(c_new)
+        return h_new, c_new
+
+
+@register_layer
+@dataclasses.dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    """Vanilla RNN: h_t = act(x_t Wx + h_{t-1} Wh + b)."""
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        k1, k2 = jax.random.split(key)
+        pd = dtypes.policy().param_dtype
+        return {
+            "Wx": self._sample_w(k1, (self.n_in, self.n_out),
+                                 self.n_in, self.n_out),
+            "Wh": self._sample_w(k2, (self.n_out, self.n_out),
+                                 self.n_out, self.n_out),
+            "b": jnp.full((self.n_out,), self.bias_init, pd),
+        }, {}
+
+    def apply_rnn(self, params, x, carry, *, training=False, rng=None,
+                  mask=None):
+        h0, _ = carry
+        act = self.activation_fn()
+
+        def step(h, inp):
+            if mask is not None:
+                xt, mt = inp
+            else:
+                xt = inp
+            h_new = act(xt @ params["Wx"] + h @ params["Wh"] + params["b"])
+            if mask is not None:
+                mt = mt[:, None]
+                h_new = jnp.where(mt > 0, h_new, h)
+                out = h_new * mt
+            else:
+                out = h_new
+            return h_new, out
+
+        xs = jnp.swapaxes(x, 0, 1)
+        inputs = (xs, jnp.swapaxes(mask, 0, 1)) if mask is not None else xs
+        h, ys = lax.scan(step, h0, inputs)
+        return jnp.swapaxes(ys, 0, 1), (h, h)
+
+
+@register_layer
+@dataclasses.dataclass
+class Bidirectional(Layer):
+    """Bidirectional wrapper (reference nn/conf/layers/recurrent/
+    Bidirectional.java semantics): runs the wrapped recurrent layer
+    forward and (on a time-reversed copy) backward, merging by
+    mode ∈ {concat, add, mul, ave}."""
+
+    fwd: Optional[dict] = None          # serialized wrapped-layer config
+    mode: str = "concat"
+
+    def __post_init__(self):
+        if isinstance(self.fwd, Layer):
+            self._fwd_layer = self.fwd
+            self.fwd = self.fwd.to_dict()
+        elif self.fwd is not None:
+            self._fwd_layer = layer_from_dict(self.fwd)
+        else:
+            self._fwd_layer = None
+
+    @property
+    def wrapped(self) -> BaseRecurrentLayer:
+        return self._fwd_layer
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.wrapped.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        base = self.wrapped.output_type(input_type)
+        n = base.size * 2 if self.mode == "concat" else base.size
+        return InputType.recurrent(n, base.timesteps)
+
+    def initialize(self, key, input_type: InputType):
+        kf, kb = jax.random.split(key)
+        self.wrapped.set_n_in(input_type)
+        pf, _ = self.wrapped.initialize(kf, input_type)
+        pb, _ = self.wrapped.initialize(kb, input_type)
+        self.fwd = self.wrapped.to_dict()   # capture inferred n_in
+        return {"fwd": pf, "bwd": pb}, {}
+
+    def _reverse(self, x, mask):
+        if mask is None:
+            return jnp.flip(x, axis=1)
+        # flip only the valid prefix per example (DL4J reverses w.r.t.
+        # actual sequence length under masking)
+        lengths = jnp.sum(mask, axis=1).astype(jnp.int32)   # (B,)
+        T = x.shape[1]
+        idx = jnp.arange(T)[None, :]                         # (1,T)
+        rev = lengths[:, None] - 1 - idx
+        rev = jnp.where(rev >= 0, rev, idx)
+        return jnp.take_along_axis(x, rev[..., None], axis=1)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        lay = self.wrapped
+        z = lay.zero_state(x.shape[0])
+        out_f, _ = lay.apply_rnn(params["fwd"], x, z, training=training,
+                                 rng=rng, mask=mask)
+        xr = self._reverse(x, mask)
+        out_b, _ = lay.apply_rnn(params["bwd"], xr, z, training=training,
+                                 rng=rng, mask=mask)
+        out_b = self._reverse(out_b, mask)
+        if self.mode == "concat":
+            y = jnp.concatenate([out_f, out_b], axis=-1)
+        elif self.mode == "add":
+            y = out_f + out_b
+        elif self.mode == "mul":
+            y = out_f * out_b
+        elif self.mode == "ave":
+            y = 0.5 * (out_f + out_b)
+        else:
+            raise ValueError(self.mode)
+        return y, state
+
+    def to_dict(self) -> dict:
+        return {"@type": "Bidirectional", "name": self.name,
+                "dropout": self.dropout, "fwd": self.fwd, "mode": self.mode}
+
+
+@register_layer
+@dataclasses.dataclass
+class GravesBidirectionalLSTM(Bidirectional):
+    """(nn/conf/layers/GravesBidirectionalLSTM.java) — a bidirectional
+    GravesLSTM with concat merge, kept as its own type for config parity."""
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    activation: str = "tanh"
+    weight_init: str = "xavier"
+    forget_gate_bias_init: float = 1.0
+
+    def __post_init__(self):
+        if self.fwd is None and self.n_out is not None:
+            self._fwd_layer = GravesLSTM(
+                n_in=self.n_in, n_out=self.n_out, activation=self.activation,
+                weight_init=self.weight_init,
+                forget_gate_bias_init=self.forget_gate_bias_init)
+            self.fwd = self._fwd_layer.to_dict()
+        else:
+            super().__post_init__()
+
+
+@register_layer
+@dataclasses.dataclass
+class LastTimeStep(Layer):
+    """Wrapper extracting the last (unmasked) timestep → FF output
+    (reference nn/conf/layers/recurrent/LastTimeStep.java +
+    LastTimeStepVertex)."""
+
+    underlying: Optional[dict] = None
+
+    def __post_init__(self):
+        if isinstance(self.underlying, Layer):
+            self._under = self.underlying
+            self.underlying = self._under.to_dict()
+        elif self.underlying is not None:
+            self._under = layer_from_dict(self.underlying)
+        else:
+            self._under = None
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self._under.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        base = self._under.output_type(input_type)
+        return InputType.feed_forward(base.size)
+
+    def initialize(self, key, input_type: InputType):
+        p, s = self._under.initialize(key, input_type)
+        self.underlying = self._under.to_dict()
+        return p, s
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        y, new_state = self._under.apply(params, state, x, training=training,
+                                         rng=rng, mask=mask)
+        if mask is None:
+            return y[:, -1, :], new_state
+        lengths = jnp.sum(mask, axis=1).astype(jnp.int32)
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(
+            y, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0, :], \
+            new_state
+
+
+@register_layer
+@dataclasses.dataclass
+class RnnLossLayer(LossLayer):
+    """Time-distributed loss layer without weights
+    (nn/conf/layers/RnnLossLayer semantics)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type

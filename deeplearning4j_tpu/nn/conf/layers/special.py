@@ -1,0 +1,303 @@
+"""Special layers: FrozenLayer, VariationalAutoencoder, Yolo2OutputLayer.
+
+- FrozenLayer: nn/layers/FrozenLayer.java — wraps any layer; executors
+  stop gradients through its params (here: ``lax.stop_gradient`` on the
+  param subtree + exclusion from the optimizer, handled by the
+  executor's trainable-mask).
+- VariationalAutoencoder: nn/layers/variational/VariationalAutoencoder
+  .java (1154 LoC) — MLP encoder → diagonal-Gaussian latent →
+  MLP decoder → pluggable reconstruction distribution
+  (nn/conf/layers/variational/*: Bernoulli/Gaussian/Exponential/
+  Composite). Supervised forward = encoder mean activations (matching
+  the reference's use as a feature extractor); unsupervised pretraining
+  maximises the ELBO.
+- Yolo2OutputLayer: nn/layers/objdetect/Yolo2OutputLayer.java (663 LoC)
+  — YOLOv2 loss over anchor-box grid predictions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn import activations
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    BaseLayer, FeedForwardLayer, Layer, register_layer, layer_from_dict,
+)
+from deeplearning4j_tpu.nn.weights import init_weight
+
+__all__ = ["FrozenLayer", "VariationalAutoencoder", "Yolo2OutputLayer"]
+
+
+@register_layer
+@dataclasses.dataclass
+class FrozenLayer(Layer):
+    """Wraps a layer whose params receive no updates
+    (nn/layers/FrozenLayer.java; created by transfer learning's
+    setFeatureExtractor)."""
+
+    inner: Optional[dict] = None
+
+    def __post_init__(self):
+        if isinstance(self.inner, Layer):
+            self._inner = self.inner
+            self.inner = self._inner.to_dict()
+        elif self.inner is not None:
+            self._inner = layer_from_dict(self.inner)
+        else:
+            self._inner = None
+
+    @property
+    def wrapped(self) -> Layer:
+        return self._inner
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self._inner.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self._inner.output_type(input_type)
+
+    def initialize(self, key, input_type: InputType):
+        p, s = self._inner.initialize(key, input_type)
+        self.inner = self._inner.to_dict()
+        return p, s
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        frozen = jax.lax.stop_gradient(params)
+        # frozen layers run in inference mode (reference FrozenLayer
+        # disables dropout and training-time behavior)
+        return self._inner.apply(frozen, state, x, training=False, rng=rng,
+                                 mask=mask)
+
+    def has_loss(self):
+        return self._inner.has_loss()
+
+    def to_dict(self) -> dict:
+        return {"@type": "FrozenLayer", "name": self.name,
+                "dropout": self.dropout, "inner": self.inner}
+
+
+def _mlp_init(key, sizes, weight_init, pd):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, nin, nout in zip(keys, sizes[:-1], sizes[1:]):
+        params.append({
+            "W": init_weight(k, (nin, nout), weight_init, nin, nout, dtype=pd),
+            "b": jnp.zeros((nout,), pd),
+        })
+    return params
+
+
+def _mlp_apply(layers, x, act):
+    for lay in layers:
+        x = act(x @ lay["W"] + lay["b"])
+    return x
+
+
+@register_layer
+@dataclasses.dataclass
+class VariationalAutoencoder(FeedForwardLayer):
+    """(nn/conf/layers/variational/VariationalAutoencoder.java).
+
+    ``encoder_layer_sizes`` / ``decoder_layer_sizes`` mirror
+    encoderLayerSizes/decoderLayerSizes; ``n_out`` is the latent size
+    (nOut in the reference); ``reconstruction_distribution`` one of
+    'bernoulli' | 'gaussian' | 'exponential', matching
+    nn/conf/layers/variational/{Bernoulli,Gaussian,Exponential}
+    ReconstructionDistribution. ``num_samples`` = MC samples for the
+    ELBO (reference numSamples).
+    """
+
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    reconstruction_distribution: str = "bernoulli"
+    pzx_activation: str = "identity"
+    num_samples: int = 1
+    activation: str = "tanh"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        pd = dtypes.policy().param_dtype
+        k_enc, k_mu, k_lv, k_dec, k_out = jax.random.split(key, 5)
+        enc_sizes = (self.n_in,) + tuple(self.encoder_layer_sizes)
+        dec_sizes = (self.n_out,) + tuple(self.decoder_layer_sizes)
+        eh = enc_sizes[-1]
+        dh = dec_sizes[-1]
+        # gaussian reconstruction needs mean+logvar per visible unit
+        rec_out = (2 * self.n_in
+                   if self.reconstruction_distribution == "gaussian"
+                   else self.n_in)
+        params = {
+            "enc": _mlp_init(k_enc, enc_sizes, self.weight_init, pd),
+            "mu": {"W": init_weight(k_mu, (eh, self.n_out), self.weight_init,
+                                    eh, self.n_out, dtype=pd),
+                   "b": jnp.zeros((self.n_out,), pd)},
+            "logvar": {"W": init_weight(k_lv, (eh, self.n_out),
+                                        self.weight_init, eh, self.n_out,
+                                        dtype=pd),
+                       "b": jnp.zeros((self.n_out,), pd)},
+            "dec": _mlp_init(k_dec, dec_sizes, self.weight_init, pd),
+            "out": {"W": init_weight(k_out, (dh, rec_out), self.weight_init,
+                                     dh, rec_out, dtype=pd),
+                    "b": jnp.zeros((rec_out,), pd)},
+        }
+        return params, {}
+
+    def _encode(self, params, x):
+        act = self.activation_fn()
+        h = _mlp_apply(params["enc"], x, act)
+        mu = h @ params["mu"]["W"] + params["mu"]["b"]
+        logvar = h @ params["logvar"]["W"] + params["logvar"]["b"]
+        return mu, logvar
+
+    def _decode(self, params, z):
+        act = self.activation_fn()
+        h = _mlp_apply(params["dec"], z, act)
+        return h @ params["out"]["W"] + params["out"]["b"]
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        mu, _ = self._encode(params, x)
+        return activations.get(self.pzx_activation)(mu), state
+
+    def _reconstruction_logprob(self, dec_out, x):
+        d = self.reconstruction_distribution
+        if d == "bernoulli":
+            p = jax.nn.sigmoid(dec_out)
+            eps = 1e-7
+            return jnp.sum(x * jnp.log(p + eps)
+                           + (1 - x) * jnp.log(1 - p + eps), axis=-1)
+        if d == "gaussian":
+            mean, logvar = jnp.split(dec_out, 2, axis=-1)
+            var = jnp.exp(logvar)
+            return jnp.sum(-0.5 * (jnp.log(2 * jnp.pi) + logvar
+                                   + (x - mean) ** 2 / var), axis=-1)
+        if d == "exponential":
+            lam = jnp.exp(jnp.clip(dec_out, -20, 20))
+            return jnp.sum(jnp.log(lam) - lam * x, axis=-1)
+        raise ValueError(f"Unknown reconstruction distribution '{d}'")
+
+    def pretrain_loss(self, params, x, rng):
+        """Negative ELBO (mean over batch), MC-estimated with
+        ``num_samples`` draws — the quantity the reference minimises in
+        VariationalAutoencoder.computeGradientAndScore."""
+        mu, logvar = self._encode(params, x)
+        kl = -0.5 * jnp.sum(1 + logvar - mu ** 2 - jnp.exp(logvar), axis=-1)
+        rec = 0.0
+        keys = jax.random.split(rng, self.num_samples)
+        for k in keys:
+            eps = jax.random.normal(k, mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            rec = rec + self._reconstruction_logprob(self._decode(params, z),
+                                                     x)
+        rec = rec / self.num_samples
+        return jnp.mean(kl - rec)
+
+    def reconstruction_probability(self, params, x, rng, num_samples=5):
+        """reconstructionProbability (reference :  used for anomaly
+        detection) — MC estimate of log p(x)."""
+        mu, logvar = self._encode(params, x)
+        keys = jax.random.split(rng, num_samples)
+        logps = []
+        for k in keys:
+            eps = jax.random.normal(k, mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            logps.append(self._reconstruction_logprob(
+                self._decode(params, z), x))
+        return jax.nn.logsumexp(jnp.stack(logps), axis=0) - jnp.log(
+            float(num_samples))
+
+    def generate(self, params, z):
+        """Decode latent samples to visible-space means
+        (generateAtMeanGivenZ)."""
+        dec_out = self._decode(params, z)
+        if self.reconstruction_distribution == "bernoulli":
+            return jax.nn.sigmoid(dec_out)
+        if self.reconstruction_distribution == "gaussian":
+            return jnp.split(dec_out, 2, axis=-1)[0]
+        return jnp.exp(jnp.clip(dec_out, -20, 20))
+
+
+@register_layer
+@dataclasses.dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 output layer (nn/conf/layers/objdetect/Yolo2OutputLayer
+    .java + nn/layers/objdetect/Yolo2OutputLayer.java).
+
+    Input: conv activations (B, H, W, A*(5+C)); labels: (B, H, W,
+    A*(5+C))-formatted ground truth built by the data pipeline (or the
+    (B, 4+label) box format converted upstream). ``anchors``: (A, 2)
+    prior box sizes in grid units. Loss follows YOLOv2: coordinate SSE
+    (lambda_coord, sqrt-w/h), object/no-object confidence SSE
+    (IOU-weighted), class cross-entropy.
+    """
+
+    anchors: Tuple[Tuple[float, float], ...] = ((1.0, 1.0),)
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    def __post_init__(self):
+        self.anchors = tuple(tuple(float(v) for v in a) for a in self.anchors)
+
+    def has_loss(self):
+        return True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def _split(self, y, n_classes):
+        # y: (B,H,W,A,5+C) after reshape
+        xy = jax.nn.sigmoid(y[..., 0:2])          # center offsets in cell
+        wh = y[..., 2:4]                          # raw; exp * anchor
+        conf = jax.nn.sigmoid(y[..., 4])
+        cls = y[..., 5:]
+        return xy, wh, conf, cls
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        b, h, w, c = x.shape
+        a = len(self.anchors)
+        depth = c // a
+        y = x.reshape(b, h, w, a, depth)
+        xy, wh, conf, cls = self._split(y, depth - 5)
+        anchors = jnp.asarray(self.anchors)
+        wh = jnp.exp(jnp.clip(wh, -10, 10)) * anchors
+        out = jnp.concatenate(
+            [xy, wh, conf[..., None], jax.nn.softmax(cls, axis=-1)], axis=-1)
+        return out.reshape(b, h, w, c), state
+
+    def loss_from_input(self, params, x, labels, *, training, rng, mask=None):
+        b, h, w, c = x.shape
+        a = len(self.anchors)
+        depth = c // a
+        y = x.reshape(b, h, w, a, depth)
+        t = labels.reshape(b, h, w, a, depth)
+        xy, wh_raw, conf, cls = self._split(y, depth - 5)
+        anchors = jnp.asarray(self.anchors)
+        wh = jnp.exp(jnp.clip(wh_raw, -10, 10)) * anchors
+
+        t_xy = t[..., 0:2]
+        t_wh = t[..., 2:4]
+        t_obj = t[..., 4]                          # 1 where object present
+        t_cls = t[..., 5:]
+
+        coord = jnp.sum(
+            t_obj[..., None] * ((xy - t_xy) ** 2
+                                + (jnp.sqrt(wh + 1e-8)
+                                   - jnp.sqrt(t_wh + 1e-8)) ** 2),
+            axis=-1)
+        obj_loss = t_obj * (conf - 1.0) ** 2
+        noobj_loss = (1.0 - t_obj) * conf ** 2
+        logp = jax.nn.log_softmax(cls, axis=-1)
+        cls_loss = -jnp.sum(t_cls * logp, axis=-1) * t_obj
+
+        total = (self.lambda_coord * coord + obj_loss
+                 + self.lambda_no_obj * noobj_loss + cls_loss)
+        return jnp.mean(jnp.sum(total, axis=(1, 2, 3)))

@@ -1,0 +1,181 @@
+"""Input preprocessors: reshape/transpose between layer families.
+
+Mirrors nn/conf/preprocessor/*.java (12 classes). The executors insert
+these between layers whose InputTypes disagree, exactly like
+``MultiLayerConfiguration.Builder`` does via
+``InputType.getPreProcessorForInputType``. Conv activations are NHWC
+(TPU-native) rather than the reference's NCHW; the *Flat* forms use
+channel-last flattening accordingly (documented divergence — Keras
+import compensates when loading NCHW-trained weights).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+__all__ = ["InputPreProcessor", "preprocessor_from_dict",
+           "CnnToFeedForwardPreProcessor", "FeedForwardToCnnPreProcessor",
+           "RnnToFeedForwardPreProcessor", "FeedForwardToRnnPreProcessor",
+           "CnnToRnnPreProcessor", "RnnToCnnPreProcessor",
+           "auto_preprocessor"]
+
+_PP_REGISTRY: Dict[str, type] = {}
+
+
+def _register(cls):
+    _PP_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def preprocessor_from_dict(d: Optional[dict]):
+    if d is None:
+        return None
+    d = dict(d)
+    t = d.pop("@type")
+    return _PP_REGISTRY[t](**d)
+
+
+@dataclasses.dataclass
+class InputPreProcessor:
+    def __call__(self, x):
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = {"@type": type(self).__name__}
+        d.update(dataclasses.asdict(self))
+        return d
+
+
+@_register
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """(nn/conf/preprocessor/CnnToFeedForwardPreProcessor.java)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.feed_forward(t.flat_size())
+
+
+@_register
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """(nn/conf/preprocessor/FeedForwardToCnnPreProcessor.java).
+    Reshapes (B, H*W*C) → (B,H,W,C)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x):
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@_register
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """(B,T,C) → (B*T,C) (nn/conf/preprocessor/RnnToFeedForward...).
+    NOTE: executors apply dense layers time-distributed on 3-d input
+    directly, so this is mainly for explicit-config parity."""
+
+    def __call__(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.feed_forward(t.size)
+
+
+@_register
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    timesteps: int = 0
+
+    def __call__(self, x):
+        return x.reshape(-1, self.timesteps, x.shape[-1])
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.recurrent(t.size, self.timesteps or None)
+
+
+@_register
+@dataclasses.dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """(B,H,W,C) → (B,T=H,  W*C) — treat rows as timesteps (matches the
+    reference's flattening of spatial dims to a sequence)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        return x.reshape(b, h, w * c)
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.recurrent(t.width * t.channels, t.height)
+
+
+@_register
+@dataclasses.dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x):
+        b = x.shape[0]
+        return x.reshape(b, self.height, self.width, self.channels)
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+def auto_preprocessor(have: InputType, layer) -> Optional[InputPreProcessor]:
+    """Pick the preprocessor between activation type ``have`` and the
+    next layer, mirroring InputType.getPreProcessorForInputType +
+    InputTypeUtil auto-insertion in MultiLayerConfiguration.Builder."""
+    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+        ConvolutionLayer, Convolution1DLayer)
+    from deeplearning4j_tpu.nn.conf.layers.pooling import (
+        SubsamplingLayer, Subsampling1DLayer, GlobalPoolingLayer)
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+        BaseRecurrentLayer, Bidirectional, LastTimeStep)
+    from deeplearning4j_tpu.nn.conf.layers.normalization import (
+        BatchNormalization, LocalResponseNormalization)
+    from deeplearning4j_tpu.nn.conf.layers.output import RnnOutputLayer
+
+    wants_cnn = isinstance(layer, (ConvolutionLayer, SubsamplingLayer,
+                                   LocalResponseNormalization)) and not \
+        isinstance(layer, (Convolution1DLayer, Subsampling1DLayer))
+    wants_rnn = isinstance(layer, (BaseRecurrentLayer, Bidirectional,
+                                   LastTimeStep, RnnOutputLayer,
+                                   Convolution1DLayer, Subsampling1DLayer))
+
+    if have.kind == "cnnflat" and wants_cnn:
+        return FeedForwardToCnnPreProcessor(have.height, have.width,
+                                            have.channels)
+    if have.kind == "cnn" and not wants_cnn and not wants_rnn and not \
+            isinstance(layer, (BatchNormalization, GlobalPoolingLayer)):
+        # dense/output after conv: flatten
+        return CnnToFeedForwardPreProcessor(have.height, have.width,
+                                            have.channels)
+    if have.kind == "cnn" and wants_rnn:
+        return CnnToRnnPreProcessor(have.height, have.width, have.channels)
+    if have.kind == "cnnflat" and not wants_cnn:
+        return None
+    return None

@@ -1,0 +1,154 @@
+"""Updater (optimizer) configs + learning-rate schedules.
+
+Mirrors the reference's updater vocabulary (ND4J
+org.nd4j.linalg.learning.config.* referenced from
+NeuralNetConfiguration.java:1081-1096: Sgd/Adam/AdaMax/Nesterovs/
+AdaGrad/AdaDelta/RmsProp/NoOp) and the lr decay policies
+(UpdaterBlock.applyLrDecayPolicy: exponential/inverse/poly/sigmoid/
+step/schedule). Configs are plain dicts (JSON-stable); ``to_optax``
+compiles one to an optax GradientTransformation — the whole updater
+runs inside the jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+__all__ = ["to_optax", "make_schedule", "sgd", "adam", "adamax", "nesterovs",
+           "adagrad", "adadelta", "rmsprop", "noop", "amsgrad", "nadam"]
+
+
+# ---- config constructors (builder sugar) ----
+
+def sgd(lr=0.1, schedule=None):
+    return {"type": "sgd", "lr": lr, "schedule": schedule}
+
+
+def adam(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, schedule=None):
+    return {"type": "adam", "lr": lr, "beta1": beta1, "beta2": beta2,
+            "eps": eps, "schedule": schedule}
+
+
+def amsgrad(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, schedule=None):
+    return {"type": "amsgrad", "lr": lr, "beta1": beta1, "beta2": beta2,
+            "eps": eps, "schedule": schedule}
+
+
+def nadam(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, schedule=None):
+    return {"type": "nadam", "lr": lr, "beta1": beta1, "beta2": beta2,
+            "eps": eps, "schedule": schedule}
+
+
+def adamax(lr=2e-3, beta1=0.9, beta2=0.999, eps=1e-8, schedule=None):
+    return {"type": "adamax", "lr": lr, "beta1": beta1, "beta2": beta2,
+            "eps": eps, "schedule": schedule}
+
+
+def nesterovs(lr=0.1, momentum=0.9, schedule=None):
+    return {"type": "nesterovs", "lr": lr, "momentum": momentum,
+            "schedule": schedule}
+
+
+def adagrad(lr=0.1, eps=1e-6, schedule=None):
+    return {"type": "adagrad", "lr": lr, "eps": eps, "schedule": schedule}
+
+
+def adadelta(rho=0.95, eps=1e-6):
+    return {"type": "adadelta", "rho": rho, "eps": eps}
+
+
+def rmsprop(lr=1e-3, decay=0.95, eps=1e-8, schedule=None):
+    return {"type": "rmsprop", "lr": lr, "decay": decay, "eps": eps,
+            "schedule": schedule}
+
+
+def noop():
+    return {"type": "noop"}
+
+
+# ---- schedules (ISchedule / lr decay policies) ----
+
+def make_schedule(base_lr: float, sched: Optional[dict]):
+    """dict → optax schedule. Types: 'exponential' {gamma}, 'inverse'
+    {gamma, power}, 'poly' {power, max_iter}, 'sigmoid' {gamma, step},
+    'step' {decay_rate, step}, 'map' {values: {iter: lr}}, 'warmup_cosine'
+    {warmup_steps, total_steps, [end_lr]}."""
+    if sched is None:
+        return base_lr
+    t = sched["type"]
+    if t == "exponential":
+        g = sched.get("gamma", 0.99)
+        return lambda i: base_lr * g ** i
+    if t == "inverse":
+        g, p = sched.get("gamma", 1e-2), sched.get("power", 1.0)
+        return lambda i: base_lr / (1 + g * i) ** p
+    if t == "poly":
+        p = sched.get("power", 1.0)
+        mx = sched.get("max_iter", 10000)
+        import jax.numpy as jnp
+        return lambda i: base_lr * (1 - jnp.minimum(i, mx) / mx) ** p
+    if t == "sigmoid":
+        g, s = sched.get("gamma", 0.5), sched.get("step", 10)
+        import jax.numpy as jnp
+        return lambda i: base_lr / (1 + jnp.exp(-g * (i - s)))
+    if t == "step":
+        d, s = sched.get("decay_rate", 0.1), sched.get("step", 1000)
+        import jax.numpy as jnp
+        return lambda i: base_lr * d ** jnp.floor(i / s)
+    if t == "map":
+        import jax.numpy as jnp
+        pairs = sorted((int(k), float(v))
+                       for k, v in sched["values"].items())
+        def f(i):
+            lr = base_lr
+            for it, v in pairs:
+                lr = jnp.where(i >= it, v, lr)
+            return lr
+        return f
+    if t == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            0.0, base_lr, sched.get("warmup_steps", 0),
+            sched.get("total_steps", 10000), sched.get("end_lr", 0.0))
+    raise ValueError(f"Unknown schedule type '{t}'")
+
+
+def to_optax(cfg: Optional[dict]) -> optax.GradientTransformation:
+    """Compile an updater config dict to optax."""
+    if cfg is None:
+        cfg = sgd()
+    t = cfg.get("type", "sgd")
+    lr = make_schedule(cfg.get("lr", 0.1), cfg.get("schedule"))
+    if t == "sgd":
+        return optax.sgd(lr)
+    if t == "adam":
+        return optax.adam(lr, b1=cfg.get("beta1", 0.9),
+                          b2=cfg.get("beta2", 0.999),
+                          eps=cfg.get("eps", 1e-8))
+    if t == "amsgrad":
+        return optax.amsgrad(lr, b1=cfg.get("beta1", 0.9),
+                             b2=cfg.get("beta2", 0.999),
+                             eps=cfg.get("eps", 1e-8))
+    if t == "nadam":
+        return optax.nadam(lr, b1=cfg.get("beta1", 0.9),
+                           b2=cfg.get("beta2", 0.999),
+                           eps=cfg.get("eps", 1e-8))
+    if t == "adamax":
+        return optax.adamax(lr, b1=cfg.get("beta1", 0.9),
+                            b2=cfg.get("beta2", 0.999),
+                            eps=cfg.get("eps", 1e-8))
+    if t == "nesterovs":
+        return optax.sgd(lr, momentum=cfg.get("momentum", 0.9),
+                         nesterov=True)
+    if t == "adagrad":
+        return optax.adagrad(lr, eps=cfg.get("eps", 1e-6))
+    if t == "adadelta":
+        return optax.adadelta(rho=cfg.get("rho", 0.95),
+                              eps=cfg.get("eps", 1e-6))
+    if t == "rmsprop":
+        return optax.rmsprop(lr, decay=cfg.get("decay", 0.95),
+                             eps=cfg.get("eps", 1e-8))
+    if t == "noop":
+        return optax.set_to_zero()
+    raise ValueError(f"Unknown updater type '{t}'")

@@ -1,0 +1,150 @@
+"""Loss function registry.
+
+Mirrors the reference's ``LossFunctions.LossFunction`` vocabulary (used
+by nn/conf/layers/OutputLayer via ``lossFunction(...)``). Every loss is
+``fn(labels, preds, mask) -> per-example score`` averaged to a scalar by
+the caller; ``mask`` is an optional broadcastable 0/1 array (the
+reference applies label masks inside ILossFunction.computeScoreArray).
+
+Semantics follow the reference conventions:
+- losses are computed on *post-activation* output (e.g. MCXENT expects
+  softmax output, XENT expects sigmoid output), matching DL4J where the
+  output layer applies its activation then the loss. Fused stable paths
+  (softmax+CE) are used internally when the layer knows its activation.
+- per-output scores are *summed over the output dimension* and averaged
+  over examples (DL4J divides the total score by #examples).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get", "register", "LOSSES", "score"]
+
+_EPS = 1e-10
+
+
+def _reduce(per_output, mask):
+    # sum over feature axes -> per-example score
+    if mask is not None:
+        per_output = per_output * mask
+    axes = tuple(range(1, per_output.ndim))
+    return jnp.sum(per_output, axis=axes)
+
+
+def mcxent(labels, preds, mask=None):
+    """Multi-class cross entropy against probabilities (post-softmax)."""
+    return _reduce(-labels * jnp.log(preds + _EPS), mask)
+
+
+def negativeloglikelihood(labels, preds, mask=None):
+    return mcxent(labels, preds, mask)
+
+
+def xent(labels, preds, mask=None):
+    """Binary cross entropy (post-sigmoid), summed over outputs."""
+    p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+    return _reduce(-(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p)), mask)
+
+
+def mse(labels, preds, mask=None):
+    # DL4J SQUARED_LOSS: mean over output dim of squared error
+    d = (preds - labels) ** 2
+    n = d.shape[-1]
+    return _reduce(d, mask) / n
+
+
+def l2(labels, preds, mask=None):
+    return _reduce((preds - labels) ** 2, mask)
+
+
+def mae(labels, preds, mask=None):
+    d = jnp.abs(preds - labels)
+    return _reduce(d, mask) / d.shape[-1]
+
+
+def l1(labels, preds, mask=None):
+    return _reduce(jnp.abs(preds - labels), mask)
+
+
+def hinge(labels, preds, mask=None):
+    # labels in {-1, +1} or {0,1} (converted)
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    return _reduce(jnp.maximum(0.0, 1.0 - y * preds), mask)
+
+
+def squared_hinge(labels, preds, mask=None):
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    return _reduce(jnp.maximum(0.0, 1.0 - y * preds) ** 2, mask)
+
+
+def kl_divergence(labels, preds, mask=None):
+    p = jnp.clip(preds, _EPS, 1.0)
+    t = jnp.clip(labels, _EPS, 1.0)
+    return _reduce(labels * (jnp.log(t) - jnp.log(p)), mask)
+
+
+def poisson(labels, preds, mask=None):
+    return _reduce(preds - labels * jnp.log(preds + _EPS), mask)
+
+
+def cosine_proximity(labels, preds, mask=None):
+    if mask is not None:
+        labels = labels * mask
+        preds = preds * mask
+    ln = jnp.linalg.norm(labels, axis=-1)
+    pn = jnp.linalg.norm(preds, axis=-1)
+    dot = jnp.sum(labels * preds, axis=-1)
+    out = -dot / (ln * pn + _EPS)
+    axes = tuple(range(1, out.ndim))
+    return jnp.sum(out, axis=axes) if axes else out
+
+
+def mean_squared_logarithmic_error(labels, preds, mask=None):
+    d = (jnp.log1p(jnp.maximum(preds, -1 + _EPS)) - jnp.log1p(labels)) ** 2
+    return _reduce(d, mask) / d.shape[-1]
+
+
+def mean_absolute_percentage_error(labels, preds, mask=None):
+    d = jnp.abs((labels - preds) / (jnp.abs(labels) + _EPS)) * 100.0
+    return _reduce(d, mask) / d.shape[-1]
+
+
+LOSSES = {
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "xent": xent,
+    "mse": mse,
+    "squared_loss": mse,
+    "l2": l2,
+    "mae": mae,
+    "l1": l1,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "kl_divergence": kl_divergence,
+    "reconstruction_crossentropy": xent,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "msle": mean_squared_logarithmic_error,
+    "mape": mean_absolute_percentage_error,
+}
+
+
+def register(name: str, fn) -> None:
+    LOSSES[name.lower()] = fn
+
+
+def get(name):
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(LOSSES)}")
+    return LOSSES[key]
+
+
+def score(name, labels, preds, mask=None, average: bool = True):
+    """Total (or mean) score, DL4J-style: sum of per-example scores / N."""
+    per_ex = get(name)(labels, preds, mask)
+    return jnp.mean(per_ex) if average else jnp.sum(per_ex)

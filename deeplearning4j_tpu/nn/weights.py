@@ -1,0 +1,147 @@
+"""Weight initialization schemes.
+
+Mirrors the reference's ``WeightInit`` enum + ``WeightInitUtil``
+(deeplearning4j-nn nn/weights/WeightInit.java:54, WeightInitUtil.java)
+and the distribution classes (nn/conf/distribution/*). Fan-in/fan-out
+are computed from the *logical* layer geometry and passed in by the
+param initializer, exactly as the reference does.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_weight", "distribution_sample", "WEIGHT_INITS"]
+
+
+def init_weight(key, shape, scheme, fan_in, fan_out, *, distribution=None,
+                dtype=jnp.float32):
+    """Sample a weight array of ``shape`` under ``scheme``.
+
+    ``scheme`` is a lower-case string from the WeightInit vocabulary, or
+    'distribution' with a distribution config dict (see
+    :func:`distribution_sample`).
+    """
+    s = str(scheme).lower()
+    fan_in = max(float(fan_in), 1.0)
+    fan_out = max(float(fan_out), 1.0)
+
+    if s == "zero":
+        return jnp.zeros(shape, dtype)
+    if s == "ones":
+        return jnp.ones(shape, dtype)
+    if s == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2-d shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if s == "normal":  # DL4J NORMAL: N(0, 1/sqrt(fan_in))
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+    if s == "lecun_normal":
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(1.0 / fan_in)
+    if s == "lecun_uniform":
+        b = jnp.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -b, b)
+    if s == "uniform":  # DL4J UNIFORM: U(-a, a), a = 1/sqrt(fan_in)
+        a = 1.0 / jnp.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "xavier":  # N(0, 2 / (fan_in + fan_out))
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(
+            2.0 / (fan_in + fan_out))
+    if s == "xavier_uniform":  # U(-a, a), a = sqrt(6/(fan_in+fan_out))
+        a = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "xavier_fan_in":
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+    if s == "xavier_legacy":
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(
+            1.0 / (fan_in + fan_out))
+    if s == "relu":  # He: N(0, 2/fan_in)
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+    if s == "relu_uniform":  # U(-a, a), a = sqrt(6/fan_in)
+        a = jnp.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "sigmoid_uniform":
+        a = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s in ("var_scaling_normal_fan_in", "var_scaling_normal_fan_out",
+             "var_scaling_normal_fan_avg", "var_scaling_uniform_fan_in",
+             "var_scaling_uniform_fan_out", "var_scaling_uniform_fan_avg"):
+        if s.endswith("fan_in"):
+            n = fan_in
+        elif s.endswith("fan_out"):
+            n = fan_out
+        else:
+            n = 0.5 * (fan_in + fan_out)
+        if "normal" in s:
+            return jax.random.normal(key, shape, dtype) * jnp.sqrt(1.0 / n)
+        a = jnp.sqrt(3.0 / n)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit 'distribution' requires a "
+                             "distribution config")
+        return distribution_sample(key, shape, distribution, dtype=dtype)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")
+
+
+WEIGHT_INITS = [
+    "zero", "ones", "identity", "normal", "lecun_normal", "lecun_uniform",
+    "uniform", "xavier", "xavier_uniform", "xavier_fan_in", "xavier_legacy",
+    "relu", "relu_uniform", "sigmoid_uniform", "distribution",
+    "var_scaling_normal_fan_in", "var_scaling_normal_fan_out",
+    "var_scaling_normal_fan_avg", "var_scaling_uniform_fan_in",
+    "var_scaling_uniform_fan_out", "var_scaling_uniform_fan_avg",
+]
+
+
+def distribution_sample(key, shape, dist, *, dtype=jnp.float32):
+    """Sample from a distribution config dict.
+
+    Mirrors nn/conf/distribution/*: ``{"type": "normal"|"gaussian",
+    "mean": m, "std": s}``, ``{"type": "uniform", "lower": a, "upper": b}``,
+    ``{"type": "binomial", "n": n, "p": p}``,
+    ``{"type": "truncated_normal", ...}``, ``{"type": "constant", ...}``,
+    ``{"type": "log_normal", ...}``, ``{"type": "orthogonal", "gain": g}``.
+    """
+    t = str(dist.get("type", "normal")).lower()
+    if t in ("normal", "gaussian"):
+        return (dist.get("mean", 0.0)
+                + dist.get("std", 1.0) * jax.random.normal(key, shape, dtype))
+    if t == "uniform":
+        return jax.random.uniform(key, shape, dtype,
+                                  dist.get("lower", 0.0),
+                                  dist.get("upper", 1.0))
+    if t == "binomial":
+        p = dist.get("p", 0.5)
+        n = int(dist.get("n", 1))
+        return jax.random.binomial(
+            key, n, p, shape=shape).astype(dtype)
+    if t == "truncated_normal":
+        std = dist.get("std", 1.0)
+        mean = dist.get("mean", 0.0)
+        return mean + std * jax.random.truncated_normal(key, -2.0, 2.0,
+                                                        shape, dtype)
+    if t == "constant":
+        return jnp.full(shape, dist.get("value", 0.0), dtype)
+    if t == "log_normal":
+        return jnp.exp(dist.get("mean", 0.0)
+                       + dist.get("std", 1.0)
+                       * jax.random.normal(key, shape, dtype))
+    if t == "orthogonal":
+        return _orthogonal(key, shape, dist.get("gain", 1.0), dtype)
+    raise ValueError(f"Unknown distribution type '{t}'")
+
+
+def _orthogonal(key, shape, gain, dtype):
+    n_rows = shape[0]
+    n_cols = 1
+    for d in shape[1:]:
+        n_cols *= d
+    flat = (max(n_rows, n_cols), min(n_rows, n_cols))
+    a = jax.random.normal(key, flat, jnp.float32)
+    q, r = jnp.linalg.qr(a)
+    q = q * jnp.sign(jnp.diagonal(r))
+    if n_rows < n_cols:
+        q = q.T
+    return (gain * q[:n_rows, :n_cols].reshape(shape)).astype(dtype)

@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.parallel.mesh import (
+    MeshSpec, build_mesh, device_count,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+__all__ = ["MeshSpec", "build_mesh", "device_count", "ParallelWrapper"]

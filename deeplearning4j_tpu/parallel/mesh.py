@@ -1,0 +1,74 @@
+"""Device-mesh abstraction — the substrate for every parallelism mode.
+
+Replaces the reference's device plumbing (ParallelWrapper's
+AffinityManager device picking, Spark executor topology, Aeron shard
+routing) with ONE concept: a named ``jax.sharding.Mesh`` over the
+device torus. Axes:
+
+- ``data``     — data parallelism (≈ ParallelWrapper / ParameterAveraging)
+- ``model``    — tensor parallelism (absent in the 2017 reference;
+                 required capability for the TPU rebuild, SURVEY §2.3)
+- ``pipe``     — pipeline stages
+- ``seq``      — sequence/context parallelism (ring attention)
+
+Collectives over these axes ride ICI within a slice and DCN across
+slices; XLA chooses the algorithms (the rebuild's answer to
+EncodedGradientsAccumulator/Aeron).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["MeshSpec", "build_mesh", "device_count", "data_sharding",
+           "replicated"]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape; -1 on one axis means 'all remaining devices'."""
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: Optional[int] = None) -> Tuple[int, ...]:
+        n = n_devices or device_count()
+        dims = [self.data, self.model, self.pipe, self.seq]
+        fixed = 1
+        for d in dims:
+            if d != -1:
+                fixed *= d
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed mesh "
+                             f"dims {dims}")
+        return tuple(n // fixed if d == -1 else d for d in dims)
+
+
+AXES = ("data", "model", "pipe", "seq")
+
+
+def build_mesh(spec: MeshSpec = MeshSpec(),
+               devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    shape = spec.resolve(len(devices))
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Batch-dim sharded over ('data','seq' collapsed? no — data only)."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

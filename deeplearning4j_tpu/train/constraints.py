@@ -1,0 +1,62 @@
+"""Parameter constraints — post-update projections.
+
+Mirrors nn/conf/constraint/*.java (MaxNormConstraint,
+MinMaxNormConstraint, NonNegativeConstraint, UnitNormConstraint),
+applied after each optimizer step (reference:
+StochasticGradientDescent.java:96 applyConstraints). Config form:
+``{"type": "max_norm", "max_norm": 2.0}`` etc.; constraints attach to a
+layer config's ``constraints`` tuple and are applied to its weight
+params ("W"-like keys, not biases, matching the reference default
+applyToWeights=true/applyToBiases=false).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["apply_constraint", "apply_layer_constraints"]
+
+_EPS = 1e-8
+
+
+def _norms(w, axis):
+    return jnp.sqrt(jnp.sum(w * w, axis=axis, keepdims=True))
+
+
+def apply_constraint(w, cfg: dict):
+    t = cfg["type"]
+    # norm over all axes but the last (output dim) — matches the
+    # reference's dimension convention for dense/conv weights
+    axis = tuple(range(w.ndim - 1)) or (0,)
+    if t == "max_norm":
+        n = _norms(w, axis)
+        target = jnp.minimum(n, cfg.get("max_norm", 2.0))
+        return w * target / (n + _EPS)
+    if t == "min_max_norm":
+        lo = cfg.get("min_norm", 0.0)
+        hi = cfg.get("max_norm", 2.0)
+        rate = cfg.get("rate", 1.0)
+        n = _norms(w, axis)
+        clipped = jnp.clip(n, lo, hi)
+        scaled = w * (rate * clipped / (n + _EPS) + (1 - rate))
+        return scaled
+    if t == "non_negative":
+        return jnp.maximum(w, 0.0)
+    if t == "unit_norm":
+        return w / (_norms(w, axis) + _EPS)
+    raise ValueError(f"Unknown constraint type '{t}'")
+
+
+def apply_layer_constraints(layer_cfg, layer_params: dict) -> dict:
+    if not getattr(layer_cfg, "constraints", None):
+        return layer_params
+    out = dict(layer_params)
+    for cfg in layer_cfg.constraints:
+        apply_b = cfg.get("apply_to_biases", False)
+        apply_w = cfg.get("apply_to_weights", True)
+        for k, v in out.items():
+            is_bias = k in ("b", "vb", "beta")
+            if (is_bias and apply_b) or (not is_bias and apply_w
+                                         and v.ndim >= 2):
+                out[k] = apply_constraint(v, cfg)
+    return out

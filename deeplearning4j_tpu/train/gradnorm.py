@@ -1,0 +1,77 @@
+"""Per-layer gradient normalization.
+
+Mirrors the reference's GradientNormalization enum (applied in
+BaseLayer.update via the updater chain): RenormalizeL2PerLayer,
+RenormalizeL2PerParamType, ClipElementWiseAbsoluteValue,
+ClipL2PerLayer, ClipL2PerParamType. Applied to the raw gradients
+inside the jitted train step, before the optax update — matching where
+the reference applies it (pre-updater).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["normalize_layer_gradients"]
+
+_EPS = 1e-8
+
+
+def _global_norm(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(l * l) for l in leaves) + _EPS)
+
+
+def normalize_layer_gradients(grads, kind: str, threshold: float):
+    """grads: one layer's param dict. Returns transformed dict."""
+    k = (kind or "").lower()
+    if not k or k == "none":
+        return grads
+    if k == "renormalize_l2_per_layer":
+        n = _global_norm(grads)
+        return jax.tree_util.tree_map(lambda g: g / n, grads)
+    if k == "renormalize_l2_per_param_type":
+        return {key: g / (jnp.sqrt(jnp.sum(g * g)) + _EPS)
+                for key, g in grads.items()}
+    if k == "clip_element_wise_absolute_value":
+        t = threshold
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -t, t), grads)
+    if k == "clip_l2_per_layer":
+        n = _global_norm(grads)
+        scale = jnp.minimum(1.0, threshold / n)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if k == "clip_l2_per_param_type":
+        out = {}
+        for key, g in grads.items():
+            n = jnp.sqrt(jnp.sum(g * g)) + _EPS
+            out[key] = g * jnp.minimum(1.0, threshold / n)
+        return out
+    raise ValueError(f"Unknown gradient normalization '{kind}'")
+
+
+def apply_gradient_normalization(layers, grads):
+    """Apply each layer's configured normalization to its grad subtree.
+    ``layers``: layer configs (list or dict of name->config);
+    ``grads``: matching pytree of per-layer param dicts."""
+    if isinstance(grads, dict) and not isinstance(layers, list):
+        out = {}
+        for name, g in grads.items():
+            cfg = layers[name]
+            kind = getattr(cfg, "gradient_normalization", None)
+            if kind:
+                g = normalize_layer_gradients(
+                    g, kind,
+                    getattr(cfg, "gradient_normalization_threshold", 1.0))
+            out[name] = g
+        return out
+    out = []
+    for cfg, g in zip(layers, grads):
+        kind = getattr(cfg, "gradient_normalization", None)
+        if kind:
+            g = normalize_layer_gradients(
+                g, kind,
+                getattr(cfg, "gradient_normalization_threshold", 1.0))
+        out.append(g)
+    return out

@@ -1,0 +1,23 @@
+import os
+
+# Tests run on a virtual 8-device CPU mesh: sharding/collective tests
+# exercise real multi-device code paths without TPU hardware. The env
+# may pin JAX_PLATFORMS to a hardware plugin (e.g. 'axon'), so force
+# cpu via the config API as well as the env var.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

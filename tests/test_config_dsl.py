@@ -1,0 +1,150 @@
+"""Config DSL: builder, shape inference, JSON/YAML round-trip.
+
+Models the reference's config serde tests (nn/conf round-trip and
+regression tests, SURVEY.md §4.3).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerConfiguration,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, DropoutLayer,
+    GlobalPoolingLayer, LSTM, OutputLayer, RnnOutputLayer,
+    SubsamplingLayer,
+)
+
+
+def lenet_conf():
+    return (NeuralNetConfiguration.builder()
+            .set_seed(12345)
+            .updater(updaters.adam(1e-3))
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+
+
+class TestShapeInference:
+    def test_lenet_shapes(self):
+        conf = lenet_conf()
+        # conv(5x5) on 28x28 -> 24x24x20; pool -> 12x12x20;
+        # conv -> 8x8x50; pool -> 4x4x50; dense nIn = 800
+        assert conf.layers[4].n_in == 4 * 4 * 50
+        assert conf.layers[5].n_in == 500
+        out = conf.output_type()
+        assert out.kind == "ff" and out.size == 10
+
+    def test_preprocessors_inserted(self):
+        conf = lenet_conf()
+        # flat input -> cnn for layer 0; cnn -> ff for the dense layer
+        assert 0 in conf.preprocessors
+        assert 4 in conf.preprocessors
+
+    def test_rnn_shapes(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(LSTM(n_out=16))
+                .layer(RnnOutputLayer(n_out=4, loss="mcxent"))
+                .set_input_type(InputType.recurrent(8, 20))
+                .build())
+        assert conf.layers[0].n_in == 8
+        assert conf.layers[1].n_in == 16
+        out = conf.output_type()
+        assert out.kind == "rnn" and out.size == 4
+
+
+class TestSerde:
+    def test_json_round_trip(self):
+        conf = lenet_conf()
+        j = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(j)
+        assert conf2.to_json() == j
+        assert len(conf2.layers) == len(conf.layers)
+        assert conf2.layers[0].kernel == (5, 5)
+        assert conf2.layers[4].n_in == 800
+        assert conf2.conf.updater_cfg["type"] == "adam"
+
+    def test_yaml_round_trip(self):
+        conf = lenet_conf()
+        y = conf.to_yaml()
+        conf2 = MultiLayerConfiguration.from_yaml(y)
+        assert conf2.to_json() == conf.to_json()
+
+    def test_unknown_layer_type_raises(self):
+        d = lenet_conf().to_dict()
+        d["layers"][0]["@type"] = "NoSuchLayer"
+        with pytest.raises(ValueError, match="NoSuchLayer"):
+            MultiLayerConfiguration.from_dict(d)
+
+    def test_newer_format_version_rejected(self):
+        d = lenet_conf().to_dict()
+        d["format_version"] = 999
+        with pytest.raises(ValueError, match="newer"):
+            MultiLayerConfiguration.from_dict(d)
+
+    def test_global_defaults_stamped(self):
+        conf = (NeuralNetConfiguration.builder()
+                .weight_init("relu")
+                .activation("tanh")
+                .l2(1e-4)
+                .list()
+                .layer(DenseLayer(n_out=4))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        assert conf.layers[0].weight_init == "relu"
+        assert conf.layers[0].activation == "tanh"
+        assert conf.layers[0].l2 == 1e-4
+        # OutputLayer declares softmax explicitly; default must not
+        # override a non-default layer value
+        assert conf.layers[1].activation == "softmax"
+
+
+class TestGraphConfig:
+    def test_graph_round_trip_and_topo(self):
+        from deeplearning4j_tpu.nn.conf.graph import (ElementWiseVertex,
+                                                      MergeVertex)
+        from deeplearning4j_tpu import ComputationGraphConfiguration
+        g = (NeuralNetConfiguration.builder()
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("a", DenseLayer(n_out=8, activation="relu"), "in")
+             .add_layer("b", DenseLayer(n_out=8, activation="relu"), "in")
+             .add_vertex("sum", ElementWiseVertex(op="add"), "a", "b")
+             .add_vertex("cat", MergeVertex(), "a", "sum")
+             .add_layer("out", OutputLayer(n_out=3), "cat")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(5))
+             .build())
+        order = g.topological_order()
+        assert order.index("a") < order.index("sum")
+        assert order.index("b") < order.index("sum")
+        assert order.index("sum") < order.index("cat")
+        assert g.vertices["out"][0].n_in == 16
+        j = g.to_json()
+        g2 = ComputationGraphConfiguration.from_json(j)
+        assert g2.to_json() == j
+
+    def test_cycle_detection(self):
+        from deeplearning4j_tpu import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+        conf = NeuralNetConfiguration()
+        with pytest.raises(ValueError, match="cycle"):
+            ComputationGraphConfiguration(
+                conf, ["in"],
+                {"a": (DenseLayer(n_in=2, n_out=2), ["b"]),
+                 "b": (DenseLayer(n_in=2, n_out=2), ["a"])},
+                ["a"]).topological_order()

@@ -1,0 +1,103 @@
+"""End-to-end slice: LeNet on MNIST (SURVEY.md §7 Stage 2 deliverable).
+
+iterator → jitted train_step (fwd + grad + Adam) → eval accuracy,
+checkpoint → reload → resume. The TPU rewrite of the reference's
+MultiLayerNetwork.fit stack (SURVEY.md §3.1).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.data.fetchers import (MnistDataSetIterator,
+                                              iris_data)
+from deeplearning4j_tpu.data.iterators import AsyncDataSetIterator
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.train.listeners import (CollectScoresIterationListener,
+                                                PerformanceListener)
+from deeplearning4j_tpu.util.model_serializer import (restore_model,
+                                                      write_model)
+
+
+def lenet():
+    conf = (NeuralNetConfiguration.builder()
+            .set_seed(12345)
+            .updater(updaters.adam(3e-3))
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=16, kernel=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestLeNetMnist:
+    def test_train_eval_checkpoint_resume(self, tmp_path):
+        train_it = AsyncDataSetIterator(
+            MnistDataSetIterator(128, train=True, n=2048))
+        test_it = MnistDataSetIterator(256, train=False, n=512,
+                                       shuffle=False)
+
+        net = lenet()
+        scores = CollectScoresIterationListener()
+        perf = PerformanceListener(frequency=10, report=False)
+        net.set_listeners(scores, perf)
+
+        net.fit(train_it, epochs=6)
+
+        # loss went down
+        first = scores.scores[0][1]
+        last = scores.scores[-1][1]
+        assert last < first * 0.5, (first, last)
+
+        ev = net.evaluate(test_it)
+        acc = ev.accuracy()
+        assert acc > 0.9, ev.stats()
+
+        # checkpoint → reload → identical predictions
+        path = os.path.join(tmp_path, "lenet.zip")
+        write_model(net, path)
+        net2 = restore_model(path)
+        x, _ = next(iter(test_it))._arrays()[:2]
+        np.testing.assert_allclose(np.asarray(net.output(x[:16])),
+                                   np.asarray(net2.output(x[:16])),
+                                   rtol=1e-5, atol=1e-5)
+        assert net2.iteration_count == net.iteration_count
+
+        # resume training continues improving (or at least runs)
+        before = net2.iteration_count
+        net2.fit(MnistDataSetIterator(128, train=True, n=512), epochs=1)
+        assert net2.iteration_count > before
+        assert net2.evaluate(test_it).accuracy() > 0.85
+
+
+class TestIrisMlp:
+    def test_mlp_iris(self):
+        xs, ys = iris_data()
+        conf = (NeuralNetConfiguration.builder()
+                .set_seed(42)
+                .updater(updaters.adam(0.02))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(xs[:120], ys[:120], epochs=60, batch_size=32)
+        ev = net.evaluate(xs[120:], ys[120:])
+        assert ev.accuracy() > 0.85, ev.stats()
+        # score API
+        from deeplearning4j_tpu.data.dataset import DataSet
+        s = net.score(DataSet(xs[120:], ys[120:]))
+        assert np.isfinite(s)

@@ -1,0 +1,217 @@
+"""Training plumbing: tBPTT state carry, gradient normalization,
+per-layer updaters, masking, constraints, reproducibility."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import (ComputationGraph, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.fetchers import (iris_data,
+                                              synthetic_sequences)
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, DropoutLayer,
+                                               LSTM, OutputLayer,
+                                               RnnOutputLayer)
+
+
+class TestTbptt:
+    def test_tbptt_carries_state_across_chunks(self):
+        """A memory task only solvable with cross-chunk state: the label
+        depends on the FIRST timestep; tBPTT chunks of 5 over T=20 can
+        only solve it if hidden state carries across chunks."""
+        rng = np.random.default_rng(0)
+        n, t = 512, 20
+        first = rng.integers(0, 2, n)
+        xs = rng.normal(0, 0.1, (n, t, 2)).astype(np.float32)
+        xs[:, 0, 0] = first * 2.0 - 1.0         # signal only at t=0
+        ys = np.zeros((n, t, 2), np.float32)
+        ys[np.arange(n), :, :] = np.eye(2, dtype=np.float32)[first][:, None]
+
+        conf = (NeuralNetConfiguration.builder()
+                .set_seed(0)
+                .updater(updaters.adam(0.01))
+                .backprop_type("tbptt", fwd_length=5, bwd_length=5)
+                .list()
+                .layer(LSTM(n_out=12))
+                .layer(RnnOutputLayer(n_out=2, loss="mcxent"))
+                .set_input_type(InputType.recurrent(2, t))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(xs, ys, epochs=10, batch_size=128)
+        # accuracy on the LAST timestep (requires memory of t=0 across
+        # 4 chunk boundaries)
+        preds = np.asarray(net.output(xs[:256]))[:, -1, :]
+        acc = (preds.argmax(1) == first[:256]).mean()
+        assert acc > 0.9, acc
+
+    def test_tbptt_iteration_count(self):
+        xs, ys = synthetic_sequences(64, 20, 4, 3)
+        ys_seq = ys[:, None, :].repeat(20, 1)
+        conf = (NeuralNetConfiguration.builder()
+                .updater(updaters.adam(0.01))
+                .backprop_type("tbptt", fwd_length=8, bwd_length=8)
+                .list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=3))
+                .set_input_type(InputType.recurrent(4, 20))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(xs, ys_seq, epochs=1, batch_size=64)
+        # 20 steps / fwd 8 → 3 chunks = 3 iterations
+        assert net.iteration_count == 3
+
+
+class TestGradientNormalization:
+    def test_clip_l2_per_layer_bounds_update(self):
+        xs, ys = iris_data()
+        conf = (NeuralNetConfiguration.builder()
+                .updater(updaters.sgd(1.0))     # huge lr
+                .gradient_normalization("clip_l2_per_layer", 1e-4)
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        before = net.params_flat()
+        net.fit(xs[:32], ys[:32], epochs=1, batch_size=32)
+        delta = np.abs(net.params_flat() - before).max()
+        # grad norm clipped to 1e-4, lr=1 → tiny updates
+        assert delta < 1e-3, delta
+
+    def test_unknown_kind_raises(self):
+        from deeplearning4j_tpu.train.gradnorm import (
+            normalize_layer_gradients)
+        import jax.numpy as jnp
+        with pytest.raises(ValueError):
+            normalize_layer_gradients({"W": jnp.ones((2, 2))}, "bogus", 1.0)
+
+
+class TestPerLayerUpdaters:
+    def test_mln_frozen_lr_layer(self):
+        xs, ys = iris_data()
+        conf = (NeuralNetConfiguration.builder()
+                .updater(updaters.adam(0.05))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu",
+                                  updater=updaters.sgd(0.0)))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(net.params[0]["W"]).copy()
+        net.fit(xs[:64], ys[:64], epochs=3, batch_size=32)
+        np.testing.assert_allclose(np.asarray(net.params[0]["W"]), w0)
+        # output layer did move
+        assert np.abs(np.asarray(net.params[1]["W"])).sum() > 0
+
+    def test_graph_frozen_lr_layer(self):
+        xs, ys = iris_data()
+        g = (NeuralNetConfiguration.builder()
+             .updater(updaters.adam(0.05))
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("d", DenseLayer(n_out=8, activation="relu",
+                                        updater=updaters.sgd(0.0)), "in")
+             .add_layer("out", OutputLayer(n_out=3), "d")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(4))
+             .build())
+        cg = ComputationGraph(g).init()
+        w0 = np.asarray(cg.params["d"]["W"]).copy()
+        cg.fit(DataSet(xs[:64], ys[:64]), epochs=3)
+        np.testing.assert_allclose(np.asarray(cg.params["d"]["W"]), w0)
+
+
+class TestReproducibility:
+    def test_graph_dropout_deterministic_given_seed(self):
+        xs, ys = iris_data()
+
+        def run():
+            g = (NeuralNetConfiguration.builder()
+                 .set_seed(99)
+                 .updater(updaters.adam(0.01))
+                 .graph_builder()
+                 .add_inputs("in")
+                 .add_layer("d", DenseLayer(n_out=16, activation="relu",
+                                            dropout=0.5), "in")
+                 .add_layer("out", OutputLayer(n_out=3), "d")
+                 .set_outputs("out")
+                 .set_input_types(InputType.feed_forward(4))
+                 .build())
+            cg = ComputationGraph(g).init()
+            cg.fit(DataSet(xs[:64], ys[:64]), epochs=3)
+            return np.asarray(cg.params["d"]["W"])
+
+        np.testing.assert_allclose(run(), run())
+
+    def test_mln_training_deterministic_given_seed(self):
+        xs, ys = iris_data()
+
+        def run():
+            conf = (NeuralNetConfiguration.builder()
+                    .set_seed(7).updater(updaters.adam(0.01))
+                    .list()
+                    .layer(DenseLayer(n_out=8, activation="relu",
+                                      dropout=0.3))
+                    .layer(OutputLayer(n_out=3))
+                    .set_input_type(InputType.feed_forward(4))
+                    .build())
+            net = MultiLayerNetwork(conf).init()
+            net.fit(xs[:64], ys[:64], epochs=2, batch_size=32)
+            return net.params_flat()
+
+        np.testing.assert_allclose(run(), run())
+
+
+class TestConstraints:
+    def test_max_norm_constraint_applied(self):
+        xs, ys = iris_data()
+        conf = (NeuralNetConfiguration.builder()
+                .updater(updaters.sgd(0.5))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu",
+                                  constraints=({"type": "max_norm",
+                                                "max_norm": 0.5},)))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(xs[:64], ys[:64], epochs=5, batch_size=32)
+        w = np.asarray(net.params[0]["W"])
+        norms = np.sqrt((w ** 2).sum(axis=0))
+        assert (norms <= 0.5 + 1e-5).all(), norms
+
+
+class TestMasking:
+    def test_masked_rnn_loss_ignores_padded_steps(self):
+        xs, ys = synthetic_sequences(32, 10, 4, 3)
+        ys_seq = ys[:, None, :].repeat(10, 1)
+        conf = (NeuralNetConfiguration.builder()
+                .updater(updaters.adam(0.01)).list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=3))
+                .set_input_type(InputType.recurrent(4, 10))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        # full mask vs zero-padded tail with mask: padded version's score
+        # must equal the truncated version's score on the valid prefix
+        mask = np.ones((32, 10), np.float32)
+        mask[:, 6:] = 0.0
+        xs_pad = xs.copy()
+        xs_pad[:, 6:] = 0.0
+        s_masked = net.score(DataSet(xs_pad, ys_seq, labels_mask=mask,
+                                     features_mask=mask))
+        # corrupt the padded region — masked score must not change
+        xs_garbage = xs_pad.copy()
+        xs_garbage[:, 6:] = 99.0
+        ys_garbage = ys_seq.copy()
+        ys_garbage[:, 6:] = 5.0
+        s_garbage = net.score(DataSet(xs_garbage, ys_garbage,
+                                      labels_mask=mask,
+                                      features_mask=mask))
+        np.testing.assert_allclose(s_masked, s_garbage, rtol=1e-5)
