@@ -1,102 +1,149 @@
 """Benchmark harness — prints ONE JSON line with the headline metric.
 
-Current headline: LeNet-MNIST training throughput (images/sec) on the
-available chip(s), against the BASELINE.md LeNet config. Will move to
-ResNet50/ImageNet images/sec/chip as the zoo fills out (BASELINE.json
-north star). ``vs_baseline`` compares against a same-process JAX/Flax
-reference implementation of the identical model/step, so the number is
-hardware-independent (1.0 = parity with hand-written flax)."""
+Headline (BASELINE.json north star): ResNet50 training throughput,
+images/sec/chip, vs a hand-written JAX/Flax ResNet50 train step run in
+the same process on the same chip (``vs_baseline`` = ours/flax; 1.0 =
+parity with idiomatic flax, the reference implementation the target is
+defined against).
+
+Extra metrics (LeNet throughput) print to stderr for debugging; stdout
+stays one JSON line for the driver.
+"""
 
 import json
+import sys
 import time
 
 import numpy as np
 
+BATCH = 128
+IMG = 224
+STEPS = 20
+WARMUP = 3
 
-def _bench_net(steps: int = 60, batch: int = 256, warmup: int = 5):
+
+def _time_steps(step_fn, args, steps, warmup, get_loss):
     import jax
-    from __graft_entry__ import _lenet
-    from deeplearning4j_tpu.data.dataset import DataSet
-
-    net, _ = _lenet()
-    rng = np.random.default_rng(0)
-    x = rng.normal(0, 1, (batch, 784)).astype("float32")
-    y = np.eye(10, dtype="float32")[rng.integers(0, 10, batch)]
-    ds = DataSet(x, y)
-
-    step_fn = net._make_train_step()
-    batch_t = net._batch_tuple(ds)
-    params, state, opt = net.params, net.state, net.opt_state
-    key = jax.random.PRNGKey(0)
-    for i in range(warmup):
-        params, state, opt, loss = step_fn(params, state, opt, batch_t,
-                                           key, np.int32(i))
-    jax.block_until_ready(loss)
+    for _ in range(warmup):
+        args = step_fn(*args)
+    jax.block_until_ready(get_loss(args))
     t0 = time.perf_counter()
-    for i in range(steps):
-        params, state, opt, loss = step_fn(params, state, opt, batch_t,
-                                           key, np.int32(i))
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    for _ in range(steps):
+        args = step_fn(*args)
+    jax.block_until_ready(get_loss(args))
+    return time.perf_counter() - t0
+
+
+def bench_ours(batch=BATCH, img=IMG, steps=STEPS):
+    import jax
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    net = ResNet50(n_classes=1000, input_shape=(img, img, 3),
+                   updater=updaters.nesterovs(0.1, 0.9)).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (batch, img, img, 3)).astype("float32")
+    y = np.eye(1000, dtype="float32")[rng.integers(0, 1000, batch)]
+    batch_t = net._batch_tuple(net._as_multi(DataSet(x, y)))
+    step = net._make_train_step()
+    key = jax.random.PRNGKey(0)
+    it = np.int32(0)
+
+    def one(params, state, opt, loss):
+        return step(params, state, opt, batch_t, key, it)
+
+    dt = _time_steps(one, (net.params, net.state, net.opt_state, None),
+                     steps, WARMUP, lambda a: a[3])
     return steps * batch / dt
 
 
-def _bench_flax_reference(steps: int = 60, batch: int = 256,
-                          warmup: int = 5):
-    """Same LeNet, hand-written in flax/optax — the perf reference."""
+def bench_flax_resnet50(batch=BATCH, img=IMG, steps=STEPS):
     import jax
     import jax.numpy as jnp
     import optax
     from flax import linen as nn
 
-    class LeNet(nn.Module):
+    class Bottleneck(nn.Module):
+        mid: int
+        out: int
+        stride: int = 1
+        project: bool = False
+
         @nn.compact
-        def __call__(self, x):
-            x = x.reshape(x.shape[0], 28, 28, 1)
-            x = nn.relu(nn.Conv(20, (5, 5), padding="VALID")(x))
-            x = nn.max_pool(x, (2, 2), (2, 2))
-            x = nn.relu(nn.Conv(50, (5, 5), padding="VALID")(x))
-            x = nn.max_pool(x, (2, 2), (2, 2))
-            x = x.reshape(x.shape[0], -1)
-            x = nn.relu(nn.Dense(500)(x))
-            return nn.Dense(10)(x)
+        def __call__(self, x, train=True):
+            r = x
+            y = nn.Conv(self.mid, (1, 1), (self.stride, self.stride),
+                        use_bias=False)(x)
+            y = nn.relu(nn.BatchNorm(use_running_average=not train)(y))
+            y = nn.Conv(self.mid, (3, 3), padding="SAME",
+                        use_bias=False)(y)
+            y = nn.relu(nn.BatchNorm(use_running_average=not train)(y))
+            y = nn.Conv(self.out, (1, 1), use_bias=False)(y)
+            y = nn.BatchNorm(use_running_average=not train)(y)
+            if self.project:
+                r = nn.Conv(self.out, (1, 1), (self.stride, self.stride),
+                            use_bias=False)(x)
+                r = nn.BatchNorm(use_running_average=not train)(r)
+            return nn.relu(y + r)
+
+    class ResNet50F(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(64, (7, 7), (2, 2), padding="SAME",
+                        use_bias=False)(x)
+            x = nn.relu(nn.BatchNorm(use_running_average=not train)(x))
+            x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+            for blocks, mid, out, stride in ((3, 64, 256, 1),
+                                             (4, 128, 512, 2),
+                                             (6, 256, 1024, 2),
+                                             (3, 512, 2048, 2)):
+                for b in range(blocks):
+                    x = Bottleneck(mid, out,
+                                   stride if b == 0 else 1,
+                                   project=(b == 0))(x, train)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(1000)(x)
 
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(0, 1, (batch, 784)).astype("float32"))
-    y = jnp.asarray(np.eye(10, dtype="float32")[
-        rng.integers(0, 10, batch)])
-    model = LeNet()
-    params = model.init(jax.random.PRNGKey(0), x)
-    tx = optax.adam(1e-3)
+    x = jnp.asarray(rng.normal(0, 1, (batch, img, img, 3))
+                    .astype("float32"))
+    y = jnp.asarray(np.eye(1000, dtype="float32")[
+        rng.integers(0, 1000, batch)])
+    model = ResNet50F()
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    params = variables["params"]
+    batch_stats = variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
     opt = tx.init(params)
 
     @jax.jit
-    def step(params, opt, x, y):
+    def step(params, batch_stats, opt, loss_prev):
         def loss_fn(p):
-            logits = model.apply(p, x)
-            return optax.softmax_cross_entropy(logits, y).mean()
-        loss, g = jax.value_and_grad(loss_fn)(params)
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            return optax.softmax_cross_entropy(logits, y).mean(), upd
+        (loss, upd), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
         u, opt2 = tx.update(g, opt, params)
-        return optax.apply_updates(params, u), opt2, loss
+        return optax.apply_updates(params, u), upd["batch_stats"], opt2, \
+            loss
 
-    for _ in range(warmup):
-        params, opt, loss = step(params, opt, x, y)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt, loss = step(params, opt, x, y)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    dt = _time_steps(lambda *a: step(*a),
+                     (params, batch_stats, opt, None), steps, WARMUP,
+                     lambda a: a[3])
     return steps * batch / dt
 
 
 def main():
-    ours = _bench_net()
-    ref = _bench_flax_reference()
+    ours = bench_ours()
+    print(f"ours: {ours:.1f} img/s", file=sys.stderr)
+    ref = bench_flax_resnet50()
+    print(f"flax ref: {ref:.1f} img/s", file=sys.stderr)
     print(json.dumps({
-        "metric": "LeNet-MNIST train throughput",
+        "metric": "ResNet50 train throughput (batch 128, 224x224, f32)",
         "value": round(ours, 1),
-        "unit": "images/sec",
+        "unit": "images/sec/chip",
         "vs_baseline": round(ours / ref, 3),
     }))
 

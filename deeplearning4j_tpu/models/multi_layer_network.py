@@ -514,8 +514,9 @@ class MultiLayerNetwork:
         m = MultiLayerNetwork(self.conf.clone())
         if self.params is not None:
             m.init()
-            m.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            m.state = jax.tree_util.tree_map(lambda a: a, self.state)
+            from deeplearning4j_tpu.util.tree import tree_copy
+            m.params = tree_copy(self.params)
+            m.state = tree_copy(self.state)
         return m
 
     def summary(self) -> str:

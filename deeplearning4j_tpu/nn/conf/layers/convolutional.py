@@ -189,12 +189,21 @@ class Deconvolution2DLayer(ConvolutionLayer):
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         x = self.apply_input_dropout(x, training=training, rng=rng)
-        pad = ("SAME" if self.convolution_mode == "same"
-               else [(p, p) for p in self.padding])
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            # forward-conv-equivalent semantics: out = s*(in-1)+k-2p,
+            # i.e. VALID transpose cropped by p per side (explicit pad
+            # lists mean something else to lax.conv_transpose)
+            pad = "VALID"
         y = lax.conv_transpose(
             x, params["W"], strides=self.stride, padding=pad,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             transpose_kernel=True)
+        if self.convolution_mode != "same" and any(self.padding):
+            ph, pw = self.padding
+            h, w = y.shape[1], y.shape[2]
+            y = y[:, ph:h - ph or None, pw:w - pw or None, :]
         if self.has_bias:
             y = y + params["b"]
         return self.activation_fn()(y), state

@@ -133,6 +133,9 @@ class LossLayer(OutputLayer):
         self.n_out = self.n_in
         return {}, {}
 
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
     def _pre_output(self, params, x, *, training, rng):
         return self.apply_input_dropout(x, training=training, rng=rng)
 

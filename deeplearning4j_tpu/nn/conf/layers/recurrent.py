@@ -52,8 +52,10 @@ class BaseRecurrentLayer(FeedForwardLayer):
         if self.n_in is None:
             self.n_in = input_type.size
 
-    def zero_state(self, batch: int):
-        z = jnp.zeros((batch, self.n_out), jnp.float32)
+    def zero_state(self, batch: int, dtype=None):
+        from deeplearning4j_tpu import dtypes as dtypes_mod
+        z = jnp.zeros((batch, self.n_out),
+                      dtype or dtypes_mod.policy().param_dtype)
         return (z, z)
 
     def apply_rnn(self, params, x, carry, *, training=False, rng=None,
@@ -62,7 +64,8 @@ class BaseRecurrentLayer(FeedForwardLayer):
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         x = self.apply_input_dropout(x, training=training, rng=rng)
-        out, _ = self.apply_rnn(params, x, self.zero_state(x.shape[0]),
+        out, _ = self.apply_rnn(params, x,
+                                self.zero_state(x.shape[0], x.dtype),
                                 training=training, rng=rng, mask=mask)
         return out, state
 

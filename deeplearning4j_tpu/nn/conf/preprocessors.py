@@ -150,7 +150,9 @@ def auto_preprocessor(have: InputType, layer) -> Optional[InputPreProcessor]:
     next layer, mirroring InputType.getPreProcessorForInputType +
     InputTypeUtil auto-insertion in MultiLayerConfiguration.Builder."""
     from deeplearning4j_tpu.nn.conf.layers.convolutional import (
-        ConvolutionLayer, Convolution1DLayer)
+        ConvolutionLayer, Convolution1DLayer, ZeroPaddingLayer,
+        UpsamplingLayer, CroppingLayer, SpaceToDepthLayer,
+        SpaceToBatchLayer)
     from deeplearning4j_tpu.nn.conf.layers.pooling import (
         SubsamplingLayer, Subsampling1DLayer, GlobalPoolingLayer)
     from deeplearning4j_tpu.nn.conf.layers.recurrent import (
@@ -158,9 +160,14 @@ def auto_preprocessor(have: InputType, layer) -> Optional[InputPreProcessor]:
     from deeplearning4j_tpu.nn.conf.layers.normalization import (
         BatchNormalization, LocalResponseNormalization)
     from deeplearning4j_tpu.nn.conf.layers.output import RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf.layers.special import Yolo2OutputLayer
 
     wants_cnn = isinstance(layer, (ConvolutionLayer, SubsamplingLayer,
-                                   LocalResponseNormalization)) and not \
+                                   LocalResponseNormalization,
+                                   ZeroPaddingLayer, UpsamplingLayer,
+                                   CroppingLayer, SpaceToDepthLayer,
+                                   SpaceToBatchLayer,
+                                   Yolo2OutputLayer)) and not \
         isinstance(layer, (Convolution1DLayer, Subsampling1DLayer))
     wants_rnn = isinstance(layer, (BaseRecurrentLayer, Bidirectional,
                                    LastTimeStep, RnnOutputLayer,

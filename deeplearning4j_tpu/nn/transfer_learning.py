@@ -1,0 +1,160 @@
+"""Transfer learning: network surgery on trained models.
+
+Mirrors nn/transferlearning/TransferLearning.java: freeze layers below
+a boundary (``set_feature_extractor``, reference :84 — wraps them in
+FrozenLayer), replace a layer's n_out with re-initialized weights
+(``n_out_replace``, :98), remove/add output layers, and apply a
+``FineTuneConfiguration`` (new global updater/lr for the unfrozen part).
+
+Works on MultiLayerNetwork; graph surgery (TransferLearning.GraphBuilder)
+operates on ComputationGraph by vertex name.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.nn.conf.layers.special import FrozenLayer
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["TransferLearning", "FineTuneConfiguration"]
+
+
+class FineTuneConfiguration:
+    """(nn/transferlearning/FineTuneConfiguration.java): overrides
+    applied to the *unfrozen* part of the network."""
+
+    def __init__(self, updater: Optional[dict] = None,
+                 seed: Optional[int] = None,
+                 dropout: Optional[float] = None):
+        self.updater = updater
+        self.seed = seed
+        self.dropout = dropout
+
+
+class TransferLearning:
+    """Builder (nn/transferlearning/TransferLearning.java Builder)."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        if net.params is None:
+            raise ValueError("Transfer learning requires an initialized net")
+        self._src = net
+        self._freeze_until: Optional[int] = None
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._nout_replacements = {}       # idx -> (n_out, weight_init)
+        self._remove_last = 0
+        self._appended: List[Layer] = []
+
+    @staticmethod
+    def builder(net: MultiLayerNetwork) -> "TransferLearning":
+        return TransferLearning(net)
+
+    def fine_tune_configuration(self, cfg: FineTuneConfiguration):
+        self._fine_tune = cfg
+        return self
+
+    def set_feature_extractor(self, layer_idx: int):
+        """Freeze layers [0..layer_idx] (reference :84)."""
+        self._freeze_until = layer_idx
+        return self
+
+    def n_out_replace(self, layer_idx: int, n_out: int,
+                      weight_init: str = "xavier"):
+        self._nout_replacements[layer_idx] = (n_out, weight_init)
+        return self
+
+    def remove_output_layer(self):
+        self._remove_last += 1
+        return self
+
+    def remove_layers_from_output(self, n: int):
+        self._remove_last += n
+        return self
+
+    def add_layer(self, layer: Layer):
+        self._appended.append(layer)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        src = self._src
+        conf_dict = src.conf.to_dict()
+        new_conf = MultiLayerConfiguration.from_dict(conf_dict)
+        layers = new_conf.layers
+        from deeplearning4j_tpu.util.tree import tree_copy
+        params = tree_copy(src.params)
+        states = tree_copy(src.state)
+
+        # 1. remove output layers
+        for _ in range(self._remove_last):
+            layers.pop()
+            params.pop()
+            states.pop()
+            new_conf.preprocessors.pop(len(layers), None)
+
+        # 2. append new layers (shapes inferred below at init of new ones)
+        layers.extend(self._appended)
+
+        # 3. apply fine-tune overrides
+        if self._fine_tune is not None:
+            if self._fine_tune.updater is not None:
+                new_conf.conf.updater_cfg = self._fine_tune.updater
+            if self._fine_tune.seed is not None:
+                new_conf.conf.seed = self._fine_tune.seed
+
+        # 4. wrap frozen layers
+        if self._freeze_until is not None:
+            for i in range(self._freeze_until + 1):
+                if not isinstance(layers[i], FrozenLayer):
+                    layers[i] = FrozenLayer(inner=layers[i])
+
+        # 5. rebuild net; re-init then copy/transplant params
+        net = MultiLayerNetwork(new_conf)
+        net.init(new_conf.conf.seed)
+        n_copied = len(params)
+        for i in range(len(layers)):
+            if i in self._nout_replacements:
+                continue                  # keep fresh init
+            if i < n_copied:
+                net.params[i] = params[i]
+                net.state[i] = states[i]
+
+        # 6. n_out replacement: re-init that layer AND the next (its
+        #    n_in changed), reference nOutReplace semantics
+        if self._nout_replacements:
+            t = new_conf.input_type
+            key = jax.random.PRNGKey(new_conf.conf.seed or 0)
+            for idx, (n_out, w_init) in self._nout_replacements.items():
+                lay = layers[idx]
+                target = lay.wrapped if isinstance(lay, FrozenLayer) else lay
+                target.n_out = n_out
+                target.weight_init = w_init
+            # recompute shapes & re-init affected layers
+            t = new_conf.input_type
+            for i, lay in enumerate(layers):
+                if t is not None and i in new_conf.preprocessors:
+                    t = new_conf.preprocessors[i].output_type(t)
+                affected = (i in self._nout_replacements
+                            or (i - 1) in self._nout_replacements)
+                if affected:
+                    target = lay.wrapped if isinstance(lay, FrozenLayer) \
+                        else lay
+                    if hasattr(target, "n_in"):
+                        target.n_in = None
+                    p, s = lay.initialize(jax.random.fold_in(key, i), t)
+                    net.params[i] = p
+                    net.state[i] = s
+                elif t is not None:
+                    lay.set_n_in(t)
+                t = lay.output_type(t) if t is not None else None
+
+        net._build_optimizer()
+        return net

@@ -1,6 +1,8 @@
 from deeplearning4j_tpu.parallel.mesh import (
     MeshSpec, build_mesh, device_count,
 )
-from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.wrapper import (ParallelWrapper,
+                                                 GraphParallelWrapper)
 
-__all__ = ["MeshSpec", "build_mesh", "device_count", "ParallelWrapper"]
+__all__ = ["MeshSpec", "build_mesh", "device_count", "ParallelWrapper",
+           "GraphParallelWrapper"]

@@ -1,0 +1,154 @@
+"""ParallelInference: high-throughput serving with dynamic batching.
+
+Mirrors deeplearning4j-scaleout-parallelwrapper's ``ParallelInference``
+(ParallelInference.java:32) and its observables
+(BatchedInferenceObservable.java): concurrent callers submit inputs;
+in BATCHED mode a collector thread coalesces up to ``max_batch_size``
+requests into one device call (dynamic batching — the TPU loves big
+batches); SEQUENTIAL mode serves each request directly. Shapes are
+bucketed by padding the coalesced batch to the next power of two so
+XLA sees few distinct shapes (no retrace storms).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["InferenceMode", "ParallelInference"]
+
+
+class InferenceMode:
+    SEQUENTIAL = "sequential"
+    BATCHED = "batched"
+
+
+class _Pending:
+    def __init__(self, x):
+        self.x = x
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class ParallelInference:
+    def __init__(self, model, mode: str = InferenceMode.BATCHED,
+                 max_batch_size: int = 32, queue_limit: int = 64,
+                 wait_ms: float = 2.0):
+        self.model = model
+        self.mode = mode
+        self.max_batch_size = max_batch_size
+        self.wait_ms = wait_ms
+        self._queue: "queue.Queue[_Pending]" = queue.Queue(queue_limit)
+        self._stop = threading.Event()
+        self._worker = None
+        if mode == InferenceMode.BATCHED:
+            self._worker = threading.Thread(target=self._collector,
+                                            daemon=True)
+            self._worker.start()
+
+    # ---- builder parity (ParallelInference.Builder) ----
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._mode = InferenceMode.BATCHED
+            self._bs = 32
+            self._ql = 64
+
+        def inference_mode(self, m):
+            self._mode = m
+            return self
+
+        def batch_limit(self, n):
+            self._bs = n
+            return self
+
+        def queue_limit(self, n):
+            self._ql = n
+            return self
+
+        def build(self):
+            return ParallelInference(self._model, self._mode, self._bs,
+                                     self._ql)
+
+    @staticmethod
+    def builder(model):
+        return ParallelInference.Builder(model)
+
+    # ---- serving ----
+    def output(self, x) -> np.ndarray:
+        """Blocking inference call, safe from many threads."""
+        x = np.asarray(x)
+        if self.mode == InferenceMode.SEQUENTIAL:
+            return np.asarray(self.model.output(x))
+        p = _Pending(x)
+        self._queue.put(p)
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _collector(self):
+        carry: Optional[_Pending] = None      # dequeued but over-limit
+        while not self._stop.is_set():
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            batch: List[_Pending] = [first]
+            total = first.x.shape[0]
+            deadline = self.wait_ms / 1000.0
+            t_end = _now() + deadline
+            while total < self.max_batch_size:
+                remaining = t_end - _now()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if total + nxt.x.shape[0] > self.max_batch_size:
+                    carry = nxt          # would exceed cap: next round
+                    break
+                batch.append(nxt)
+                total += nxt.x.shape[0]
+            self._serve(batch, total)
+
+    def _serve(self, batch: List[_Pending], total: int):
+        try:
+            x = np.concatenate([p.x for p in batch], axis=0)
+            # pad to next power of two -> few distinct compiled shapes
+            target = 1
+            while target < x.shape[0]:
+                target *= 2
+            if target != x.shape[0]:
+                pad = np.zeros((target - x.shape[0],) + x.shape[1:],
+                               x.dtype)
+                x = np.concatenate([x, pad], axis=0)
+            out = np.asarray(self.model.output(x))
+            off = 0
+            for p in batch:
+                n = p.x.shape[0]
+                p.result = out[off:off + n]
+                off += n
+                p.event.set()
+        except BaseException as e:   # deliver the error to every waiter
+            for p in batch:
+                p.error = e
+                p.event.set()
+
+    def shutdown(self):
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=1.0)
+
+
+def _now() -> float:
+    import time
+    return time.monotonic()

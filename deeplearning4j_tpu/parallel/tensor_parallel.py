@@ -1,0 +1,107 @@
+"""Tensor parallelism: param sharding rules over the ``model`` mesh axis.
+
+Absent from the 2017 reference (data parallelism only — SURVEY §2.3);
+a required capability of the TPU rebuild. Implementation is the
+idiomatic JAX one: *sharding annotations, not rewritten math*. A rule
+table maps layer param names to PartitionSpecs (Megatron-style
+column/row split for consecutive dense layers, head-split for
+attention); ``shard_params`` applies them, and XLA inserts the
+all-gathers/reduce-scatters when the jitted train step runs.
+
+Usage:
+    mesh = build_mesh(MeshSpec(data=4, model=2))
+    net.params = shard_params(net.params, net, mesh)
+    pw = ParallelWrapper(net, mesh)     # batch over 'data', params over
+    pw.fit(...)                         # 'model' where rules apply
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["TPRule", "default_tp_rules", "shard_params",
+           "replicate_params"]
+
+
+class TPRule:
+    COLUMN = "column"     # split output dim  (Megatron first linear)
+    ROW = "row"           # split input dim   (Megatron second linear)
+    REPLICATE = "replicate"
+
+
+def default_tp_rules(layers) -> Dict[int, str]:
+    """Alternate column/row splits over consecutive Dense layers — the
+    Megatron pairing that avoids resharding between them. Conv layers
+    shard output channels (column-like). Output layers replicate (their
+    softmax/loss needs the full feature dim)."""
+    from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer
+    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+        ConvolutionLayer)
+    from deeplearning4j_tpu.nn.conf.layers.output import OutputLayer
+
+    rules: Dict[int, str] = {}
+    parity = 0
+    for i, layer in enumerate(layers):
+        if isinstance(layer, OutputLayer):
+            rules[i] = TPRule.REPLICATE
+        elif isinstance(layer, DenseLayer):
+            rules[i] = TPRule.COLUMN if parity == 0 else TPRule.ROW
+            parity ^= 1
+        elif isinstance(layer, ConvolutionLayer):
+            rules[i] = TPRule.COLUMN
+        else:
+            rules[i] = TPRule.REPLICATE
+    return rules
+
+
+def _spec_for(param_name: str, ndim: int, rule: str,
+              axis: str) -> P:
+    if rule == TPRule.REPLICATE:
+        return P()
+    if param_name in ("b", "beta", "gamma"):
+        # bias/scale follow the output dim: sharded under COLUMN
+        return P(axis) if rule == TPRule.COLUMN else P()
+    if ndim == 2:                       # dense W (in, out)
+        return P(None, axis) if rule == TPRule.COLUMN else P(axis, None)
+    if ndim == 4:                       # conv W (kh, kw, in, out)
+        return (P(None, None, None, axis) if rule == TPRule.COLUMN
+                else P(None, None, axis, None))
+    return P()
+
+
+def shard_params(params, model, mesh: Mesh, *, axis: str = "model",
+                 rules: Optional[Dict[int, str]] = None):
+    """Apply TP shardings to a MultiLayerNetwork's param list."""
+    layers = model.layers
+    rules = rules if rules is not None else default_tp_rules(layers)
+    n_model = mesh.shape[axis]
+    out = []
+    for i, layer_params in enumerate(params):
+        rule = rules.get(i, TPRule.REPLICATE)
+        placed = {}
+        for name, arr in layer_params.items():
+            spec = _spec_for(name, arr.ndim, rule, axis)
+            # divisibility guard: fall back to replication
+            ok = True
+            for dim, ax in zip(arr.shape, spec):
+                if ax is not None and dim % n_model:
+                    ok = False
+            if not ok:
+                logger.debug("layer %d param %s %s not divisible by %d; "
+                             "replicating", i, name, arr.shape, n_model)
+                spec = P()
+            placed[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+        out.append(placed)
+    return out
+
+
+def replicate_params(params, mesh: Mesh):
+    repl = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, repl),
+                                  params)

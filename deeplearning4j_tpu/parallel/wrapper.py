@@ -4,48 +4,42 @@ The TPU rewrite of deeplearning4j-scaleout-parallelwrapper's
 ``ParallelWrapper`` (ParallelWrapper.java:58, 898 LoC of worker
 threads, model clones, round-robin queues, averaging): here the model
 is **sharded, not cloned** — params replicated, batch split over the
-``data`` mesh axis, and one jitted step runs SPMD on every device with
-XLA inserting the gradient ``psum`` over ICI.
+``data`` mesh axis, and the model's OWN jitted train step runs SPMD on
+every device with XLA inserting the gradient ``psum`` over ICI (the
+shardings of batch vs params force an all-reduce in the backward pass;
+no wrapper-specific step code is needed).
 
-Equivalences:
+Equivalences to the reference:
 - AVERAGING mode (params averaged every N iters, :251-257)   →
   synchronous all-reduce EVERY step (strictly stronger consistency,
-  and faster on ICI than host-side averaging ever was on PCIe).
+  and faster on ICI than host-side averaging ever was over PCIe).
 - SHARED_GRADIENTS / EncodedGradientsAccumulator 1-bit compression →
-  unnecessary on ICI; the optional compressed path lives in
-  parallel/compression.py for DCN-spanning topologies.
+  unnecessary on ICI; a compressed path belongs to DCN-spanning
+  multi-slice topologies (parallel/compression.py).
 - prefetchBuffer / MagicQueue → AsyncDataSetIterator + device put.
 - workers(n) → mesh data-axis size.
 
-Usage mirrors the reference builder:
-
-    pw = (ParallelWrapper.builder(net)
-          .workers(8)            # or mesh=...
-          .prefetch_buffer(4)
-          .build())
-    pw.fit(iterator, epochs=...)
+Works with both executors: MultiLayerNetwork and ComputationGraph
+(GraphParallelWrapper alias keeps call sites explicit).
 """
 
 from __future__ import annotations
 
-import functools
 import logging
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.data.iterators import (AsyncDataSetIterator,
                                                DataSetIterator)
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, build_mesh
-from deeplearning4j_tpu.train.constraints import apply_layer_constraints
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["ParallelWrapper"]
+__all__ = ["ParallelWrapper", "GraphParallelWrapper"]
 
 
 class ParallelWrapper:
@@ -54,7 +48,6 @@ class ParallelWrapper:
         self.model = model
         self.mesh = mesh if mesh is not None else build_mesh(MeshSpec())
         self.prefetch = prefetch_buffer
-        self._jit_step = None
 
     # ---- builder parity ----
     class Builder:
@@ -87,44 +80,28 @@ class ParallelWrapper:
     def builder(model) -> "ParallelWrapper.Builder":
         return ParallelWrapper.Builder(model)
 
-    # ---- training ----
-    def _make_step(self):
-        model = self.model
-        mesh = self.mesh
-        optimizer = model._optimizer
-        repl = NamedSharding(mesh, P())
+    # ---- sharding helpers ----
+    def _replicated(self):
+        return NamedSharding(self.mesh, P())
 
-        def data_spec(a):
-            return NamedSharding(mesh, P("data", *([None] * (a.ndim - 1))))
+    def _shard_leaf(self, a):
+        return jax.device_put(
+            a, NamedSharding(self.mesh, P("data", *([None] * (a.ndim - 1)))))
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def step(params, state, opt_state, batch, base_rng, it):
-            rng = jax.random.fold_in(base_rng, it)
-
-            def loss_fn(p):
-                return model._loss(p, state, batch, rng, training=True)
-
-            (loss, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            # gradient psum over ICI is inserted by XLA from shardings:
-            # batch is sharded over 'data', params replicated, so the
-            # grad contraction produces an all-reduce automatically.
-            updates, new_opt = optimizer.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            new_params = [apply_layer_constraints(l, p) for l, p in
-                          zip(model.layers, new_params)]
-            return new_params, new_state, new_opt, loss
-
-        return step, repl, data_spec
+    def _shard_batch(self, batch):
+        return jax.tree_util.tree_map(self._shard_leaf, batch)
 
     def fit(self, iterator: DataSetIterator, *, epochs: int = 1):
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
         model = self.model
         if model.params is None:
             model.init()
-        if self._jit_step is None:
-            self._jit_step = self._make_step()
-        step, repl, data_spec = self._jit_step
-        # replicate params/opt state across the mesh once
+        is_graph = isinstance(model, ComputationGraph)
+        if model._jit_train_step is None:
+            model._jit_train_step = model._make_train_step()
+        step = model._jit_train_step
+        repl = self._replicated()
         model.params = jax.device_put(model.params, repl)
         model.state = jax.device_put(model.state, repl)
         model.opt_state = jax.device_put(model.opt_state, repl)
@@ -142,19 +119,21 @@ class ParallelWrapper:
                                      "devices)", n, ndata)
                         continue
                     # truncate to a device-divisible count; repeating
-                    # examples instead would bias the mean gradient
+                    # examples would bias the mean gradient
                     ds = _truncate_batch(ds, (n // ndata) * ndata)
-                batch = tuple(
-                    None if a is None else jax.device_put(
-                        jnp.asarray(a), data_spec(np.asarray(a)))
-                    for a in (ds.features, ds.labels, ds.features_mask,
-                              ds.labels_mask))
+                    n = ds.num_examples()
+                if is_graph:
+                    batch = model._batch_tuple(model._as_multi(ds))
+                else:
+                    batch = model._batch_tuple(ds)
+                batch = self._shard_batch(batch)
                 model.params, model.state, model.opt_state, loss = step(
                     model.params, model.state, model.opt_state, batch,
                     model._rng_key, np.int32(model.iteration_count))
                 model.score_value = loss
                 for lst in model.listeners:
-                    lst.iteration_done(model, model.iteration_count, loss, n)
+                    lst.iteration_done(model, model.iteration_count, loss,
+                                       n)
                 model.iteration_count += 1
             for lst in model.listeners:
                 lst.on_epoch_end(model)
@@ -162,13 +141,24 @@ class ParallelWrapper:
         return model
 
 
+# graph and sequential models share the wrapper; alias for readability
+GraphParallelWrapper = ParallelWrapper
+
+
 def _truncate_batch(ds, target: int):
     """Trim a batch to ``target`` examples (device-divisible static
-    shape without the gradient bias padding-by-repeat would cause)."""
-    from deeplearning4j_tpu.data.dataset import DataSet
+    shape without the gradient bias padding-by-repeat would cause).
+    Handles DataSet and MultiDataSet (lists of per-input arrays)."""
+    from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 
     def take(a):
         return None if a is None else a[:target]
 
+    if isinstance(ds, MultiDataSet):
+        def take_list(lst):
+            return None if lst is None else [take(a) for a in lst]
+        return MultiDataSet(take_list(ds.features), take_list(ds.labels),
+                            take_list(ds.features_masks),
+                            take_list(ds.labels_masks))
     return DataSet(take(ds.features), take(ds.labels),
                    take(ds.features_mask), take(ds.labels_mask))

@@ -1,0 +1,15 @@
+"""Pytree helpers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["tree_copy"]
+
+
+def tree_copy(tree):
+    """Deep-copy array leaves. NOT tree_map(identity): the jitted train
+    steps donate their param/state buffers, so an aliasing 'copy' would
+    be deleted by the next fit() on either network."""
+    return jax.tree_util.tree_map(jnp.copy, tree)

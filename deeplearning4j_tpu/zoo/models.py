@@ -1,0 +1,565 @@
+"""Model zoo.
+
+Mirrors deeplearning4j-zoo (zoo/model/*.java: AlexNet, LeNet, VGG16/19,
+GoogLeNet, ResNet50, InceptionResNetV1, FaceNetNN4Small2, SimpleCNN,
+TextGenerationLSTM, TinyYOLO, Darknet19) + the ZooModel base
+(zoo/ZooModel.java:40 initPretrained download/checksum — here gated on
+a local weight cache since build env has no egress; the checkpoint
+format is this framework's own zip).
+
+All image models are NHWC. Architectures follow the canonical papers
+(as the reference's do); input shapes default to each model's
+reference defaults.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import (ElementWiseVertex,
+                                              MergeVertex)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
+    LocalResponseNormalization, LSTM, OutputLayer, PoolingType,
+    RnnOutputLayer, SubsamplingLayer, ZeroPaddingLayer,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
+           "ResNet50", "GoogLeNet", "InceptionResNetV1",
+           "FaceNetNN4Small2", "TextGenerationLSTM", "TinyYOLO",
+           "Darknet19", "UNet", "available_models"]
+
+
+class ZooModel:
+    """Base (zoo/ZooModel.java). ``init_pretrained`` loads weights from
+    the local cache dir (reference downloads + checksums; no egress
+    here, so a missing cache raises with the expected path)."""
+
+    name: str = "zoo"
+
+    def __init__(self, n_classes: int = 1000, seed: int = 123,
+                 input_shape: Optional[Tuple[int, ...]] = None,
+                 updater: Optional[dict] = None):
+        self.n_classes = n_classes
+        self.seed = seed
+        self.input_shape = input_shape or self.default_input_shape()
+        self.updater = updater or updaters.nesterovs(1e-2, 0.9)
+
+    def default_input_shape(self) -> Tuple[int, ...]:
+        return (224, 224, 3)
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        c = self.conf()
+        from deeplearning4j_tpu.nn.conf.multi_layer import (
+            MultiLayerConfiguration)
+        if isinstance(c, MultiLayerConfiguration):
+            return MultiLayerNetwork(c).init(self.seed)
+        return ComputationGraph(c).init(self.seed)
+
+    def pretrained_path(self) -> str:
+        base = os.environ.get(
+            "DL4J_TPU_ZOO_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "deeplearning4j_tpu", "zoo"))
+        return os.path.join(base, f"{self.name}.zip")
+
+    def init_pretrained(self):
+        path = self.pretrained_path()
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"No pretrained weights for {self.name}: expected {path} "
+                f"(this environment has no network egress; place the "
+                f"checkpoint there manually)")
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        return restore_model(path)
+
+    def _builder(self):
+        return (NeuralNetConfiguration.builder()
+                .set_seed(self.seed)
+                .updater(self.updater))
+
+
+# ---------------------------------------------------------------------------
+# sequential models
+# ---------------------------------------------------------------------------
+
+class LeNet(ZooModel):
+    """(zoo/model/LeNet.java)."""
+
+    name = "lenet"
+
+    def default_input_shape(self):
+        return (28, 28, 1)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (self._builder().list()
+                .layer(ConvolutionLayer(n_out=20, kernel=(5, 5),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=50, kernel=(5, 5),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=500, activation="relu"))
+                .layer(OutputLayer(n_out=self.n_classes, loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+class SimpleCNN(ZooModel):
+    """(zoo/model/SimpleCNN.java)."""
+
+    name = "simplecnn"
+
+    def default_input_shape(self):
+        return (48, 48, 3)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = self._builder().list()
+        for n_out in (16, 32):
+            b = (b.layer(ConvolutionLayer(n_out=n_out, kernel=(3, 3),
+                                          convolution_mode="same"))
+                 .layer(BatchNormalization(activation="relu"))
+                 .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2))))
+        b = (b.layer(ConvolutionLayer(n_out=64, kernel=(3, 3),
+                                      convolution_mode="same"))
+             .layer(BatchNormalization(activation="relu"))
+             .layer(DropoutLayer(dropout=0.3))
+             .layer(GlobalPoolingLayer(pooling=PoolingType.AVG))
+             .layer(OutputLayer(n_out=self.n_classes, loss="mcxent")))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+class AlexNet(ZooModel):
+    """(zoo/model/AlexNet.java) — incl. the LRN layers."""
+
+    name = "alexnet"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (self._builder().list()
+                .layer(ConvolutionLayer(n_out=96, kernel=(11, 11),
+                                        stride=(4, 4), activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(kernel=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=256, kernel=(5, 5),
+                                        padding=(2, 2), activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(kernel=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=384, kernel=(3, 3),
+                                        padding=(1, 1), activation="relu"))
+                .layer(ConvolutionLayer(n_out=384, kernel=(3, 3),
+                                        padding=(1, 1), activation="relu"))
+                .layer(ConvolutionLayer(n_out=256, kernel=(3, 3),
+                                        padding=(1, 1), activation="relu"))
+                .layer(SubsamplingLayer(kernel=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, activation="relu",
+                                  dropout=0.5))
+                .layer(DenseLayer(n_out=4096, activation="relu",
+                                  dropout=0.5))
+                .layer(OutputLayer(n_out=self.n_classes, loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+def _vgg_blocks(b, plan):
+    for n_convs, n_out in plan:
+        for _ in range(n_convs):
+            b = b.layer(ConvolutionLayer(n_out=n_out, kernel=(3, 3),
+                                         convolution_mode="same",
+                                         activation="relu"))
+        b = b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+    return b
+
+
+class VGG16(ZooModel):
+    """(zoo/model/VGG16.java)."""
+
+    name = "vgg16"
+    plan = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = _vgg_blocks(self._builder().list(), self.plan)
+        return (b.layer(DenseLayer(n_out=4096, activation="relu",
+                                   dropout=0.5))
+                .layer(DenseLayer(n_out=4096, activation="relu",
+                                  dropout=0.5))
+                .layer(OutputLayer(n_out=self.n_classes, loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+class VGG19(VGG16):
+    """(zoo/model/VGG19.java)."""
+
+    name = "vgg19"
+    plan = [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)]
+
+
+class TextGenerationLSTM(ZooModel):
+    """Char-level LSTM (zoo/model/TextGenerationLSTM.java): 2 stacked
+    GravesLSTM(256) + RnnOutput, vocabulary-sized one-hot IO."""
+
+    name = "textgenlstm"
+
+    def __init__(self, vocab_size: int = 77, seed: int = 123,
+                 updater: Optional[dict] = None, max_length: int = 40):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        super().__init__(n_classes=vocab_size, seed=seed,
+                         input_shape=(max_length, vocab_size),
+                         updater=updater or updaters.rmsprop(1e-2))
+
+    def default_input_shape(self):
+        return (40, 77)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.layers import GravesLSTM
+        return (self._builder().list()
+                .layer(GravesLSTM(n_out=256, activation="tanh"))
+                .layer(GravesLSTM(n_out=256, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=self.vocab_size,
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.vocab_size,
+                                                    self.max_length))
+                .build())
+
+
+# ---------------------------------------------------------------------------
+# graph models
+# ---------------------------------------------------------------------------
+
+def _conv_bn(g, name, inp, n_out, kernel=(3, 3), stride=(1, 1),
+             mode="same", activation="relu"):
+    g.add_layer(f"{name}_conv",
+                ConvolutionLayer(n_out=n_out, kernel=kernel, stride=stride,
+                                 convolution_mode=mode, has_bias=False),
+                inp)
+    g.add_layer(f"{name}_bn", BatchNormalization(activation=activation),
+                f"{name}_conv")
+    return f"{name}_bn"
+
+
+class ResNet50(ZooModel):
+    """(zoo/model/ResNet50.java) — bottleneck-block ResNet-50, NHWC,
+    identity/projection shortcuts via ElementWiseVertex(add)."""
+
+    name = "resnet50"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (self._builder().graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        # stem
+        last = _conv_bn(g, "stem", "in", 64, kernel=(7, 7), stride=(2, 2))
+        g.add_layer("stem_pool",
+                    SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                     convolution_mode="same"), last)
+        last = "stem_pool"
+
+        stages = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
+                  (3, 512, 2048, 2)]
+        for si, (blocks, mid, out_ch, first_stride) in enumerate(stages):
+            for bi in range(blocks):
+                stride = (first_stride, first_stride) if bi == 0 else (1, 1)
+                pre = f"s{si}b{bi}"
+                a = _conv_bn(g, f"{pre}_a", last, mid, kernel=(1, 1),
+                             stride=stride)
+                b = _conv_bn(g, f"{pre}_b", a, mid, kernel=(3, 3))
+                cb = _conv_bn(g, f"{pre}_c", b, out_ch, kernel=(1, 1),
+                              activation="identity")
+                if bi == 0:
+                    sc = _conv_bn(g, f"{pre}_sc", last, out_ch,
+                                  kernel=(1, 1), stride=stride,
+                                  activation="identity")
+                else:
+                    sc = last
+                g.add_vertex(f"{pre}_add", ElementWiseVertex(op="add"),
+                             cb, sc)
+                g.add_layer(f"{pre}_relu", ActivationLayer(
+                    activation="relu"), f"{pre}_add")
+                last = f"{pre}_relu"
+
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling=PoolingType.AVG),
+                    last)
+        g.add_layer("out", OutputLayer(n_out=self.n_classes, loss="mcxent"),
+                    "avgpool")
+        g.set_outputs("out")
+        return g.build()
+
+
+class GoogLeNet(ZooModel):
+    """(zoo/model/GoogLeNet.java) — Inception-v1 with 3x3/5x5/pool
+    branches merged channel-wise."""
+
+    name = "googlenet"
+
+    def _inception(self, g, name, inp, c1, c3r, c3, c5r, c5, pp):
+        b1 = _conv_bn(g, f"{name}_1x1", inp, c1, kernel=(1, 1))
+        r3 = _conv_bn(g, f"{name}_3r", inp, c3r, kernel=(1, 1))
+        b3 = _conv_bn(g, f"{name}_3x3", r3, c3, kernel=(3, 3))
+        r5 = _conv_bn(g, f"{name}_5r", inp, c5r, kernel=(1, 1))
+        b5 = _conv_bn(g, f"{name}_5x5", r5, c5, kernel=(5, 5))
+        g.add_layer(f"{name}_pool",
+                    SubsamplingLayer(kernel=(3, 3), stride=(1, 1),
+                                     convolution_mode="same"), inp)
+        bp = _conv_bn(g, f"{name}_pp", f"{name}_pool", pp, kernel=(1, 1))
+        g.add_vertex(f"{name}_cat", MergeVertex(), b1, b3, b5, bp)
+        return f"{name}_cat"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (self._builder().graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        last = _conv_bn(g, "c1", "in", 64, kernel=(7, 7), stride=(2, 2))
+        g.add_layer("p1", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           convolution_mode="same"), last)
+        last = _conv_bn(g, "c2", "p1", 192, kernel=(3, 3))
+        g.add_layer("p2", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           convolution_mode="same"), last)
+        last = "p2"
+        specs = [("3a", 64, 96, 128, 16, 32, 32),
+                 ("3b", 128, 128, 192, 32, 96, 64)]
+        for s in specs:
+            last = self._inception(g, s[0], last, *s[1:])
+        g.add_layer("p3", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           convolution_mode="same"), last)
+        last = "p3"
+        specs = [("4a", 192, 96, 208, 16, 48, 64),
+                 ("4b", 160, 112, 224, 24, 64, 64),
+                 ("4c", 128, 128, 256, 24, 64, 64),
+                 ("4d", 112, 144, 288, 32, 64, 64),
+                 ("4e", 256, 160, 320, 32, 128, 128)]
+        for s in specs:
+            last = self._inception(g, s[0], last, *s[1:])
+        g.add_layer("p4", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           convolution_mode="same"), last)
+        last = "p4"
+        for s in [("5a", 256, 160, 320, 32, 128, 128),
+                  ("5b", 384, 192, 384, 48, 128, 128)]:
+            last = self._inception(g, s[0], last, *s[1:])
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling=PoolingType.AVG),
+                    last)
+        g.add_layer("drop", DropoutLayer(dropout=0.4), "avgpool")
+        g.add_layer("out", OutputLayer(n_out=self.n_classes,
+                                       loss="mcxent"), "drop")
+        g.set_outputs("out")
+        return g.build()
+
+
+class InceptionResNetV1(ZooModel):
+    """(zoo/model/InceptionResNetV1.java) — compact faithful variant:
+    stem + residual inception-A/B blocks with scaled residual adds."""
+
+    name = "inception_resnet_v1"
+
+    def default_input_shape(self):
+        return (160, 160, 3)
+
+    def _block_a(self, g, name, inp, scale=0.17):
+        from deeplearning4j_tpu.nn.conf.graph import ScaleVertex
+        b0 = _conv_bn(g, f"{name}_b0", inp, 32, kernel=(1, 1))
+        b1 = _conv_bn(g, f"{name}_b1a", inp, 32, kernel=(1, 1))
+        b1 = _conv_bn(g, f"{name}_b1b", b1, 32, kernel=(3, 3))
+        b2 = _conv_bn(g, f"{name}_b2a", inp, 32, kernel=(1, 1))
+        b2 = _conv_bn(g, f"{name}_b2b", b2, 32, kernel=(3, 3))
+        b2 = _conv_bn(g, f"{name}_b2c", b2, 32, kernel=(3, 3))
+        g.add_vertex(f"{name}_cat", MergeVertex(), b0, b1, b2)
+        up = _conv_bn(g, f"{name}_up", f"{name}_cat", 256, kernel=(1, 1),
+                      activation="identity")
+        g.add_vertex(f"{name}_scale", ScaleVertex(scale=scale), up)
+        g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp,
+                     f"{name}_scale")
+        g.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_relu"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (self._builder().graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        last = _conv_bn(g, "s1", "in", 32, kernel=(3, 3), stride=(2, 2))
+        last = _conv_bn(g, "s2", last, 64, kernel=(3, 3))
+        g.add_layer("sp", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           convolution_mode="same"), last)
+        last = _conv_bn(g, "s3", "sp", 128, kernel=(3, 3))
+        last = _conv_bn(g, "s4", last, 256, kernel=(3, 3), stride=(2, 2))
+        for i in range(3):
+            last = self._block_a(g, f"a{i}", last)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling=PoolingType.AVG),
+                    last)
+        g.add_layer("bottleneck", DenseLayer(n_out=128,
+                                             activation="identity"),
+                    "avgpool")
+        g.add_layer("out", OutputLayer(n_out=self.n_classes,
+                                       loss="mcxent"), "bottleneck")
+        g.set_outputs("out")
+        return g.build()
+
+
+class FaceNetNN4Small2(ZooModel):
+    """(zoo/model/FaceNetNN4Small2.java) — embedding net ending in an
+    L2-normalized 128-d bottleneck; center-loss output as in the
+    reference."""
+
+    name = "facenet_nn4_small2"
+
+    def default_input_shape(self):
+        return (96, 96, 3)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.graph import L2NormalizeVertex
+        from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer
+        h, w, c = self.input_shape
+        g = (self._builder().graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        last = _conv_bn(g, "c1", "in", 64, kernel=(7, 7), stride=(2, 2))
+        g.add_layer("p1", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           convolution_mode="same"), last)
+        last = _conv_bn(g, "c2", "p1", 64, kernel=(1, 1))
+        last = _conv_bn(g, "c3", last, 192, kernel=(3, 3))
+        g.add_layer("p2", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           convolution_mode="same"), last)
+        last = _conv_bn(g, "c4", "p2", 256, kernel=(3, 3), stride=(2, 2))
+        last = _conv_bn(g, "c5", last, 512, kernel=(3, 3), stride=(2, 2))
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling=PoolingType.AVG),
+                    last)
+        g.add_layer("embed", DenseLayer(n_out=128, activation="identity"),
+                    "avgpool")
+        g.add_vertex("l2norm", L2NormalizeVertex(), "embed")
+        g.add_layer("out", CenterLossOutputLayer(n_out=self.n_classes,
+                                                 loss="mcxent"), "l2norm")
+        g.set_outputs("out")
+        return g.build()
+
+
+class Darknet19(ZooModel):
+    """(zoo/model/Darknet19.java)."""
+
+    name = "darknet19"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = self._builder().list()
+        plan = [(32,), "M", (64,), "M", (128, 64, 128), "M",
+                (256, 128, 256), "M", (512, 256, 512, 256, 512), "M",
+                (1024, 512, 1024, 512, 1024)]
+        for item in plan:
+            if item == "M":
+                b = b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            else:
+                for i, n_out in enumerate(item):
+                    k = (1, 1) if (len(item) > 1 and i % 2 == 1) else (3, 3)
+                    b = (b.layer(ConvolutionLayer(n_out=n_out, kernel=k,
+                                                  convolution_mode="same",
+                                                  has_bias=False))
+                         .layer(BatchNormalization(
+                             activation="leakyrelu")))
+        b = (b.layer(ConvolutionLayer(n_out=self.n_classes, kernel=(1, 1),
+                                      convolution_mode="same"))
+             .layer(GlobalPoolingLayer(pooling=PoolingType.AVG))
+             .layer(OutputLayer(n_out=self.n_classes, loss="mcxent")))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+class TinyYOLO(ZooModel):
+    """(zoo/model/TinyYOLO.java) — Darknet-tiny trunk + Yolo2OutputLayer."""
+
+    name = "tinyyolo"
+
+    def __init__(self, n_classes: int = 20, seed: int = 123,
+                 input_shape=None, updater=None,
+                 anchors=((1.08, 1.19), (3.42, 4.41), (6.63, 11.38),
+                          (9.42, 5.11), (16.62, 10.52))):
+        super().__init__(n_classes, seed, input_shape or (416, 416, 3),
+                         updater)
+        self.anchors = anchors
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.layers import Yolo2OutputLayer
+        h, w, c = self.input_shape
+        b = self._builder().list()
+        n_out_seq = [16, 32, 64, 128, 256, 512]
+        for i, n_out in enumerate(n_out_seq):
+            b = (b.layer(ConvolutionLayer(n_out=n_out, kernel=(3, 3),
+                                          convolution_mode="same",
+                                          has_bias=False))
+                 .layer(BatchNormalization(activation="leakyrelu")))
+            stride = (2, 2) if i < 5 else (1, 1)
+            b = b.layer(SubsamplingLayer(kernel=(2, 2), stride=stride,
+                                         convolution_mode="same"))
+        b = (b.layer(ConvolutionLayer(n_out=1024, kernel=(3, 3),
+                                      convolution_mode="same",
+                                      has_bias=False))
+             .layer(BatchNormalization(activation="leakyrelu"))
+             .layer(ConvolutionLayer(
+                 n_out=len(self.anchors) * (5 + self.n_classes),
+                 kernel=(1, 1), convolution_mode="same"))
+             .layer(Yolo2OutputLayer(anchors=tuple(self.anchors))))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+class UNet(ZooModel):
+    """U-Net encoder/decoder with skip connections (capability parity
+    with later-reference zoo; exercises Deconvolution + Merge)."""
+
+    name = "unet"
+
+    def default_input_shape(self):
+        return (128, 128, 3)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            Deconvolution2DLayer, LossLayer)
+        h, w, c = self.input_shape
+        g = (self._builder().graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        skips = []
+        last = "in"
+        chans = [32, 64, 128]
+        for i, ch in enumerate(chans):
+            last = _conv_bn(g, f"e{i}", last, ch)
+            skips.append(last)
+            g.add_layer(f"ep{i}", SubsamplingLayer(kernel=(2, 2),
+                                                   stride=(2, 2)), last)
+            last = f"ep{i}"
+        last = _conv_bn(g, "mid", last, 256)
+        for i, ch in reversed(list(enumerate(chans))):
+            g.add_layer(f"up{i}", Deconvolution2DLayer(
+                n_out=ch, kernel=(2, 2), stride=(2, 2)), last)
+            g.add_vertex(f"cat{i}", MergeVertex(), f"up{i}", skips[i])
+            last = _conv_bn(g, f"d{i}", f"cat{i}", ch)
+        g.add_layer("head", ConvolutionLayer(n_out=self.n_classes,
+                                             kernel=(1, 1),
+                                             activation="sigmoid"), last)
+        g.add_layer("out", LossLayer(loss="xent", activation="identity"),
+                    "head")
+        g.set_outputs("out")
+        return g.build()
+
+
+def available_models():
+    return {cls.name: cls for cls in
+            (LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50, GoogLeNet,
+             InceptionResNetV1, FaceNetNN4Small2, TextGenerationLSTM,
+             TinyYOLO, Darknet19, UNet)}

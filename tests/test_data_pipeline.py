@@ -1,0 +1,131 @@
+"""Data pipeline: normalizers, record readers, iterators."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (ArrayDataSetIterator,
+                                               AsyncDataSetIterator,
+                                               BenchmarkDataSetIterator,
+                                               EarlyTerminationDataSetIterator,
+                                               MultipleEpochsIterator)
+from deeplearning4j_tpu.data.normalizers import (ImagePreProcessingScaler,
+                                                 NormalizerMinMaxScaler,
+                                                 NormalizerStandardize,
+                                                 normalizer_from_dict)
+from deeplearning4j_tpu.data.records import (CSVRecordReader,
+                                             CSVSequenceRecordReader,
+                                             RecordReaderDataSetIterator,
+                                             SequenceRecordReaderDataSetIterator)
+
+
+class TestNormalizers:
+    def test_standardize_round_trip(self, rng):
+        x = rng.normal(5, 3, (100, 4)).astype(np.float32)
+        n = NormalizerStandardize().fit(DataSet(x))
+        t = n.transform_features(x)
+        assert abs(t.mean()) < 1e-5 and abs(t.std() - 1) < 1e-2
+        np.testing.assert_allclose(n.revert_features(t), x, rtol=1e-4)
+        # serde
+        n2 = normalizer_from_dict(n.to_dict())
+        np.testing.assert_allclose(n2.transform_features(x), t, rtol=1e-6)
+
+    def test_minmax(self, rng):
+        x = rng.uniform(-10, 10, (50, 3)).astype(np.float32)
+        n = NormalizerMinMaxScaler(0, 1).fit(DataSet(x))
+        t = n.transform_features(x)
+        assert t.min() >= -1e-6 and t.max() <= 1 + 1e-6
+        np.testing.assert_allclose(n.revert_features(t), x, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_image_scaler(self):
+        x = np.array([[0, 127.5, 255]], np.float32)
+        n = ImagePreProcessingScaler()
+        np.testing.assert_allclose(n.transform_features(x),
+                                   [[0, 0.5, 1.0]])
+
+
+class TestRecordReaders:
+    def test_csv_classification(self, tmp_path):
+        p = os.path.join(tmp_path, "d.csv")
+        with open(p, "w") as f:
+            f.write("1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n7.0,8.0,1\n")
+        rr = CSVRecordReader().initialize(p)
+        it = RecordReaderDataSetIterator(rr, batch_size=3, label_index=2,
+                                         num_classes=3)
+        batches = list(it)
+        assert len(batches) == 2
+        assert batches[0].features.shape == (3, 2)
+        assert batches[0].labels.shape == (3, 3)
+        assert batches[0].labels[1].argmax() == 1
+
+    def test_csv_regression(self, tmp_path):
+        p = os.path.join(tmp_path, "r.csv")
+        with open(p, "w") as f:
+            f.write("1.0,2.0,0.5\n3.0,4.0,0.7\n")
+        rr = CSVRecordReader().initialize(p)
+        it = RecordReaderDataSetIterator(rr, 2, label_index=2,
+                                         regression=True)
+        ds = next(iter(it))
+        np.testing.assert_allclose(ds.labels[:, 0], [0.5, 0.7])
+
+    def test_sequence_reader_padding_and_masks(self, tmp_path):
+        p1 = os.path.join(tmp_path, "a.csv")
+        p2 = os.path.join(tmp_path, "b.csv")
+        with open(p1, "w") as f:
+            f.write("1,2,0\n3,4,1\n5,6,0\n")      # 3 steps
+        with open(p2, "w") as f:
+            f.write("7,8,1\n")                     # 1 step
+        rr = CSVSequenceRecordReader().initialize([p1, p2])
+        it = SequenceRecordReaderDataSetIterator(rr, 2, label_index=2,
+                                                 num_classes=2)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 3, 2)
+        np.testing.assert_allclose(ds.features_mask,
+                                   [[1, 1, 1], [1, 0, 0]])
+        assert ds.labels[0, 1].argmax() == 1
+
+    def test_image_reader(self, tmp_path):
+        from PIL import Image
+        for label in ("cat", "dog"):
+            d = os.path.join(tmp_path, label)
+            os.makedirs(d)
+            for i in range(2):
+                Image.new("RGB", (10, 8),
+                          (i * 100, 50, 50)).save(
+                              os.path.join(d, f"{i}.png"))
+        from deeplearning4j_tpu.data.records import ImageRecordReader
+        rr = ImageRecordReader(height=8, width=10).initialize(
+            str(tmp_path))
+        it = RecordReaderDataSetIterator(rr, batch_size=4)
+        ds = next(iter(it))
+        assert ds.features.shape == (4, 8, 10, 3)
+        assert ds.labels.shape == (4, 2)
+        assert rr.labels == ["cat", "dog"]
+
+
+class TestIterators:
+    def test_multiple_epochs_and_early_termination(self):
+        base = ArrayDataSetIterator(np.zeros((10, 2)), np.zeros((10, 2)),
+                                    batch_size=5)
+        me = MultipleEpochsIterator(base, 3)
+        assert len(list(me)) == 6
+        et = EarlyTerminationDataSetIterator(base, 1)
+        assert len(list(et)) == 1
+
+    def test_benchmark_iterator(self):
+        ds = DataSet(np.zeros((4, 2)), np.zeros((4, 2)))
+        b = BenchmarkDataSetIterator(ds, 7)
+        assert len(list(b)) == 7
+
+    def test_async_propagates_errors(self):
+        class Bad(ArrayDataSetIterator):
+            def _iterate(self):
+                yield DataSet(np.zeros((2, 2)), None)
+                raise RuntimeError("boom")
+
+        it = AsyncDataSetIterator(Bad(np.zeros((4, 2)), None, 2))
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)

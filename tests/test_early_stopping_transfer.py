@@ -1,0 +1,118 @@
+"""Early stopping + transfer learning (reference earlystopping/** and
+nn/transferlearning/** behavior)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.fetchers import iris_data
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, FrozenLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.transfer_learning import (FineTuneConfiguration,
+                                                     TransferLearning)
+from deeplearning4j_tpu.train.early_stopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration,
+    EarlyStoppingTrainer, InMemoryModelSaver,
+    InvalidScoreTerminationCondition, MaxEpochsTerminationCondition,
+    MaxTimeTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+
+
+def _net(lr=0.05, seed=0):
+    conf = (NeuralNetConfiguration.builder().set_seed(seed)
+            .updater(updaters.adam(lr)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestEarlyStopping:
+    def test_max_epochs(self):
+        xs, ys = iris_data()
+        train = ArrayDataSetIterator(xs[:120], ys[:120], 32)
+        test = ArrayDataSetIterator(xs[120:], ys[120:], 32)
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+            score_calculator=DataSetLossCalculator(test),
+            model_saver=InMemoryModelSaver())
+        result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+        assert result.termination_reason == "epoch"
+        assert result.total_epochs == 5
+        assert result.best_model_epoch >= 0
+        assert result.best_model_score < 1.2
+
+    def test_score_improvement_patience(self):
+        xs, ys = iris_data()
+        train = ArrayDataSetIterator(xs[:120], ys[:120], 32)
+        test = ArrayDataSetIterator(xs[120:], ys[120:], 32)
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(200),
+                ScoreImprovementEpochTerminationCondition(3)],
+            score_calculator=DataSetLossCalculator(test))
+        result = EarlyStoppingTrainer(cfg, _net(lr=0.05), train).fit()
+        # converges long before 200 epochs then patience fires
+        assert result.total_epochs < 200
+        assert result.termination_details in (
+            "ScoreImprovementEpochTerminationCondition",
+            "MaxEpochsTerminationCondition")
+
+    def test_invalid_score_stops(self):
+        xs, ys = iris_data()
+        # absurd lr → NaN quickly
+        train = ArrayDataSetIterator(xs[:120] * 1e6, ys[:120], 32)
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(50)],
+            iteration_termination_conditions=[
+                InvalidScoreTerminationCondition()])
+        net = _net(lr=1e6)
+        result = EarlyStoppingTrainer(cfg, net, train).fit()
+        if result.termination_reason == "iteration":
+            assert result.termination_details == \
+                "InvalidScoreTerminationCondition"
+        # else it survived numerically; acceptable
+
+
+class TestTransferLearning:
+    def test_freeze_and_replace_head(self):
+        xs, ys = iris_data()
+        src = _net()
+        src.fit(xs[:120], ys[:120], epochs=10, batch_size=32)
+        frozen_w = np.asarray(src.params[0]["W"]).copy()
+
+        tl = (TransferLearning.builder(src)
+              .fine_tune_configuration(
+                  FineTuneConfiguration(updater=updaters.adam(0.02)))
+              .set_feature_extractor(1)       # freeze layers 0..1
+              .remove_output_layer()
+              .add_layer(OutputLayer(n_out=3))
+              .build())
+        assert isinstance(tl.layers[0], FrozenLayer)
+        assert isinstance(tl.layers[1], FrozenLayer)
+        tl.fit(xs[:120], ys[:120], epochs=5, batch_size=32)
+        # frozen layer params unchanged
+        np.testing.assert_allclose(np.asarray(tl.params[0]["W"]), frozen_w)
+        # still learns via the new head
+        assert tl.evaluate(xs[120:], ys[120:]).accuracy() > 0.7
+
+    def test_nout_replace(self):
+        xs, ys = iris_data()
+        src = _net()
+        src.fit(xs[:120], ys[:120], epochs=5, batch_size=32)
+        tl = (TransferLearning.builder(src)
+              .n_out_replace(1, 12)
+              .build())
+        assert tl.layers[1].n_out == 12
+        assert tl.layers[2].n_in == 12
+        # runs forward fine
+        out = np.asarray(tl.output(xs[:4]))
+        assert out.shape == (4, 3)
+        # layer 0 weights preserved from source
+        np.testing.assert_allclose(np.asarray(tl.params[0]["W"]),
+                                   np.asarray(src.params[0]["W"]))

@@ -1,0 +1,234 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (reference
+pattern: distributed math must equal single-device math — SURVEY §4.6
+TestCompareParameterAveragingSparkVsSingleMachine)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.fetchers import iris_data
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, build_mesh
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="needs 8 virtual devices")
+
+
+def _net(seed=0, lr=0.1):
+    conf = (NeuralNetConfiguration.builder().set_seed(seed)
+            .updater(updaters.sgd(lr)).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestDataParallel:
+    def test_dp_equals_single_device(self):
+        """The distributed-result-equals-single-machine contract."""
+        xs, ys = iris_data()
+        batch = DataSet(xs[:64], ys[:64])
+
+        single = _net(seed=3)
+        single.fit(batch)
+        p_single = single.params_flat()
+
+        dp = _net(seed=3)
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        ParallelWrapper(dp, mesh, prefetch_buffer=0).fit(
+            ListDataSetIterator([batch]), epochs=1)
+        p_dp = dp.params_flat()
+        np.testing.assert_allclose(p_dp, p_single, rtol=1e-5, atol=1e-6)
+
+    def test_dp_trains_to_accuracy(self):
+        xs, ys = iris_data()
+        net = _net(seed=1, lr=0.3)
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        pw = ParallelWrapper(net, mesh)
+        it = ListDataSetIterator(DataSet(xs[:120], ys[:120]).batch_by(40))
+        pw.fit(it, epochs=40)
+        assert net.evaluate(xs[120:], ys[120:]).accuracy() > 0.85
+
+    def test_partial_batch_truncated(self):
+        xs, ys = iris_data()
+        net = _net()
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        # batch of 13 → truncated to 8; batch of 5 → dropped
+        it = ListDataSetIterator([DataSet(xs[:13], ys[:13]),
+                                  DataSet(xs[:5], ys[:5])])
+        ParallelWrapper(net, mesh, prefetch_buffer=0).fit(it, epochs=1)
+        assert net.iteration_count == 1
+
+
+class TestRingAttention:
+    def test_matches_reference(self):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            attention_reference, ring_attention)
+        rng = np.random.default_rng(0)
+        B, T, H, D = 2, 32, 4, 8
+        q = rng.normal(0, 1, (B, T, H, D)).astype(np.float32)
+        k = rng.normal(0, 1, (B, T, H, D)).astype(np.float32)
+        v = rng.normal(0, 1, (B, T, H, D)).astype(np.float32)
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        out = np.asarray(ring_attention(q, k, v, mesh))
+        ref = np.asarray(attention_reference(q, k, v))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_causal_matches_reference(self):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            attention_reference, ring_attention)
+        rng = np.random.default_rng(1)
+        B, T, H, D = 1, 16, 2, 4
+        q = rng.normal(0, 1, (B, T, H, D)).astype(np.float32)
+        k = rng.normal(0, 1, (B, T, H, D)).astype(np.float32)
+        v = rng.normal(0, 1, (B, T, H, D)).astype(np.float32)
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        out = np.asarray(ring_attention(q, k, v, mesh, causal=True))
+        ref = np.asarray(attention_reference(q, k, v, causal=True))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_blockwise_matches_reference(self):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            attention_reference, blockwise_attention)
+        rng = np.random.default_rng(2)
+        q = rng.normal(0, 1, (2, 50, 2, 8)).astype(np.float32)
+        k = rng.normal(0, 1, (2, 50, 2, 8)).astype(np.float32)
+        v = rng.normal(0, 1, (2, 50, 2, 8)).astype(np.float32)
+        out = np.asarray(blockwise_attention(q, k, v, block_size=16))
+        ref = np.asarray(attention_reference(q, k, v))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+        outc = np.asarray(blockwise_attention(q, k, v, block_size=16,
+                                              causal=True))
+        refc = np.asarray(attention_reference(q, k, v, causal=True))
+        np.testing.assert_allclose(outc, refc, rtol=2e-4, atol=2e-5)
+
+
+class TestTensorParallel:
+    def test_tp_sharded_training_matches_replicated(self):
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            shard_params)
+        xs, ys = iris_data()
+        # n_out=16 divisible by model=2
+        ref_net = _net(seed=9)
+        ref_net.fit(DataSet(xs[:64], ys[:64]))
+        p_ref = ref_net.params_flat()
+
+        tp_net = _net(seed=9)
+        mesh = build_mesh(MeshSpec(data=4, model=2), jax.devices()[:8])
+        tp_net.params = shard_params(tp_net.params, tp_net, mesh)
+        tp_net.opt_state = tp_net._optimizer.init(tp_net.params)
+        ParallelWrapper(tp_net, mesh, prefetch_buffer=0).fit(
+            ListDataSetIterator([DataSet(xs[:64], ys[:64])]), epochs=1)
+        np.testing.assert_allclose(tp_net.params_flat(), p_ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_rules_table(self):
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            TPRule, default_tp_rules)
+        net = _net()
+        rules = default_tp_rules(net.layers)
+        assert rules[0] == TPRule.COLUMN
+        assert rules[1] == TPRule.REPLICATE     # output layer
+
+
+class TestCompression:
+    def test_threshold_residual_semantics(self):
+        from deeplearning4j_tpu.parallel.compression import (
+            ThresholdCompressor)
+        tc = ThresholdCompressor(threshold=0.5)
+        g = jnp.asarray([0.9, -0.2, 0.6, 0.1])
+        r = jnp.zeros(4)
+        q, r2, density = tc.encode(g, r)
+        np.testing.assert_allclose(np.asarray(q), [0.5, 0.0, 0.5, 0.0])
+        # residual keeps what wasn't sent
+        np.testing.assert_allclose(np.asarray(r2),
+                                   [0.4, -0.2, 0.1, 0.1], atol=1e-6)
+        assert 0.49 < float(density) < 0.51
+
+    def test_int8_allreduce_close_to_exact(self):
+        from deeplearning4j_tpu.parallel.compression import (
+            int8_all_reduce)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (8, 64)).astype(np.float32)
+
+        f = shard_map(lambda a: int8_all_reduce(a[0], "data"),
+                      mesh=mesh, in_specs=P("data"), out_specs=P())
+        approx = np.asarray(jax.jit(f)(x))
+        exact = x.sum(axis=0)
+        # int8 quantization: relative error bounded by ~1/127 per term
+        np.testing.assert_allclose(approx, exact, atol=8 * 0.02)
+
+
+class TestPipeline:
+    def test_pipeline_trains(self):
+        from deeplearning4j_tpu.parallel.pipeline import PipelineParallel
+        xs, ys = iris_data()
+        conf = (NeuralNetConfiguration.builder().set_seed(5)
+                .updater(updaters.adam(0.05)).list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        pp = PipelineParallel(net, devices=jax.devices()[:4],
+                              n_microbatches=4)
+        losses = [pp.train_batch(xs[:64], ys[:64]) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+        pp.collect_params()
+        assert net.evaluate(xs[120:], ys[120:]).accuracy() > 0.6
+
+    def test_pipeline_matches_single_device_step(self):
+        from deeplearning4j_tpu.parallel.pipeline import PipelineParallel
+        xs, ys = iris_data()
+        conf_kw = dict(seed=11, lr=0.1)
+        single = _net(**{"seed": 11, "lr": 0.1})
+        single.fit(DataSet(xs[:32], ys[:32]))
+        p_single = single.params_flat()
+
+        net2 = _net(**{"seed": 11, "lr": 0.1})
+        pp = PipelineParallel(net2, devices=jax.devices()[:2],
+                              n_microbatches=1)
+        pp.train_batch(xs[:32], ys[:32])
+        pp.collect_params()
+        np.testing.assert_allclose(net2.params_flat(), p_single,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestParallelInference:
+    def test_batched_inference_matches_direct(self):
+        import threading
+        from deeplearning4j_tpu.parallel.inference import (
+            InferenceMode, ParallelInference)
+        xs, ys = iris_data()
+        net = _net()
+        net.fit(xs[:64], ys[:64], epochs=3, batch_size=32)
+        pi = (ParallelInference.builder(net)
+              .inference_mode(InferenceMode.BATCHED)
+              .batch_limit(16).build())
+        direct = np.asarray(net.output(xs[:40]))
+        results = {}
+
+        def call(i):
+            results[i] = pi.output(xs[i * 8:(i + 1) * 8])
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        got = np.concatenate([results[i] for i in range(5)])
+        np.testing.assert_allclose(got, direct, rtol=1e-5, atol=1e-6)
+        pi.shutdown()

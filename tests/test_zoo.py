@@ -1,0 +1,111 @@
+"""Zoo models: build, forward-shape, and tiny-fit checks (reference
+deeplearning4j-zoo/src/test pattern: instantiate + run tiny fits)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.zoo import (AlexNet, Darknet19, FaceNetNN4Small2,
+                                    GoogLeNet, InceptionResNetV1, LeNet,
+                                    ResNet50, SimpleCNN, TextGenerationLSTM,
+                                    TinyYOLO, UNet, VGG16,
+                                    available_models)
+
+
+def _img_batch(shape, n=2, seed=0):
+    return np.random.default_rng(seed).normal(
+        0, 1, (n,) + tuple(shape)).astype(np.float32)
+
+
+class TestZooBuilds:
+    """Every model builds its config and reports consistent shapes.
+    Small input shapes keep CPU runtime sane."""
+
+    def test_lenet_fit(self):
+        m = LeNet(n_classes=10).init()
+        x = _img_batch((28, 28, 1), 4)
+        y = np.eye(10, dtype=np.float32)[[0, 1, 2, 3]]
+        m.fit(DataSet(x, y))
+        assert np.asarray(m.output(x)).shape == (4, 10)
+
+    def test_simplecnn(self):
+        m = SimpleCNN(n_classes=5, input_shape=(32, 32, 3)).init()
+        out = np.asarray(m.output(_img_batch((32, 32, 3))))
+        assert out.shape == (2, 5)
+
+    def test_alexnet(self):
+        m = AlexNet(n_classes=10, input_shape=(96, 96, 3)).init()
+        out = np.asarray(m.output(_img_batch((96, 96, 3))))
+        assert out.shape == (2, 10)
+
+    def test_vgg16(self):
+        m = VGG16(n_classes=7, input_shape=(64, 64, 3)).init()
+        out = np.asarray(m.output(_img_batch((64, 64, 3))))
+        assert out.shape == (2, 7)
+
+    def test_resnet50(self):
+        m = ResNet50(n_classes=10, input_shape=(64, 64, 3)).init()
+        # 53 conv layers in bottleneck resnet-50
+        n_convs = sum(1 for name in m.conf.vertices
+                      if name.endswith("_conv"))
+        assert n_convs == 53, n_convs
+        out = np.asarray(m.output(_img_batch((64, 64, 3))))
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+    def test_resnet50_trains(self):
+        m = ResNet50(n_classes=3, input_shape=(32, 32, 3)).init()
+        x = _img_batch((32, 32, 3), 4)
+        y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+        m.fit(DataSet(x, y), epochs=2)
+        assert np.isfinite(float(m.score_value))
+
+    def test_googlenet(self):
+        m = GoogLeNet(n_classes=6, input_shape=(64, 64, 3)).init()
+        out = np.asarray(m.output(_img_batch((64, 64, 3))))
+        assert out.shape == (2, 6)
+
+    def test_inception_resnet_v1(self):
+        m = InceptionResNetV1(n_classes=5, input_shape=(64, 64, 3)).init()
+        out = np.asarray(m.output(_img_batch((64, 64, 3))))
+        assert out.shape == (2, 5)
+
+    def test_facenet(self):
+        m = FaceNetNN4Small2(n_classes=5, input_shape=(64, 64, 3)).init()
+        out = np.asarray(m.output(_img_batch((64, 64, 3))))
+        assert out.shape == (2, 5)
+
+    def test_textgen_lstm(self):
+        m = TextGenerationLSTM(vocab_size=30, max_length=16).init()
+        x = np.eye(30, dtype=np.float32)[
+            np.random.default_rng(0).integers(0, 30, (2, 16))]
+        out = np.asarray(m.output(x))
+        assert out.shape == (2, 16, 30)
+
+    def test_darknet19(self):
+        m = Darknet19(n_classes=8, input_shape=(64, 64, 3)).init()
+        out = np.asarray(m.output(_img_batch((64, 64, 3))))
+        assert out.shape == (2, 8)
+
+    def test_tinyyolo(self):
+        m = TinyYOLO(n_classes=4, input_shape=(64, 64, 3)).init()
+        x = _img_batch((64, 64, 3))
+        out = np.asarray(m.output(x))
+        # 64/32 = 2x2 grid, 5 anchors * (5+4)
+        assert out.shape == (2, 2, 2, 5 * 9)
+
+    def test_unet(self):
+        m = UNet(n_classes=1, input_shape=(32, 32, 3)).init()
+        out = np.asarray(m.output(_img_batch((32, 32, 3))))
+        assert out.shape == (2, 32, 32, 1)
+        assert (out >= 0).all() and (out <= 1).all()
+
+    def test_registry(self):
+        models = available_models()
+        assert len(models) == 13
+        assert "resnet50" in models
+
+    def test_pretrained_missing_raises(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(tmp_path))
+        with pytest.raises(FileNotFoundError, match="resnet50"):
+            ResNet50().init_pretrained()
