@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.quadtree import QuadTree, SpTree
+
+__all__ = ["KMeansClustering", "VPTree", "KDTree", "QuadTree", "SpTree"]

@@ -1,0 +1,95 @@
+"""K-Means clustering.
+
+Mirrors nearestneighbor-core clustering/kmeans/KMeansClustering.java —
+but the assignment/update steps are one jitted Lloyd iteration (full
+(N,K) distance matrix on the MXU, segment-sum centroid update) instead
+of per-point Java loops. k-means++ initialization included.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["KMeansClustering"]
+
+
+@jax.jit
+def _lloyd_step(points, centroids):
+    # points (N,D), centroids (K,D)
+    d2 = (jnp.sum(points ** 2, axis=1, keepdims=True)
+          - 2 * points @ centroids.T
+          + jnp.sum(centroids ** 2, axis=1)[None, :])
+    assign = jnp.argmin(d2, axis=1)                       # (N,)
+    onehot = jax.nn.one_hot(assign, centroids.shape[0],
+                            dtype=points.dtype)           # (N,K)
+    sums = onehot.T @ points                              # (K,D)
+    counts = jnp.sum(onehot, axis=0)[:, None]
+    new_centroids = jnp.where(counts > 0, sums / jnp.maximum(counts, 1),
+                              centroids)
+    inertia = jnp.sum(jnp.min(d2, axis=1))
+    return new_centroids, assign, inertia
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100,
+                 tol: float = 1e-5, seed: int = 0,
+                 init: str = "kmeans++"):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+        self.init = init
+        self.centroids: Optional[np.ndarray] = None
+        self.inertia: float = float("inf")
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = 100,
+              distance: str = "euclidean") -> "KMeansClustering":
+        """Reference-style factory (KMeansClustering.setup)."""
+        return KMeansClustering(k, max_iterations)
+
+    def _init_centroids(self, x: np.ndarray,
+                        rng: np.random.Generator) -> np.ndarray:
+        if self.init != "kmeans++":
+            return x[rng.choice(x.shape[0], self.k, replace=False)]
+        centroids = [x[rng.integers(0, x.shape[0])]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                [np.sum((x - c) ** 2, axis=1) for c in centroids],
+                axis=0)
+            probs = d2 / max(d2.sum(), 1e-12)
+            centroids.append(x[rng.choice(x.shape[0], p=probs)])
+        return np.stack(centroids)
+
+    def apply_to(self, points: np.ndarray) -> np.ndarray:
+        """Fit; returns cluster assignments (reference applyTo returns a
+        ClusterSet — assignments + centroids here)."""
+        x = np.asarray(points, np.float32)
+        rng = np.random.default_rng(self.seed)
+        c = jnp.asarray(self._init_centroids(x, rng))
+        xj = jnp.asarray(x)
+        prev = np.inf
+        assign = None
+        for it in range(self.max_iterations):
+            c, assign, inertia = _lloyd_step(xj, c)
+            inertia = float(inertia)
+            if abs(prev - inertia) < self.tol * max(abs(prev), 1.0):
+                break
+            prev = inertia
+        self.centroids = np.asarray(c)
+        self.inertia = inertia
+        return np.asarray(assign)
+
+    fit_predict = apply_to
+
+    def predict(self, points: np.ndarray) -> np.ndarray:
+        x = jnp.asarray(np.asarray(points, np.float32))
+        _, assign, _ = _lloyd_step(x, jnp.asarray(self.centroids))
+        return np.asarray(assign)

@@ -1,0 +1,101 @@
+"""VP-tree for exact nearest-neighbor search.
+
+Mirrors nearestneighbor-core clustering/vptree/VPTree.java:48 (build)
+and :471-508 (search): vantage-point partitioning by median distance,
+branch-and-bound k-NN with a bounded priority queue. Distances:
+euclidean / cosine (the reference's similarity functions).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["VPTree"]
+
+
+class _Node:
+    __slots__ = ("index", "threshold", "left", "right")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.threshold = 0.0
+        self.left: Optional["_Node"] = None
+        self.right: Optional["_Node"] = None
+
+
+class VPTree:
+    def __init__(self, items: np.ndarray, distance: str = "euclidean",
+                 seed: int = 0):
+        self.items = np.asarray(items, np.float64)
+        self.distance = distance
+        if distance == "cosine":
+            norms = np.linalg.norm(self.items, axis=1, keepdims=True)
+            self._normed = self.items / np.maximum(norms, 1e-12)
+        self._rng = np.random.default_rng(seed)
+        idx = list(range(len(self.items)))
+        self.root = self._build(idx)
+
+    def _dist_many(self, i: int, others: np.ndarray) -> np.ndarray:
+        if self.distance == "cosine":
+            return 1.0 - self._normed[others] @ self._normed[i]
+        diff = self.items[others] - self.items[i]
+        return np.sqrt(np.sum(diff * diff, axis=1))
+
+    def _dist_point(self, q: np.ndarray, i: int) -> float:
+        if self.distance == "cosine":
+            qn = q / max(np.linalg.norm(q), 1e-12)
+            return float(1.0 - self._normed[i] @ qn)
+        return float(np.linalg.norm(self.items[i] - q))
+
+    def _build(self, idx: List[int]) -> Optional[_Node]:
+        if not idx:
+            return None
+        vp_pos = self._rng.integers(0, len(idx))
+        vp = idx.pop(int(vp_pos))
+        node = _Node(vp)
+        if not idx:
+            return node
+        others = np.array(idx)
+        dists = self._dist_many(vp, others)
+        median = float(np.median(dists))
+        node.threshold = median
+        inner = [int(i) for i, d in zip(others, dists) if d < median]
+        outer = [int(i) for i, d in zip(others, dists) if d >= median]
+        node.left = self._build(inner)
+        node.right = self._build(outer)
+        return node
+
+    def search(self, query: np.ndarray, k: int) -> Tuple[List[int],
+                                                         List[float]]:
+        """k nearest neighbors (reference search :471)."""
+        q = np.asarray(query, np.float64)
+        heap: List[Tuple[float, int]] = []   # max-heap via negatives
+        tau = [np.inf]
+
+        def visit(node: Optional[_Node]):
+            if node is None:
+                return
+            d = self._dist_point(q, node.index)
+            if d < tau[0] or len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+                if len(heap) > k:
+                    heapq.heappop(heap)
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+            if node.left is None and node.right is None:
+                return
+            if d < node.threshold:
+                visit(node.left)
+                if d + tau[0] >= node.threshold:
+                    visit(node.right)
+            else:
+                visit(node.right)
+                if d - tau[0] <= node.threshold:
+                    visit(node.left)
+
+        visit(self.root)
+        pairs = sorted((-nd, i) for nd, i in heap)
+        return [i for _, i in pairs], [d for d, _ in pairs]

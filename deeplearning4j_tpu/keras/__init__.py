@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.keras.importer import (
+    import_keras_model_and_weights, import_keras_sequential_model,
+    KerasImportError,
+)
+
+__all__ = ["import_keras_model_and_weights",
+           "import_keras_sequential_model", "KerasImportError"]

@@ -1,0 +1,11 @@
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, NGramTokenizerFactory, STOP_WORDS,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor, Huffman
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, SequenceVectors
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+
+__all__ = ["DefaultTokenizerFactory", "NGramTokenizerFactory", "STOP_WORDS",
+           "VocabCache", "VocabConstructor", "Huffman", "Word2Vec",
+           "SequenceVectors", "ParagraphVectors", "Glove"]

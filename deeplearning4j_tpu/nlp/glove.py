@@ -1,0 +1,122 @@
+"""GloVe embeddings.
+
+Mirrors models/glove/Glove.java (429 LoC) +
+learning/impl/elements/GloVe.java: co-occurrence matrix with 1/distance
+weighting within a window, then the weighted least-squares objective
+  J = Σ f(X_ij)(wᵢᵀw̃ⱼ + bᵢ + b̃ⱼ − log X_ij)²,   f(x)=(x/x_max)^α
+trained with AdaGrad — but batched over all non-zero co-occurrences in
+one jitted step, not per-pair HOGWILD.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["Glove"]
+
+
+class Glove(SequenceVectors):
+    def __init__(self, *, x_max: float = 100.0, alpha: float = 0.75,
+                 symmetric: bool = True, **kw):
+        kw.setdefault("learning_rate", 0.05)
+        super().__init__(**kw)
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self.bias_w: Optional[np.ndarray] = None
+        self.bias_c: Optional[np.ndarray] = None
+
+    def _cooccurrences(self, sequences) -> Dict[Tuple[int, int], float]:
+        counts: Dict[Tuple[int, int], float] = {}
+        for seq in sequences:
+            idxs = [self.vocab.index_of(t) for t in seq]
+            idxs = [i for i in idxs if i >= 0]
+            for pos, w in enumerate(idxs):
+                for off in range(1, self.window + 1):
+                    j = pos + off
+                    if j >= len(idxs):
+                        break
+                    c = idxs[j]
+                    inc = 1.0 / off        # 1/distance weighting
+                    counts[(w, c)] = counts.get((w, c), 0.0) + inc
+                    if self.symmetric:
+                        counts[(c, w)] = counts.get((c, w), 0.0) + inc
+        return counts
+
+    def fit(self, sequences: List[List[str]]):
+        if self.vocab is None:
+            self.build_vocab(sequences)
+        co = self._cooccurrences(sequences)
+        if not co:
+            raise ValueError("No co-occurrences found")
+        rows = np.array([k[0] for k in co], np.int32)
+        cols = np.array([k[1] for k in co], np.int32)
+        vals = np.array(list(co.values()), np.float32)
+        logv = np.log(vals)
+        weights = np.minimum(1.0, (vals / self.x_max) ** self.alpha) \
+            .astype(np.float32)
+
+        V, D = len(self.vocab), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray(((rng.random((V, D)) - 0.5) / D)
+                        .astype(np.float32))
+        wc = jnp.asarray(((rng.random((V, D)) - 0.5) / D)
+                         .astype(np.float32))
+        bw = jnp.zeros((V,), jnp.float32)
+        bc = jnp.zeros((V,), jnp.float32)
+        # AdaGrad accumulators
+        gw = jnp.full((V, D), 1e-8, jnp.float32)
+        gwc = jnp.full((V, D), 1e-8, jnp.float32)
+        gbw = jnp.full((V,), 1e-8, jnp.float32)
+        gbc = jnp.full((V,), 1e-8, jnp.float32)
+
+        rows_j = jnp.asarray(rows)
+        cols_j = jnp.asarray(cols)
+        logv_j = jnp.asarray(logv)
+        wgt_j = jnp.asarray(weights)
+        lr = self.learning_rate
+
+        @jax.jit
+        def epoch_step(w, wc, bw, bc, gw, gwc, gbw, gbc):
+            def loss_fn(w, wc, bw, bc):
+                wi = jnp.take(w, rows_j, axis=0)
+                cj = jnp.take(wc, cols_j, axis=0)
+                pred = (jnp.sum(wi * cj, axis=-1)
+                        + jnp.take(bw, rows_j) + jnp.take(bc, cols_j))
+                err = pred - logv_j
+                return 0.5 * jnp.sum(wgt_j * err * err)
+            loss, grads = jax.value_and_grad(loss_fn, (0, 1, 2, 3))(
+                w, wc, bw, bc)
+            dw, dwc, dbw, dbc = grads
+            gw2 = gw + dw * dw
+            gwc2 = gwc + dwc * dwc
+            gbw2 = gbw + dbw * dbw
+            gbc2 = gbc + dbc * dbc
+            w2 = w - lr * dw / jnp.sqrt(gw2)
+            wc2 = wc - lr * dwc / jnp.sqrt(gwc2)
+            bw2 = bw - lr * dbw / jnp.sqrt(gbw2)
+            bc2 = bc - lr * dbc / jnp.sqrt(gbc2)
+            return w2, wc2, bw2, bc2, gw2, gwc2, gbw2, gbc2, loss
+
+        loss = None
+        for ep in range(max(self.epochs, 1)):
+            (w, wc, bw, bc, gw, gwc, gbw, gbc,
+             loss) = epoch_step(w, wc, bw, bc, gw, gwc, gbw, gbc)
+        logger.info("GloVe fit: %d cooccurrences, final loss %.4f",
+                    len(vals), float(loss))
+        # final embedding = w + context (GloVe convention)
+        self.syn0 = np.asarray(w + wc)
+        self.syn1 = np.asarray(wc)
+        self.bias_w = np.asarray(bw)
+        self.bias_c = np.asarray(bc)
+        return self

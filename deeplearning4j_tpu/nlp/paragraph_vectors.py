@@ -1,0 +1,173 @@
+"""ParagraphVectors (doc2vec).
+
+Mirrors models/paragraphvectors/ParagraphVectors.java (1449 LoC):
+PV-DBOW (doc vector predicts words — the reference's DBOW sequence
+algorithm, learning/impl/sequence/DBOW.java) and PV-DM (doc + context
+mean predicts center, DM.java). Document vectors live in a separate
+table; inference of a new doc's vector freezes word/softmax weights
+and gradient-descends only the doc vector (reference
+inferVector semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import SequenceVectors
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["ParagraphVectors"]
+
+
+class ParagraphVectors(SequenceVectors):
+    def __init__(self, *, dm: bool = False, **kw):
+        super().__init__(**kw)
+        self.dm = dm
+        self.doc_vectors: Optional[np.ndarray] = None
+        self.doc_labels: List[str] = []
+        self._label_index: Dict[str, int] = {}
+
+    def fit_documents(self, documents: Sequence, labels=None):
+        """documents: list of token lists; labels default doc_0..n."""
+        documents = [list(d) for d in documents]
+        labels = (list(labels) if labels is not None
+                  else [f"doc_{i}" for i in range(len(documents))])
+        self.doc_labels = labels
+        self._label_index = {l: i for i, l in enumerate(labels)}
+        self.build_vocab(documents)
+        rng = np.random.default_rng(self.seed)
+        D = self.layer_size
+        self.doc_vectors = ((rng.random((len(documents), D)) - 0.5)
+                            / D).astype(np.float32)
+
+        pairs = []          # (doc_idx, center, [context for DM])
+        for di, doc in enumerate(documents):
+            idxs = [self.vocab.index_of(t) for t in doc]
+            idxs = [i for i in idxs if i >= 0]
+            for pos, center in enumerate(idxs):
+                if self.dm:
+                    lo = max(0, pos - self.window)
+                    hi = min(len(idxs), pos + self.window + 1)
+                    ctx = [idxs[j] for j in range(lo, hi) if j != pos]
+                    if not ctx:
+                        continue
+                    ctx = (ctx * self.window)[:self.window]
+                    pairs.append((di, center, ctx))
+                else:
+                    pairs.append((di, center, None))
+
+        step = self._make_doc_step()
+        syn0 = jnp.asarray(self.syn0)
+        syn1 = jnp.asarray(self.syn1)
+        docs = jnp.asarray(self.doc_vectors)
+        V = len(self.vocab)
+        B = min(self.batch_size, max(1, len(pairs)))
+        total_steps = max(1, len(pairs) * self.epochs // B)
+        step_i = 0
+        for _ in range(self.epochs):
+            if not pairs:
+                continue
+            order = rng.permutation(len(pairs))
+            if len(pairs) < B:
+                order = np.resize(order, B)
+            for s in range(0, len(order) - B + 1, B):
+                sel = order[s:s + B]
+                di = jnp.asarray([pairs[i][0] for i in sel], jnp.int32)
+                ce = jnp.asarray([pairs[i][1] for i in sel], jnp.int32)
+                if self.dm:
+                    cx = jnp.asarray([pairs[i][2] for i in sel],
+                                     jnp.int32)
+                else:
+                    cx = None
+                negs = jnp.asarray(
+                    rng.choice(V, size=(len(sel), self.negative),
+                               p=self._unigram_table), jnp.int32)
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1 - step_i / total_steps))
+                docs, syn0, syn1, loss = step(docs, syn0, syn1, di, ce,
+                                              cx, negs, jnp.float32(lr))
+                step_i += 1
+        self.syn0 = np.asarray(syn0)
+        self.syn1 = np.asarray(syn1)
+        self.doc_vectors = np.asarray(docs)
+        return self
+
+    def _make_doc_step(self):
+        dm = self.dm
+
+        @jax.jit
+        def step(docs, syn0, syn1, doc_idx, centers, contexts, negatives,
+                 lr):
+            def loss_fn(dv, s0, s1):
+                d = jnp.take(dv, doc_idx, axis=0)            # (B,D)
+                if dm:
+                    ctx = jnp.take(s0, contexts, axis=0)     # (B,W,D)
+                    h = (d + jnp.sum(ctx, axis=1)) / (1 + ctx.shape[1])
+                else:
+                    h = d
+                pos = jnp.take(s1, centers, axis=0)
+                neg = jnp.take(s1, negatives, axis=0)
+                pos_score = jnp.sum(h * pos, axis=-1)
+                neg_score = jnp.einsum("bd,bkd->bk", h, neg)
+                return (jnp.sum(jax.nn.softplus(-pos_score))
+                        + jnp.sum(jax.nn.softplus(neg_score)))
+            loss, (gd, g0, g1) = jax.value_and_grad(
+                loss_fn, (0, 1, 2))(docs, syn0, syn1)
+            from deeplearning4j_tpu.nlp.word2vec import _clip_rows
+            return (docs - lr * _clip_rows(gd),
+                    syn0 - lr * _clip_rows(g0),
+                    syn1 - lr * _clip_rows(g1), loss)
+
+        return step
+
+    # ------------------------------------------------------------- queries
+    def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self._label_index.get(label)
+        return None if i is None else self.doc_vectors[i]
+
+    def infer_vector(self, tokens: List[str], steps: int = 50,
+                     lr: float = 0.05) -> np.ndarray:
+        """Infer an unseen document's vector with word weights frozen
+        (reference inferVector)."""
+        idxs = [self.vocab.index_of(t) for t in tokens]
+        idxs = [i for i in idxs if i >= 0]
+        if not idxs:
+            return np.zeros(self.layer_size, np.float32)
+        rng = np.random.default_rng(self.seed)
+        v = jnp.asarray(((rng.random(self.layer_size) - 0.5)
+                         / self.layer_size).astype(np.float32))
+        syn1 = jnp.asarray(self.syn1)
+        centers = jnp.asarray(idxs, jnp.int32)
+        V = len(self.vocab)
+
+        @jax.jit
+        def infer_step(v, negs, lr_):
+            def loss_fn(vv):
+                pos = jnp.take(syn1, centers, axis=0)
+                neg = jnp.take(syn1, negs, axis=0)
+                pos_score = pos @ vv
+                neg_score = neg @ vv
+                return (jnp.mean(jax.nn.softplus(-pos_score))
+                        + jnp.mean(jnp.sum(jax.nn.softplus(neg_score),
+                                           axis=-1)))
+            loss, g = jax.value_and_grad(loss_fn)(v)
+            return v - lr_ * g
+
+        for s in range(steps):
+            negs = jnp.asarray(
+                rng.choice(V, size=(len(idxs), self.negative),
+                           p=self._unigram_table), jnp.int32)
+            v = infer_step(v, negs, jnp.float32(lr * (1 - s / steps)))
+        return np.asarray(v)
+
+    def similarity_to_label(self, tokens: List[str], label: str) -> float:
+        v = self.infer_vector(tokens)
+        d = self.get_doc_vector(label)
+        denom = np.linalg.norm(v) * np.linalg.norm(d)
+        return float(v @ d / denom) if denom else 0.0

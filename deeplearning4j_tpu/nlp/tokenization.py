@@ -1,0 +1,118 @@
+"""Tokenization: TokenizerFactory SPI + preprocessors + stopwords.
+
+Mirrors deeplearning4j-nlp's text layer (TokenizerFactory SPI,
+DefaultTokenizerFactory, NGramTokenizerFactory,
+CommonPreprocessor/EndingPreProcessor, stopwords list). Language packs
+(ansj Chinese / Kuromoji Japanese bundles) are out of scope — the SPI
+accepts any callable tokenizer, which is where those plug in.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterable, List, Optional
+
+__all__ = ["Tokenizer", "DefaultTokenizerFactory",
+           "NGramTokenizerFactory", "CommonPreprocessor", "STOP_WORDS",
+           "SentenceIterator", "ListSentenceIterator",
+           "FileSentenceIterator"]
+
+# the reference's stopwords resource (stopwords file in
+# deeplearning4j-nlp resources), trimmed to the common core
+STOP_WORDS = frozenset("""a an and are as at be but by for if in into is it
+no not of on or such that the their then there these they this to was will
+with""".split())
+
+
+class CommonPreprocessor:
+    """Lowercase + strip punctuation (CommonPreprocessor.java)."""
+
+    _punct = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._punct.sub("", token.lower())
+
+
+class Tokenizer:
+    def __init__(self, tokens: List[str], preprocessor=None):
+        self._tokens = tokens
+        self._pre = preprocessor
+
+    def get_tokens(self) -> List[str]:
+        if self._pre is None:
+            return list(self._tokens)
+        out = []
+        for t in self._tokens:
+            t = self._pre.pre_process(t)
+            if t:
+                out.append(t)
+        return out
+
+
+class DefaultTokenizerFactory:
+    """Whitespace/word tokenizer (DefaultTokenizerFactory.java)."""
+
+    _word = re.compile(r"\S+")
+
+    def __init__(self):
+        self._pre = None
+
+    def set_token_pre_processor(self, pre):
+        self._pre = pre
+        return self
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(self._word.findall(text), self._pre)
+
+
+class NGramTokenizerFactory:
+    """Word n-grams (NGramTokenizerFactory.java)."""
+
+    def __init__(self, n_min: int, n_max: int):
+        self.n_min = n_min
+        self.n_max = n_max
+        self._base = DefaultTokenizerFactory()
+
+    def set_token_pre_processor(self, pre):
+        self._base.set_token_pre_processor(pre)
+        return self
+
+    def create(self, text: str) -> Tokenizer:
+        words = self._base.create(text).get_tokens()
+        grams = []
+        for n in range(self.n_min, self.n_max + 1):
+            for i in range(len(words) - n + 1):
+                grams.append(" ".join(words[i:i + n]))
+        return Tokenizer(grams)
+
+
+class SentenceIterator:
+    """(sentenceiterator SPI)."""
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class ListSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Iterable[str]):
+        self.sentences = list(sentences)
+
+    def __iter__(self):
+        return iter(self.sentences)
+
+
+class FileSentenceIterator(SentenceIterator):
+    """One sentence per line (LineSentenceIterator.java)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self):
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line

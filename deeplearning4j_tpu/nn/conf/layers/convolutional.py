@@ -216,6 +216,11 @@ class DepthwiseConvolution2DLayer(ConvolutionLayer):
 
     depth_multiplier: int = 1
 
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.channels
+        self.n_out = self.n_in * self.depth_multiplier
+
     def output_type(self, input_type: InputType) -> InputType:
         base = super().output_type(input_type)
         return InputType.convolutional(base.height, base.width,

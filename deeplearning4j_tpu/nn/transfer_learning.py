@@ -109,6 +109,13 @@ class TransferLearning:
                 new_conf.conf.updater_cfg = self._fine_tune.updater
             if self._fine_tune.seed is not None:
                 new_conf.conf.seed = self._fine_tune.seed
+            if self._fine_tune.dropout is not None:
+                # applies to layers that will remain trainable (frozen
+                # layers run inference-mode anyway)
+                start = (self._freeze_until + 1
+                         if self._freeze_until is not None else 0)
+                for lay in layers[start:]:
+                    lay.dropout = self._fine_tune.dropout
 
         # 4. wrap frozen layers
         if self._freeze_until is not None:

@@ -92,10 +92,10 @@ class ParallelInference:
         return p.result
 
     def _collector(self):
-        carry: Optional[_Pending] = None      # dequeued but over-limit
+        self._carry = None                    # dequeued but over-limit
         while not self._stop.is_set():
-            if carry is not None:
-                first, carry = carry, None
+            if self._carry is not None:
+                first, self._carry = self._carry, None
             else:
                 try:
                     first = self._queue.get(timeout=0.1)
@@ -114,7 +114,7 @@ class ParallelInference:
                 except queue.Empty:
                     break
                 if total + nxt.x.shape[0] > self.max_batch_size:
-                    carry = nxt          # would exceed cap: next round
+                    self._carry = nxt    # would exceed cap: next round
                     break
                 batch.append(nxt)
                 total += nxt.x.shape[0]
@@ -147,6 +147,22 @@ class ParallelInference:
         self._stop.set()
         if self._worker is not None:
             self._worker.join(timeout=1.0)
+        # fail any requests still queued so their callers don't block
+        # forever on event.wait()
+        err = RuntimeError("ParallelInference shut down before request "
+                           "was served")
+        carry = getattr(self, "_carry", None)
+        if carry is not None:
+            carry.error = err
+            carry.event.set()
+            self._carry = None
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            p.error = err
+            p.event.set()
 
 
 def _now() -> float:

@@ -148,11 +148,28 @@ class PipelineParallel:
                 grads[s] = gp if grads[s] is None else \
                     jax.tree_util.tree_map(jnp.add, grads[s], gp)
                 cot = gx
+        # regularization gradients + post-update constraints per stage —
+        # the pieces the model's own jitted step applies
+        # (multi_layer_network._loss / apply_layer_constraints)
+        from deeplearning4j_tpu.train.constraints import (
+            apply_layer_constraints)
         for s in range(S):
+            lo, hi = self._stage_ranges[s]
+
+            def stage_reg(p, lo=lo, hi=hi):
+                r = jnp.zeros(())
+                for j, li in enumerate(range(lo, hi)):
+                    r = r + self.net.layers[li].regularization_loss(p[j])
+                return r
+
+            reg_g = jax.grad(stage_reg)(self.stage_params[s])
+            grads[s] = jax.tree_util.tree_map(jnp.add, grads[s], reg_g)
             upd, self.opt_states[s] = self._opts[s].update(
                 grads[s], self.opt_states[s], self.stage_params[s])
-            self.stage_params[s] = optax.apply_updates(
-                self.stage_params[s], upd)
+            new_p = optax.apply_updates(self.stage_params[s], upd)
+            self.stage_params[s] = [
+                apply_layer_constraints(self.net.layers[lo + j], p)
+                for j, p in enumerate(new_p)]
         self.iteration_count += 1
         loss = float(sum(float(l) * w for l, w in zip(losses, weights)))
         self.net.score_value = loss

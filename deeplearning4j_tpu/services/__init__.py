@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.services.nearest_neighbors import (
+    NearestNeighborsServer, NearestNeighborsClient,
+)
+
+__all__ = ["NearestNeighborsServer", "NearestNeighborsClient"]

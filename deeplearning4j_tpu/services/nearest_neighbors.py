@@ -1,0 +1,145 @@
+"""Nearest-neighbors REST service + client.
+
+Mirrors deeplearning4j-nearestneighbor-server
+(NearestNeighborsServer.java — Play REST over a serialized VPTree, CLI
+via JCommander) and the Java client: a threaded HTTP server exposing
+k-NN over a VPTree index. Wire model: JSON (the reference wraps base64
+NDArrays; plain float lists here).
+
+Endpoints:
+  POST /knn          {"vector": [...], "k": 5} → {"indices", "distances"}
+  POST /knnindex     {"index": 12, "k": 5}
+  GET  /status       {"points": N, "dims": D}
+CLI: python -m deeplearning4j_tpu.services.nearest_neighbors
+     --points data.npy --port 9200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["NearestNeighborsServer", "NearestNeighborsClient"]
+
+
+class NearestNeighborsServer:
+    def __init__(self, points: np.ndarray, port: int = 0,
+                 distance: str = "euclidean"):
+        self.points = np.asarray(points, np.float64)
+        self.tree = VPTree(self.points, distance=distance)
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def start(self) -> "NearestNeighborsServer":
+        tree = self.tree
+        points = self.points
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, obj):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/status":
+                    self._send(200, {"points": int(points.shape[0]),
+                                     "dims": int(points.shape[1])})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n).decode())
+                except json.JSONDecodeError:
+                    self._send(400, {"error": "invalid JSON"})
+                    return
+                k = int(body.get("k", 5))
+                if self.path == "/knn":
+                    vec = np.asarray(body["vector"], np.float64)
+                    if vec.shape != (points.shape[1],):
+                        self._send(400, {"error":
+                                         f"vector must have dim "
+                                         f"{points.shape[1]}"})
+                        return
+                elif self.path == "/knnindex":
+                    idx = int(body["index"])
+                    if not 0 <= idx < points.shape[0]:
+                        self._send(400, {"error": "index out of range"})
+                        return
+                    vec = points[idx]
+                else:
+                    self._send(404, {"error": "not found"})
+                    return
+                ids, dists = tree.search(vec, k)
+                self._send(200, {"indices": ids,
+                                 "distances": [float(d) for d in dists]})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                          Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        logger.info("NearestNeighborsServer on port %d", self.port)
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+
+class NearestNeighborsClient:
+    def __init__(self, host: str = "localhost", port: int = 9200):
+        self.base = f"http://{host}:{port}"
+
+    def _post(self, path: str, payload: dict) -> dict:
+        import urllib.request
+        req = urllib.request.Request(
+            self.base + path, json.dumps(payload).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read().decode())
+
+    def knn(self, vector, k: int = 5) -> dict:
+        return self._post("/knn", {"vector": list(map(float, vector)),
+                                   "k": k})
+
+    def knn_index(self, index: int, k: int = 5) -> dict:
+        return self._post("/knnindex", {"index": index, "k": k})
+
+
+def main():
+    p = argparse.ArgumentParser(description="k-NN REST server")
+    p.add_argument("--points", required=True,
+                   help=".npy file of shape (N, D)")
+    p.add_argument("--port", type=int, default=9200)
+    p.add_argument("--distance", default="euclidean",
+                   choices=["euclidean", "cosine"])
+    args = p.parse_args()
+    pts = np.load(args.points)
+    server = NearestNeighborsServer(pts, args.port, args.distance)
+    server.start()
+    import time
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

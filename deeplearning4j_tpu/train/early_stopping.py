@@ -42,6 +42,8 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 class EpochTerminationCondition:
+    requires_score = True      # False → checked even on unscored epochs
+
     def initialize(self):
         pass
 
@@ -58,6 +60,8 @@ class IterationTerminationCondition:
 
 
 class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    requires_score = False
+
     def __init__(self, max_epochs: int):
         self.max_epochs = max_epochs
 
@@ -280,22 +284,29 @@ class EarlyStoppingTrainer:
                     reason = "iteration"
                     details = type(stop.cond).__name__
                     break
-                # score this epoch
-                if cfg.score_calculator is not None and \
-                        epoch % cfg.evaluate_every_n_epochs == 0:
-                    score = float(
-                        cfg.score_calculator.calculate_score(model))
+                # score this epoch; with a score calculator, epochs it
+                # skips are NOT scored at all (mixing train loss into
+                # best-model selection would compare different metrics —
+                # reference BaseEarlyStoppingTrainer skips them too)
+                score = None
+                if cfg.score_calculator is not None:
+                    if epoch % cfg.evaluate_every_n_epochs == 0:
+                        score = float(
+                            cfg.score_calculator.calculate_score(model))
                 else:
                     score = float(model.score_value)
-                score_vs_epoch[epoch] = score
-                if score < best_score:
-                    best_score = score
-                    best_epoch = epoch
-                    cfg.model_saver.save_best(model)
+                if score is not None:
+                    score_vs_epoch[epoch] = score
+                    if score < best_score:
+                        best_score = score
+                        best_epoch = epoch
+                        cfg.model_saver.save_best(model)
                 if cfg.save_last_model:
                     cfg.model_saver.save_latest(model)
                 stop_now = False
                 for c in cfg.epoch_termination_conditions:
+                    if score is None and c.requires_score:
+                        continue
                     if c.terminate(epoch, score):
                         reason = "epoch"
                         details = type(c).__name__

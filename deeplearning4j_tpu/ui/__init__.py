@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.ui.stats import (StatsListener, StatsReport,
+                                         InMemoryStatsStorage,
+                                         FileStatsStorage)
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = ["StatsListener", "StatsReport", "InMemoryStatsStorage",
+           "FileStatsStorage", "UIServer"]

@@ -1,0 +1,170 @@
+"""Training visualization web UI.
+
+Mirrors deeplearning4j-play's PlayUIServer (ui/play/PlayUIServer.java:53,
+default port 9000) + the train module (module/train/TrainModule.java):
+a web dashboard showing score-vs-iteration, throughput, and per-layer
+parameter mean magnitudes. Stdlib http.server + a self-contained HTML
+page (inline SVG charts — zero external assets), instead of the
+Play framework + JS bundles.
+
+Endpoints: ``/`` (dashboard), ``/api/sessions``, ``/api/updates?session=``.
+POST ``/api/remote`` accepts remote stats (the remote-listener path,
+deeplearning4j-ui-remote-iterationlisteners).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage, StatsReport
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["UIServer"]
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j-tpu training UI</title>
+<style>
+ body { font-family: sans-serif; margin: 2em; background: #fafafa; }
+ h1 { font-size: 1.3em; } h2 { font-size: 1.05em; color: #444; }
+ .chart { background: white; border: 1px solid #ddd; margin: 1em 0;
+          padding: 0.5em; }
+ text { font-size: 10px; fill: #666; }
+ .meta { color: #888; font-size: 0.9em; }
+</style></head>
+<body>
+<h1>Training dashboard</h1>
+<div class="meta" id="meta"></div>
+<div class="chart"><h2>Score vs iteration</h2>
+  <svg id="score" width="800" height="220"></svg></div>
+<div class="chart"><h2>Samples/sec</h2>
+  <svg id="tput" width="800" height="160"></svg></div>
+<div class="chart"><h2>Mean |param| per layer</h2>
+  <svg id="params" width="800" height="220"></svg></div>
+<script>
+function line(svg, xs, ys, color) {
+  const el = document.getElementById(svg);
+  const W = el.getAttribute('width'), H = el.getAttribute('height');
+  if (xs.length < 2) return;
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const yv = ys.filter(v => isFinite(v));
+  const ymin = Math.min(...yv), ymax = Math.max(...yv);
+  const sx = x => 40 + (x - xmin) / Math.max(xmax - xmin, 1e-9) * (W - 60);
+  const sy = y => H - 20 - (y - ymin) / Math.max(ymax - ymin, 1e-9) * (H - 40);
+  const pts = xs.map((x, i) => `${sx(x)},${sy(ys[i])}`).join(' ');
+  el.innerHTML += `<polyline points="${pts}" fill="none" stroke="${color}"
+                   stroke-width="1.5"/>` +
+    `<text x="4" y="14">${ymax.toPrecision(4)}</text>` +
+    `<text x="4" y="${H-22}">${ymin.toPrecision(4)}</text>`;
+}
+async function refresh() {
+  const sessions = await (await fetch('/api/sessions')).json();
+  if (!sessions.length) return;
+  const sid = sessions[sessions.length - 1];
+  const updates = await (await fetch('/api/updates?session=' + sid)).json();
+  document.getElementById('meta').textContent =
+    `session ${sid} — ${updates.length} reports`;
+  for (const id of ['score', 'tput', 'params'])
+    document.getElementById(id).innerHTML = '';
+  const it = updates.map(u => u.iteration);
+  line('score', it, updates.map(u => u.score), '#d33');
+  line('tput', it, updates.map(u => u.samples_per_sec), '#36c');
+  const names = Object.keys(updates[updates.length-1]
+                            .param_mean_magnitudes || {});
+  const colors = ['#283', '#c63', '#639', '#366', '#933', '#369'];
+  names.forEach((n, i) => line('params', it,
+    updates.map(u => u.param_mean_magnitudes[n] || 0),
+    colors[i % colors.length]));
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+class UIServer:
+    """(PlayUIServer equivalent). ``UIServer.get_instance().attach(
+    storage)`` then browse http://localhost:<port>/ ."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self.storage = InMemoryStatsStorage()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+            cls._instance.start()
+        return cls._instance
+
+    def attach(self, storage) -> None:
+        self.storage = storage
+
+    def start(self) -> None:
+        storage_ref = lambda: self.storage      # noqa: E731
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, body, ctype="application/json"):
+                data = body.encode() if isinstance(body, str) else body
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                storage = storage_ref()
+                if url.path in ("/", "/train", "/train/overview"):
+                    self._send(200, _PAGE, "text/html")
+                elif url.path == "/api/sessions":
+                    self._send(200,
+                               json.dumps(storage.list_session_ids()))
+                elif url.path == "/api/updates":
+                    q = parse_qs(url.query)
+                    sid = q.get("session", [None])[0]
+                    if sid is None:
+                        ids = storage.list_session_ids()
+                        sid = ids[-1] if ids else ""
+                    ups = [dataclasses.asdict(u)
+                           for u in storage.get_all_updates(sid)]
+                    self._send(200, json.dumps(ups))
+                else:
+                    self._send(404, json.dumps({"error": "not found"}))
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                if url.path == "/api/remote":
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n).decode()
+                    report = StatsReport.from_json(body)
+                    storage_ref().put_update(report)
+                    self._send(200, json.dumps({"ok": True}))
+                else:
+                    self._send(404, json.dumps({"error": "not found"}))
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                          Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        logger.info("UI server on http://localhost:%d/", self.port)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        UIServer._instance = None

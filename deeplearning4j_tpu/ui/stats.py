@@ -1,0 +1,161 @@
+"""Training stats collection + storage.
+
+Mirrors deeplearning4j-ui-model's BaseStatsListener
+(ui/stats/BaseStatsListener.java:297 iterationDone → :349 memory/
+timings → :446-457 histograms & mean magnitudes of params/gradients/
+updates/activations) and the StatsStorage API (deeplearning4j-core
+api/storage/StatsStorage.java; in-memory + file impls). The reference's
+SBE binary wire format becomes JSON-lines (human-debuggable, and the
+dashboard reads it directly); the Persistable/sessionID/typeID/workerID
+key scheme is preserved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+__all__ = ["StatsReport", "StatsListener", "InMemoryStatsStorage",
+           "FileStatsStorage"]
+
+
+@dataclasses.dataclass
+class StatsReport:
+    """One iteration's stats (SbeStatsReport equivalent)."""
+
+    session_id: str
+    worker_id: str
+    iteration: int
+    timestamp: float
+    score: float
+    # per-param-group summaries: name -> value
+    param_mean_magnitudes: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    gradient_mean_magnitudes: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    update_mean_magnitudes: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    histograms: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    duration_ms: float = 0.0
+    samples_per_sec: float = 0.0
+    memory_bytes: Optional[int] = None
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def from_json(s: str) -> "StatsReport":
+        return StatsReport(**json.loads(s))
+
+
+class InMemoryStatsStorage:
+    """(api/storage/impl/InMemoryStatsStorage.java)."""
+
+    def __init__(self):
+        self._reports: Dict[str, List[StatsReport]] = {}
+
+    def put_update(self, report: StatsReport):
+        self._reports.setdefault(report.session_id, []).append(report)
+
+    def list_session_ids(self) -> List[str]:
+        return sorted(self._reports)
+
+    def get_all_updates(self, session_id: str) -> List[StatsReport]:
+        return list(self._reports.get(session_id, []))
+
+    def get_latest_update(self, session_id: str) -> Optional[StatsReport]:
+        r = self._reports.get(session_id)
+        return r[-1] if r else None
+
+
+class FileStatsStorage(InMemoryStatsStorage):
+    """JSON-lines file persistence (FileStatsStorage.java equivalent)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        super().put_update(StatsReport.from_json(line))
+
+    def put_update(self, report: StatsReport):
+        super().put_update(report)
+        with open(self.path, "a") as f:
+            f.write(report.to_json() + "\n")
+
+
+def _histogram(arr: np.ndarray, bins: int = 20) -> dict:
+    counts, edges = np.histogram(arr, bins=bins)
+    return {"min": float(edges[0]), "max": float(edges[-1]),
+            "counts": counts.tolist()}
+
+
+class StatsListener(TrainingListener):
+    """(BaseStatsListener.java:44). Collects score + per-layer param/
+    gradient summaries every ``frequency`` iterations into a
+    StatsStorage. Reading device arrays forces a sync, so heavyweight
+    stats (histograms) only run on reporting iterations."""
+
+    def __init__(self, storage, frequency: int = 10,
+                 session_id: Optional[str] = None,
+                 worker_id: str = "worker_0",
+                 collect_histograms: bool = True):
+        self.storage = storage
+        self.freq = max(1, frequency)
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self._last_time = None
+        self._prev_params: Optional[np.ndarray] = None
+
+    def iteration_done(self, model, iteration, score, batch_size):
+        if iteration % self.freq != 0:
+            return
+        now = time.perf_counter()
+        duration = 0.0 if self._last_time is None else \
+            (now - self._last_time) * 1000 / self.freq
+        self._last_time = now
+        report = StatsReport(
+            session_id=self.session_id, worker_id=self.worker_id,
+            iteration=iteration, timestamp=time.time(),
+            score=float(score), duration_ms=duration,
+            samples_per_sec=(batch_size * 1000.0 / duration
+                             if duration > 0 else 0.0))
+        flat_now = []
+        for i, layer_params in enumerate(self._iter_params(model)):
+            for k, p in layer_params.items():
+                arr = np.asarray(p)
+                name = f"{i}_{k}"
+                report.param_mean_magnitudes[name] = float(
+                    np.mean(np.abs(arr)))
+                if self.collect_histograms:
+                    report.histograms[f"param/{name}"] = _histogram(arr)
+                flat_now.append(arr.ravel())
+        if flat_now:
+            fp = np.concatenate(flat_now)
+            if self._prev_params is not None and \
+                    fp.shape == self._prev_params.shape:
+                upd = fp - self._prev_params
+                report.update_mean_magnitudes["all"] = float(
+                    np.mean(np.abs(upd)))
+                if self.collect_histograms:
+                    report.histograms["update/all"] = _histogram(upd)
+            self._prev_params = fp
+        self.storage.put_update(report)
+
+    @staticmethod
+    def _iter_params(model):
+        params = model.params
+        if isinstance(params, dict):
+            return [params[k] for k in sorted(params)]
+        return params

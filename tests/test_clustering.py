@@ -1,0 +1,102 @@
+"""Clustering/ANN: KMeans, VPTree, KDTree, SpTree, Barnes-Hut t-SNE."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (KDTree, KMeansClustering,
+                                           QuadTree, SpTree, VPTree)
+
+
+def _blobs(rng, n_per=50, centers=((0, 0), (10, 10), (-10, 10))):
+    xs, ys = [], []
+    for ci, c in enumerate(centers):
+        xs.append(rng.normal(0, 1, (n_per, len(c))) + np.asarray(c))
+        ys.extend([ci] * n_per)
+    return np.concatenate(xs).astype(np.float32), np.array(ys)
+
+
+class TestKMeans:
+    def test_recovers_blobs(self, rng):
+        x, y = _blobs(rng)
+        km = KMeansClustering(k=3, seed=0)
+        assign = km.apply_to(x)
+        # each true cluster maps to one dominant predicted cluster
+        for ci in range(3):
+            labels, counts = np.unique(assign[y == ci],
+                                       return_counts=True)
+            assert counts.max() / counts.sum() > 0.95
+        # predict matches fit assignment
+        np.testing.assert_array_equal(km.predict(x), assign)
+
+    def test_inertia_decreases_with_k(self, rng):
+        x, _ = _blobs(rng)
+        inertias = []
+        for k in (1, 3):
+            km = KMeansClustering(k=k, seed=0)
+            km.apply_to(x)
+            inertias.append(km.inertia)
+        assert inertias[1] < inertias[0]
+
+
+class TestTrees:
+    def test_vptree_matches_bruteforce(self, rng):
+        x = rng.normal(0, 1, (200, 8))
+        tree = VPTree(x)
+        q = rng.normal(0, 1, 8)
+        ids, dists = tree.search(q, 5)
+        brute = np.argsort(np.linalg.norm(x - q, axis=1))[:5]
+        assert set(ids) == set(brute.tolist())
+        assert dists == sorted(dists)
+
+    def test_vptree_cosine(self, rng):
+        x = rng.normal(0, 1, (100, 6))
+        tree = VPTree(x, distance="cosine")
+        q = x[17] * 3.0        # same direction, different norm
+        ids, dists = tree.search(q, 1)
+        assert ids[0] == 17
+        assert dists[0] < 1e-9
+
+    def test_kdtree_matches_bruteforce(self, rng):
+        x = rng.normal(0, 1, (150, 4))
+        tree = KDTree(x)
+        q = rng.normal(0, 1, 4)
+        ids, _ = tree.knn(q, 3)
+        brute = np.argsort(np.linalg.norm(x - q, axis=1))[:3]
+        assert set(ids) == set(brute.tolist())
+
+    def test_kdtree_insert(self, rng):
+        x = rng.normal(0, 1, (20, 3))
+        tree = KDTree(x)
+        new_pt = np.array([100.0, 100.0, 100.0])
+        tree.insert(new_pt)
+        nid, nd = tree.nearest(np.array([99.0, 99.0, 99.0]))
+        assert nid == 20
+
+    def test_sptree_mass_conservation(self, rng):
+        pts = rng.normal(0, 1, (64, 3))
+        tree = SpTree.build(pts)
+        assert tree.count == 64
+        np.testing.assert_allclose(tree.cum_center, pts.mean(0),
+                                   atol=1e-8)
+
+    def test_sptree_duplicate_points(self):
+        pts = np.zeros((10, 2))
+        tree = QuadTree.build(pts)     # must not infinitely recurse
+        assert tree.count == 10
+
+
+class TestTsne:
+    def test_separates_blobs(self, rng):
+        from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+        x, y = _blobs(rng, n_per=30)
+        ts = BarnesHutTsne(perplexity=10, n_iter=250, seed=1)
+        emb = ts.fit(x)
+        assert emb.shape == (90, 2)
+        # clusters separated: within-cluster dist << between-cluster
+        centers = np.stack([emb[y == c].mean(0) for c in range(3)])
+        within = np.mean([np.linalg.norm(emb[y == c]
+                                         - centers[c], axis=1).mean()
+                          for c in range(3)])
+        between = np.mean([np.linalg.norm(centers[i] - centers[j])
+                           for i in range(3) for j in range(i + 1, 3)])
+        assert between > 2 * within, (within, between)

@@ -1,0 +1,208 @@
+"""NLP stack: tokenization, vocab/Huffman, Word2Vec (ns + hs),
+ParagraphVectors, GloVe, DeepWalk, serialization, vectorizers."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
+                                                 DefaultTokenizerFactory,
+                                                 ListSentenceIterator,
+                                                 NGramTokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import (Huffman, VocabConstructor)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def _corpus(n_sent=300, seed=0):
+    """Synthetic corpus with two topic clusters: fruit words co-occur,
+    tech words co-occur — embeddings must separate them."""
+    rng = np.random.default_rng(seed)
+    fruit = ["apple", "banana", "cherry", "mango", "grape"]
+    tech = ["cpu", "gpu", "ram", "disk", "cache"]
+    glue = ["the", "a", "is", "was", "and"]
+    sents = []
+    for i in range(n_sent):
+        topic = fruit if i % 2 == 0 else tech
+        words = []
+        for _ in range(8):
+            words.append(topic[rng.integers(0, len(topic))])
+            if rng.random() < 0.3:
+                words.append(glue[rng.integers(0, len(glue))])
+        sents.append(" ".join(words))
+    return sents
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tf = DefaultTokenizerFactory()
+        assert tf.create("Hello world foo").get_tokens() == \
+            ["Hello", "world", "foo"]
+
+    def test_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        assert tf.create("Hello, World!").get_tokens() == \
+            ["hello", "world"]
+
+    def test_ngrams(self):
+        tf = NGramTokenizerFactory(1, 2)
+        toks = tf.create("a b c").get_tokens()
+        assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+class TestVocab:
+    def test_min_frequency_pruning(self):
+        seqs = [["a", "a", "a", "b", "b", "c"]]
+        cache = VocabConstructor(min_word_frequency=2) \
+            .build_joint_vocabulary(seqs)
+        assert "a" in cache and "b" in cache and "c" not in cache
+        assert cache.words[0].word == "a"    # frequency ordering
+
+    def test_huffman_codes(self):
+        seqs = [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]]
+        cache = VocabConstructor(1).build_joint_vocabulary(seqs)
+        h = Huffman(cache)
+        # most frequent word gets the shortest code
+        lens = {w.word: len(w.codes) for w in cache.words}
+        assert lens["a"] <= lens["d"]
+        # prefix-free: no code is a prefix of another
+        codes = ["".join(map(str, w.codes)) for w in cache.words]
+        for i, c1 in enumerate(codes):
+            for j, c2 in enumerate(codes):
+                if i != j:
+                    assert not c2.startswith(c1)
+        pts, cds, msk = h.padded_arrays()
+        assert pts.shape == cds.shape == msk.shape
+
+
+class TestWord2Vec:
+    def _check_topics(self, w2v):
+        fruit_sim = w2v.similarity("apple", "banana")
+        cross_sim = w2v.similarity("apple", "cpu")
+        assert fruit_sim > cross_sim, (fruit_sim, cross_sim)
+
+    def test_negative_sampling(self):
+        w2v = (Word2Vec.builder()
+               .layer_size(32).window_size(4).negative_sample(5)
+               .min_word_frequency(3).epochs(5).seed(1)
+               .learning_rate(0.025).sampling(0.0)
+               .iterate(ListSentenceIterator(_corpus()))
+               .build())
+        w2v.fit()
+        self._check_topics(w2v)
+        nearest = w2v.words_nearest("apple", 3)
+        assert any(w in ("banana", "cherry", "mango", "grape")
+                   for w in nearest), nearest
+
+    def test_hierarchical_softmax(self):
+        w2v = (Word2Vec.builder()
+               .layer_size(32).window_size(4).use_hierarchic_softmax()
+               .min_word_frequency(3).epochs(5).seed(2)
+               .learning_rate(0.025).sampling(0.0)
+               .iterate(ListSentenceIterator(_corpus()))
+               .build())
+        w2v.fit()
+        self._check_topics(w2v)
+
+    def test_serialization_round_trip(self, tmp_path):
+        import os
+        from deeplearning4j_tpu.nlp.serializer import (read_word_vectors,
+                                                       write_word_vectors)
+        w2v = (Word2Vec.builder().layer_size(16).min_word_frequency(3)
+               .epochs(1).iterate(ListSentenceIterator(_corpus(100)))
+               .build())
+        w2v.fit()
+        p = os.path.join(tmp_path, "vecs.txt")
+        write_word_vectors(w2v, p)
+        cache, vecs = read_word_vectors(p)
+        assert len(cache) == len(w2v.vocab)
+        i = cache.index_of("apple")
+        np.testing.assert_allclose(vecs[i],
+                                   w2v.get_word_vector("apple"),
+                                   atol=1e-5)
+
+
+class TestParagraphVectors:
+    def test_dbow_separates_topics(self):
+        from deeplearning4j_tpu.nlp.paragraph_vectors import (
+            ParagraphVectors)
+        sents = _corpus(200)
+        tf = DefaultTokenizerFactory()
+        docs = [tf.create(s).get_tokens() for s in sents]
+        labels = [f"d{i}" for i in range(len(docs))]
+        pv = ParagraphVectors(layer_size=24, min_word_frequency=3,
+                              epochs=20, seed=3, learning_rate=0.05,
+                              subsampling=0.0)
+        pv.fit_documents(docs, labels)
+
+        def cos(a, b):
+            return a @ b / (np.linalg.norm(a) * np.linalg.norm(b))
+        # same-topic docs more similar than cross-topic (averaged over
+        # pairs: doc even = fruit, odd = tech)
+        same = [cos(pv.get_doc_vector(f"d{i}"),
+                    pv.get_doc_vector(f"d{i + 2}"))
+                for i in range(0, 38, 2)]
+        cross = [cos(pv.get_doc_vector(f"d{i}"),
+                     pv.get_doc_vector(f"d{i + 1}"))
+                 for i in range(0, 38, 2)]
+        assert np.mean(same) > np.mean(cross) + 0.2, (np.mean(same),
+                                                      np.mean(cross))
+
+    def test_infer_vector(self):
+        from deeplearning4j_tpu.nlp.paragraph_vectors import (
+            ParagraphVectors)
+        sents = _corpus(200)
+        tf = DefaultTokenizerFactory()
+        docs = [tf.create(s).get_tokens() for s in sents]
+        pv = ParagraphVectors(layer_size=24, min_word_frequency=3,
+                              epochs=5, seed=4, learning_rate=0.025,
+                              subsampling=0.0)
+        pv.fit_documents(docs)
+        v = pv.infer_vector(["apple", "banana", "cherry"])
+        assert v.shape == (24,)
+        assert np.isfinite(v).all()
+
+
+class TestGlove:
+    def test_glove_separates_topics(self):
+        from deeplearning4j_tpu.nlp.glove import Glove
+        sents = _corpus(300)
+        tf = DefaultTokenizerFactory()
+        docs = [tf.create(s).get_tokens() for s in sents]
+        g = Glove(layer_size=24, min_word_frequency=3, epochs=150,
+                  seed=5, window=4)
+        g.fit(docs)
+        assert g.similarity("apple", "banana") > \
+            g.similarity("apple", "cpu")
+
+
+class TestDeepWalk:
+    def test_community_structure(self):
+        from deeplearning4j_tpu.nlp.deepwalk import DeepWalk, Graph
+        # two 8-cliques joined by one edge
+        g = Graph(16)
+        for base in (0, 8):
+            for i in range(8):
+                for j in range(i + 1, 8):
+                    g.add_edge(base + i, base + j)
+        g.add_edge(0, 8)
+        dw = DeepWalk(vector_size=16, walk_length=20, walks_per_vertex=8,
+                      window_size=4, epochs=2, seed=6)
+        dw.fit(g)
+        same = dw.similarity(1, 2)       # same clique
+        cross = dw.similarity(1, 9)      # different cliques
+        assert same > cross, (same, cross)
+
+
+class TestVectorizers:
+    def test_bow_and_tfidf(self):
+        from deeplearning4j_tpu.nlp.serializer import (BagOfWordsVectorizer,
+                                                       TfidfVectorizer)
+        docs = [["a", "b", "a"], ["b", "c"], ["c", "c", "c"]]
+        bow = BagOfWordsVectorizer().fit(docs)
+        v = bow.transform(["a", "a", "c"])
+        assert v[bow.vocab.index_of("a")] == 2
+        tfidf = TfidfVectorizer().fit(docs)
+        v2 = tfidf.transform(["a", "b"])
+        # 'a' appears in 1 doc, 'b' in 2 → idf(a) > idf(b)
+        assert v2[tfidf.vocab.index_of("a")] > \
+            v2[tfidf.vocab.index_of("b")]

@@ -192,12 +192,39 @@ class TestPipeline:
     def test_pipeline_matches_single_device_step(self):
         from deeplearning4j_tpu.parallel.pipeline import PipelineParallel
         xs, ys = iris_data()
-        conf_kw = dict(seed=11, lr=0.1)
         single = _net(**{"seed": 11, "lr": 0.1})
         single.fit(DataSet(xs[:32], ys[:32]))
         p_single = single.params_flat()
 
         net2 = _net(**{"seed": 11, "lr": 0.1})
+        pp = PipelineParallel(net2, devices=jax.devices()[:2],
+                              n_microbatches=1)
+        pp.train_batch(xs[:32], ys[:32])
+        pp.collect_params()
+        np.testing.assert_allclose(net2.params_flat(), p_single,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pipeline_matches_single_device_with_regularization(self):
+        """Pipeline must also apply l2 + constraints like net.fit."""
+        from deeplearning4j_tpu.parallel.pipeline import PipelineParallel
+
+        def make():
+            conf = (NeuralNetConfiguration.builder().set_seed(13)
+                    .updater(updaters.sgd(0.1)).l2(1e-2).list()
+                    .layer(DenseLayer(
+                        n_out=16, activation="tanh",
+                        constraints=({"type": "max_norm",
+                                      "max_norm": 0.8},)))
+                    .layer(OutputLayer(n_out=3))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            return MultiLayerNetwork(conf).init()
+
+        xs, ys = iris_data()
+        single = make()
+        single.fit(DataSet(xs[:32], ys[:32]))
+        p_single = single.params_flat()
+
+        net2 = make()
         pp = PipelineParallel(net2, devices=jax.devices()[:2],
                               n_microbatches=1)
         pp.train_batch(xs[:32], ys[:32])

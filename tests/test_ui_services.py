@@ -1,0 +1,115 @@
+"""UI stats pipeline + dashboard server + NN REST service."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.fetchers import iris_data
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ui.stats import (FileStatsStorage,
+                                         InMemoryStatsStorage,
+                                         StatsListener, StatsReport)
+
+
+def _fit_with_listener(storage, freq=2):
+    xs, ys = iris_data()
+    conf = (NeuralNetConfiguration.builder()
+            .updater(updaters.adam(0.05)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(StatsListener(storage, frequency=freq,
+                                    session_id="s1"))
+    net.fit(xs[:120], ys[:120], epochs=4, batch_size=40)
+    return net
+
+
+class TestStatsPipeline:
+    def test_collects_reports(self):
+        storage = InMemoryStatsStorage()
+        _fit_with_listener(storage)
+        assert storage.list_session_ids() == ["s1"]
+        ups = storage.get_all_updates("s1")
+        assert len(ups) >= 3
+        last = storage.get_latest_update("s1")
+        assert np.isfinite(last.score)
+        assert last.param_mean_magnitudes        # per-layer entries
+        assert any(k.startswith("param/") for k in last.histograms)
+        # update magnitudes appear after the first report
+        assert "all" in ups[-1].update_mean_magnitudes
+
+    def test_file_storage_round_trip(self, tmp_path):
+        import os
+        path = os.path.join(tmp_path, "stats.jsonl")
+        storage = FileStatsStorage(path)
+        _fit_with_listener(storage)
+        n = len(storage.get_all_updates("s1"))
+        # reload from disk
+        storage2 = FileStatsStorage(path)
+        assert len(storage2.get_all_updates("s1")) == n
+        assert storage2.get_latest_update("s1").iteration == \
+            storage.get_latest_update("s1").iteration
+
+
+class TestUIServer:
+    def test_dashboard_and_api(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        server = UIServer(port=0)
+        server.start()
+        try:
+            storage = InMemoryStatsStorage()
+            server.attach(storage)
+            _fit_with_listener(storage)
+            base = f"http://localhost:{server.port}"
+            page = urllib.request.urlopen(base + "/").read().decode()
+            assert "Training dashboard" in page
+            sessions = json.loads(
+                urllib.request.urlopen(base + "/api/sessions").read())
+            assert sessions == ["s1"]
+            ups = json.loads(urllib.request.urlopen(
+                base + "/api/updates?session=s1").read())
+            assert len(ups) >= 3
+            assert "score" in ups[0]
+            # remote-listener POST path
+            report = StatsReport(session_id="remote", worker_id="w9",
+                                 iteration=1, timestamp=0.0, score=1.5)
+            req = urllib.request.Request(
+                base + "/api/remote", report.to_json().encode(),
+                {"Content-Type": "application/json"})
+            assert json.loads(urllib.request.urlopen(req).read())["ok"]
+            assert "remote" in json.loads(urllib.request.urlopen(
+                base + "/api/sessions").read())
+        finally:
+            server.stop()
+
+
+class TestNearestNeighborsService:
+    def test_knn_round_trip(self, rng):
+        from deeplearning4j_tpu.services.nearest_neighbors import (
+            NearestNeighborsClient, NearestNeighborsServer)
+        pts = rng.normal(0, 1, (100, 5))
+        server = NearestNeighborsServer(pts, port=0).start()
+        try:
+            client = NearestNeighborsClient(port=server.port)
+            res = client.knn_index(7, k=3)
+            assert res["indices"][0] == 7
+            assert res["distances"][0] < 1e-9
+            res2 = client.knn(pts[11] + 0.001, k=1)
+            assert res2["indices"][0] == 11
+            # brute-force agreement
+            q = rng.normal(0, 1, 5)
+            res3 = client.knn(q, k=4)
+            brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:4]
+            assert set(res3["indices"]) == set(brute.tolist())
+            # error paths
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError):
+                client.knn([1.0, 2.0], k=3)     # wrong dim
+        finally:
+            server.stop()
