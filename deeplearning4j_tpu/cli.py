@@ -1,0 +1,128 @@
+"""Command-line surface.
+
+Mirrors the reference's thin JCommander CLIs (SURVEY §1 'CLI surface'):
+ParallelWrapperMain (--modelPath --workers --prefetchSize ...),
+PlayUIServer main, NearestNeighborsServer main. One entry point with
+subcommands:
+
+    python -m deeplearning4j_tpu train --model m.zip --data d.csv \
+        --features 4 --label-index 4 --classes 3 --workers 8
+    python -m deeplearning4j_tpu ui --port 9000
+    python -m deeplearning4j_tpu serve-knn --points p.npy --port 9200
+    python -m deeplearning4j_tpu summary --model m.zip
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _cmd_train(args):
+    from deeplearning4j_tpu.data.records import (CSVRecordReader,
+                                                 RecordReaderDataSetIterator)
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+    from deeplearning4j_tpu.train.listeners import (PerformanceListener,
+                                                    ScoreIterationListener)
+    from deeplearning4j_tpu.util.model_serializer import (restore_model,
+                                                          write_model)
+    model = restore_model(args.model)
+    rr = CSVRecordReader().initialize(args.data)
+    it = RecordReaderDataSetIterator(
+        rr, args.batch_size, label_index=args.label_index,
+        num_classes=args.classes, regression=args.classes == 0)
+    model.set_listeners(ScoreIterationListener(10),
+                        PerformanceListener(frequency=10))
+    if args.workers and args.workers > 1:
+        pw = (ParallelWrapper.builder(model).workers(args.workers)
+              .prefetch_buffer(args.prefetch).build())
+        pw.fit(it, epochs=args.epochs)
+    else:
+        model.fit(it, epochs=args.epochs)
+    out = args.output or args.model
+    write_model(model, out)
+    print(f"trained {args.epochs} epochs; saved to {out}")
+
+
+def _cmd_ui(args):
+    import time
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.stats import FileStatsStorage
+    server = UIServer(port=args.port)
+    server.start()
+    if args.stats_file:
+        server.attach(FileStatsStorage(args.stats_file))
+    print(f"UI on http://localhost:{server.port}/ (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+def _cmd_serve_knn(args):
+    import time
+    import numpy as np
+    from deeplearning4j_tpu.services.nearest_neighbors import (
+        NearestNeighborsServer)
+    pts = np.load(args.points)
+    server = NearestNeighborsServer(pts, args.port, args.distance)
+    server.start()
+    print(f"k-NN server on port {server.port} ({pts.shape[0]} points)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+def _cmd_summary(args):
+    from deeplearning4j_tpu.util.model_guesser import (guess_format,
+                                                       load_model_guess)
+    kind = guess_format(args.model)
+    print(f"format: {kind}")
+    model = load_model_guess(args.model)
+    if hasattr(model, "summary"):
+        print(model.summary())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="deeplearning4j_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train a saved model on CSV data")
+    t.add_argument("--model", required=True)
+    t.add_argument("--data", required=True)
+    t.add_argument("--label-index", type=int, required=True)
+    t.add_argument("--classes", type=int, default=0,
+                   help="0 = regression")
+    t.add_argument("--batch-size", type=int, default=64)
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--workers", type=int, default=0,
+                   help=">1 = data-parallel over that many devices")
+    t.add_argument("--prefetch", type=int, default=2)
+    t.add_argument("--output", default=None)
+    t.set_defaults(fn=_cmd_train)
+
+    u = sub.add_parser("ui", help="training dashboard server")
+    u.add_argument("--port", type=int, default=9000)
+    u.add_argument("--stats-file", default=None)
+    u.set_defaults(fn=_cmd_ui)
+
+    k = sub.add_parser("serve-knn", help="k-NN REST server")
+    k.add_argument("--points", required=True)
+    k.add_argument("--port", type=int, default=9200)
+    k.add_argument("--distance", default="euclidean",
+                   choices=["euclidean", "cosine"])
+    k.set_defaults(fn=_cmd_serve_knn)
+
+    s = sub.add_parser("summary", help="inspect a model file")
+    s.add_argument("--model", required=True)
+    s.set_defaults(fn=_cmd_summary)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

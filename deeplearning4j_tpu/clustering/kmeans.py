@@ -40,12 +40,15 @@ def _lloyd_step(points, centroids):
 class KMeansClustering:
     def __init__(self, k: int, max_iterations: int = 100,
                  tol: float = 1e-5, seed: int = 0,
-                 init: str = "kmeans++"):
+                 init: str = "kmeans++", distance: str = "euclidean"):
+        if distance not in ("euclidean", "cosine"):
+            raise ValueError(f"Unsupported distance '{distance}'")
         self.k = k
         self.max_iterations = max_iterations
         self.tol = tol
         self.seed = seed
         self.init = init
+        self.distance = distance
         self.centroids: Optional[np.ndarray] = None
         self.inertia: float = float("inf")
 
@@ -53,7 +56,15 @@ class KMeansClustering:
     def setup(k: int, max_iterations: int = 100,
               distance: str = "euclidean") -> "KMeansClustering":
         """Reference-style factory (KMeansClustering.setup)."""
-        return KMeansClustering(k, max_iterations)
+        return KMeansClustering(k, max_iterations, distance=distance)
+
+    def _prep(self, x: np.ndarray) -> np.ndarray:
+        if self.distance == "cosine":
+            # spherical k-means: L2-normalize so squared-euclidean
+            # ordering equals cosine ordering
+            n = np.linalg.norm(x, axis=1, keepdims=True)
+            return x / np.maximum(n, 1e-12)
+        return x
 
     def _init_centroids(self, x: np.ndarray,
                         rng: np.random.Generator) -> np.ndarray:
@@ -71,7 +82,7 @@ class KMeansClustering:
     def apply_to(self, points: np.ndarray) -> np.ndarray:
         """Fit; returns cluster assignments (reference applyTo returns a
         ClusterSet — assignments + centroids here)."""
-        x = np.asarray(points, np.float32)
+        x = self._prep(np.asarray(points, np.float32))
         rng = np.random.default_rng(self.seed)
         c = jnp.asarray(self._init_centroids(x, rng))
         xj = jnp.asarray(x)
@@ -90,6 +101,6 @@ class KMeansClustering:
     fit_predict = apply_to
 
     def predict(self, points: np.ndarray) -> np.ndarray:
-        x = jnp.asarray(np.asarray(points, np.float32))
+        x = jnp.asarray(self._prep(np.asarray(points, np.float32)))
         _, assign, _ = _lloyd_step(x, jnp.asarray(self.centroids))
         return np.asarray(assign)

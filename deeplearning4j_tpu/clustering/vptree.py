@@ -27,28 +27,31 @@ class _Node:
 
 
 class VPTree:
+    """NOTE on cosine: 1-cos violates the triangle inequality, which
+    breaks VP-tree pruning. Internally cosine mode searches EUCLIDEAN
+    distance on L2-normalized vectors (a true metric with identical
+    ordering: ||a-b||² = 2(1-cos) on the unit sphere) and converts
+    reported distances back to 1-cos."""
+
     def __init__(self, items: np.ndarray, distance: str = "euclidean",
                  seed: int = 0):
         self.items = np.asarray(items, np.float64)
         self.distance = distance
         if distance == "cosine":
             norms = np.linalg.norm(self.items, axis=1, keepdims=True)
-            self._normed = self.items / np.maximum(norms, 1e-12)
+            self._search_items = self.items / np.maximum(norms, 1e-12)
+        else:
+            self._search_items = self.items
         self._rng = np.random.default_rng(seed)
         idx = list(range(len(self.items)))
         self.root = self._build(idx)
 
     def _dist_many(self, i: int, others: np.ndarray) -> np.ndarray:
-        if self.distance == "cosine":
-            return 1.0 - self._normed[others] @ self._normed[i]
-        diff = self.items[others] - self.items[i]
+        diff = self._search_items[others] - self._search_items[i]
         return np.sqrt(np.sum(diff * diff, axis=1))
 
     def _dist_point(self, q: np.ndarray, i: int) -> float:
-        if self.distance == "cosine":
-            qn = q / max(np.linalg.norm(q), 1e-12)
-            return float(1.0 - self._normed[i] @ qn)
-        return float(np.linalg.norm(self.items[i] - q))
+        return float(np.linalg.norm(self._search_items[i] - q))
 
     def _build(self, idx: List[int]) -> Optional[_Node]:
         if not idx:
@@ -70,8 +73,11 @@ class VPTree:
 
     def search(self, query: np.ndarray, k: int) -> Tuple[List[int],
                                                          List[float]]:
-        """k nearest neighbors (reference search :471)."""
+        """k nearest neighbors (reference search :471). Cosine mode
+        returns 1-cos distances."""
         q = np.asarray(query, np.float64)
+        if self.distance == "cosine":
+            q = q / max(np.linalg.norm(q), 1e-12)
         heap: List[Tuple[float, int]] = []   # max-heap via negatives
         tau = [np.inf]
 
@@ -98,4 +104,7 @@ class VPTree:
 
         visit(self.root)
         pairs = sorted((-nd, i) for nd, i in heap)
-        return [i for _, i in pairs], [d for d, _ in pairs]
+        dists = [d for d, _ in pairs]
+        if self.distance == "cosine":
+            dists = [d * d / 2.0 for d in dists]    # ||a-b||²/2 = 1-cos
+        return [i for _, i in pairs], dists

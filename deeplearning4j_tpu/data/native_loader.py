@@ -1,0 +1,184 @@
+"""ctypes binding for the native C++ data-loading runtime.
+
+Builds native/libdl4jtpu.so on first use (g++, cached) and exposes:
+
+- :class:`NativeCSVDataSetIterator` — multi-threaded CSV parsing into
+  ready batches (DataSetIterator-compatible), the native-speed
+  counterpart of records.CSVRecordReader + RecordReaderDataSetIterator.
+- :func:`native_count_words` — parallel word counting for vocab builds.
+
+If no C++ toolchain is available the import still succeeds;
+``native_available()`` gates usage and callers fall back to the pure
+Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["native_available", "NativeCSVDataSetIterator",
+           "native_count_words"]
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    so_path = os.path.join(_NATIVE_DIR, "libdl4jtpu.so")
+    src = os.path.join(_NATIVE_DIR, "src", "dataloader.cpp")
+    if not os.path.exists(so_path) or \
+            os.path.getmtime(so_path) < os.path.getmtime(src):
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall",
+                 "-pthread", "-shared", "-o", so_path, src],
+                check=True, capture_output=True, timeout=120)
+            logger.info("built native library %s", so_path)
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                subprocess.TimeoutExpired) as e:
+            detail = getattr(e, "stderr", b"")
+            logger.warning("native build failed (%s); falling back to "
+                           "pure python. %s", e,
+                           detail.decode() if detail else "")
+            return None
+    lib = ctypes.CDLL(so_path)
+    lib.dl4j_csv_loader_create.restype = ctypes.c_void_p
+    lib.dl4j_csv_loader_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.dl4j_loader_num_lines.restype = ctypes.c_int64
+    lib.dl4j_loader_num_lines.argtypes = [ctypes.c_void_p]
+    lib.dl4j_loader_next.restype = ctypes.c_int
+    lib.dl4j_loader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float)]
+    lib.dl4j_loader_destroy.argtypes = [ctypes.c_void_p]
+    lib.dl4j_count_words.restype = ctypes.c_void_p
+    lib.dl4j_count_words.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dl4j_counts_size.restype = ctypes.c_int64
+    lib.dl4j_counts_size.argtypes = [ctypes.c_void_p]
+    lib.dl4j_counts_word.restype = ctypes.c_char_p
+    lib.dl4j_counts_word.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dl4j_counts_count.restype = ctypes.c_int64
+    lib.dl4j_counts_count.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dl4j_counts_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            _LIB = _build_and_load() or False
+    return _LIB or None
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+class NativeCSVDataSetIterator(DataSetIterator):
+    """CSV → DataSet batches parsed by the C++ worker pool."""
+
+    def __init__(self, path: str, batch_size: int, n_features: int,
+                 label_index: int = -1, num_classes: int = 0,
+                 n_threads: int = 2, queue_capacity: int = 4):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable (no g++?); "
+                               "use RecordReaderDataSetIterator instead")
+        self._lib = lib
+        self.path = path
+        self._bs = batch_size
+        self.n_features = n_features
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.n_threads = n_threads
+        self.queue_capacity = queue_capacity
+        self._handle = None
+        self._n_lines = None
+
+    def _open(self):
+        h = self._lib.dl4j_csv_loader_create(
+            self.path.encode(), self._bs, self.n_features,
+            self.label_index, self.num_classes, self.n_threads,
+            self.queue_capacity)
+        if not h:
+            raise IOError(f"cannot open {self.path}")
+        self._handle = h
+        self._n_lines = int(self._lib.dl4j_loader_num_lines(h))
+
+    def reset(self):
+        self._close()
+
+    def _close(self):
+        if self._handle:
+            self._lib.dl4j_loader_destroy(self._handle)
+            self._handle = None
+
+    def _iterate(self):
+        self._open()
+        lab_width = (0 if self.label_index < 0
+                     else (self.num_classes or 1))
+        feat = np.empty((self._bs, self.n_features), np.float32)
+        lab = np.empty((self._bs, lab_width), np.float32) \
+            if lab_width else None
+        try:
+            while True:
+                n = self._lib.dl4j_loader_next(
+                    self._handle,
+                    feat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    lab.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                    if lab is not None else None)
+                if n <= 0:
+                    return
+                yield DataSet(feat[:n].copy(),
+                              lab[:n].copy() if lab is not None else None)
+        finally:
+            self._close()
+
+    def batch_size(self):
+        return self._bs
+
+    def num_examples(self):
+        if self._n_lines is None:
+            self._open()
+            self._close()
+        return self._n_lines
+
+    def __del__(self):
+        try:
+            self._close()
+        except Exception:
+            pass
+
+
+def native_count_words(path: str, n_threads: int = 4
+                       ) -> Optional[Dict[str, int]]:
+    """Parallel token counting; None if the native lib is unavailable."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    h = lib.dl4j_count_words(path.encode(), n_threads)
+    if not h:
+        raise IOError(f"cannot open {path}")
+    try:
+        n = lib.dl4j_counts_size(h)
+        return {lib.dl4j_counts_word(h, i).decode():
+                int(lib.dl4j_counts_count(h, i)) for i in range(n)}
+    finally:
+        lib.dl4j_counts_destroy(h)

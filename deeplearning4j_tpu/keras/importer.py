@@ -165,10 +165,13 @@ def _map_global_pool(cfg, pooling):
 
 def _map_batchnorm(cfg):
     from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+    # always import with learnable gamma/beta params; scale=False /
+    # center=False become fixed 1/0 values at weight-assignment time
+    # (our layer has no separate use_gamma/use_beta switches)
     return BatchNormalization(
         eps=float(cfg.get("epsilon", 1e-3)),
         decay=float(cfg.get("momentum", 0.99)),
-        lock_gamma_beta=not bool(cfg.get("scale", True)),
+        lock_gamma_beta=False,
         name=cfg.get("name"))
 
 
@@ -310,18 +313,27 @@ def _weight_arrays(h5file, layer_name: str) -> List[np.ndarray]:
              for n in grp.attrs.get("weight_names", [])]
     if names:
         return [np.asarray(grp[n]) for n in names]
-    # fallback: walk datasets in insertion order
-    out = []
 
-    def walk(g):
-        import h5py
+    # count datasets (weightless layers have an empty group — fine)
+    import h5py
+    n_datasets = 0
+
+    def count(g):
+        nonlocal n_datasets
         for k in g:
             if isinstance(g[k], h5py.Group):
-                walk(g[k])
+                count(g[k])
             else:
-                out.append(np.asarray(g[k]))
-    walk(grp)
-    return out
+                n_datasets += 1
+    count(grp)
+    if n_datasets == 0:
+        return []
+    # Datasets but no weight_names: h5py iterates ALPHABETICALLY, which
+    # would silently reorder e.g. [bias, kernel] or swap same-shaped
+    # gamma/beta — refuse rather than corrupt.
+    raise KerasImportError(
+        f"Layer '{layer_name}' has {n_datasets} weight datasets but no "
+        f"weight_names attribute; cannot determine weight order safely")
 
 
 def _lstm_gate_permute(w: np.ndarray, units: int) -> np.ndarray:
@@ -332,7 +344,8 @@ def _lstm_gate_permute(w: np.ndarray, units: int) -> np.ndarray:
 
 
 def _assign_weights(layer, params: dict, state: dict,
-                    arrays: List[np.ndarray], class_name: str):
+                    arrays: List[np.ndarray], class_name: str,
+                    kcfg: Optional[dict] = None):
     import jax.numpy as jnp
     from deeplearning4j_tpu import dtypes
 
@@ -365,13 +378,24 @@ def _assign_weights(layer, params: dict, state: dict,
         if len(arrays) > 2 and "b" in params:
             put(params, "b", arrays[2])
     elif class_name == "BatchNormalization":
-        # keras order: [gamma, beta, moving_mean, moving_variance]
-        # (gamma/beta omitted when scale/center False)
+        # keras order: [gamma if scale][beta if center][mean, variance]
         arrs = list(arrays)
-        if "gamma" in params:
+        kcfg = kcfg or {}
+        scale = bool(kcfg.get("scale", True))
+        center = bool(kcfg.get("center", True))
+        expected = int(scale) + int(center) + 2
+        if len(arrs) != expected:
+            raise KerasImportError(
+                f"BatchNormalization: {len(arrs)} weight arrays but "
+                f"scale={scale}, center={center} implies {expected}")
+        if scale:
             put(params, "gamma", arrs.pop(0))
-        if "beta" in params:
+        else:
+            params["gamma"] = jnp.ones_like(params["gamma"])
+        if center:
             put(params, "beta", arrs.pop(0))
+        else:
+            params["beta"] = jnp.zeros_like(params["beta"])
         put(state, "mean", arrs.pop(0), jnp.float32)
         put(state, "var", arrs.pop(0), jnp.float32)
     elif class_name == "LSTM":
@@ -493,21 +517,21 @@ def _import_sequential(model_cfg, f):
                        "GlobalMaxPooling2D"):
             seq_mode = False
         if layer is not None:
-            mapped.append((cfg.get("name", cname), cname, layer))
+            mapped.append((cfg.get("name", cname), cname, layer, cfg))
     if input_type is None:
         raise KerasImportError("Could not determine model input shape")
 
     b = NeuralNetConfiguration.builder().list()
-    for _, _, layer in mapped:
+    for _, _, layer, _ in mapped:
         b = b.layer(layer)
     conf = b.set_input_type(input_type).build()
     net = MultiLayerNetwork(conf).init()
 
-    for idx, (kname, cname, _) in enumerate(mapped):
+    for idx, (kname, cname, _, kcfg) in enumerate(mapped):
         arrays = _weight_arrays(f, kname)
         if arrays:
             _assign_weights(net.layers[idx], net.params[idx],
-                            net.state[idx], arrays, cname)
+                            net.state[idx], arrays, cname, kcfg)
     return net
 
 
@@ -562,7 +586,7 @@ def _import_functional(model_cfg, f):
             alias[name] = inbound[0]
             continue
         plan.append((name, layer, inbound, False))
-        weight_map[name] = (cname, layer)
+        weight_map[name] = (cname, lcfg)
 
     # pass 2: build the graph config
     gb = NeuralNetConfiguration.builder().graph_builder()
@@ -576,10 +600,10 @@ def _import_functional(model_cfg, f):
     gb.set_outputs(*[alias.get(o, o) for o in output_refs])
     cg = ComputationGraph(gb.build()).init()
 
-    for name, (cname, _) in weight_map.items():
+    for name, (cname, kcfg) in weight_map.items():
         arrays = _weight_arrays(f, name)
         if arrays:
             obj, _ = cg.conf.vertices[name]
             _assign_weights(obj, cg.params[name], cg.state[name],
-                            arrays, cname)
+                            arrays, cname, kcfg)
     return cg

@@ -167,7 +167,9 @@ class ParagraphVectors(SequenceVectors):
         return np.asarray(v)
 
     def similarity_to_label(self, tokens: List[str], label: str) -> float:
-        v = self.infer_vector(tokens)
         d = self.get_doc_vector(label)
+        if d is None:
+            return float("nan")       # matches similarity() on unknowns
+        v = self.infer_vector(tokens)
         denom = np.linalg.norm(v) * np.linalg.norm(d)
         return float(v @ d / denom) if denom else 0.0

@@ -39,6 +39,9 @@ from deeplearning4j_tpu.nn.conf.layers.recurrent import (
 from deeplearning4j_tpu.nn.conf.layers.special import (
     FrozenLayer, VariationalAutoencoder, Yolo2OutputLayer,
 )
+from deeplearning4j_tpu.nn.conf.layers.attention import (
+    SelfAttentionLayer, TransformerEncoderLayer,
+)
 
 __all__ = [
     "Layer", "BaseLayer", "FeedForwardLayer", "register_layer",
@@ -56,4 +59,5 @@ __all__ = [
     "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "Bidirectional",
     "SimpleRnn", "LastTimeStep", "RnnLossLayer",
     "FrozenLayer", "VariationalAutoencoder", "Yolo2OutputLayer",
+    "SelfAttentionLayer", "TransformerEncoderLayer",
 ]

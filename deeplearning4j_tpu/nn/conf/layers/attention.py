@@ -1,0 +1,173 @@
+"""Attention layers — transformer building blocks.
+
+The 2017 reference predates transformers (its long-context story is
+tBPTT + masking, SURVEY §5); attention layers are a required capability
+extension of the TPU rebuild. ``SelfAttentionLayer`` is multi-head
+self-attention over (B,T,C) inputs backed by the Pallas flash kernel on
+TPU (ops/attention.py — the framework's hand-written-kernel seam);
+``TransformerEncoderLayer`` is the full pre-LN block (MHA + MLP with
+residuals) so the config DSL can express transformer stacks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (BaseLayer,
+                                                    register_layer)
+
+__all__ = ["SelfAttentionLayer", "TransformerEncoderLayer"]
+
+
+def _layer_norm(x, gamma, beta, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def _masked_attention(q, k, v, mask, causal):
+    """Exact attention with key-padding mask as an additive -inf bias
+    (and optional causal bias). q,k,v: (B,T,H,D); mask: (B,T) 0/1."""
+    import math as _math
+    scale = 1.0 / _math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30)
+    if causal:
+        T = q.shape[1]
+        cb = jnp.where(jnp.tril(jnp.ones((T, T), bool)), 0.0, -1e30)
+        bias = bias + cb[None, None, :, :]
+    probs = jax.nn.softmax(logits + bias, axis=-1)
+    # fully-masked query rows (padding): zero their output
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out * mask[:, :, None, None]
+
+
+@register_layer
+@dataclasses.dataclass
+class SelfAttentionLayer(BaseLayer):
+    """Multi-head self-attention, (B,T,C) → (B,T,n_out)."""
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None        # model dim (defaults to n_in)
+    n_heads: int = 4
+    causal: bool = False
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.size
+        if self.n_out is None:
+            self.n_out = self.n_in
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out or input_type.size,
+                                   input_type.timesteps)
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out {self.n_out} not divisible by "
+                             f"n_heads {self.n_heads}")
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        pd = dtypes.policy().param_dtype
+        d = self.n_out
+        p = {
+            "Wq": self._sample_w(kq, (self.n_in, d), self.n_in, d),
+            "Wk": self._sample_w(kk, (self.n_in, d), self.n_in, d),
+            "Wv": self._sample_w(kv, (self.n_in, d), self.n_in, d),
+            "Wo": self._sample_w(ko, (d, d), d, d),
+            "bo": jnp.zeros((d,), pd),
+        }
+        return p, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None,
+              mask=None):
+        from deeplearning4j_tpu.ops.attention import flash_attention
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        B, T, _ = x.shape
+        H = self.n_heads
+        Dh = self.n_out // H
+
+        def split_heads(y):
+            return y.reshape(B, T, H, Dh)
+
+        q = split_heads(x @ params["Wq"])
+        k = split_heads(x @ params["Wk"])
+        v = split_heads(x @ params["Wv"])
+        if mask is not None:
+            # padded keys must leave the softmax DENOMINATOR, not just
+            # contribute zero values — zeroing k/v would still give each
+            # masked position weight exp(0) and dilute every real token.
+            # The explicit-bias path handles this exactly.
+            out = _masked_attention(q, k, v, mask, self.causal)
+        else:
+            out = flash_attention(q, k, v, causal=self.causal)
+        out = out.reshape(B, T, self.n_out)
+        return out @ params["Wo"] + params["bo"], state
+
+
+@register_layer
+@dataclasses.dataclass
+class TransformerEncoderLayer(BaseLayer):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    n_heads: int = 4
+    ffn_multiplier: int = 4
+    causal: bool = False
+    activation: str = "gelu"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.size
+        if self.n_out is None:
+            self.n_out = self.n_in
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        if self.n_in != self.n_out:
+            raise ValueError("TransformerEncoderLayer requires "
+                             "n_in == n_out (residual)")
+        ka, k1, k2 = jax.random.split(key, 3)
+        pd = dtypes.policy().param_dtype
+        d = self.n_out
+        dff = d * self.ffn_multiplier
+        self._attn = SelfAttentionLayer(
+            n_in=d, n_out=d, n_heads=self.n_heads, causal=self.causal,
+            weight_init=self.weight_init)
+        attn_p, _ = self._attn.initialize(ka, InputType.recurrent(d))
+        p = {
+            "attn": attn_p,
+            "ln1_g": jnp.ones((d,), pd), "ln1_b": jnp.zeros((d,), pd),
+            "ln2_g": jnp.ones((d,), pd), "ln2_b": jnp.zeros((d,), pd),
+            "W1": self._sample_w(k1, (d, dff), d, dff),
+            "b1": jnp.zeros((dff,), pd),
+            "W2": self._sample_w(k2, (dff, d), dff, d),
+            "b2": jnp.zeros((d,), pd),
+        }
+        return p, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None,
+              mask=None):
+        if not hasattr(self, "_attn"):
+            self._attn = SelfAttentionLayer(
+                n_in=self.n_in, n_out=self.n_out, n_heads=self.n_heads,
+                causal=self.causal)
+        h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        a, _ = self._attn.apply(params["attn"], {}, h,
+                                training=training, rng=rng, mask=mask)
+        x = x + a
+        h = _layer_norm(x, params["ln2_g"], params["ln2_b"])
+        act = self.activation_fn()
+        h = act(h @ params["W1"] + params["b1"]) @ params["W2"] \
+            + params["b2"]
+        return x + h, state

@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.ops.attention import (
+    flash_attention, pallas_flash_attention,
+)
+
+__all__ = ["flash_attention", "pallas_flash_attention"]

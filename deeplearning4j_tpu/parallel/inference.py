@@ -84,8 +84,18 @@ class ParallelInference:
         x = np.asarray(x)
         if self.mode == InferenceMode.SEQUENTIAL:
             return np.asarray(self.model.output(x))
+        if self._stop.is_set():
+            raise RuntimeError("ParallelInference is shut down")
         p = _Pending(x)
         self._queue.put(p)
+        if self._stop.is_set() and not p.event.is_set():
+            # raced with shutdown's drain: serve directly rather than
+            # waiting on a collector that already exited
+            try:
+                p.result = np.asarray(self.model.output(x))
+            except BaseException as e:
+                p.error = e
+            p.event.set()
         p.event.wait()
         if p.error is not None:
             raise p.error

@@ -65,6 +65,8 @@ class PipelineParallel:
                             for lo, hi in self._stage_ranges]
         self._fwd_fns = [self._make_stage_fwd(i)
                          for i in range(len(self._stage_ranges))]
+        self._reg_grad_fns = [self._make_stage_reg_grad(i)
+                              for i in range(len(self._stage_ranges))]
         # one optimizer per stage: params live on different devices, so
         # a single jitted update would mix devices
         self._opts = [updaters_mod.to_optax(
@@ -99,6 +101,18 @@ class PipelineParallel:
 
         # execution device follows the (device_put) input placement
         return jax.jit(fwd)
+
+    def _make_stage_reg_grad(self, si: int):
+        lo, hi = self._stage_ranges[si]
+        net = self.net
+
+        def stage_reg(p):
+            r = jnp.zeros(())
+            for j, li in enumerate(range(lo, hi)):
+                r = r + net.layers[li].regularization_loss(p[j])
+            return r
+
+        return jax.jit(jax.grad(stage_reg))
 
     def train_batch(self, features, labels) -> float:
         """One GPipe batch: forward all microbatches through all stages
@@ -150,19 +164,13 @@ class PipelineParallel:
                 cot = gx
         # regularization gradients + post-update constraints per stage —
         # the pieces the model's own jitted step applies
-        # (multi_layer_network._loss / apply_layer_constraints)
+        # (multi_layer_network._loss / apply_layer_constraints); the
+        # reg-grad fns are jitted ONCE in __init__ (no per-step retrace)
         from deeplearning4j_tpu.train.constraints import (
             apply_layer_constraints)
         for s in range(S):
             lo, hi = self._stage_ranges[s]
-
-            def stage_reg(p, lo=lo, hi=hi):
-                r = jnp.zeros(())
-                for j, li in enumerate(range(lo, hi)):
-                    r = r + self.net.layers[li].regularization_loss(p[j])
-                return r
-
-            reg_g = jax.grad(stage_reg)(self.stage_params[s])
+            reg_g = self._reg_grad_fns[s](self.stage_params[s])
             grads[s] = jax.tree_util.tree_map(jnp.add, grads[s], reg_g)
             upd, self.opt_states[s] = self._opts[s].update(
                 grads[s], self.opt_states[s], self.stage_params[s])

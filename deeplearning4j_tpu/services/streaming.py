@@ -1,0 +1,123 @@
+"""Streaming inference routes.
+
+Mirrors dl4j-streaming (streaming/routes/DL4jServeRouteBuilder.java —
+Camel routes wiring Kafka topics to model inference;
+streaming/kafka/NDArrayPublisher/NDArrayKafkaClient): a
+consume → predict → publish pipeline over pluggable transports. Kafka
+itself isn't in this environment, so the broker abstraction has an
+in-process implementation (the reference's own tests run an
+EmbeddedKafkaCluster for the same reason); a real Kafka transport plugs
+into the same Publisher/Consumer SPI.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["InProcessBroker", "NDArrayPublisher", "NDArrayConsumer",
+           "InferenceRoute"]
+
+
+class InProcessBroker:
+    """Topic → subscriber queues (EmbeddedKafkaCluster stand-in)."""
+
+    def __init__(self):
+        self._topics: Dict[str, List[queue.Queue]] = {}
+        self._lock = threading.Lock()
+
+    def publish(self, topic: str, payload: bytes):
+        with self._lock:
+            subs = list(self._topics.get(topic, []))
+        for q in subs:
+            q.put(payload)
+
+    def subscribe(self, topic: str) -> "queue.Queue[bytes]":
+        q: "queue.Queue[bytes]" = queue.Queue()
+        with self._lock:
+            self._topics.setdefault(topic, []).append(q)
+        return q
+
+
+def _encode(arr: np.ndarray) -> bytes:
+    return json.dumps({"shape": list(arr.shape),
+                       "data": arr.ravel().tolist()}).encode()
+
+
+def _decode(payload: bytes) -> np.ndarray:
+    obj = json.loads(payload.decode())
+    return np.asarray(obj["data"], np.float32).reshape(obj["shape"])
+
+
+class NDArrayPublisher:
+    """(streaming/kafka/NDArrayPublisher.java)."""
+
+    def __init__(self, broker: InProcessBroker, topic: str):
+        self.broker = broker
+        self.topic = topic
+
+    def publish(self, arr: np.ndarray):
+        self.broker.publish(self.topic, _encode(np.asarray(arr)))
+
+
+class NDArrayConsumer:
+    """(streaming/kafka/NDArrayConsumer.java)."""
+
+    def __init__(self, broker: InProcessBroker, topic: str):
+        self.queue = broker.subscribe(topic)
+
+    def get(self, timeout: Optional[float] = None) -> np.ndarray:
+        return _decode(self.queue.get(timeout=timeout))
+
+
+class InferenceRoute:
+    """consume(in_topic) → model.output → publish(out_topic)
+    (DL4jServeRouteBuilder semantics). ``start`` spawns the worker;
+    errors are published to ``<out_topic>.errors`` instead of killing
+    the route."""
+
+    def __init__(self, broker: InProcessBroker, model,
+                 in_topic: str, out_topic: str,
+                 transform: Optional[Callable] = None):
+        self.broker = broker
+        self.model = model
+        self.in_q = broker.subscribe(in_topic)
+        self.out_topic = out_topic
+        self.transform = transform
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "InferenceRoute":
+        def run():
+            while not self._stop.is_set():
+                try:
+                    payload = self.in_q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                try:
+                    x = _decode(payload)
+                    if self.transform is not None:
+                        x = self.transform(x)
+                    y = np.asarray(self.model.output(x))
+                    self.broker.publish(self.out_topic, _encode(y))
+                except Exception as e:        # route stays alive
+                    logger.warning("inference route error: %s", e)
+                    self.broker.publish(
+                        self.out_topic + ".errors",
+                        json.dumps({"error": str(e)}).encode())
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)

@@ -1,0 +1,307 @@
+// Native data-loading runtime.
+//
+// The TPU-native equivalent of the reference's native ETL machinery
+// (libnd4j-backed DataVec record readers + the device-affine
+// MagicQueue, deeplearning4j-core parallelism/MagicQueue.java): a
+// multi-threaded CSV/float parser feeding a bounded producer/consumer
+// ring of ready-to-device batches. Python binds via ctypes
+// (deeplearning4j_tpu/data/native_loader.py); each next() hands the
+// consumer a fully assembled (features, one-hot labels) pair that goes
+// straight into jax.device_put, keeping host ETL off the critical path
+// the same way AsyncDataSetIterator's prefetch thread does — but with
+// parsing itself parallel and allocation-free after warmup.
+//
+// C ABI only (no C++ symbols exported) so ctypes stays trivial.
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<float> features;
+  std::vector<float> labels;
+  int n;  // rows actually filled (last batch may be short)
+};
+
+struct Loader {
+  // config
+  std::string path;
+  int batch_size;
+  int n_features;
+  int label_index;   // -1: no labels
+  int n_classes;     // 0: regression (1 label col)
+  int queue_capacity;
+
+  // state
+  std::vector<std::string> lines;
+  std::atomic<size_t> next_line{0};
+  std::queue<Batch*> ready;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::vector<std::thread> workers;
+  std::atomic<int> active_workers{0};
+  bool stopped = false;
+
+  ~Loader() { stop(); }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      stopped = true;
+    }
+    cv_space.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    workers.clear();
+    std::lock_guard<std::mutex> lock(mu);
+    while (!ready.empty()) {
+      delete ready.front();
+      ready.pop();
+    }
+  }
+
+  bool load_lines() {
+    std::ifstream f(path);
+    if (!f.is_open()) return false;
+    std::string line;
+    lines.clear();
+    while (std::getline(f, line)) {
+      if (!line.empty()) lines.push_back(line);
+    }
+    return true;
+  }
+
+  // parse one CSV line into the row-th slot of batch
+  bool parse_line(const std::string& line, Batch* b, int row) {
+    const char* p = line.c_str();
+    char* end;
+    int col = 0, feat_i = 0;
+    bool saw_label = false;
+    float label_val = 0.0f;
+    float* feat_row = b->features.data() + (size_t)row * n_features;
+    while (*p) {
+      float v = strtof(p, &end);
+      if (end == p) break;
+      if (col == label_index) {
+        label_val = v;
+        saw_label = true;
+      } else {
+        if (feat_i >= n_features) return false;
+        feat_row[feat_i++] = v;
+      }
+      ++col;
+      p = end;
+      while (*p == ',' || *p == ' ' || *p == '\t') ++p;
+    }
+    if (feat_i != n_features) return false;
+    if (label_index >= 0 && !saw_label) return false;  // short row:
+      // without this a row missing its label column would silently
+      // train as class 0
+    if (label_index >= 0) {
+      if (n_classes > 0) {
+        float* lab_row = b->labels.data() + (size_t)row * n_classes;
+        std::memset(lab_row, 0, sizeof(float) * n_classes);
+        int cls = (int)label_val;
+        if (cls < 0 || cls >= n_classes) return false;
+        lab_row[cls] = 1.0f;
+      } else {
+        b->labels[row] = label_val;
+      }
+    }
+    return true;
+  }
+
+  void worker() {
+    const int lab_width = label_index < 0 ? 0
+                          : (n_classes > 0 ? n_classes : 1);
+    for (;;) {
+      size_t start = next_line.fetch_add((size_t)batch_size);
+      if (start >= lines.size()) break;
+      size_t end_i = std::min(start + (size_t)batch_size, lines.size());
+      Batch* b = new Batch();
+      b->features.resize((size_t)batch_size * n_features, 0.0f);
+      if (lab_width) b->labels.resize((size_t)batch_size * lab_width, 0.0f);
+      int row = 0;
+      for (size_t i = start; i < end_i; ++i) {
+        if (parse_line(lines[i], b, row)) ++row;
+      }
+      b->n = row;
+      std::unique_lock<std::mutex> lock(mu);
+      cv_space.wait(lock, [&] {
+        return stopped || (int)ready.size() < queue_capacity;
+      });
+      if (stopped) {
+        delete b;
+        break;
+      }
+      ready.push(b);
+      cv_ready.notify_one();
+    }
+    if (active_workers.fetch_sub(1) == 1) cv_ready.notify_all();
+  }
+
+  void start(int n_threads) {
+    active_workers = n_threads;
+    for (int i = 0; i < n_threads; ++i)
+      workers.emplace_back([this] { worker(); });
+  }
+
+  // returns rows in batch, 0 when exhausted, -1 on stopped
+  int next(float* feat_out, float* lab_out) {
+    Batch* b = nullptr;
+    {
+      std::unique_lock<std::mutex> lock(mu);
+      cv_ready.wait(lock, [&] {
+        return stopped || !ready.empty() || active_workers.load() == 0;
+      });
+      if (stopped) return -1;
+      if (ready.empty()) return 0;  // workers done, queue drained
+      b = ready.front();
+      ready.pop();
+      cv_space.notify_one();
+    }
+    std::memcpy(feat_out, b->features.data(),
+                b->features.size() * sizeof(float));
+    if (lab_out && !b->labels.empty())
+      std::memcpy(lab_out, b->labels.data(),
+                  b->labels.size() * sizeof(float));
+    int n = b->n;
+    delete b;
+    return n;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// fast word counting for vocab construction (NLP VocabConstructor's
+// hot loop; the reference parallelizes this across threads too)
+struct WordCounts {
+  std::vector<std::string> words;
+  std::vector<int64_t> counts;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dl4j_csv_loader_create(const char* path, int batch_size,
+                             int n_features, int label_index,
+                             int n_classes, int n_threads,
+                             int queue_capacity) {
+  auto* l = new Loader();
+  l->path = path;
+  l->batch_size = batch_size;
+  l->n_features = n_features;
+  l->label_index = label_index;
+  l->n_classes = n_classes;
+  l->queue_capacity = queue_capacity > 0 ? queue_capacity : 4;
+  if (!l->load_lines()) {
+    delete l;
+    return nullptr;
+  }
+  l->start(n_threads > 0 ? n_threads : 2);
+  return l;
+}
+
+int64_t dl4j_loader_num_lines(void* handle) {
+  return (int64_t) static_cast<Loader*>(handle)->lines.size();
+}
+
+int dl4j_loader_next(void* handle, float* feat_out, float* lab_out) {
+  return static_cast<Loader*>(handle)->next(feat_out, lab_out);
+}
+
+void dl4j_loader_destroy(void* handle) {
+  delete static_cast<Loader*>(handle);
+}
+
+// Count whitespace-separated tokens in a text file using n_threads.
+// Returns a handle; query with dl4j_counts_size/get, free with
+// dl4j_counts_destroy. Tokens are lowercased; ASCII punctuation
+// stripped from token edges (CommonPreprocessor-lite).
+void* dl4j_count_words(const char* path, int n_threads) {
+  std::ifstream f(path);
+  if (!f.is_open()) return nullptr;
+  std::string content((std::istreambuf_iterator<char>(f)),
+                      std::istreambuf_iterator<char>());
+  int nt = n_threads > 0 ? n_threads : 4;
+  size_t chunk = content.size() / nt + 1;
+  std::vector<std::unordered_map<std::string, int64_t>> partial(nt);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < nt; ++t) {
+    threads.emplace_back([&, t] {
+      size_t start = t * chunk;
+      size_t end = std::min(content.size(), start + chunk);
+      if (start > 0) {  // skip partial token at chunk head
+        while (start < end && !isspace((unsigned char)content[start]))
+          ++start;
+      }
+      // include token spilling past chunk tail
+      size_t hard_end = end;
+      while (hard_end < content.size() &&
+             !isspace((unsigned char)content[hard_end]))
+        ++hard_end;
+      std::string tok;
+      auto flush = [&] {
+        if (!tok.empty()) {
+          partial[t][tok] += 1;
+          tok.clear();
+        }
+      };
+      for (size_t i = start; i < hard_end; ++i) {
+        char c = content[i];
+        if (isspace((unsigned char)c)) {
+          flush();
+        } else if (isalnum((unsigned char)c) || c == '\'' || c == '-' ||
+                   (unsigned char)c >= 128) {
+          tok.push_back((char)tolower((unsigned char)c));
+        }
+        // other punctuation: dropped
+      }
+      flush();
+    });
+  }
+  for (auto& t : threads) t.join();
+  auto* out = new WordCounts();
+  std::unordered_map<std::string, int64_t> merged;
+  for (auto& m : partial)
+    for (auto& kv : m) merged[kv.first] += kv.second;
+  out->words.reserve(merged.size());
+  for (auto& kv : merged) {
+    out->words.push_back(kv.first);
+    out->counts.push_back(kv.second);
+  }
+  return out;
+}
+
+int64_t dl4j_counts_size(void* handle) {
+  return (int64_t) static_cast<WordCounts*>(handle)->words.size();
+}
+
+const char* dl4j_counts_word(void* handle, int64_t i) {
+  return static_cast<WordCounts*>(handle)->words[i].c_str();
+}
+
+int64_t dl4j_counts_count(void* handle, int64_t i) {
+  return static_cast<WordCounts*>(handle)->counts[i];
+}
+
+void dl4j_counts_destroy(void* handle) {
+  delete static_cast<WordCounts*>(handle);
+}
+
+}  // extern "C"

@@ -56,6 +56,21 @@ class TestTrees:
         assert ids[0] == 17
         assert dists[0] < 1e-9
 
+    def test_vptree_cosine_matches_bruteforce(self, rng):
+        # 1-cos is not a metric; the tree must still return exact
+        # results (it searches euclidean on normalized vectors)
+        x = rng.normal(0, 1, (200, 5))
+        tree = VPTree(x, distance="cosine")
+        xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+        for t in range(10):
+            q = rng.normal(0, 1, 5)
+            qn = q / np.linalg.norm(q)
+            ids, dists = tree.search(q, 5)
+            brute = np.argsort(1.0 - xn @ qn)[:5]
+            assert set(ids) == set(brute.tolist()), t
+            np.testing.assert_allclose(
+                sorted(dists), sorted((1.0 - xn @ qn)[brute]), atol=1e-9)
+
     def test_kdtree_matches_bruteforce(self, rng):
         x = rng.normal(0, 1, (150, 4))
         tree = KDTree(x)

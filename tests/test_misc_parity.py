@@ -1,0 +1,153 @@
+"""ModelGuesser, CLI, streaming routes, evaluation HTML export."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.fetchers import iris_data
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.util.model_serializer import write_model
+
+
+def _net():
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .updater(updaters.adam(0.05)).list()
+         .layer(DenseLayer(n_out=8, activation="relu"))
+         .layer(OutputLayer(n_out=3))
+         .set_input_type(InputType.feed_forward(4)).build())).init()
+
+
+class TestModelGuesser:
+    def test_guesses_checkpoint(self, tmp_path):
+        from deeplearning4j_tpu.util.model_guesser import (guess_format,
+                                                           load_model_guess)
+        p = os.path.join(tmp_path, "m.zip")
+        write_model(_net(), p)
+        assert guess_format(p) == "checkpoint"
+        m = load_model_guess(p)
+        assert m.num_params() > 0
+
+    def test_guesses_keras(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        from keras import layers
+        from deeplearning4j_tpu.util.model_guesser import (guess_format,
+                                                           load_model_guess)
+        m = keras.Sequential([keras.Input((4,)),
+                              layers.Dense(3, activation="softmax")])
+        p = os.path.join(tmp_path, "k.h5")
+        m.save(p)
+        assert guess_format(p) == "keras_h5"
+        net = load_model_guess(p)
+        assert np.asarray(net.output(np.zeros((1, 4), "float32"))).shape \
+            == (1, 3)
+
+    def test_guesses_word_vectors(self, tmp_path):
+        from deeplearning4j_tpu.util.model_guesser import (guess_format,
+                                                           load_model_guess)
+        p = os.path.join(tmp_path, "v.txt")
+        with open(p, "w") as f:
+            f.write("2 3\nfoo 1.0 2.0 3.0\nbar 4.0 5.0 6.0\n")
+        assert guess_format(p) == "word_vectors"
+        cache, vecs = load_model_guess(p)
+        assert vecs.shape == (2, 3)
+
+    def test_unknown(self, tmp_path):
+        from deeplearning4j_tpu.util.model_guesser import guess_format
+        p = os.path.join(tmp_path, "x.bin")
+        with open(p, "wb") as f:
+            f.write(b"\x00\x01\x02\x03garbage")
+        assert guess_format(p) == "unknown"
+
+
+class TestCli:
+    def test_train_and_summary(self, tmp_path):
+        xs, ys = iris_data()
+        model_path = os.path.join(tmp_path, "m.zip")
+        write_model(_net(), model_path)
+        data_path = os.path.join(tmp_path, "iris.csv")
+        with open(data_path, "w") as f:
+            for x, y in zip(xs, ys):
+                f.write(",".join(f"{v:.5f}" for v in x)
+                        + f",{y.argmax()}\n")
+        out_path = os.path.join(tmp_path, "trained.zip")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu", "train",
+             "--model", model_path, "--data", data_path,
+             "--label-index", "4", "--classes", "3", "--epochs", "20",
+             "--batch-size", "32", "--output", out_path],
+            capture_output=True, text=True, env=env, timeout=600,
+            cwd="/root/repo")
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert os.path.exists(out_path)
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        net = restore_model(out_path)
+        assert net.evaluate(xs, ys).accuracy() > 0.85
+        r2 = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu", "summary",
+             "--model", out_path],
+            capture_output=True, text=True, env=env, timeout=300,
+            cwd="/root/repo")
+        assert r2.returncode == 0
+        assert "format: checkpoint" in r2.stdout
+        assert "total params" in r2.stdout
+
+
+class TestStreaming:
+    def test_inference_route(self):
+        from deeplearning4j_tpu.services.streaming import (
+            InProcessBroker, InferenceRoute, NDArrayConsumer,
+            NDArrayPublisher)
+        xs, ys = iris_data()
+        net = _net()
+        net.fit(xs[:120], ys[:120], epochs=20, batch_size=40)
+        broker = InProcessBroker()
+        route = InferenceRoute(broker, net, "in", "out").start()
+        try:
+            pub = NDArrayPublisher(broker, "in")
+            sub = NDArrayConsumer(broker, "out")
+            pub.publish(xs[:8])
+            preds = sub.get(timeout=10)
+            assert preds.shape == (8, 3)
+            np.testing.assert_allclose(
+                preds, np.asarray(net.output(xs[:8])), atol=1e-5)
+            # error path keeps the route alive
+            err_q = broker.subscribe("out.errors")
+            broker.publish("in", b"not an ndarray payload")
+            err = json.loads(err_q.get(timeout=10))
+            assert "error" in err
+            pub.publish(xs[8:12])
+            assert sub.get(timeout=10).shape == (4, 3)
+        finally:
+            route.stop()
+
+
+class TestEvaluationTools:
+    def test_html_exports(self, tmp_path):
+        from deeplearning4j_tpu.evaluation.classification import Evaluation
+        from deeplearning4j_tpu.evaluation.roc import ROC
+        from deeplearning4j_tpu.evaluation.tools import (
+            export_evaluation_html, export_roc_html)
+        rng = np.random.default_rng(0)
+        labels = np.eye(3)[rng.integers(0, 3, 100)]
+        preds = labels * 0.7 + rng.random((100, 3)) * 0.3
+        ev = Evaluation()
+        ev.eval(labels, preds)
+        p1 = os.path.join(tmp_path, "eval.html")
+        export_evaluation_html(ev, p1)
+        html = open(p1).read()
+        assert "Accuracy" in html and "Confusion" in html
+        roc = ROC()
+        roc.eval(labels[:, :2], preds[:, :2] /
+                 preds[:, :2].sum(1, keepdims=True))
+        p2 = os.path.join(tmp_path, "roc.html")
+        export_roc_html(roc, p2)
+        assert "AUC" in open(p2).read()

@@ -1,0 +1,235 @@
+"""Native C++ runtime components + Pallas kernels + attention layers."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+
+class TestNativeLoader:
+    def _write_csv(self, tmp_path, n=100, f=4, classes=3):
+        rng = np.random.default_rng(0)
+        path = os.path.join(tmp_path, "data.csv")
+        rows = []
+        feats = rng.normal(0, 1, (n, f))
+        labels = rng.integers(0, classes, n)
+        with open(path, "w") as fh:
+            for i in range(n):
+                fh.write(",".join(f"{v:.6f}" for v in feats[i])
+                         + f",{labels[i]}\n")
+        return path, feats, labels
+
+    def test_native_csv_matches_python_reader(self, tmp_path):
+        from deeplearning4j_tpu.data.native_loader import (
+            NativeCSVDataSetIterator, native_available)
+        if not native_available():
+            pytest.skip("no native toolchain")
+        path, feats, labels = self._write_csv(tmp_path)
+        it = NativeCSVDataSetIterator(path, batch_size=32, n_features=4,
+                                      label_index=4, num_classes=3)
+        assert it.num_examples() == 100
+        got_f, got_l = [], []
+        for ds in it:
+            got_f.append(ds.features)
+            got_l.append(ds.labels)
+        gf = np.concatenate(got_f)
+        gl = np.concatenate(got_l)
+        assert gf.shape == (100, 4)
+        # same multiset of rows (threads may reorder batches)
+        order_ref = np.lexsort(feats.T)
+        order_got = np.lexsort(gf.astype(np.float64).T)
+        np.testing.assert_allclose(gf[order_got],
+                                   feats[order_ref], atol=1e-5)
+        np.testing.assert_array_equal(
+            gl[order_got].argmax(1), labels[order_ref])
+        # restartable
+        assert sum(ds.num_examples() for ds in it) == 100
+
+    def test_native_trains_a_model(self, tmp_path):
+        from deeplearning4j_tpu.data.native_loader import (
+            NativeCSVDataSetIterator, native_available)
+        if not native_available():
+            pytest.skip("no native toolchain")
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.data.fetchers import iris_data
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        xs, ys = iris_data()
+        path = os.path.join(tmp_path, "iris.csv")
+        with open(path, "w") as fh:
+            for x, y in zip(xs, ys):
+                fh.write(",".join(f"{v:.5f}" for v in x)
+                         + f",{y.argmax()}\n")
+        it = NativeCSVDataSetIterator(path, batch_size=32, n_features=4,
+                                      label_index=4, num_classes=3)
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .updater(updaters.adam(0.05)).list()
+             .layer(DenseLayer(n_out=16, activation="relu"))
+             .layer(OutputLayer(n_out=3))
+             .set_input_type(InputType.feed_forward(4)).build())).init()
+        net.fit(it, epochs=30)
+        assert net.evaluate(xs, ys).accuracy() > 0.9
+
+    def test_word_count(self, tmp_path):
+        from deeplearning4j_tpu.data.native_loader import (
+            native_available, native_count_words)
+        if not native_available():
+            pytest.skip("no native toolchain")
+        p = os.path.join(tmp_path, "text.txt")
+        with open(p, "w") as fh:
+            fh.write("Apple banana apple!\nCherry, apple banana.\n" * 50)
+        counts = native_count_words(p)
+        assert counts["apple"] == 150
+        assert counts["banana"] == 100
+        assert counts["cherry"] == 50
+
+    def test_missing_file(self):
+        from deeplearning4j_tpu.data.native_loader import (
+            NativeCSVDataSetIterator, native_available)
+        if not native_available():
+            pytest.skip("no native toolchain")
+        it = NativeCSVDataSetIterator("/nonexistent.csv", 8, 2)
+        with pytest.raises(IOError):
+            list(it)
+
+
+class TestFlashAttention:
+    """Pallas kernel in interpret mode on CPU (real-TPU run covered by
+    bench/driver); dispatcher falls back to blockwise off-TPU."""
+
+    def test_interpret_matches_reference(self, rng):
+        from deeplearning4j_tpu.ops.attention import (
+            pallas_flash_attention)
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            attention_reference)
+        q, k, v = (rng.normal(0, 1, (1, 16, 2, 8)).astype(np.float32)
+                   for _ in range(3))
+        out = np.asarray(pallas_flash_attention(
+            q, k, v, block_q=8, block_k=8, interpret=True))
+        ref = np.asarray(attention_reference(q, k, v))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_interpret_causal(self, rng):
+        from deeplearning4j_tpu.ops.attention import (
+            pallas_flash_attention)
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            attention_reference)
+        q, k, v = (rng.normal(0, 1, (1, 16, 2, 8)).astype(np.float32)
+                   for _ in range(3))
+        out = np.asarray(pallas_flash_attention(
+            q, k, v, block_q=8, block_k=8, causal=True, interpret=True))
+        ref = np.asarray(attention_reference(q, k, v, causal=True))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_dispatcher_cpu_fallback(self, rng):
+        from deeplearning4j_tpu.ops.attention import flash_attention
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            attention_reference)
+        q, k, v = (rng.normal(0, 1, (2, 20, 2, 4)).astype(np.float32)
+                   for _ in range(3))
+        out = np.asarray(flash_attention(
+            __import__("jax").numpy.asarray(q),
+            __import__("jax").numpy.asarray(k),
+            __import__("jax").numpy.asarray(v)))
+        ref = np.asarray(attention_reference(q, k, v))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+class TestAttentionLayers:
+    def test_self_attention_trains(self, rng):
+        """Marker-retrieval task — the class is determined by WHICH of 3
+        marker vectors appears at a random position in a noisy sequence:
+        exactly what attention retrieves and pooling cannot."""
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GlobalPoolingLayer, OutputLayer, SelfAttentionLayer)
+        n, t, f = 384, 12, 8
+        markers = rng.normal(0, 3.0, (3, f)).astype(np.float32)
+        xs = rng.normal(0, 0.5, (n, t, f)).astype(np.float32)
+        labels = rng.integers(0, 3, n)
+        pos = rng.integers(0, t, n)
+        xs[np.arange(n), pos] = markers[labels] \
+            + rng.normal(0, 0.1, (n, f)).astype(np.float32)
+        ys = np.eye(3, dtype=np.float32)[labels]
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(5e-3)).list()
+                .layer(SelfAttentionLayer(n_out=16, n_heads=4))
+                .layer(GlobalPoolingLayer(pooling="max"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.recurrent(f, t)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(xs[:320], ys[:320], epochs=30, batch_size=64)
+        assert net.evaluate(xs[320:], ys[320:]).accuracy() > 0.85
+
+    def test_transformer_block_shapes_and_gradcheck(self, rng):
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.gradientcheck import check_gradients
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GlobalPoolingLayer, OutputLayer, TransformerEncoderLayer)
+        conf = (NeuralNetConfiguration.builder().set_seed(1).list()
+                .layer(TransformerEncoderLayer(n_heads=2,
+                                               ffn_multiplier=2))
+                .layer(GlobalPoolingLayer(pooling="avg"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(8, 6)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(0, 1, (4, 6, 8))
+        y = np.eye(2)[rng.integers(0, 2, 4)]
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 2)
+        assert check_gradients(net, DataSet(x, y), subset=150)
+
+    def test_causal_attention_respects_order(self, rng):
+        """Changing a LATER timestep must not affect earlier outputs."""
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        import jax
+        lay = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2, causal=True)
+        p, s = lay.initialize(jax.random.PRNGKey(0),
+                              __import__(
+                                  "deeplearning4j_tpu.nn.conf.inputs",
+                                  fromlist=["InputType"]
+                              ).InputType.recurrent(8, 10))
+        x = rng.normal(0, 1, (1, 10, 8)).astype(np.float32)
+        y1, _ = lay.apply(p, s, x)
+        x2 = x.copy()
+        x2[0, 7:] += 10.0
+        y2, _ = lay.apply(p, s, x2)
+        np.testing.assert_allclose(np.asarray(y1)[0, :7],
+                                   np.asarray(y2)[0, :7], atol=1e-5)
+
+
+    def test_masked_attention_excludes_padded_keys(self, rng):
+        """Mask must remove padded keys from the softmax denominator:
+        output on a padded+masked sequence equals output on the
+        truncated sequence."""
+        import jax
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        import numpy as np
+        lay = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2)
+        p, s = lay.initialize(jax.random.PRNGKey(0),
+                              InputType.recurrent(8, 6))
+        x_short = rng.normal(0, 1, (2, 3, 8)).astype(np.float32)
+        x_pad = np.concatenate(
+            [x_short, rng.normal(0, 9, (2, 3, 8)).astype(np.float32)],
+            axis=1)
+        mask = np.zeros((2, 6), np.float32)
+        mask[:, :3] = 1.0
+        y_short, _ = lay.apply(p, s, x_short)
+        y_pad, _ = lay.apply(p, s, x_pad, mask=mask)
+        np.testing.assert_allclose(np.asarray(y_pad)[:, :3],
+                                   np.asarray(y_short), atol=1e-5)
+        # padded rows output zero
+        assert np.abs(np.asarray(y_pad)[:, 3:]).max() < 1e-6
