@@ -270,9 +270,7 @@ class ComputationGraph:
                              self._batch_tuple(mds), None, training=False)
         return float(loss)
 
-    def evaluate(self, data):
-        from deeplearning4j_tpu.evaluation.classification import Evaluation
-        ev = Evaluation()
+    def _eval_with(self, data, ev):
         if isinstance(data, (DataSet, MultiDataSet)):
             data = [data]
         for ds in data:
@@ -280,8 +278,26 @@ class ComputationGraph:
             preds = self.output(*mds.features)
             if isinstance(preds, tuple):
                 preds = preds[0]
-            ev.eval(mds.labels[0], np.asarray(preds))
+            lmask = (mds.labels_masks[0]
+                     if mds.labels_masks is not None else None)
+            try:
+                ev.eval(mds.labels[0], np.asarray(preds), mask=lmask)
+            except TypeError:     # evaluators without mask support (ROC)
+                ev.eval(mds.labels[0], np.asarray(preds))
         return ev
+
+    def evaluate(self, data):
+        from deeplearning4j_tpu.evaluation.classification import Evaluation
+        return self._eval_with(data, Evaluation())
+
+    def evaluate_regression(self, data):
+        from deeplearning4j_tpu.evaluation.regression import (
+            RegressionEvaluation)
+        return self._eval_with(data, RegressionEvaluation())
+
+    def evaluate_roc(self, data, threshold_steps: int = 0):
+        from deeplearning4j_tpu.evaluation.roc import ROC
+        return self._eval_with(data, ROC(threshold_steps))
 
     # ------------------------------------------------------------------
     def rnn_time_step(self, *inputs):

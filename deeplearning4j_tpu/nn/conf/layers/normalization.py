@@ -65,8 +65,14 @@ class BatchNormalization(BaseLayer):
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))   # all but channel/feature axis
         if training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # single-pass statistics: var = E[x²] − E[x]² lets XLA fuse
+            # both reductions into one sweep. ALWAYS in float32 — in
+            # bf16 the subtraction catastrophically cancels whenever
+            # |mean|/std ≳ 16 (flax BatchNorm makes the same choice)
+            xs = jnp.asarray(x, jnp.float32)
+            mean = jnp.mean(xs, axis=axes)
+            mean_sq = jnp.mean(jnp.square(xs), axis=axes)
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,

@@ -1,0 +1,51 @@
+"""Checkpoint-format regression tests.
+
+The reference's backward-compat contract (SURVEY §4.3,
+regressiontest/RegressionTest050.java: zips produced by older releases
+must keep loading): tests/fixtures/*_v1.zip were produced by the v1
+format writer and are COMMITTED — any build that cannot load them, or
+that computes different outputs from their weights, breaks the
+serialization contract. When format_version bumps, add a migration in
+multi_layer.migrate_config and keep these fixtures passing; do NOT
+regenerate them.
+"""
+
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.util.model_serializer import restore_model
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+class TestV1Format:
+    def test_mln_v1_loads_and_reproduces_outputs(self):
+        net = restore_model(os.path.join(FIXTURES, "mln_v1.zip"))
+        io = np.load(os.path.join(FIXTURES, "mln_v1_io.npz"))
+        out = np.asarray(net.output(io["x"]))
+        np.testing.assert_allclose(out, io["out"], rtol=1e-5, atol=1e-6)
+        # layers survived: conv/pool/bn/dense/output
+        names = [type(l).__name__ for l in net.layers]
+        assert names == ["ConvolutionLayer", "SubsamplingLayer",
+                         "BatchNormalization", "DenseLayer",
+                         "OutputLayer"]
+        # regularization + dropout config survived
+        assert net.layers[3].l2 == 1e-4
+        assert net.layers[3].dropout == 0.2
+
+    def test_mln_v1_resumes_training(self):
+        net = restore_model(os.path.join(FIXTURES, "mln_v1.zip"))
+        io = np.load(os.path.join(FIXTURES, "mln_v1_io.npz"))
+        y = np.eye(3, dtype="float32")[[0, 1, 2]]
+        before = net.iteration_count
+        net.fit(io["x"], y, epochs=1)
+        assert net.iteration_count == before + 1
+        assert np.isfinite(float(net.score_value))
+
+    def test_cg_v1_loads_and_reproduces_outputs(self):
+        cg = restore_model(os.path.join(FIXTURES, "cg_v1.zip"))
+        io = np.load(os.path.join(FIXTURES, "cg_v1_io.npz"))
+        out = np.asarray(cg.output(io["x"]))
+        np.testing.assert_allclose(out, io["out"], rtol=1e-5, atol=1e-6)
+        assert "cat" in cg.conf.vertices

@@ -1,0 +1,157 @@
+"""VAE pretraining + YOLO output layer behavior (the two big bespoke
+math ports, SURVEY §7 'hard parts' #7)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (AutoEncoder, DenseLayer,
+                                               OutputLayer,
+                                               VariationalAutoencoder,
+                                               Yolo2OutputLayer)
+
+
+class TestVae:
+    def _data(self, rng, n=256):
+        # two-cluster binary data the VAE must model
+        protos = (rng.random((2, 12)) > 0.5).astype(np.float32)
+        labels = rng.integers(0, 2, n)
+        flips = rng.random((n, 12)) < 0.1
+        x = np.abs(protos[labels] - flips.astype(np.float32))
+        return x, labels
+
+    def test_pretrain_improves_elbo(self, rng):
+        x, _ = self._data(rng)
+        vae = VariationalAutoencoder(
+            n_in=12, n_out=4, encoder_layer_sizes=(16,),
+            decoder_layer_sizes=(16,),
+            reconstruction_distribution="bernoulli")
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-2)).list()
+                .layer(vae)
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf).init()
+        key = jax.random.PRNGKey(0)
+        loss0 = float(vae.pretrain_loss(net.params[0], x[:64], key))
+        net.pretrain(DataSet(x), epochs=30, batch_size=64)
+        loss1 = float(vae.pretrain_loss(net.params[0], x[:64], key))
+        assert loss1 < loss0 * 0.8, (loss0, loss1)
+
+    def test_reconstruction_probability_discriminates(self, rng):
+        x, _ = self._data(rng)
+        vae = VariationalAutoencoder(
+            n_in=12, n_out=4, encoder_layer_sizes=(16,),
+            decoder_layer_sizes=(16,))
+        conf = (NeuralNetConfiguration.builder().set_seed(1)
+                .updater(updaters.adam(1e-2)).list()
+                .layer(vae)
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.pretrain(DataSet(x), epochs=40, batch_size=64)
+        key = jax.random.PRNGKey(3)
+        # in-distribution data scores higher log p(x) than noise
+        p_in = np.asarray(vae.reconstruction_probability(
+            net.params[0], x[:32], key))
+        noise = (rng.random((32, 12)) > 0.5).astype(np.float32)
+        p_out = np.asarray(vae.reconstruction_probability(
+            net.params[0], noise, key))
+        assert p_in.mean() > p_out.mean() + 1.0, (p_in.mean(),
+                                                 p_out.mean())
+
+    def test_generate_shapes(self, rng):
+        vae = VariationalAutoencoder(n_in=12, n_out=4)
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(vae).layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf).init()
+        z = rng.normal(0, 1, (5, 4)).astype(np.float32)
+        gen = np.asarray(vae.generate(net.params[0], z))
+        assert gen.shape == (5, 12)
+        assert (gen >= 0).all() and (gen <= 1).all()   # bernoulli means
+
+    def test_autoencoder_pretrain(self, rng):
+        x = rng.normal(0, 1, (128, 10)).astype(np.float32)
+        ae = AutoEncoder(n_in=10, n_out=6, corruption_level=0.2,
+                         activation="tanh")
+        conf = (NeuralNetConfiguration.builder().set_seed(2)
+                .updater(updaters.adam(1e-2)).list()
+                .layer(ae).layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(10)).build())
+        net = MultiLayerNetwork(conf).init()
+        key = jax.random.PRNGKey(0)
+        l0 = float(ae.pretrain_loss(net.params[0], x, key))
+        net.pretrain(DataSet(x), epochs=40, batch_size=64)
+        l1 = float(ae.pretrain_loss(net.params[0], x, key))
+        assert l1 < l0 * 0.8
+
+
+class TestYolo:
+    def _target(self, rng, b=2, g=4, a=2, c=3):
+        """Grid targets: one object per image at a random cell."""
+        t = np.zeros((b, g, g, a * (5 + c)), np.float32)
+        for i in range(b):
+            gx, gy = rng.integers(0, g, 2)
+            anchor = rng.integers(0, a)
+            base = anchor * (5 + c)
+            t[i, gy, gx, base:base + 2] = rng.random(2)       # xy
+            t[i, gy, gx, base + 2:base + 4] = 0.5 + rng.random(2)
+            t[i, gy, gx, base + 4] = 1.0                       # obj
+            t[i, gy, gx, base + 5 + rng.integers(0, c)] = 1.0  # class
+        return t
+
+    def test_loss_decreases_under_training(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+        g, a, c = 4, 2, 3
+        anchors = ((1.0, 1.5), (2.0, 1.0))
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(3e-3)).list()
+                .layer(ConvolutionLayer(n_out=16, kernel=(3, 3),
+                                        convolution_mode="same",
+                                        activation="relu"))
+                .layer(ConvolutionLayer(n_out=a * (5 + c), kernel=(1, 1),
+                                        convolution_mode="same"))
+                .layer(Yolo2OutputLayer(anchors=anchors))
+                .set_input_type(InputType.convolutional(g, g, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(0, 1, (8, g, g, 3)).astype(np.float32)
+        t = self._target(rng, b=8, g=g, a=a, c=c)
+        losses = []
+        for _ in range(100):
+            net.fit(DataSet(x, t))
+            losses.append(float(net.score_value))
+        assert losses[-1] < losses[0] * 0.4, (losses[0], losses[-1])
+
+    def test_forward_decodes_boxes(self, rng):
+        g, a, c = 4, 2, 3
+        lay = Yolo2OutputLayer(anchors=((1.0, 1.5), (2.0, 1.0)))
+        x = rng.normal(0, 1, (2, g, g, a * (5 + c))).astype(np.float32)
+        out, _ = lay.apply({}, {}, x)
+        out = np.asarray(out).reshape(2, g, g, a, 5 + c)
+        # xy in (0,1), wh positive, confidence in (0,1), classes sum to 1
+        assert (out[..., 0:2] > 0).all() and (out[..., 0:2] < 1).all()
+        assert (out[..., 2:4] > 0).all()
+        assert (out[..., 4] > 0).all() and (out[..., 4] < 1).all()
+        np.testing.assert_allclose(out[..., 5:].sum(-1), 1.0, rtol=1e-5)
+
+    def test_gradient_check(self, rng):
+        from deeplearning4j_tpu.gradientcheck import check_gradients
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+        g, a, c = 3, 1, 2
+        conf = (NeuralNetConfiguration.builder().set_seed(1).list()
+                .layer(ConvolutionLayer(n_out=a * (5 + c), kernel=(1, 1),
+                                        convolution_mode="same"))
+                .layer(Yolo2OutputLayer(anchors=((1.0, 1.0),)))
+                .set_input_type(InputType.convolutional(g, g, 2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(0, 1, (2, g, g, 2))
+        t = self._target(rng, b=2, g=g, a=a, c=c)
+        assert check_gradients(net, DataSet(x, t))
