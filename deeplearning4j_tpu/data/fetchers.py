@@ -25,7 +25,8 @@ from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
 
 __all__ = ["mnist_data", "MnistDataSetIterator", "iris_data",
            "IrisDataSetIterator", "cifar10_data", "Cifar10DataSetIterator",
-           "EmnistDataSetIterator", "synthetic_classification",
+           "EmnistDataSetIterator", "TinyImageNetDataSetIterator",
+           "LFWDataSetIterator", "synthetic_classification",
            "synthetic_images", "synthetic_sequences"]
 
 
@@ -235,3 +236,62 @@ class Cifar10DataSetIterator(ArrayDataSetIterator):
                  n: Optional[int] = None, seed: int = 42):
         xs, ys = cifar10_data(train=train, n=n, seed=seed)
         super().__init__(xs, ys, batch_size, shuffle=train, seed=seed)
+
+
+def _image_tree_or_synthetic(root, h, w, c, n_classes, n, seed,
+                             max_synth):
+    """Load a dir-per-label image tree if present (decoding at most
+    ``n`` images — never the whole tree), else synthesize."""
+    if os.path.isdir(root):
+        from deeplearning4j_tpu.data.records import ImageRecordReader
+        rr = ImageRecordReader(h, w, c).initialize(root)
+        if n is not None:
+            rr._items = rr._items[:n]       # truncate BEFORE decoding
+        xs, ys = [], []
+        for arr, li in rr:
+            xs.append(arr / 255.0)
+            ys.append(li)
+        xs = np.stack(xs).astype(np.float32)
+        onehot = np.eye(len(rr.labels), dtype=np.float32)[ys]
+    else:
+        count = min(n or max_synth, max_synth)
+        xs, onehot = synthetic_images(count, h, w, c, n_classes,
+                                      seed=seed)
+    if n is not None:
+        xs, onehot = xs[:n], onehot[:n]
+    return xs, onehot
+
+
+class TinyImageNetDataSetIterator(ArrayDataSetIterator):
+    """(datasets/iterator/impl/TinyImageNetDataSetIterator.java):
+    64x64x3, 200 classes. Real files via ImageRecordReader on a local
+    cache (<data_dir>/tiny-imagenet-200/train as a dir-per-label tree;
+    the standard val/ split — val/images + val_annotations.txt — is NOT
+    a label tree, so train=False with a real cache falls back to
+    synthetic unless a relabeled val tree is provided at val_tree/);
+    synthetic surrogate otherwise."""
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 n: Optional[int] = None, seed: int = 99,
+                 n_classes: int = 200):
+        base = os.path.join(_data_dir(), "tiny-imagenet-200")
+        root = os.path.join(base, "train" if train else "val_tree")
+        xs, onehot = _image_tree_or_synthetic(
+            root, 64, 64, 3, n_classes, n,
+            seed if train else seed + 1, max_synth=4096)
+        super().__init__(xs, onehot, batch_size, shuffle=train, seed=seed)
+
+
+class LFWDataSetIterator(ArrayDataSetIterator):
+    """(datasets/iterator/impl/LFWDataSetIterator.java): face images,
+    dir-per-person tree under <data_dir>/lfw; synthetic surrogate
+    otherwise."""
+
+    def __init__(self, batch_size: int, shape=(64, 64, 3),
+                 n: Optional[int] = None, n_labels: int = 40,
+                 train: bool = True, seed: int = 17):
+        h, w, c = shape
+        xs, onehot = _image_tree_or_synthetic(
+            os.path.join(_data_dir(), "lfw"), h, w, c, n_labels, n,
+            seed if train else seed + 1, max_synth=2048)
+        super().__init__(xs, onehot, batch_size, shuffle=train, seed=seed)

@@ -142,6 +142,8 @@ class ComputationGraph:
         acts, new_state = self._forward(params, state, inputs,
                                         training=training, rng=rng,
                                         fmasks=fmasks, exclude_outputs=True)
+        from deeplearning4j_tpu.nn.conf.layers.output import (
+            CenterLossOutputLayer)
         total = jnp.zeros(())
         topo = self.conf.topological_order()
         for i, out_name in enumerate(self.conf.network_outputs):
@@ -153,6 +155,11 @@ class ComputationGraph:
                 total = total + obj.loss_from_input(
                     params[out_name], acts[out_name], labels[i],
                     training=training, rng=lrng, mask=lmask)
+                if isinstance(obj, CenterLossOutputLayer):
+                    total = total + obj.lambda_ * obj.center_loss(
+                        state[out_name], acts[out_name], labels[i])
+                    new_state[out_name] = obj.update_centers(
+                        state[out_name], acts[out_name], labels[i])
             else:
                 raise ValueError(f"Output vertex '{out_name}' has no loss")
         for name, (obj, _) in self.conf.vertices.items():

@@ -89,3 +89,44 @@ class DeepWalk:
 
     def verts_nearest(self, v: int, n: int = 10) -> List[int]:
         return [int(w) for w in self._sv.words_nearest(str(v), n)]
+
+
+class Node2Vec(DeepWalk):
+    """node2vec (Grover & Leskovec): 2nd-order biased random walks with
+    return parameter p and in-out parameter q over the DeepWalk trainer
+    (the reference exposes Node2Vec atop SequenceVectors too)."""
+
+    def __init__(self, *, p: float = 1.0, q: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.p = p
+        self.q = q
+
+    def _walks(self, graph: Graph, rng) -> List[List[str]]:
+        walks = []
+        adj_sets = [set(a) for a in graph.adj]
+        for _ in range(self.walks_per_vertex):
+            for start in rng.permutation(graph.n):
+                walk = [int(start)]
+                prev = None
+                cur = int(start)
+                for _ in range(self.walk_length - 1):
+                    nbrs = graph.adj[cur]
+                    if not nbrs:
+                        break
+                    if prev is None:
+                        nxt = int(nbrs[rng.integers(0, len(nbrs))])
+                    else:
+                        w = np.empty(len(nbrs))
+                        for i, x in enumerate(nbrs):
+                            if x == prev:
+                                w[i] = 1.0 / self.p      # return
+                            elif x in adj_sets[prev]:
+                                w[i] = 1.0               # distance 1
+                            else:
+                                w[i] = 1.0 / self.q      # explore
+                        w /= w.sum()
+                        nxt = int(nbrs[rng.choice(len(nbrs), p=w)])
+                    walk.append(nxt)
+                    prev, cur = cur, nxt
+                walks.append([str(v) for v in walk])
+        return walks

@@ -57,7 +57,11 @@ class SequenceVectors:
                  min_learning_rate: float = 1e-4,
                  min_word_frequency: int = 5, subsampling: float = 1e-3,
                  epochs: int = 1, batch_size: int = 512, seed: int = 123,
-                 stop_words: Iterable[str] = ()):
+                 stop_words: Iterable[str] = (),
+                 algorithm: str = "skipgram"):
+        if algorithm not in ("skipgram", "cbow"):
+            raise ValueError(f"Unknown algorithm '{algorithm}'")
+        self.algorithm = algorithm
         self.layer_size = layer_size
         self.window = window
         self.negative = negative
@@ -145,6 +149,119 @@ class SequenceVectors:
 
         return step
 
+    def _make_cbow_step(self):
+        """CBOW (learning/impl/elements/CBOW.java): the mean of the
+        context-word vectors predicts the center word, negative
+        sampling on syn1. Contexts arrive as a fixed-width (B, 2W)
+        index matrix with a validity mask."""
+
+        if self.hs:
+            points, codes, mask = self._hs_arrays
+            points = jnp.asarray(points)
+            codes = jnp.asarray(codes)
+            hmask = jnp.asarray(mask)
+
+        @jax.jit
+        def step(syn0, syn1, contexts, ctx_mask, centers, negatives, lr):
+            def loss_fn(s0, s1):
+                ctx = jnp.take(s0, contexts, axis=0)         # (B,2W,D)
+                denom = jnp.maximum(
+                    jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0)
+                h = jnp.sum(ctx * ctx_mask[..., None], axis=1) / denom
+                if self.hs:
+                    # hierarchical softmax on the CENTER word's path
+                    pts = jnp.take(points, centers, axis=0)
+                    cds = jnp.take(codes, centers, axis=0)
+                    msk = jnp.take(hmask, centers, axis=0)
+                    node_vecs = jnp.take(s1, pts, axis=0)    # (B,L,D)
+                    scores = jnp.einsum("bd,bld->bl", h, node_vecs)
+                    per = jax.nn.softplus(scores) - cds * scores
+                    return jnp.sum(per * msk)
+                pos = jnp.take(s1, centers, axis=0)          # (B,D)
+                neg = jnp.take(s1, negatives, axis=0)        # (B,K,D)
+                pos_score = jnp.sum(h * pos, axis=-1)
+                neg_score = jnp.einsum("bd,bkd->bk", h, neg)
+                return (jnp.sum(jax.nn.softplus(-pos_score))
+                        + jnp.sum(jax.nn.softplus(neg_score)))
+            loss, (g0, g1) = jax.value_and_grad(loss_fn, (0, 1))(syn0,
+                                                                syn1)
+            return (syn0 - lr * _clip_rows(g0),
+                    syn1 - lr * _clip_rows(g1), loss)
+
+        return step
+
+    def _cbow_batches(self, sequences, rng):
+        """(contexts (B,2W), mask, centers) tuples. Applies the same
+        frequency subsampling as the skip-gram path."""
+        vocab = self.vocab
+        W = self.window
+        freqs = vocab.frequencies()
+        total = max(freqs.sum(), 1.0)
+        keep_prob = np.ones(len(vocab))
+        if self.subsampling > 0:
+            f = freqs / total
+            keep_prob = np.minimum(
+                1.0, (np.sqrt(f / self.subsampling) + 1)
+                * self.subsampling / np.maximum(f, 1e-12))
+        ctxs, masks, centers = [], [], []
+        for seq in sequences:
+            idxs = [vocab.index_of(t) for t in seq]
+            idxs = [i for i in idxs if i >= 0
+                    and rng.random() < keep_prob[i]]
+            n = len(idxs)
+            for pos, center in enumerate(idxs):
+                row = np.zeros(2 * W, np.int32)
+                m = np.zeros(2 * W, np.float32)
+                j = 0
+                for off in range(-W, W + 1):
+                    if off == 0:
+                        continue
+                    k = pos + off
+                    if 0 <= k < n:
+                        row[j] = idxs[k]
+                        m[j] = 1.0
+                        j += 1
+                if j:
+                    ctxs.append(row)
+                    masks.append(m)
+                    centers.append(center)
+        return (np.stack(ctxs) if ctxs else np.zeros((0, 2 * W), np.int32),
+                np.stack(masks) if masks else np.zeros((0, 2 * W),
+                                                       np.float32),
+                np.asarray(centers, np.int32))
+
+    def _fit_cbow(self, sequences):
+        rng = np.random.default_rng(self.seed + 1)
+        step = self._make_cbow_step()
+        syn0 = jnp.asarray(self.syn0)
+        syn1 = jnp.asarray(self.syn1)
+        V = len(self.vocab)
+        B = self.batch_size
+        ctxs, masks, centers = self._cbow_batches(sequences, rng)
+        n = len(centers)
+        if n == 0:
+            raise ValueError("No CBOW training examples")
+        total_steps = max(1, n * self.epochs // B)
+        step_i = 0
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            if n < B:
+                order = np.resize(order, B)
+            for s in range(0, len(order) - B + 1, B):
+                sel = order[s:s + B]
+                negs = rng.choice(V, size=(B, self.negative),
+                                  p=self._unigram_table)
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1 - step_i / total_steps))
+                syn0, syn1, loss = step(
+                    syn0, syn1, jnp.asarray(ctxs[sel]),
+                    jnp.asarray(masks[sel]), jnp.asarray(centers[sel]),
+                    jnp.asarray(negs, jnp.int32), jnp.float32(lr))
+                step_i += 1
+        self.syn0 = np.asarray(syn0)
+        self.syn1 = np.asarray(syn1)
+        return self
+
     def _make_hs_step(self):
         points, codes, mask = self._hs_arrays
         points = jnp.asarray(points)
@@ -173,6 +290,8 @@ class SequenceVectors:
     def fit(self, sequences: List[List[str]]):
         if self.vocab is None:
             self.build_vocab(sequences)
+        if self.algorithm == "cbow":
+            return self._fit_cbow(sequences)
         rng = np.random.default_rng(self.seed + 1)
         step = self._make_hs_step() if self.hs else self._make_ns_step()
         syn0 = jnp.asarray(self.syn0)
@@ -295,6 +414,12 @@ class Word2Vec(SequenceVectors):
 
         def stop_words(self, sw):
             self._kw["stop_words"] = sw
+            return self
+
+        def elements_learning_algorithm(self, name: str):
+            """'skipgram' | 'cbow' (reference
+            elementsLearningAlgorithm(SkipGram/CBOW))."""
+            self._kw["algorithm"] = name.lower()
             return self
 
         def iterate(self, it: SentenceIterator):

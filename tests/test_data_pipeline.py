@@ -129,3 +129,20 @@ class TestIterators:
         it = AsyncDataSetIterator(Bad(np.zeros((4, 2)), None, 2))
         with pytest.raises(RuntimeError, match="boom"):
             list(it)
+
+
+class TestExtraFetchers:
+    def test_tiny_imagenet_synthetic(self):
+        from deeplearning4j_tpu.data.fetchers import (
+            TinyImageNetDataSetIterator)
+        it = TinyImageNetDataSetIterator(32, n=64)
+        ds = next(iter(it))
+        assert ds.features.shape == (32, 64, 64, 3)
+        assert ds.labels.shape[1] == 200
+
+    def test_lfw_synthetic(self):
+        from deeplearning4j_tpu.data.fetchers import LFWDataSetIterator
+        it = LFWDataSetIterator(16, shape=(32, 32, 3), n=32, n_labels=10)
+        ds = next(iter(it))
+        assert ds.features.shape == (16, 32, 32, 3)
+        assert ds.labels.shape[1] == 10

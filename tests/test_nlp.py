@@ -206,3 +206,38 @@ class TestVectorizers:
         # 'a' appears in 1 doc, 'b' in 2 → idf(a) > idf(b)
         assert v2[tfidf.vocab.index_of("a")] > \
             v2[tfidf.vocab.index_of("b")]
+
+
+class TestCbow:
+    def test_cbow_separates_topics(self):
+        w2v = (Word2Vec.builder()
+               .layer_size(32).window_size(4).negative_sample(5)
+               .min_word_frequency(3).epochs(5).seed(9)
+               .learning_rate(0.025).sampling(0.0)
+               .elements_learning_algorithm("cbow")
+               .iterate(ListSentenceIterator(_corpus()))
+               .build())
+        w2v.fit()
+        assert w2v.similarity("apple", "banana") > \
+            w2v.similarity("apple", "cpu")
+
+    def test_unknown_algorithm_rejected(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="algorithm"):
+            Word2Vec(algorithm="glove-ish")
+
+
+class TestNode2Vec:
+    def test_biased_walks_community(self):
+        from deeplearning4j_tpu.nlp.deepwalk import Graph, Node2Vec
+        g = Graph(16)
+        for base in (0, 8):
+            for i in range(8):
+                for j in range(i + 1, 8):
+                    g.add_edge(base + i, base + j)
+        g.add_edge(0, 8)
+        n2v = Node2Vec(p=0.5, q=2.0, vector_size=16, walk_length=20,
+                       walks_per_vertex=8, window_size=4, epochs=2,
+                       seed=11)
+        n2v.fit(g)
+        assert n2v.similarity(1, 2) > n2v.similarity(1, 9)

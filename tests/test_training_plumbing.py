@@ -215,3 +215,34 @@ class TestMasking:
                                       labels_mask=mask,
                                       features_mask=mask))
         np.testing.assert_allclose(s_masked, s_garbage, rtol=1e-5)
+
+
+class TestCenterLossGraph:
+    def test_center_loss_updates_centers_in_graph(self):
+        """CenterLossOutputLayer in a ComputationGraph must apply the
+        center term and EMA-update centers (FaceNet zoo path)."""
+        import numpy as np
+        from deeplearning4j_tpu import (ComputationGraph,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.fetchers import iris_data
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            CenterLossOutputLayer, DenseLayer)
+        xs, ys = iris_data()
+        g = (NeuralNetConfiguration.builder().set_seed(0)
+             .updater(updaters.adam(0.05)).graph_builder()
+             .add_inputs("in")
+             .add_layer("h", DenseLayer(n_out=8, activation="relu"),
+                        "in")
+             .add_layer("out", CenterLossOutputLayer(n_out=3,
+                                                     lambda_=0.01), "h")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(4)).build())
+        cg = ComputationGraph(g).init()
+        centers0 = np.asarray(cg.state["out"]["centers"]).copy()
+        cg.fit(DataSet(xs[:120], ys[:120]), epochs=120)
+        centers1 = np.asarray(cg.state["out"]["centers"])
+        assert np.abs(centers1 - centers0).max() > 1e-3
+        assert cg.evaluate(DataSet(xs[120:], ys[120:])).accuracy() > 0.75
