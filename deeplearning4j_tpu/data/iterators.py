@@ -20,7 +20,8 @@ from deeplearning4j_tpu.data.dataset import DataSet
 __all__ = ["DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
            "AsyncDataSetIterator", "MultipleEpochsIterator",
            "EarlyTerminationDataSetIterator", "SamplingDataSetIterator",
-           "BenchmarkDataSetIterator"]
+           "BenchmarkDataSetIterator", "JointParallelDataSetIterator",
+           "FileSplitParallelDataSetIterator"]
 
 
 class DataSetIterator:
@@ -245,3 +246,50 @@ class BenchmarkDataSetIterator(DataSetIterator):
 
     def num_examples(self):
         return self.batch.num_examples() * self.n_batches
+
+
+class JointParallelDataSetIterator(DataSetIterator):
+    """Interleaves several source iterators round-robin (reference
+    datasets/iterator/parallel/JointParallelDataSetIterator.java —
+    feeds multi-device training from N independent sources)."""
+
+    def __init__(self, *iterators: DataSetIterator):
+        if not iterators:
+            raise ValueError("need at least one iterator")
+        self.iterators = list(iterators)
+
+    def reset(self):
+        for it in self.iterators:
+            it.reset()
+
+    def _iterate(self):
+        gens = [it._iterate() for it in self.iterators]
+        while gens:
+            done = []
+            for g in gens:
+                try:
+                    yield next(g)
+                except StopIteration:
+                    done.append(g)
+            for g in done:
+                gens.remove(g)
+
+    def batch_size(self):
+        return self.iterators[0].batch_size()
+
+
+class FileSplitParallelDataSetIterator(JointParallelDataSetIterator):
+    """One CSV file per worker, interleaved (reference
+    FileSplitParallelDataSetIterator). ``files``: list of csv paths."""
+
+    def __init__(self, files, batch_size: int, label_index: int,
+                 num_classes: int = 0, regression: bool = False):
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader, RecordReaderDataSetIterator)
+        its = []
+        for f in files:
+            rr = CSVRecordReader().initialize(f)
+            its.append(RecordReaderDataSetIterator(
+                rr, batch_size, label_index=label_index,
+                num_classes=num_classes, regression=regression))
+        super().__init__(*its)

@@ -131,6 +131,84 @@ class EmbeddingSequenceLayer(FeedForwardLayer):
 
 @register_layer
 @dataclasses.dataclass
+class RBM(FeedForwardLayer):
+    """Restricted Boltzmann Machine (nn/conf/layers/RBM.java, impl
+    nn/layers/feedforward/rbm/RBM.java — the reference's legacy
+    pretraining layer).
+
+    Supervised forward = hidden activations (sigmoid propup), like the
+    reference. Unsupervised pretraining uses contrastive divergence:
+    ``pretrain_loss`` is the free-energy difference F(v) − F(ṽ) with
+    the CD-1 reconstruction ṽ held constant (stop_gradient), whose
+    gradient is exactly the CD-1 update — so the same jitted
+    pretraining machinery (jax.grad + optax) that serves AutoEncoder/VAE
+    drives RBM, instead of the reference's hand-coded Gibbs updates.
+    """
+
+    k: int = 1                      # CD-k Gibbs steps
+    activation: str = "sigmoid"
+    visible_unit: str = "binary"    # 'binary' | 'gaussian'
+    hidden_unit: str = "binary"
+
+    def __post_init__(self):
+        # the softplus free-energy form assumes sigmoid-binary hiddens;
+        # reject configs that would silently train a different model
+        if self.activation != "sigmoid":
+            raise ValueError("RBM supports only sigmoid hidden "
+                             "activation (free-energy objective)")
+        for name, v in (("visible_unit", self.visible_unit),
+                        ("hidden_unit", self.hidden_unit)):
+            if v not in ("binary", "gaussian"):
+                raise ValueError(f"RBM {name} must be 'binary' or "
+                                 f"'gaussian', got '{v}'")
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        pd = dtypes.policy().param_dtype
+        return {
+            "W": self._sample_w(key, (self.n_in, self.n_out),
+                                self.n_in, self.n_out),
+            "b": jnp.full((self.n_out,), self.bias_init, pd),  # hidden
+            "vb": jnp.zeros((self.n_in,), pd),                 # visible
+        }, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        return jax.nn.sigmoid(x @ params["W"] + params["b"]), state
+
+    def _free_energy(self, params, v):
+        # F(v) = -v·vb - Σ softplus(vW + hb)
+        vis = jnp.sum(v * params["vb"], axis=-1)
+        hid = jnp.sum(jax.nn.softplus(v @ params["W"] + params["b"]),
+                      axis=-1)
+        return -vis - hid
+
+    def _gibbs(self, params, v, rng):
+        ph = jax.nn.sigmoid(v @ params["W"] + params["b"])
+        k1, k2 = jax.random.split(rng)
+        h = (jax.random.bernoulli(k1, ph).astype(v.dtype)
+             if self.hidden_unit == "binary" else ph)
+        pv = h @ params["W"].T + params["vb"]
+        if self.visible_unit == "binary":
+            pv = jax.nn.sigmoid(pv)
+        return pv
+
+    def pretrain_loss(self, params, x, rng):
+        v_model = x
+        keys = jax.random.split(rng, max(self.k, 1))
+        for kk in keys:
+            v_model = self._gibbs(params, v_model, kk)
+        v_model = jax.lax.stop_gradient(v_model)
+        return jnp.mean(self._free_energy(params, x)
+                        - self._free_energy(params, v_model))
+
+    def reconstruction_error(self, params, x, rng):
+        recon = self._gibbs(params, x, rng)
+        return jnp.mean((x - recon) ** 2)
+
+
+@register_layer
+@dataclasses.dataclass
 class AutoEncoder(FeedForwardLayer):
     """Denoising autoencoder layer (nn/conf/layers/AutoEncoder.java,
     impl nn/layers/feedforward/autoencoder/AutoEncoder.java).

@@ -146,3 +146,48 @@ class TestExtraFetchers:
         ds = next(iter(it))
         assert ds.features.shape == (16, 32, 32, 3)
         assert ds.labels.shape[1] == 10
+
+
+class TestParallelSplitIterators:
+    def test_joint_round_robin(self):
+        from deeplearning4j_tpu.data.iterators import (
+            ArrayDataSetIterator, JointParallelDataSetIterator)
+        a = ArrayDataSetIterator(np.zeros((4, 2)), np.zeros((4, 2)), 2)
+        b = ArrayDataSetIterator(np.ones((6, 2)), np.ones((6, 2)), 2)
+        joint = JointParallelDataSetIterator(a, b)
+        batches = list(joint)
+        assert len(batches) == 5           # 2 + 3 interleaved
+        # round-robin: first two batches come from different sources
+        assert batches[0].features[0, 0] != batches[1].features[0, 0]
+
+    def test_file_split(self, tmp_path):
+        from deeplearning4j_tpu.data.iterators import (
+            FileSplitParallelDataSetIterator)
+        paths = []
+        for i in range(2):
+            p = os.path.join(tmp_path, f"p{i}.csv")
+            with open(p, "w") as f:
+                for j in range(4):
+                    f.write(f"{i}.0,{j}.0,{j % 2}\n")
+            paths.append(p)
+        it = FileSplitParallelDataSetIterator(paths, 2, label_index=2,
+                                              num_classes=2)
+        total = sum(ds.num_examples() for ds in it)
+        assert total == 8
+
+
+class TestResultWrappers:
+    def test_binary(self):
+        from deeplearning4j_tpu.util.results import (
+            BinaryClassificationResult)
+        r = BinaryClassificationResult(np.array([[0.8, 0.2],
+                                                 [0.3, 0.7]]))
+        np.testing.assert_array_equal(r.predicted(), [0, 1])
+
+    def test_rank(self):
+        from deeplearning4j_tpu.util.results import (
+            RankClassificationResult)
+        r = RankClassificationResult(np.array([[0.1, 0.7, 0.2]]),
+                                     labels=["a", "b", "c"])
+        assert r.max_outcome(0) == "b"
+        assert r.ranked_classes(0) == ["b", "c", "a"]

@@ -16,14 +16,18 @@ from deeplearning4j_tpu.nn.conf.layers import (AutoEncoder, DenseLayer,
                                                Yolo2OutputLayer)
 
 
+def _two_cluster_binary(rng, n=256, flip_p=0.1):
+    """Two-cluster binary data (shared by VAE and RBM tests)."""
+    protos = (rng.random((2, 12)) > 0.5).astype(np.float32)
+    labels = rng.integers(0, 2, n)
+    flips = rng.random((n, 12)) < flip_p
+    x = np.abs(protos[labels] - flips.astype(np.float32))
+    return x, labels
+
+
 class TestVae:
     def _data(self, rng, n=256):
-        # two-cluster binary data the VAE must model
-        protos = (rng.random((2, 12)) > 0.5).astype(np.float32)
-        labels = rng.integers(0, 2, n)
-        flips = rng.random((n, 12)) < 0.1
-        x = np.abs(protos[labels] - flips.astype(np.float32))
-        return x, labels
+        return _two_cluster_binary(rng, n)
 
     def test_pretrain_improves_elbo(self, rng):
         x, _ = self._data(rng)
@@ -155,3 +159,43 @@ class TestYolo:
         x = rng.normal(0, 1, (2, g, g, 2))
         t = self._target(rng, b=2, g=g, a=a, c=c)
         assert check_gradients(net, DataSet(x, t))
+
+
+class TestRbm:
+    def test_cd1_pretraining_improves_reconstruction(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import RBM
+        x, _ = _two_cluster_binary(rng, flip_p=0.05)
+        rbm = RBM(n_in=12, n_out=8, k=1)
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.sgd(0.1)).list()
+                .layer(rbm)
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf).init()
+        key = jax.random.PRNGKey(0)
+        err0 = float(rbm.reconstruction_error(net.params[0], x[:64], key))
+        net.pretrain(DataSet(x), epochs=60, batch_size=64)
+        err1 = float(rbm.reconstruction_error(net.params[0], x[:64], key))
+        assert err1 < err0 * 0.7, (err0, err1)
+
+    def test_supervised_forward_and_serde(self, rng):
+        from deeplearning4j_tpu import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import RBM
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(RBM(n_out=8))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(6)).build())
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.layers[0].k == 1
+        net = MultiLayerNetwork(conf2).init()
+        out = np.asarray(net.output(
+            rng.random((3, 6)).astype(np.float32)))
+        assert out.shape == (3, 2)
+
+
+    def test_invalid_config_rejected(self):
+        from deeplearning4j_tpu.nn.conf.layers import RBM
+        with pytest.raises(ValueError, match="sigmoid"):
+            RBM(n_out=4, activation="relu")
+        with pytest.raises(ValueError, match="visible_unit"):
+            RBM(n_out=4, visible_unit="Binary")
