@@ -18,8 +18,8 @@ import numpy as np
 
 BATCH = 128
 IMG = 224
-STEPS = 20
-WARMUP = 3
+STEPS = 40
+WARMUP = 5
 
 
 def _time_steps(step_fn, args, steps, warmup, get_loss):

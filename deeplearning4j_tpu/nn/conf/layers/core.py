@@ -156,11 +156,15 @@ class RBM(FeedForwardLayer):
         if self.activation != "sigmoid":
             raise ValueError("RBM supports only sigmoid hidden "
                              "activation (free-energy objective)")
-        for name, v in (("visible_unit", self.visible_unit),
-                        ("hidden_unit", self.hidden_unit)):
-            if v not in ("binary", "gaussian"):
-                raise ValueError(f"RBM {name} must be 'binary' or "
-                                 f"'gaussian', got '{v}'")
+        if self.visible_unit not in ("binary", "gaussian"):
+            raise ValueError(f"RBM visible_unit must be 'binary' or "
+                             f"'gaussian', got '{self.visible_unit}'")
+        # the softplus marginalization below is the BINARY-hidden free
+        # energy; gaussian hiddens need a quadratic term we don't
+        # implement — reject rather than silently fit the wrong model
+        if self.hidden_unit != "binary":
+            raise ValueError(f"RBM hidden_unit supports only 'binary', "
+                             f"got '{self.hidden_unit}'")
 
     def initialize(self, key, input_type: InputType):
         self.set_n_in(input_type)

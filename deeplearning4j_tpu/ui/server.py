@@ -47,6 +47,25 @@ _PAGE = """<!DOCTYPE html>
   <svg id="tput" width="800" height="160"></svg></div>
 <div class="chart"><h2>Mean |param| per layer</h2>
   <svg id="params" width="800" height="220"></svg></div>
+<div class="chart"><h2>Parameter histograms (latest report)</h2>
+  <div id="hists"></div></div>
+<script>
+function histogram(container, name, h) {
+  const W = 240, H = 110, n = h.counts.length;
+  const max = Math.max(...h.counts, 1);
+  let bars = '';
+  for (let i = 0; i < n; i++) {
+    const bh = h.counts[i] / max * (H - 30);
+    bars += `<rect x="${6 + i * (W - 12) / n}" y="${H - 16 - bh}"
+             width="${(W - 14) / n}" height="${bh}" fill="#69b"/>`;
+  }
+  container.innerHTML +=
+    `<svg width="${W}" height="${H}" style="margin:4px">${bars}
+     <text x="6" y="12">${name}</text>
+     <text x="6" y="${H-4}">${h.min.toPrecision(3)}</text>
+     <text x="${W-60}" y="${H-4}">${h.max.toPrecision(3)}</text></svg>`;
+}
+</script>
 <script>
 function line(svg, xs, ys, color) {
   const el = document.getElementById(svg);
@@ -81,6 +100,10 @@ async function refresh() {
   names.forEach((n, i) => line('params', it,
     updates.map(u => u.param_mean_magnitudes[n] || 0),
     colors[i % colors.length]));
+  const hd = document.getElementById('hists');
+  hd.innerHTML = '';
+  const hs = updates[updates.length-1].histograms || {};
+  Object.keys(hs).slice(0, 12).forEach(n => histogram(hd, n, hs[n]));
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>

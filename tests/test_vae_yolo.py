@@ -199,3 +199,5 @@ class TestRbm:
             RBM(n_out=4, activation="relu")
         with pytest.raises(ValueError, match="visible_unit"):
             RBM(n_out=4, visible_unit="Binary")
+        with pytest.raises(ValueError, match="hidden_unit"):
+            RBM(n_out=4, hidden_unit="gaussian")
