@@ -22,7 +22,8 @@ from deeplearning4j_tpu.nn.conf.layers.base import (
 )
 
 __all__ = ["DenseLayer", "ActivationLayer", "DropoutLayer",
-           "EmbeddingLayer", "EmbeddingSequenceLayer", "AutoEncoder"]
+           "EmbeddingLayer", "EmbeddingSequenceLayer", "AutoEncoder",
+           "RBM"]
 
 
 @register_layer
@@ -189,9 +190,8 @@ class RBM(FeedForwardLayer):
 
     def _gibbs(self, params, v, rng):
         ph = jax.nn.sigmoid(v @ params["W"] + params["b"])
-        k1, k2 = jax.random.split(rng)
-        h = (jax.random.bernoulli(k1, ph).astype(v.dtype)
-             if self.hidden_unit == "binary" else ph)
+        k1, _ = jax.random.split(rng)
+        h = jax.random.bernoulli(k1, ph).astype(v.dtype)
         pv = h @ params["W"].T + params["vb"]
         if self.visible_unit == "binary":
             pv = jax.nn.sigmoid(pv)

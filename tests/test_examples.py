@@ -1,0 +1,47 @@
+"""Examples must actually run (reference keeps examples working;
+smoke-run each with small settings)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+           XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=4"))
+
+
+def _run(script, *args, timeout=900):
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        capture_output=True, text=True, env=ENV, timeout=timeout,
+        cwd=os.path.dirname(EXAMPLES))
+    assert r.returncode == 0, f"{script} failed:\n{r.stderr[-2000:]}"
+    return r.stdout
+
+
+class TestExamples:
+    def test_lenet_mnist(self):
+        out = _run("lenet_mnist.py", "--epochs", "2", "--batch", "128")
+        assert "Accuracy" in out
+        assert "checkpoint round trip OK" in out
+
+    def test_data_parallel_resnet(self):
+        out = _run("data_parallel_resnet.py", "--img", "32",
+                   "--steps", "3")
+        assert "4 devices" in out
+        assert "final loss" in out
+
+    def test_word2vec(self):
+        out = _run("word2vec_text.py")
+        assert "nearest(king):" in out
+        assert "vectors written" in out
+
+    def test_keras_import_finetune(self):
+        pytest.importorskip("keras")
+        out = _run("keras_import_finetune.py")
+        assert "max |keras - ours|" in out
+        assert "fine-tuned accuracy" in out
