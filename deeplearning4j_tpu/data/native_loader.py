@@ -62,6 +62,8 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.dl4j_loader_num_lines.restype = ctypes.c_int64
     lib.dl4j_loader_num_lines.argtypes = [ctypes.c_void_p]
+    lib.dl4j_loader_skipped_rows.restype = ctypes.c_int64
+    lib.dl4j_loader_skipped_rows.argtypes = [ctypes.c_void_p]
     lib.dl4j_loader_next.restype = ctypes.c_int
     lib.dl4j_loader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
@@ -111,6 +113,7 @@ class NativeCSVDataSetIterator(DataSetIterator):
         self.queue_capacity = queue_capacity
         self._handle = None
         self._n_lines = None
+        self.skipped_rows = 0
 
     def _open(self):
         h = self._lib.dl4j_csv_loader_create(
@@ -127,6 +130,15 @@ class NativeCSVDataSetIterator(DataSetIterator):
 
     def _close(self):
         if self._handle:
+            skipped = int(self._lib.dl4j_loader_skipped_rows(
+                self._handle))
+            if skipped and skipped != self.skipped_rows:
+                logger.warning(
+                    "native CSV loader skipped %d unparseable row(s) of "
+                    "%s (bad numeric fields, wrong column count for "
+                    "n_features=%d, or out-of-range labels)", skipped,
+                    self.path, self.n_features)
+            self.skipped_rows = skipped
             self._lib.dl4j_loader_destroy(self._handle)
             self._handle = None
 

@@ -49,7 +49,8 @@ class ComputationGraph:
         self._rng_key = None
         self._optimizer = None
         self._jit_train_step = None
-        self._jit_output = None
+        self._jit_tbptt_step = None
+        self._jit_output = {}
         self._rnn_state: Optional[Dict[str, object]] = None
 
     # ------------------------------------------------------------------
@@ -99,24 +100,40 @@ class ComputationGraph:
             self._optimizer = optax.chain(pre, self._optimizer)
         self.opt_state = self._optimizer.init(self.params)
         self._jit_train_step = None
+        self._jit_tbptt_step = None
+        self._jit_output = {}
 
     # ------------------------------------------------------------------
     def _forward(self, params, state, inputs: Sequence, *, training, rng,
-                 fmasks=None, exclude_outputs: bool = False):
+                 fmasks=None, exclude_outputs: bool = False, carries=None,
+                 only=None):
         """Topo-order interpreter (reference ComputationGraph.java
-        :793-817). Returns (activations dict, new state dict)."""
+        :793-817). Masks are routed per vertex via
+        ``GraphVertex.propagate_mask`` (reference feedForwardMaskArrays
+        per vertex impl), NOT first-non-None-input. ``carries``: dict
+        vertex-name -> recurrent (h, c) initial state, used by tBPTT to
+        carry hidden state across chunks (reference
+        rnnActivateUsingStoredState :2219). Returns (activations dict,
+        new state dict, new carries dict)."""
+        from deeplearning4j_tpu.nn.conf.graph import (
+            LastTimeStepVertex, combine_masks_or)
         acts: Dict[str, jnp.ndarray] = dict(
             zip(self.conf.network_inputs, inputs))
-        masks: Dict[str, Optional[jnp.ndarray]] = {}
+        masks: Dict[str, Optional[jnp.ndarray]] = {
+            n: None for n in self.conf.network_inputs}
         if fmasks is not None:
             masks.update(zip(self.conf.network_inputs, fmasks))
         new_state = {}
+        new_carries = {} if carries is not None else None
         for vidx, name in enumerate(self.conf.topological_order()):
+            if only is not None and name not in only:
+                continue        # pretrain: only the ancestor subgraph
             obj, ins = self.conf.vertices[name]
             xs = [acts[i] for i in ins]
-            in_mask = next((masks.get(i) for i in ins
-                            if masks.get(i) is not None), None)
+            in_masks = [masks.get(i) for i in ins]
             if isinstance(obj, Layer):
+                # a layer vertex consumes its (single) wired input's mask
+                in_mask = in_masks[0]
                 if exclude_outputs and name in self.conf.network_outputs \
                         and obj.has_loss():
                     # leave the loss layer's input available instead
@@ -128,20 +145,50 @@ class ComputationGraph:
                 # (python hash is per-process randomized)
                 lrng = (jax.random.fold_in(rng, vidx)
                         if rng is not None else None)
-                y, s = obj.apply(params[name], state[name], xs[0],
-                                 training=training, rng=lrng, mask=in_mask)
+                from deeplearning4j_tpu.nn.errors import (
+                    layer_error_context)
+                with layer_error_context(f"vertex '{name}'", obj, xs[0]):
+                    if carries is not None and \
+                            isinstance(obj, BaseRecurrentLayer):
+                        c0 = carries.get(name)
+                        if c0 is None:
+                            c0 = obj.zero_state(xs[0].shape[0])
+                        xd = obj.apply_input_dropout(xs[0],
+                                                     training=training,
+                                                     rng=lrng)
+                        y, c1 = obj.apply_rnn(params[name], xd, c0,
+                                              training=training, rng=lrng,
+                                              mask=in_mask)
+                        new_carries[name] = c1
+                        s = state[name]
+                    else:
+                        y, s = obj.apply(params[name], state[name], xs[0],
+                                         training=training, rng=lrng,
+                                         mask=in_mask)
                 new_state[name] = s
                 acts[name] = y
+                masks[name] = in_mask
             else:
-                acts[name] = obj.apply(xs, mask=in_mask)
-            masks[name] = in_mask
-        return acts, new_state
+                from deeplearning4j_tpu.nn.errors import (
+                    layer_error_context)
+                if isinstance(obj, LastTimeStepVertex) and \
+                        obj.mask_input is not None:
+                    use_mask = masks.get(obj.mask_input)
+                else:
+                    use_mask = combine_masks_or(in_masks)
+                with layer_error_context(f"vertex '{name}'", obj,
+                                         xs[0] if xs else None):
+                    acts[name] = obj.apply(xs, mask=use_mask)
+                masks[name] = obj.propagate_mask(in_masks, xs,
+                                                 mask_env=masks)
+        return acts, new_state, new_carries
 
-    def _loss(self, params, state, batch, rng, *, training=True):
+    def _loss(self, params, state, batch, rng, *, training=True,
+              carries=None):
         inputs, labels, fmasks, lmasks = batch
-        acts, new_state = self._forward(params, state, inputs,
-                                        training=training, rng=rng,
-                                        fmasks=fmasks, exclude_outputs=True)
+        acts, new_state, new_carries = self._forward(
+            params, state, inputs, training=training, rng=rng,
+            fmasks=fmasks, exclude_outputs=True, carries=carries)
         from deeplearning4j_tpu.nn.conf.layers.output import (
             CenterLossOutputLayer)
         total = jnp.zeros(())
@@ -165,6 +212,8 @@ class ComputationGraph:
         for name, (obj, _) in self.conf.vertices.items():
             if isinstance(obj, Layer):
                 total = total + obj.regularization_loss(params[name])
+        if carries is not None:
+            return total, (new_state, new_carries)
         return total, new_state
 
     def _make_train_step(self):
@@ -193,6 +242,41 @@ class ComputationGraph:
             return constrained, new_state, new_opt, loss
 
         return train_step
+
+    def _make_tbptt_step(self):
+        """Graph tBPTT step (reference ComputationGraph.doTruncatedBPTT
+        :2532, dispatched from fit :928/:1031): recurrent vertex state
+        carries across chunks, gradients are truncated at the chunk
+        boundary via stop_gradient."""
+        optimizer = self._optimizer
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def tbptt_step(params, state, opt_state, batch, carries, base_rng,
+                       step):
+            rng = jax.random.fold_in(base_rng, step)
+            carries = jax.lax.stop_gradient(carries)
+
+            def loss_fn(p):
+                return self._loss(p, state, batch, rng, training=True,
+                                  carries=carries)
+
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            from deeplearning4j_tpu.train.gradnorm import (
+                apply_gradient_normalization)
+            layer_cfgs = {n: v[0] for n, v in self.conf.vertices.items()
+                          if n in params}
+            grads = apply_gradient_normalization(layer_cfgs, grads)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            constrained = {}
+            for name, p in new_params.items():
+                obj, _ = self.conf.vertices[name]
+                constrained[name] = apply_layer_constraints(obj, p)
+            return (constrained, new_state, new_opt, loss,
+                    jax.lax.stop_gradient(new_carries))
+
+        return tbptt_step
 
     # ------------------------------------------------------------------
     def _as_multi(self, ds) -> MultiDataSet:
@@ -230,11 +314,16 @@ class ComputationGraph:
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
         step_fn = self._jit_train_step
+        tbptt = self.conf.conf.tbptt
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self)
             for ds in data:
                 mds = self._as_multi(ds)
+                if tbptt is not None and any(
+                        np.ndim(f) == 3 for f in mds.features):
+                    self._fit_tbptt(mds, tbptt)
+                    continue
                 batch = self._batch_tuple(mds)
                 self.params, self.state, self.opt_state, loss = step_fn(
                     self.params, self.state, self.opt_state, batch,
@@ -249,26 +338,84 @@ class ComputationGraph:
             self.epoch_count += 1
         return self
 
+    def _fit_tbptt(self, mds: MultiDataSet, tbptt):
+        """Truncated BPTT over a MultiDataSet (reference
+        ComputationGraph.doTruncatedBPTT :2532): every time-series
+        array (features, labels, masks) is split into fwd_length
+        chunks; recurrent vertex hidden state carries across chunks
+        with the gradient stopped at the boundary."""
+        fwd = tbptt["fwd_length"]
+        ts = [f for f in mds.features if np.ndim(f) == 3]
+        T = ts[0].shape[1]
+        B = ts[0].shape[0]
+        if self._jit_tbptt_step is None:
+            self._jit_tbptt_step = self._make_tbptt_step()
+        step_fn = self._jit_tbptt_step
+        carries = {name: obj.zero_state(B)
+                   for name, (obj, _) in self.conf.vertices.items()
+                   if isinstance(obj, BaseRecurrentLayer)}
+
+        for start in range(0, T, fwd):
+            end = min(start + fwd, T)
+            feats = tuple(f[:, start:end] if np.ndim(f) == 3 else f
+                          for f in mds.features)
+            labels = tuple(l[:, start:end] if np.ndim(l) == 3 else l
+                           for l in mds.labels)
+            fm = (tuple(None if m is None
+                        else (m[:, start:end]
+                              if np.ndim(m) == 2 and m.shape[1] == T
+                              else m)
+                        for m in mds.features_masks)
+                  if mds.features_masks is not None else None)
+            lm = (tuple(None if m is None
+                        else (m[:, start:end]
+                              if np.ndim(m) == 2 and m.shape[1] == T
+                              else m)
+                        for m in mds.labels_masks)
+                  if mds.labels_masks is not None else None)
+            sub = MultiDataSet(list(feats), list(labels),
+                               None if fm is None else list(fm),
+                               None if lm is None else list(lm))
+            batch = self._batch_tuple(sub)
+            (self.params, self.state, self.opt_state, loss,
+             carries) = step_fn(self.params, self.state, self.opt_state,
+                                batch, carries, self._rng_key,
+                                np.int32(self.iteration_count))
+            self.score_value = loss
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count, loss,
+                                   sub.num_examples())
+            self.iteration_count += 1
+
     # ------------------------------------------------------------------
-    def output(self, *inputs, training: bool = False):
+    def output(self, *inputs, training: bool = False, input_masks=None):
         if self.params is None:
             self.init()
         xs = tuple(jnp.asarray(x) for x in inputs)
-        if self._jit_output is None:
+        fmasks = (tuple(None if m is None else jnp.asarray(m)
+                        for m in input_masks)
+                  if input_masks is not None else None)
+        key = (training, fmasks is not None)
+        if key not in self._jit_output:
             @jax.jit
-            def fwd(params, state, xs):
-                acts, _ = self._forward(params, state, xs, training=False,
-                                        rng=None)
+            def fwd(params, state, xs, rng, fmasks):
+                acts, _, _ = self._forward(params, state, xs,
+                                           training=training, rng=rng,
+                                           fmasks=fmasks)
                 return tuple(acts[o] for o in self.conf.network_outputs)
-            self._jit_output = fwd
-        outs = self._jit_output(self.params, self.state, xs)
+            self._jit_output[key] = fwd
+        rng = self._rng_key if training else None
+        outs = self._jit_output[key](self.params, self.state, xs, rng,
+                                     fmasks)
         return outs if len(outs) > 1 else outs[0]
 
-    def feed_forward(self, *inputs, training: bool = False):
+    def feed_forward(self, *inputs, training: bool = False,
+                     input_masks=None):
         xs = tuple(jnp.asarray(x) for x in inputs)
-        acts, _ = self._forward(self.params, self.state, xs,
-                                training=training,
-                                rng=self._rng_key if training else None)
+        acts, _, _ = self._forward(self.params, self.state, xs,
+                                   training=training,
+                                   rng=self._rng_key if training else None,
+                                   fmasks=input_masks)
         return acts
 
     def score(self, ds) -> float:
@@ -277,34 +424,65 @@ class ComputationGraph:
                              self._batch_tuple(mds), None, training=False)
         return float(loss)
 
-    def _eval_with(self, data, ev):
+    def _eval_with(self, data, ev, output_index: int = 0):
         if isinstance(data, (DataSet, MultiDataSet)):
             data = [data]
         for ds in data:
             mds = self._as_multi(ds)
-            preds = self.output(*mds.features)
-            if isinstance(preds, tuple):
-                preds = preds[0]
-            lmask = (mds.labels_masks[0]
+            preds = self.output(*mds.features,
+                                input_masks=mds.features_masks)
+            if not isinstance(preds, tuple):
+                preds = (preds,)
+            lmask = (mds.labels_masks[output_index]
                      if mds.labels_masks is not None else None)
             try:
-                ev.eval(mds.labels[0], np.asarray(preds), mask=lmask)
+                ev.eval(mds.labels[output_index],
+                        np.asarray(preds[output_index]), mask=lmask)
             except TypeError:     # evaluators without mask support (ROC)
-                ev.eval(mds.labels[0], np.asarray(preds))
+                ev.eval(mds.labels[output_index],
+                        np.asarray(preds[output_index]))
         return ev
 
-    def evaluate(self, data):
+    def evaluate(self, data, output_index: int = 0):
         from deeplearning4j_tpu.evaluation.classification import Evaluation
-        return self._eval_with(data, Evaluation())
+        return self._eval_with(data, Evaluation(), output_index)
 
-    def evaluate_regression(self, data):
+    def evaluate_outputs(self, data, eval_factory=None):
+        """Evaluate EVERY output head in a single pass over the data
+        (fixes the reference-parity gap where only output[0] was
+        scored). Returns ``{output_name: Evaluation}``."""
+        if eval_factory is None:
+            from deeplearning4j_tpu.evaluation.classification import (
+                Evaluation)
+            eval_factory = Evaluation
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = [data]
+        evs = [eval_factory() for _ in self.conf.network_outputs]
+        for ds in data:
+            mds = self._as_multi(ds)
+            preds = self.output(*mds.features,
+                                input_masks=mds.features_masks)
+            if not isinstance(preds, tuple):
+                preds = (preds,)
+            for i, ev in enumerate(evs):
+                lmask = (mds.labels_masks[i]
+                         if mds.labels_masks is not None else None)
+                try:
+                    ev.eval(mds.labels[i], np.asarray(preds[i]),
+                            mask=lmask)
+                except TypeError:
+                    ev.eval(mds.labels[i], np.asarray(preds[i]))
+        return dict(zip(self.conf.network_outputs, evs))
+
+    def evaluate_regression(self, data, output_index: int = 0):
         from deeplearning4j_tpu.evaluation.regression import (
             RegressionEvaluation)
-        return self._eval_with(data, RegressionEvaluation())
+        return self._eval_with(data, RegressionEvaluation(), output_index)
 
-    def evaluate_roc(self, data, threshold_steps: int = 0):
+    def evaluate_roc(self, data, threshold_steps: int = 0,
+                     output_index: int = 0):
         from deeplearning4j_tpu.evaluation.roc import ROC
-        return self._eval_with(data, ROC(threshold_steps))
+        return self._eval_with(data, ROC(threshold_steps), output_index)
 
     # ------------------------------------------------------------------
     def rnn_time_step(self, *inputs):
@@ -342,9 +520,107 @@ class ComputationGraph:
         self._rnn_state = None
 
     # ------------------------------------------------------------------
+    # layerwise pretraining (reference ComputationGraph.pretrain
+    # :652,664: each pretrainable layer vertex is trained on its own
+    # input activations, fed through the already-pretrained stack)
+    # ------------------------------------------------------------------
+    def pretrain(self, data, *, epochs: int = 1):
+        if self.params is None:
+            self.init()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = [data]
+        elif not isinstance(data, (list, tuple)):
+            data = list(data)
+        for name in self.conf.topological_order():
+            obj, _ = self.conf.vertices[name]
+            if isinstance(obj, Layer) and hasattr(obj, "pretrain_loss"):
+                self._pretrain_vertex(name, data, epochs)
+        return self
+
+    def _pretrain_vertex(self, name: str, data, epochs: int):
+        obj, ins = self.conf.vertices[name]
+        opt = updaters_mod.to_optax(
+            getattr(obj, "updater", None) or self.conf.conf.updater_cfg
+            or updaters_mod.sgd())
+        opt_state = opt.init(self.params[name])
+
+        @jax.jit
+        def pre_step(lp, opt_state, x, rng):
+            def loss_fn(p):
+                return obj.pretrain_loss(p, x, rng)
+
+            loss, grads = jax.value_and_grad(loss_fn)(lp)
+            updates, opt_state2 = opt.update(grads, opt_state, lp)
+            return optax.apply_updates(lp, updates), opt_state2, loss
+
+        # only the ancestor subgraph of the vertex's input is needed —
+        # running the full DAG per batch would multiply pretraining
+        # cost by the network depth
+        needed = set()
+        stack = [ins[0]]
+        while stack:
+            cur = stack.pop()
+            if cur in needed or cur not in self.conf.vertices:
+                continue
+            needed.add(cur)
+            stack.extend(self.conf.vertices[cur][1])
+
+        @jax.jit
+        def vertex_input(params, state, inputs, fmasks):
+            acts, _, _ = self._forward(params, state, inputs,
+                                       training=False, rng=None,
+                                       fmasks=fmasks, only=needed)
+            return acts[ins[0]]
+
+        step = 0
+        loss = float("nan")
+        for _ in range(epochs):
+            for ds in data:
+                mds = self._as_multi(ds)
+                inputs = tuple(jnp.asarray(f) for f in mds.features)
+                fmasks = (tuple(None if m is None else jnp.asarray(m)
+                                for m in mds.features_masks)
+                          if mds.features_masks is not None else None)
+                x = vertex_input(self.params, self.state, inputs, fmasks)
+                rng = jax.random.fold_in(self._rng_key, step)
+                self.params[name], opt_state, loss = pre_step(
+                    self.params[name], opt_state, x, rng)
+                step += 1
+        logger.info("pretrained vertex '%s' (%s), final loss %.5f", name,
+                    type(obj).__name__, float(loss))
+
+    # ------------------------------------------------------------------
+    # params plumbing (parity with MultiLayerNetwork; reference keeps a
+    # flat params view per graph, ComputationGraph.params())
+    # ------------------------------------------------------------------
     def num_params(self) -> int:
         return sum(int(p.size)
                    for p in jax.tree_util.tree_leaves(self.params))
+
+    def params_flat(self) -> np.ndarray:
+        leaves = jax.tree_util.tree_leaves(self.params)
+        return np.concatenate([np.asarray(l).ravel() for l in leaves]) \
+            if leaves else np.zeros((0,))
+
+    def set_params_flat(self, flat: np.ndarray):
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        out = []
+        off = 0
+        for l in leaves:
+            n = int(l.size)
+            out.append(jnp.asarray(flat[off:off + n],
+                                   l.dtype).reshape(l.shape))
+            off += n
+        self.params = jax.tree_util.tree_unflatten(treedef, out)
+
+    def clone(self) -> "ComputationGraph":
+        g = ComputationGraph(self.conf.clone())
+        if self.params is not None:
+            g.init()
+            from deeplearning4j_tpu.util.tree import tree_copy
+            g.params = tree_copy(self.params)
+            g.state = tree_copy(self.state)
+        return g
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)

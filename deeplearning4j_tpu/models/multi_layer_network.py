@@ -152,23 +152,31 @@ class MultiLayerNetwork:
             if i >= n:
                 new_states.append(state[i])
                 continue
+            from deeplearning4j_tpu.nn.errors import layer_error_context
             if i in self.conf.preprocessors:
-                x = self.conf.preprocessors[i](x)
+                with layer_error_context(f"preprocessor before layer {i}",
+                                         self.conf.preprocessors[i], x):
+                    x = self.conf.preprocessors[i](x)
             lrng = None
             if rng is not None:
                 lrng = jax.random.fold_in(rng, i)
-            if carries is not None and isinstance(layer, BaseRecurrentLayer):
-                c0 = carries[i]
-                if c0 is None:
-                    c0 = layer.zero_state(x.shape[0])
-                xd = layer.apply_input_dropout(x, training=training, rng=lrng)
-                x, c1 = layer.apply_rnn(params[i], xd, c0, training=training,
-                                        rng=lrng, mask=fmask)
-                new_carries[i] = c1
-                s = state[i]
-            else:
-                x, s = layer.apply(params[i], state[i], x, training=training,
-                                   rng=lrng, mask=fmask)
+            with layer_error_context(f"layer {i}", layer, x):
+                if carries is not None and isinstance(layer,
+                                                     BaseRecurrentLayer):
+                    c0 = carries[i]
+                    if c0 is None:
+                        c0 = layer.zero_state(x.shape[0])
+                    xd = layer.apply_input_dropout(x, training=training,
+                                                   rng=lrng)
+                    x, c1 = layer.apply_rnn(params[i], xd, c0,
+                                            training=training,
+                                            rng=lrng, mask=fmask)
+                    new_carries[i] = c1
+                    s = state[i]
+                else:
+                    x, s = layer.apply(params[i], state[i], x,
+                                       training=training,
+                                       rng=lrng, mask=fmask)
             new_states.append(s)
             if collect:
                 acts.append(x)

@@ -33,6 +33,19 @@ def register_vertex(cls):
     return cls
 
 
+def combine_masks_or(masks):
+    """Reference mask-combination rule (MergeVertex.java:229-252,
+    ElementWiseVertex.java:146-160): if ANY input mask is absent the
+    output mask is null (missing = "all steps present"); otherwise
+    element-wise OR."""
+    if not masks or any(m is None for m in masks):
+        return None
+    out = masks[0]
+    for m in masks[1:]:
+        out = jnp.maximum(out, m)
+    return out
+
+
 def vertex_from_dict(d: dict):
     d = dict(d)
     t = d.pop("@type")
@@ -44,6 +57,14 @@ def vertex_from_dict(d: dict):
 class GraphVertex:
     def apply(self, inputs, *, mask=None):
         raise NotImplementedError
+
+    def propagate_mask(self, in_masks, inputs, mask_env=None):
+        """Per-vertex mask routing (reference
+        GraphVertex.feedForwardMaskArrays). ``in_masks`` aligns with
+        ``inputs``; ``mask_env`` maps every already-computed vertex /
+        network-input name to its mask (needed by vertices that
+        reference a named input, e.g. DuplicateToTimeSeriesVertex)."""
+        return combine_masks_or(in_masks)
 
     def output_type(self, *input_types: InputType) -> InputType:
         return input_types[0]
@@ -149,6 +170,25 @@ class StackVertex(GraphVertex):
     def apply(self, inputs, *, mask=None):
         return jnp.concatenate(inputs, axis=0)
 
+    def propagate_mask(self, in_masks, inputs, mask_env=None):
+        # reference StackVertex.java:165-194: vstack the masks; a
+        # missing mask becomes all-ones with the present masks' width —
+        # (B, T) for time series, (B, 1) for feed-forward inputs
+        if all(m is None for m in in_masks):
+            return None
+        width = next(m.shape[1] if m.ndim > 1 else 1
+                     for m in in_masks if m is not None)
+        mats = []
+        for m, x in zip(in_masks, inputs):
+            if m is not None:
+                mats.append(m)
+            elif x.ndim == 3:
+                mats.append(jnp.ones(x.shape[:2], dtype=jnp.float32))
+            else:
+                mats.append(jnp.ones((x.shape[0], width),
+                                     dtype=jnp.float32))
+        return jnp.concatenate(mats, axis=0)
+
 
 @register_vertex
 @dataclasses.dataclass
@@ -163,6 +203,13 @@ class UnstackVertex(GraphVertex):
         x = inputs[0]
         step = x.shape[0] // self.stack_size
         return x[self.from_ * step:(self.from_ + 1) * step]
+
+    def propagate_mask(self, in_masks, inputs, mask_env=None):
+        m = in_masks[0]
+        if m is None:
+            return None
+        step = m.shape[0] // self.stack_size
+        return m[self.from_ * step:(self.from_ + 1) * step]
 
 
 @register_vertex
@@ -281,6 +328,11 @@ class LastTimeStepVertex(GraphVertex):
         idx = jnp.maximum(lengths - 1, 0)
         return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
 
+    def propagate_mask(self, in_masks, inputs, mask_env=None):
+        # after extracting the last step the mask is consumed
+        # (reference rnn/LastTimeStepVertex.java:144-149)
+        return None
+
     def output_type(self, *ts: InputType) -> InputType:
         return InputType.feed_forward(ts[0].size)
 
@@ -298,6 +350,13 @@ class DuplicateToTimeSeriesVertex(GraphVertex):
         x, ref = inputs[0], inputs[1]
         return jnp.broadcast_to(x[:, None, :],
                                 (x.shape[0], ref.shape[1], x.shape[1]))
+
+    def propagate_mask(self, in_masks, inputs, mask_env=None):
+        # present as per the corresponding time-series input's mask
+        # (reference rnn/DuplicateToTimeSeriesVertex.java:104-113)
+        if self.ts_input is not None and mask_env is not None:
+            return mask_env.get(self.ts_input)
+        return None
 
     def output_type(self, *ts: InputType) -> InputType:
         return InputType.recurrent(ts[0].flat_size(),

@@ -1,0 +1,41 @@
+"""Layer-context error wrapping for executor forward/fit.
+
+The reference names the failing layer in config- and runtime-errors
+(e.g. shape checks in InputTypeUtil and per-layer validation in
+MultiLayerNetwork.init). In JAX, a wrong input shape surfaces at trace
+time as a long framework traceback with no hint of WHICH layer the
+mismatch hit — these helpers annotate the failure with the layer
+index/name, its class, and the offending input shape before the XLA
+detail."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = ["NetworkExecutionError", "layer_error_context"]
+
+
+class NetworkExecutionError(ValueError):
+    """A forward/fit failure annotated with the failing layer."""
+
+
+@contextmanager
+def layer_error_context(where: str, layer, x=None):
+    """Re-raise any trace-time failure inside a layer apply with the
+    layer named. ``where``: e.g. "layer 3" or "vertex 'merge'"."""
+    try:
+        yield
+    except NetworkExecutionError:
+        raise                      # already annotated (nested graphs)
+    except Exception as e:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        desc = type(layer).__name__
+        name = getattr(layer, "name", None)
+        if name:
+            desc += f" '{name}'"
+        got = (f" with input shape {tuple(shape)} ({dtype})"
+               if shape is not None else "")
+        raise NetworkExecutionError(
+            f"Error executing {where} ({desc}){got}: "
+            f"{type(e).__name__}: {e}") from e

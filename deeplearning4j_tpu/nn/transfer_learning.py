@@ -6,15 +6,19 @@ FrozenLayer), replace a layer's n_out with re-initialized weights
 (``n_out_replace``, :98), remove/add output layers, and apply a
 ``FineTuneConfiguration`` (new global updater/lr for the unfrozen part).
 
-Works on MultiLayerNetwork; graph surgery (TransferLearning.GraphBuilder)
-operates on ComputationGraph by vertex name.
+``TransferLearning`` operates on MultiLayerNetwork;
+``TransferLearningGraph`` is the vertex-name surgery builder for
+ComputationGraph (reference TransferLearning.GraphBuilder :449:
+setFeatureExtractor :501 freezes the named vertices and every vertex
+on a path from an input to them, nOutReplace :520, removeVertex
+:631/:642, addLayer/addVertex :655/:685, setOutputs :698).
 """
 
 from __future__ import annotations
 
 import copy
 import logging
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +30,8 @@ from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["TransferLearning", "FineTuneConfiguration"]
+__all__ = ["TransferLearning", "TransferLearningGraph",
+           "FineTuneConfiguration"]
 
 
 class FineTuneConfiguration:
@@ -165,3 +170,211 @@ class TransferLearning:
 
         net._build_optimizer()
         return net
+
+
+class TransferLearningGraph:
+    """Vertex-name surgery on a trained ComputationGraph (reference
+    TransferLearning.GraphBuilder, TransferLearning.java:449)."""
+
+    def __init__(self, cg):
+        if cg.params is None:
+            raise ValueError("Transfer learning requires an initialized "
+                             "graph")
+        self._src = cg
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._frozen_at: List[str] = []
+        self._nout_replacements: Dict[str, Tuple[int, str]] = {}
+        self._removed: List[Tuple[str, bool]] = []   # (name, keep_conns)
+        self._added: List[Tuple[str, object, List[str]]] = []
+        self._new_outputs: Optional[List[str]] = None
+
+    @staticmethod
+    def builder(cg) -> "TransferLearningGraph":
+        return TransferLearningGraph(cg)
+
+    def fine_tune_configuration(self, cfg: FineTuneConfiguration):
+        self._fine_tune = cfg
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str):
+        """Freeze the named vertices and every vertex on a path from an
+        input to them (reference :501)."""
+        self._frozen_at.extend(vertex_names)
+        return self
+
+    def n_out_replace(self, layer_name: str, n_out: int,
+                      weight_init: str = "xavier"):
+        """Change a layer vertex's n_out; the vertex AND its direct
+        consumers are re-initialized (reference :520 — 'this will also
+        affect the vertex layer that follows')."""
+        self._nout_replacements[layer_name] = (n_out, weight_init)
+        return self
+
+    def remove_vertex_keep_connections(self, name: str):
+        """Remove the vertex definition; downstream wiring referencing
+        ``name`` is kept, expecting a new vertex added under the same
+        name (reference removeVertexKeepConnections :631)."""
+        self._removed.append((name, True))
+        return self
+
+    def remove_vertex_and_connections(self, name: str):
+        """Remove the vertex and prune it from every consumer's input
+        list (reference removeVertexAndConnections :642)."""
+        self._removed.append((name, False))
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str):
+        self._added.append((name, layer, list(inputs)))
+        return self
+
+    def add_vertex(self, name: str, vertex, *inputs: str):
+        self._added.append((name, vertex, list(inputs)))
+        return self
+
+    def set_outputs(self, *names: str):
+        self._new_outputs = list(names)
+        return self
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _propagate_width_change(vertices, seed: str, affected: set):
+        """Mark every vertex whose input width changes when ``seed``'s
+        output width changes: direct consumers, and (transitively)
+        consumers of parameter-less vertices, which pass width through."""
+        frontier = [seed]
+        seen = {seed}
+        while frontier:
+            cur = frontier.pop()
+            for vname, (obj, ins) in vertices.items():
+                if cur in ins and vname not in seen:
+                    seen.add(vname)
+                    affected.add(vname)
+                    if not isinstance(obj, Layer):
+                        frontier.append(vname)
+
+    def _ancestors_inclusive(self, vertices, targets):
+        """The named vertices plus everything upstream of them."""
+        out = set()
+        stack = [t for t in targets]
+        while stack:
+            n = stack.pop()
+            if n in out or n not in vertices:
+                continue
+            out.add(n)
+            stack.extend(vertices[n][1])
+        return out
+
+    def build(self):
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.util.tree import tree_copy
+
+        src = self._src
+        conf = src.conf.clone()
+        vertices = conf.vertices           # name -> (obj, ins)
+        outputs = list(conf.network_outputs)
+
+        # 1. removals; consumers of a pruned vertex see a width change
+        rewired = set()
+        removed_output_pos = {}
+        for name, keep in self._removed:
+            if name not in vertices:
+                raise ValueError(f"Cannot remove unknown vertex '{name}'")
+            del vertices[name]
+            if not keep:
+                for vname, (obj, ins) in list(vertices.items()):
+                    if name in ins:
+                        vertices[vname] = (obj,
+                                           [i for i in ins if i != name])
+                        rewired.add(vname)
+            if name in outputs:
+                removed_output_pos[name] = outputs.index(name)
+                outputs = [o for o in outputs if o != name]
+
+        # 2. additions (stamp global defaults like GraphBuilder.add_layer);
+        #    re-adding a vertex under a removed output's name restores
+        #    its output slot (the remove-head/add-head fine-tune flow)
+        added_names = set()
+        for name, obj, ins in self._added:
+            if isinstance(obj, Layer):
+                obj = conf.conf.stamp_defaults(obj)
+                obj.name = name
+            vertices[name] = (obj, list(ins))
+            added_names.add(name)
+            if name in removed_output_pos and name not in outputs:
+                outputs.insert(min(removed_output_pos[name],
+                                   len(outputs)), name)
+
+        # 3. outputs
+        if self._new_outputs is not None:
+            outputs = list(self._new_outputs)
+
+        # 4. fine-tune overrides
+        if self._fine_tune is not None:
+            if self._fine_tune.updater is not None:
+                conf.conf.updater_cfg = self._fine_tune.updater
+            if self._fine_tune.seed is not None:
+                conf.conf.seed = self._fine_tune.seed
+
+        # 5. n_out replacement: mutate the named layers; mark them and
+        #    their direct consumers for re-init. Rewired vertices
+        #    (pruned inputs) are width-change sources too.
+        affected = set(added_names)
+        for vname in rewired:
+            obj2, _ = vertices[vname]
+            affected.add(vname)
+            if not isinstance(obj2, Layer):
+                # parameter-less vertex: width change propagates to
+                # its consumers
+                self._propagate_width_change(vertices, vname, affected)
+        for lname, (n_out, w_init) in self._nout_replacements.items():
+            if lname not in vertices:
+                raise ValueError(f"n_out_replace: unknown vertex "
+                                 f"'{lname}'")
+            obj, ins = vertices[lname]
+            target = obj.wrapped if isinstance(obj, FrozenLayer) else obj
+            if not isinstance(target, Layer):
+                raise ValueError(f"n_out_replace: '{lname}' is not a "
+                                 f"layer vertex")
+            target.n_out = n_out
+            target.weight_init = w_init
+            affected.add(lname)
+            # direct consumers change input width; a parameter-less
+            # vertex (Merge/ElementWise/...) passes the width change on
+            # to ITS consumers
+            self._propagate_width_change(vertices, lname, affected)
+
+        # 6. reset shape inference for affected vertices so the new
+        #    widths propagate (set_n_in only fills n_in when unset)
+        for vname in affected:
+            obj, _ = vertices.get(vname, (None, None))
+            if obj is None:
+                continue
+            target = obj.wrapped if isinstance(obj, FrozenLayer) else obj
+            if hasattr(target, "n_in"):
+                target.n_in = None
+
+        # 7. freeze: named vertices + all their ancestors
+        frozen = self._ancestors_inclusive(vertices, self._frozen_at)
+        for vname in frozen:
+            obj, ins = vertices[vname]
+            if isinstance(obj, Layer) and not isinstance(obj, FrozenLayer):
+                vertices[vname] = (FrozenLayer(inner=obj), ins)
+
+        new_conf = ComputationGraphConfiguration(
+            conf.conf, conf.network_inputs, vertices, outputs,
+            conf.input_types)
+        cg = ComputationGraph(new_conf)
+        cg.init(new_conf.conf.seed)
+
+        # 8. transplant surviving params (everything except affected)
+        for vname in cg.params:
+            if vname in affected:
+                continue
+            if src.params is not None and vname in src.params:
+                cg.params[vname] = tree_copy(src.params[vname])
+                cg.state[vname] = tree_copy(src.state[vname])
+        cg._build_optimizer()
+        return cg

@@ -110,9 +110,10 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
     num = jnp.zeros((B, H, Tl, D), q.dtype)
     den = jnp.zeros((B, H, Tl), q.dtype)
     # mark accumulators as device-varying over the ring axis so the
-    # fori_loop carry types line up (jax>=0.9 VMA typing)
+    # fori_loop carry types line up (jax>=0.9 VMA typing; pcast is the
+    # non-deprecated spelling of pvary)
     m, num, den = jax.tree_util.tree_map(
-        lambda a: lax.pvary(a, (axis_name,)), (m, num, den))
+        lambda a: lax.pcast(a, axis_name, to="varying"), (m, num, den))
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_global = idx * Tl + jnp.arange(Tl)
 

@@ -44,10 +44,22 @@ __all__ = ["ParallelWrapper", "GraphParallelWrapper"]
 
 class ParallelWrapper:
     def __init__(self, model, mesh: Optional[Mesh] = None,
-                 prefetch_buffer: int = 2):
+                 prefetch_buffer: int = 2,
+                 dcn_compression: Optional[dict] = None):
+        """``dcn_compression``: None for full-precision ICI psum (the
+        default; right on a single slice), or
+        ``{"threshold": t}`` to train with the int8 + threshold +
+        residual-error-feedback gradient reduce — the DCN-spanning
+        equivalent of the reference's SharedTrainingMaster /
+        EncodingHandler threshold encoding
+        (dl4j-spark-parameterserver/.../SharedTrainingMaster.java:55,
+        deeplearning4j-nn/.../EncodingHandler.java:116-181)."""
         self.model = model
         self.mesh = mesh if mesh is not None else build_mesh(MeshSpec())
         self.prefetch = prefetch_buffer
+        self.dcn_compression = dcn_compression
+        self._compressed_step = None
+        self._residual = None
 
     # ---- builder parity ----
     class Builder:
@@ -55,6 +67,7 @@ class ParallelWrapper:
             self._model = model
             self._workers = None
             self._prefetch = 2
+            self._compression = None
 
         def workers(self, n: int):
             self._workers = n
@@ -65,7 +78,19 @@ class ParallelWrapper:
             return self
 
         def averaging_frequency(self, n: int):
-            # sync-every-step makes this a no-op; kept for API parity
+            if n not in (0, 1):
+                logger.warning(
+                    "averaging_frequency(%d) requested, but the mesh "
+                    "trainer synchronizes gradients EVERY step (psum "
+                    "over ICI) — strictly stronger consistency than "
+                    "periodic parameter averaging; the value is "
+                    "ignored", n)
+            return self
+
+        def dcn_compression(self, threshold: float = 0.0):
+            """Enable int8 + residual-error-feedback gradient reduce
+            (see ParallelWrapper dcn_compression)."""
+            self._compression = {"threshold": threshold}
             return self
 
         def build(self) -> "ParallelWrapper":
@@ -74,11 +99,110 @@ class ParallelWrapper:
                 mesh = build_mesh(MeshSpec(data=self._workers), devs)
             else:
                 mesh = build_mesh(MeshSpec())
-            return ParallelWrapper(self._model, mesh, self._prefetch)
+            return ParallelWrapper(self._model, mesh, self._prefetch,
+                                   self._compression)
 
     @staticmethod
     def builder(model) -> "ParallelWrapper.Builder":
         return ParallelWrapper.Builder(model)
+
+    # ---- compressed DCN train step ----
+    def _make_compressed_step(self):
+        """Explicit shard_map data-parallel step with int8 + threshold
+        + residual-error-feedback gradient reduce — the trainer the
+        reference wires EncodingHandler into (SharedTrainingWrapper
+        .java:161-195 attaches the encoding accumulator to the local
+        wrapper). The residual rides along as per-device state with a
+        leading mesh axis."""
+        import functools
+
+        import optax
+
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
+        from deeplearning4j_tpu.parallel.compression import (
+            make_compressed_psum_ef)
+        from deeplearning4j_tpu.train.constraints import (
+            apply_layer_constraints)
+        from deeplearning4j_tpu.train.gradnorm import (
+            apply_gradient_normalization)
+        try:
+            from jax import shard_map
+        except ImportError:       # older jax
+            from jax.experimental.shard_map import shard_map
+
+        model = self.model
+        mesh = self.mesh
+        is_graph = isinstance(model, ComputationGraph)
+        optimizer = model._optimizer
+        ndata = mesh.shape["data"]
+        psum_ef = make_compressed_psum_ef(
+            float(self.dcn_compression.get("threshold", 0.0)))
+
+        def per_device(params, state, opt_state, residual, batch,
+                       base_rng, step):
+            # fold the device index in: otherwise every shard draws the
+            # SAME dropout mask (correlated regularization noise)
+            rng = jax.random.fold_in(
+                jax.random.fold_in(base_rng, step),
+                jax.lax.axis_index("data"))
+            residual = jax.tree_util.tree_map(lambda r: r[0], residual)
+            # mark params device-varying: otherwise jax's varying-axes
+            # AD auto-psums the cotangent (full-precision!) before we
+            # get to intercept it with the compressed reduce
+            params_v = jax.tree_util.tree_map(
+                lambda p: jax.lax.pcast(p, "data", to="varying"), params)
+
+            def loss_fn(p):
+                return model._loss(p, state, batch, rng, training=True)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params_v)
+            # local grads are means over the LOCAL shard; divide by the
+            # device count so the compressed psum yields the global mean
+            grads = jax.tree_util.tree_map(lambda g: g / ndata, grads)
+            grads, new_residual = psum_ef(grads, residual, "data")
+            if is_graph:
+                layer_cfgs = {n: v[0]
+                              for n, v in model.conf.vertices.items()
+                              if n in params}
+            else:
+                layer_cfgs = model.layers
+            grads = apply_gradient_normalization(layer_cfgs, grads)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            if is_graph:
+                new_params = {
+                    n: apply_layer_constraints(model.conf.vertices[n][0],
+                                               p)
+                    for n, p in new_params.items()}
+            else:
+                new_params = [apply_layer_constraints(l, p)
+                              for l, p in zip(model.layers, new_params)]
+            # per-device aux state (BN stats, centers) diverges across
+            # shards — average (floats) / max (ints) so the replicated
+            # out-spec holds
+            new_state = jax.tree_util.tree_map(
+                lambda s: (jax.lax.pmean(s, "data")
+                           if jnp.issubdtype(s.dtype, jnp.floating)
+                           else jax.lax.pmax(s, "data")), new_state)
+            loss = jax.lax.pmean(loss, "data")
+            new_residual = jax.tree_util.tree_map(lambda r: r[None],
+                                                  new_residual)
+            return new_params, new_state, new_opt, new_residual, loss
+
+        smapped = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(), P(), P(), P("data"), P("data"), P(), P()),
+            out_specs=(P(), P(), P(), P("data"), P()))
+        return jax.jit(smapped, donate_argnums=(0, 1, 2, 3))
+
+    def _init_residual(self):
+        ndev = self.mesh.shape["data"]
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros((ndev,) + p.shape, p.dtype),
+            self.model.params)
+        return jax.device_put(zeros, NamedSharding(self.mesh, P("data")))
 
     # ---- sharding helpers ----
     def _replicated(self):
@@ -98,13 +222,21 @@ class ParallelWrapper:
         if model.params is None:
             model.init()
         is_graph = isinstance(model, ComputationGraph)
-        if model._jit_train_step is None:
-            model._jit_train_step = model._make_train_step()
-        step = model._jit_train_step
+        compressed = self.dcn_compression is not None
+        if compressed:
+            if self._compressed_step is None:
+                self._compressed_step = self._make_compressed_step()
+            step = self._compressed_step
+        else:
+            if model._jit_train_step is None:
+                model._jit_train_step = model._make_train_step()
+            step = model._jit_train_step
         repl = self._replicated()
         model.params = jax.device_put(model.params, repl)
         model.state = jax.device_put(model.state, repl)
         model.opt_state = jax.device_put(model.opt_state, repl)
+        if compressed and self._residual is None:
+            self._residual = self._init_residual()
         it = AsyncDataSetIterator(iterator, self.prefetch) \
             if self.prefetch > 0 else iterator
         ndata = self.mesh.shape["data"]
@@ -127,9 +259,17 @@ class ParallelWrapper:
                 else:
                     batch = model._batch_tuple(ds)
                 batch = self._shard_batch(batch)
-                model.params, model.state, model.opt_state, loss = step(
-                    model.params, model.state, model.opt_state, batch,
-                    model._rng_key, np.int32(model.iteration_count))
+                if compressed:
+                    (model.params, model.state, model.opt_state,
+                     self._residual, loss) = step(
+                        model.params, model.state, model.opt_state,
+                        self._residual, batch, model._rng_key,
+                        np.int32(model.iteration_count))
+                else:
+                    model.params, model.state, model.opt_state, loss = \
+                        step(model.params, model.state, model.opt_state,
+                             batch, model._rng_key,
+                             np.int32(model.iteration_count))
                 model.score_value = loss
                 for lst in model.listeners:
                     lst.iteration_done(model, model.iteration_count, loss,

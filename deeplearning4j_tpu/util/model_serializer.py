@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["write_model", "restore_model", "save_pytree_npz",
+__all__ = ["write_model", "restore_model", "restore_normalizer",
+           "save_pytree_npz",
            "load_pytree_npz"]
 
 _FORMAT = 1
@@ -121,3 +122,16 @@ def restore_model(path: str, *, load_updater: bool = True):
         model.iteration_count = meta.get("iteration_count", 0)
         model.epoch_count = meta.get("epoch_count", 0)
     return model
+
+
+def restore_normalizer(path: str):
+    """Rebuild the data normalizer persisted by
+    :func:`write_model(..., normalizer=...)` — the reference pairs
+    restoreNormalizerFromFile with restoreMultiLayerNetwork
+    (util/ModelSerializer.java). Returns None if the checkpoint has no
+    normalizer."""
+    from deeplearning4j_tpu.data.normalizers import normalizer_from_dict
+    with zipfile.ZipFile(path, "r") as z:
+        meta = json.loads(z.read("metadata.json"))
+    nd = meta.get("normalizer")
+    return normalizer_from_dict(nd) if nd is not None else None

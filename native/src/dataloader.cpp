@@ -54,6 +54,7 @@ struct Loader {
   std::condition_variable cv_ready, cv_space;
   std::vector<std::thread> workers;
   std::atomic<int> active_workers{0};
+  std::atomic<int64_t> skipped_rows{0};
   bool stopped = false;
 
   ~Loader() { stop(); }
@@ -139,8 +140,17 @@ struct Loader {
       int row = 0;
       for (size_t i = start; i < end_i; ++i) {
         if (parse_line(lines[i], b, row)) ++row;
+        else skipped_rows.fetch_add(1);
       }
       b->n = row;
+      if (row == 0) {
+        // an all-bad batch must not reach the queue: next() treats
+        // n == 0 as end-of-data, which would silently drop every
+        // remaining batch (and turn a misconfigured n_features into
+        // a no-op instead of an error)
+        delete b;
+        continue;
+      }
       std::unique_lock<std::mutex> lock(mu);
       cv_space.wait(lock, [&] {
         return stopped || (int)ready.size() < queue_capacity;
@@ -219,6 +229,13 @@ void* dl4j_csv_loader_create(const char* path, int batch_size,
 
 int64_t dl4j_loader_num_lines(void* handle) {
   return (int64_t) static_cast<Loader*>(handle)->lines.size();
+}
+
+// rows dropped by the parser so far (bad numeric fields, wrong column
+// count, out-of-range labels); lets the Python side warn instead of
+// silently training on a subset
+int64_t dl4j_loader_skipped_rows(void* handle) {
+  return static_cast<Loader*>(handle)->skipped_rows.load();
 }
 
 int dl4j_loader_next(void* handle, float* feat_out, float* lab_out) {

@@ -25,6 +25,34 @@ def _net():
          .set_input_type(InputType.feed_forward(4)).build())).init()
 
 
+class TestNormalizerRoundTrip:
+    def test_restore_normalizer(self, tmp_path):
+        """ADVICE round-1: the persisted normalizer config must be
+        recoverable (reference restoreNormalizerFromFile)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.normalizers import (
+            NormalizerStandardize)
+        from deeplearning4j_tpu.util.model_serializer import (
+            restore_normalizer)
+        rng = np.random.default_rng(0)
+        xs = rng.normal(3.0, 2.0, (50, 4)).astype(np.float32)
+        norm = NormalizerStandardize().fit(DataSet(xs, None))
+        p = os.path.join(tmp_path, "m.zip")
+        write_model(_net(), p, normalizer=norm.to_dict())
+        back = restore_normalizer(p)
+        assert type(back).__name__ == "NormalizerStandardize"
+        got = back.transform(DataSet(xs, None)).features
+        want = norm.transform(DataSet(xs, None)).features
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+        # checkpoint without a normalizer → None
+        p2 = os.path.join(tmp_path, "m2.zip")
+        write_model(_net(), p2)
+        assert restore_normalizer(p2) is None
+
+
 class TestModelGuesser:
     def test_guesses_checkpoint(self, tmp_path):
         from deeplearning4j_tpu.util.model_guesser import (guess_format,

@@ -47,6 +47,33 @@ class TestNativeLoader:
         # restartable
         assert sum(ds.num_examples() for ds in it) == 100
 
+    def test_bad_rows_skipped_not_truncating(self, tmp_path):
+        """ADVICE round-1 (medium): a batch where every row fails to
+        parse must NOT reach the queue as n=0 — that read as
+        end-of-data and silently dropped all remaining batches. Bad
+        rows are skipped, counted, and later batches still arrive."""
+        from deeplearning4j_tpu.data.native_loader import (
+            NativeCSVDataSetIterator, native_available)
+        if not native_available():
+            pytest.skip("no native toolchain")
+        path = os.path.join(tmp_path, "bad.csv")
+        rng = np.random.default_rng(0)
+        with open(path, "w") as fh:
+            # batch 1 (rows 0-7): all garbage → would have been an n=0
+            # batch with batch_size=8
+            for _ in range(8):
+                fh.write("not,a,number,at,all\n")
+            # batches 2-3 (rows 8-23): valid
+            for _ in range(16):
+                v = rng.normal(0, 1, 4)
+                fh.write(",".join(f"{x:.5f}" for x in v) + ",1\n")
+        it = NativeCSVDataSetIterator(path, batch_size=8, n_features=4,
+                                      label_index=4, num_classes=3,
+                                      n_threads=1)
+        total = sum(ds.num_examples() for ds in it)
+        assert total == 16, f"valid rows lost: got {total}"
+        assert it.skipped_rows == 8
+
     def test_native_trains_a_model(self, tmp_path):
         from deeplearning4j_tpu.data.native_loader import (
             NativeCSVDataSetIterator, native_available)

@@ -169,6 +169,61 @@ class TestCompression:
         # int8 quantization: relative error bounded by ~1/127 per term
         np.testing.assert_allclose(approx, exact, atol=8 * 0.02)
 
+    def test_error_feedback_accumulates_dropped_values(self):
+        """int8_all_reduce_ef with a threshold: dropped values must stay
+        in the residual (reference EncodingHandler residual carry)."""
+        from deeplearning4j_tpu.parallel.compression import (
+            int8_all_reduce_ef)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        x = np.full((8, 16), 0.01, np.float32)    # all below threshold
+        r = np.zeros((8, 16), np.float32)
+
+        def f(a, res):
+            tot, nr = int8_all_reduce_ef(a[0], res[0], "data",
+                                         threshold=0.5)
+            return tot, nr[None]
+        tot, nr = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=(P(), P("data"))))(x, r)
+        # nothing crossed the threshold → zero reduce, residual keeps it
+        np.testing.assert_allclose(np.asarray(tot), 0.0)
+        np.testing.assert_allclose(np.asarray(nr), x)
+
+
+class TestCompressedTrainer:
+    def test_compressed_dp_close_to_single_device(self):
+        """dcn_compression must reproduce the single-device result
+        within int8 quantization tolerance — the compressed analog of
+        the distributed-equals-single contract."""
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        xs, ys = iris_data()
+        batch = DataSet(xs[:64], ys[:64])
+
+        single = _net(seed=3)
+        single.fit(batch)
+        p_single = single.params_flat()
+
+        dp = _net(seed=3)
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        pw = ParallelWrapper(dp, mesh, prefetch_buffer=0,
+                             dcn_compression={"threshold": 0.0})
+        pw.fit(ListDataSetIterator([batch]), epochs=1)
+        np.testing.assert_allclose(dp.params_flat(), p_single,
+                                   atol=5e-4)
+
+    def test_compressed_dp_trains_to_accuracy(self):
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        xs, ys = iris_data()
+        net = _net(seed=1, lr=0.3)
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        pw = (ParallelWrapper.builder(net).workers(8).prefetch_buffer(0)
+              .dcn_compression(threshold=1e-4).build())
+        it = ListDataSetIterator(DataSet(xs[:120], ys[:120]).batch_by(40))
+        pw.fit(it, epochs=40)
+        assert net.evaluate(xs[120:], ys[120:]).accuracy() > 0.85
+
 
 class TestPipeline:
     def test_pipeline_trains(self):

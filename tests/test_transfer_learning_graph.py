@@ -1,0 +1,121 @@
+"""TransferLearning.GraphBuilder parity (reference
+TransferLearning.java:449): vertex-name surgery on ComputationGraph —
+freeze-until-vertex, nOutReplace, add/remove vertex, FineTune."""
+
+import numpy as np
+
+from deeplearning4j_tpu import ComputationGraph, NeuralNetConfiguration
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.fetchers import iris_data
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.special import FrozenLayer
+from deeplearning4j_tpu.nn.transfer_learning import (
+    FineTuneConfiguration, TransferLearningGraph)
+
+
+def _trained_graph():
+    xs, ys = iris_data()
+    g = (NeuralNetConfiguration.builder().set_seed(0)
+         .updater(updaters.adam(0.05))
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("h1", DenseLayer(n_out=12, activation="relu"), "in")
+         .add_layer("h2", DenseLayer(n_out=8, activation="relu"), "h1")
+         .add_layer("out", OutputLayer(n_out=3), "h2")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4))
+         .build())
+    cg = ComputationGraph(g).init()
+    cg.fit(DataSet(xs[:120], ys[:120]), epochs=60)
+    return cg, xs, ys
+
+
+class TestGraphSurgery:
+    def test_freeze_until_vertex(self):
+        cg, xs, ys = _trained_graph()
+        tuned = (TransferLearningGraph.builder(cg)
+                 .fine_tune_configuration(
+                     FineTuneConfiguration(updater=updaters.adam(0.01)))
+                 .set_feature_extractor("h1")
+                 .build())
+        # h1 (and nothing downstream of it) is frozen
+        assert isinstance(tuned.conf.vertices["h1"][0], FrozenLayer)
+        assert not isinstance(tuned.conf.vertices["h2"][0], FrozenLayer)
+        w_before = np.asarray(tuned.params["h1"]["W"]).copy()
+        w2_before = np.asarray(tuned.params["h2"]["W"]).copy()
+        tuned.fit(DataSet(xs[:120], ys[:120]), epochs=10)
+        np.testing.assert_allclose(
+            w_before, np.asarray(tuned.params["h1"]["W"]))
+        assert not np.allclose(w2_before, np.asarray(tuned.params["h2"]["W"]))
+        # surgery must not have disturbed the original graph
+        assert not isinstance(cg.conf.vertices["h1"][0], FrozenLayer)
+
+    def test_frozen_params_transplanted(self):
+        cg, xs, _ = _trained_graph()
+        tuned = (TransferLearningGraph.builder(cg)
+                 .set_feature_extractor("h2")
+                 .build())
+        for name in ("h1", "h2", "out"):
+            np.testing.assert_allclose(
+                np.asarray(cg.params[name]["W"]),
+                np.asarray(tuned.params[name]["W"]))
+
+    def test_n_out_replace_reinits_consumer(self):
+        cg, xs, ys = _trained_graph()
+        tuned = (TransferLearningGraph.builder(cg)
+                 .n_out_replace("h2", 16)
+                 .build())
+        assert tuned.params["h2"]["W"].shape == (12, 16)
+        assert tuned.params["out"]["W"].shape == (16, 3)
+        # h1 untouched → params transplanted
+        np.testing.assert_allclose(np.asarray(cg.params["h1"]["W"]),
+                                   np.asarray(tuned.params["h1"]["W"]))
+        tuned.fit(DataSet(xs[:120], ys[:120]), epochs=30)
+        assert tuned.evaluate(DataSet(xs[120:], ys[120:])).accuracy() > 0.6
+
+    def test_replace_output_head(self):
+        """The canonical fine-tune flow: remove the output layer, add a
+        new head with a different class count, freeze the stem."""
+        cg, xs, ys = _trained_graph()
+        ys5 = np.zeros((xs.shape[0], 5), np.float32)
+        ys5[:, :3] = ys
+        tuned = (TransferLearningGraph.builder(cg)
+                 .set_feature_extractor("h2")
+                 .remove_vertex_keep_connections("out")
+                 .add_layer("out", OutputLayer(n_out=5), "h2")
+                 .build())
+        assert tuned.params["out"]["W"].shape == (8, 5)
+        tuned.fit(DataSet(xs[:120], ys5[:120]), epochs=60)
+        ev = tuned.evaluate(DataSet(xs[120:], ys5[120:]))
+        assert ev.accuracy() > 0.7
+        # stem stayed frozen
+        np.testing.assert_allclose(np.asarray(cg.params["h1"]["W"]),
+                                   np.asarray(tuned.params["h1"]["W"]))
+
+    def test_remove_vertex_and_connections(self):
+        xs, ys = iris_data()
+        g = (NeuralNetConfiguration.builder().set_seed(0)
+             .updater(updaters.adam(0.05))
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("a", DenseLayer(n_out=6, activation="relu"), "in")
+             .add_layer("b", DenseLayer(n_out=6, activation="relu"), "in")
+             .add_vertex("m", MergeVertex(), "a", "b")
+             .add_layer("out", OutputLayer(n_out=3), "m")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(4))
+             .build())
+        cg = ComputationGraph(g).init()
+        cg.fit(DataSet(xs[:120], ys[:120]), epochs=10)
+        pruned = (TransferLearningGraph.builder(cg)
+                  .remove_vertex_and_connections("b")
+                  .build())
+        assert "b" not in pruned.conf.vertices
+        assert pruned.conf.vertices["m"][1] == ["a"]
+        # merge of one input is width 6 → out re-inited to (6, 3)
+        assert pruned.params["out"]["W"].shape == (6, 3)
+        pruned.fit(DataSet(xs[:120], ys[:120]), epochs=80)
+        assert pruned.evaluate(DataSet(xs[120:], ys[120:])).accuracy() > 0.7
