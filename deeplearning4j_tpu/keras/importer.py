@@ -399,6 +399,10 @@ def _assign_weights(layer, params: dict, state: dict,
         put(state, "mean", arrs.pop(0), jnp.float32)
         put(state, "var", arrs.pop(0), jnp.float32)
     elif class_name == "LSTM":
+        if len(arrays) == 12:    # Keras-1 per-gate layout
+            from deeplearning4j_tpu.keras.keras1 import (
+                repack_keras1_lstm_weights)
+            arrays = repack_keras1_lstm_weights(arrays)
         units = params["b"].shape[0] // 4
         put(params, "Wx", _lstm_gate_permute(arrays[0], units))
         put(params, "Wh", _lstm_gate_permute(arrays[1], units))
@@ -480,6 +484,11 @@ def import_keras_model_and_weights(path: str):
             keras_version = keras_version.decode()
         logger.info("importing keras %s model (%s)",
                     model_cfg["class_name"], keras_version)
+        from deeplearning4j_tpu.keras.keras1 import (is_keras1,
+                                                     normalize_keras1_config)
+        if is_keras1(model_cfg, keras_version):
+            logger.info("normalizing Keras-1 legacy config fields")
+            model_cfg = normalize_keras1_config(model_cfg)
         if model_cfg["class_name"] == "Sequential":
             return _import_sequential(model_cfg, f)
         if model_cfg["class_name"] in ("Functional", "Model"):

@@ -424,7 +424,8 @@ class ComputationGraph:
                              self._batch_tuple(mds), None, training=False)
         return float(loss)
 
-    def _eval_with(self, data, ev, output_index: int = 0):
+    def _iter_pred_batches(self, data):
+        """Shared eval iteration: one forward per batch, ALL heads."""
         if isinstance(data, (DataSet, MultiDataSet)):
             data = [data]
         for ds in data:
@@ -433,14 +434,21 @@ class ComputationGraph:
                                 input_masks=mds.features_masks)
             if not isinstance(preds, tuple):
                 preds = (preds,)
+            yield mds, preds
+
+    @staticmethod
+    def _eval_one(ev, labels, preds, mask):
+        try:
+            ev.eval(labels, preds, mask=mask)
+        except TypeError:         # evaluators without mask support (ROC)
+            ev.eval(labels, preds)
+
+    def _eval_with(self, data, ev, output_index: int = 0):
+        for mds, preds in self._iter_pred_batches(data):
             lmask = (mds.labels_masks[output_index]
                      if mds.labels_masks is not None else None)
-            try:
-                ev.eval(mds.labels[output_index],
-                        np.asarray(preds[output_index]), mask=lmask)
-            except TypeError:     # evaluators without mask support (ROC)
-                ev.eval(mds.labels[output_index],
-                        np.asarray(preds[output_index]))
+            self._eval_one(ev, mds.labels[output_index],
+                           np.asarray(preds[output_index]), lmask)
         return ev
 
     def evaluate(self, data, output_index: int = 0):
@@ -455,23 +463,13 @@ class ComputationGraph:
             from deeplearning4j_tpu.evaluation.classification import (
                 Evaluation)
             eval_factory = Evaluation
-        if isinstance(data, (DataSet, MultiDataSet)):
-            data = [data]
         evs = [eval_factory() for _ in self.conf.network_outputs]
-        for ds in data:
-            mds = self._as_multi(ds)
-            preds = self.output(*mds.features,
-                                input_masks=mds.features_masks)
-            if not isinstance(preds, tuple):
-                preds = (preds,)
+        for mds, preds in self._iter_pred_batches(data):
             for i, ev in enumerate(evs):
                 lmask = (mds.labels_masks[i]
                          if mds.labels_masks is not None else None)
-                try:
-                    ev.eval(mds.labels[i], np.asarray(preds[i]),
-                            mask=lmask)
-                except TypeError:
-                    ev.eval(mds.labels[i], np.asarray(preds[i]))
+                self._eval_one(ev, mds.labels[i], np.asarray(preds[i]),
+                               lmask)
         return dict(zip(self.conf.network_outputs, evs))
 
     def evaluate_regression(self, data, output_index: int = 0):
@@ -598,20 +596,12 @@ class ComputationGraph:
                    for p in jax.tree_util.tree_leaves(self.params))
 
     def params_flat(self) -> np.ndarray:
-        leaves = jax.tree_util.tree_leaves(self.params)
-        return np.concatenate([np.asarray(l).ravel() for l in leaves]) \
-            if leaves else np.zeros((0,))
+        from deeplearning4j_tpu.util.tree import tree_flat_vector
+        return tree_flat_vector(self.params)
 
     def set_params_flat(self, flat: np.ndarray):
-        leaves, treedef = jax.tree_util.tree_flatten(self.params)
-        out = []
-        off = 0
-        for l in leaves:
-            n = int(l.size)
-            out.append(jnp.asarray(flat[off:off + n],
-                                   l.dtype).reshape(l.shape))
-            off += n
-        self.params = jax.tree_util.tree_unflatten(treedef, out)
+        from deeplearning4j_tpu.util.tree import tree_from_flat_vector
+        self.params = tree_from_flat_vector(self.params, flat)
 
     def clone(self) -> "ComputationGraph":
         g = ComputationGraph(self.conf.clone())

@@ -495,20 +495,12 @@ class MultiLayerNetwork:
                    for p in jax.tree_util.tree_leaves(self.params))
 
     def params_flat(self) -> np.ndarray:
-        leaves = jax.tree_util.tree_leaves(self.params)
-        return np.concatenate([np.asarray(l).ravel() for l in leaves]) \
-            if leaves else np.zeros((0,))
+        from deeplearning4j_tpu.util.tree import tree_flat_vector
+        return tree_flat_vector(self.params)
 
     def set_params_flat(self, flat: np.ndarray):
-        leaves, treedef = jax.tree_util.tree_flatten(self.params)
-        out = []
-        off = 0
-        for l in leaves:
-            n = int(l.size)
-            out.append(jnp.asarray(flat[off:off + n],
-                                   l.dtype).reshape(l.shape))
-            off += n
-        self.params = jax.tree_util.tree_unflatten(treedef, out)
+        from deeplearning4j_tpu.util.tree import tree_from_flat_vector
+        self.params = tree_from_flat_vector(self.params, flat)
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)

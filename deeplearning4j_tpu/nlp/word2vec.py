@@ -352,15 +352,49 @@ class SequenceVectors:
         denom = np.linalg.norm(va) * np.linalg.norm(vb)
         return float(va @ vb / denom) if denom else 0.0
 
+    def _unit_syn0(self) -> np.ndarray:
+        """Row-normalized vectors, cached (and invalidated when syn0's
+        identity changes — training replaces the array). At 100k+
+        vocab, normalizing per query was the scaling bottleneck."""
+        cached = getattr(self, "_unit_cache", None)
+        if cached is not None and cached[0] is self.syn0:
+            return cached[1]
+        norms = np.linalg.norm(self.syn0, axis=1, keepdims=True)
+        unit = self.syn0 / np.maximum(norms, 1e-12)
+        self._unit_cache = (self.syn0, unit)
+        return unit
+
     def words_nearest(self, word: str, n: int = 10) -> List[str]:
-        v = self.get_word_vector(word)
-        if v is None:
-            return []
-        norms = np.linalg.norm(self.syn0, axis=1) * np.linalg.norm(v)
-        sims = self.syn0 @ v / np.maximum(norms, 1e-12)
-        sims[self.vocab.index_of(word)] = -np.inf
-        top = np.argsort(-sims)[:n]
-        return [self.vocab.word_at(i) for i in top]
+        return self.words_nearest_batch([word], n=n)[0]
+
+    def words_nearest_batch(self, words: List[str], n: int = 10,
+                            chunk: int = 1024) -> List[List[str]]:
+        """Top-n neighbors for MANY query words via chunked matmul +
+        argpartition — the lookup-table-scale path (reference
+        InMemoryLookupTable wordsNearest over 100k+ vocab). Memory is
+        bounded at (chunk, V) regardless of query count."""
+        unit = self._unit_syn0()
+        out: List[List[str]] = []
+        idxs, valid = [], []
+        for w in words:
+            i = self.vocab.index_of(w)
+            idxs.append(i if i is not None and i >= 0 else 0)
+            valid.append(i is not None and i >= 0)
+        idxs = np.asarray(idxs)
+        for lo in range(0, len(words), chunk):
+            hi = min(lo + chunk, len(words))
+            sims = unit[idxs[lo:hi]] @ unit.T          # (chunk, V)
+            for r in range(hi - lo):
+                if not valid[lo + r]:
+                    out.append([])
+                    continue
+                sims[r, idxs[lo + r]] = -np.inf
+                k = min(n, sims.shape[1] - 1)
+                # argpartition: O(V) instead of O(V log V) full sort
+                part = np.argpartition(-sims[r], k)[:k]
+                top = part[np.argsort(-sims[r][part])]
+                out.append([self.vocab.word_at(i) for i in top])
+        return out
 
 
 class Word2Vec(SequenceVectors):

@@ -173,13 +173,17 @@ class StackVertex(GraphVertex):
     def propagate_mask(self, in_masks, inputs, mask_env=None):
         # reference StackVertex.java:165-194: vstack the masks; a
         # missing mask becomes all-ones with the present masks' width —
-        # (B, T) for time series, (B, 1) for feed-forward inputs
+        # (B, T) for time series, (B, 1) for feed-forward inputs.
+        # 1-D (B,) masks are normalized to (B, 1) first so every row
+        # of the concat has rank 2.
         if all(m is None for m in in_masks):
             return None
-        width = next(m.shape[1] if m.ndim > 1 else 1
-                     for m in in_masks if m is not None)
+        norm = [None if m is None
+                else (m[:, None] if m.ndim == 1 else m)
+                for m in in_masks]
+        width = next(m.shape[1] for m in norm if m is not None)
         mats = []
-        for m, x in zip(in_masks, inputs):
+        for m, x in zip(norm, inputs):
             if m is not None:
                 mats.append(m)
             elif x.ndim == 3:

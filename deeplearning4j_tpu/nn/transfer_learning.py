@@ -356,7 +356,14 @@ class TransferLearningGraph:
             if hasattr(target, "n_in"):
                 target.n_in = None
 
-        # 7. freeze: named vertices + all their ancestors
+        # 7. freeze: named vertices + all their ancestors. Validate the
+        #    names — a typo must not silently freeze nothing and let
+        #    fine-tuning destroy the pretrained stem
+        for name in self._frozen_at:
+            if name not in vertices:
+                raise ValueError(
+                    f"set_feature_extractor: unknown vertex '{name}' "
+                    f"(have {sorted(vertices)})")
         frozen = self._ancestors_inclusive(vertices, self._frozen_at)
         for vname in frozen:
             obj, ins = vertices[vname]

@@ -1,0 +1,208 @@
+"""Second-order / line-search optimization algorithms.
+
+The reference's OptimizationAlgorithm enum (nn/api/
+OptimizationAlgorithm.java:26) lists STOCHASTIC_GRADIENT_DESCENT,
+LINE_GRADIENT_DESCENT, CONJUGATE_GRADIENT, and LBFGS, driven by
+BackTrackLineSearch (optimize/solvers/BackTrackLineSearch.java) over
+the flat parameter view. First-order + schedules is the right TPU
+default (the jitted train step), but the API surface exists here for
+parity: full-batch optimizers over the executor's flat parameter
+vector, with the loss/gradient oracle jitted once (the TPU does the
+heavy lifting; the tiny s/y bookkeeping stays on host, as the
+reference's solver loop does on the JVM).
+
+Works with both executors (MultiLayerNetwork and ComputationGraph)
+through params_flat/set_params_flat.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["BackTrackLineSearch", "optimize", "lbfgs", "conjugate_gradient",
+           "line_gradient_descent"]
+
+
+def _flat_oracle(net, ds) -> Tuple[Callable, np.ndarray]:
+    """Jitted flat-vector loss/grad for a model + full batch."""
+    from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+    from deeplearning4j_tpu.models.computation_graph import (
+        ComputationGraph)
+
+    if isinstance(net, ComputationGraph):
+        batch = net._batch_tuple(net._as_multi(ds))
+    else:
+        batch = net._batch_tuple(ds)
+    leaves, treedef = jax.tree_util.tree_flatten(net.params)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    dtypes_ = [l.dtype for l in leaves]
+    state = net.state
+
+    def unflatten(flat):
+        out, off = [], 0
+        for shp, n, dt in zip(shapes, sizes, dtypes_):
+            out.append(flat[off:off + n].reshape(shp).astype(dt))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    @jax.jit
+    def value_and_grad(flat):
+        def loss_fn(fl):
+            loss, _ = net._loss(unflatten(fl), state, batch, None,
+                                training=False)
+            return loss
+        return jax.value_and_grad(loss_fn)(flat)
+
+    x0 = np.concatenate([np.asarray(l, np.float32).ravel()
+                         for l in leaves]) if leaves else np.zeros(0,
+                                                                   "f4")
+    return value_and_grad, jnp.asarray(x0)
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking (optimize/solvers/BackTrackLineSearch.java:
+    sufficient-decrease condition with geometric step shrink)."""
+
+    def __init__(self, c1: float = 1e-4, shrink: float = 0.5,
+                 max_steps: int = 20, initial_step: float = 1.0):
+        self.c1 = c1
+        self.shrink = shrink
+        self.max_steps = max_steps
+        self.initial_step = initial_step
+
+    def search(self, value_and_grad, x, f0, g0, direction):
+        """Returns (step, x_new, f_new, g_new, ok)."""
+        d_dot_g = float(jnp.vdot(direction, g0))
+        if d_dot_g >= 0:       # not a descent direction
+            return 0.0, x, f0, g0, False
+        step = self.initial_step
+        for _ in range(self.max_steps):
+            x_new = x + step * direction
+            f_new, g_new = value_and_grad(x_new)
+            if float(f_new) <= float(f0) + self.c1 * step * d_dot_g:
+                return step, x_new, f_new, g_new, True
+            step *= self.shrink
+        return 0.0, x, f0, g0, False
+
+
+def line_gradient_descent(value_and_grad, x0, *, iterations: int = 100,
+                          tol: float = 1e-8,
+                          line_search: Optional[BackTrackLineSearch]
+                          = None):
+    """LINE_GRADIENT_DESCENT: steepest descent + line search."""
+    ls = line_search or BackTrackLineSearch()
+    x = x0
+    f, g = value_and_grad(x)
+    history = [float(f)]
+    for _ in range(iterations):
+        step, x, f, g, ok = ls.search(value_and_grad, x, f, g, -g)
+        history.append(float(f))
+        if not ok or abs(history[-2] - history[-1]) < tol:
+            break
+    return x, history
+
+
+def conjugate_gradient(value_and_grad, x0, *, iterations: int = 100,
+                       tol: float = 1e-8,
+                       line_search: Optional[BackTrackLineSearch] = None):
+    """CONJUGATE_GRADIENT (Polak-Ribière with automatic restart,
+    optimize/solvers/ConjugateGradient.java)."""
+    ls = line_search or BackTrackLineSearch()
+    x = x0
+    f, g = value_and_grad(x)
+    d = -g
+    history = [float(f)]
+    for it in range(iterations):
+        step, x, f_new, g_new, ok = ls.search(value_and_grad, x, f, g, d)
+        history.append(float(f_new))
+        if not ok or abs(float(f) - float(f_new)) < tol:
+            break
+        # Polak-Ribière beta; restart on non-descent / every n dims
+        beta = float(jnp.vdot(g_new, g_new - g)
+                     / jnp.maximum(jnp.vdot(g, g), 1e-20))
+        beta = max(beta, 0.0)                      # PR+
+        d = -g_new + beta * d
+        if float(jnp.vdot(d, g_new)) >= 0:
+            d = -g_new                             # restart
+        f, g = f_new, g_new
+    return x, history
+
+
+def lbfgs(value_and_grad, x0, *, iterations: int = 100, history: int = 10,
+          tol: float = 1e-8,
+          line_search: Optional[BackTrackLineSearch] = None):
+    """LBFGS (optimize/solvers/LBFGS.java): limited-memory two-loop
+    recursion over (s, y) pairs + backtracking line search."""
+    ls = line_search or BackTrackLineSearch()
+    x = x0
+    f, g = value_and_grad(x)
+    S: List = []
+    Y: List = []
+    losses = [float(f)]
+    for it in range(iterations):
+        # two-loop recursion
+        q = g
+        alphas = []
+        for s, y in zip(reversed(S), reversed(Y)):
+            rho = 1.0 / float(jnp.maximum(jnp.vdot(y, s), 1e-20))
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if S:
+            s, y = S[-1], Y[-1]
+            gamma = float(jnp.vdot(s, y)
+                          / jnp.maximum(jnp.vdot(y, y), 1e-20))
+            q = gamma * q
+        for (a, rho, s, y) in reversed(alphas):
+            b = rho * float(jnp.vdot(y, q))
+            q = q + (a - b) * s
+        d = -q
+        step, x_new, f_new, g_new, ok = ls.search(value_and_grad, x, f,
+                                                  g, d)
+        losses.append(float(f_new))
+        if not ok:
+            # fall back to steepest descent once before giving up
+            step, x_new, f_new, g_new, ok = ls.search(
+                value_and_grad, x, f, g, -g)
+            if not ok:
+                break
+        S.append(x_new - x)
+        Y.append(g_new - g)
+        if len(S) > history:
+            S.pop(0)
+            Y.pop(0)
+        if abs(float(f) - float(f_new)) < tol:
+            x, f, g = x_new, f_new, g_new
+            break
+        x, f, g = x_new, f_new, g_new
+    return x, losses
+
+
+_ALGOS = {"lbfgs": lbfgs,
+          "conjugate_gradient": conjugate_gradient,
+          "line_gradient_descent": line_gradient_descent}
+
+
+def optimize(net, ds, *, algorithm: str = "lbfgs",
+             iterations: int = 100, **kw) -> List[float]:
+    """Full-batch second-order fit of a model in place (the Solver
+    facade for non-SGD OptimizationAlgorithm values). Returns the loss
+    history."""
+    if algorithm not in _ALGOS:
+        raise ValueError(f"Unknown algorithm '{algorithm}'; "
+                         f"choose from {sorted(_ALGOS)}")
+    value_and_grad, x0 = _flat_oracle(net, ds)
+    x, history = _ALGOS[algorithm](value_and_grad, x0,
+                                   iterations=iterations, **kw)
+    net.set_params_flat(np.asarray(x))
+    logger.info("%s: %d evals, loss %.6f -> %.6f", algorithm,
+                len(history), history[0], history[-1])
+    return history

@@ -45,10 +45,19 @@ _PAGE = """<!DOCTYPE html>
   <svg id="score" width="800" height="220"></svg></div>
 <div class="chart"><h2>Samples/sec</h2>
   <svg id="tput" width="800" height="160"></svg></div>
+<div class="chart"><h2>Learning rate</h2>
+  <svg id="lr" width="800" height="120"></svg></div>
 <div class="chart"><h2>Mean |param| per layer</h2>
   <svg id="params" width="800" height="220"></svg></div>
+<div class="chart"><h2>log10 update:param ratio per layer
+  (healthy ~ -3)</h2>
+  <svg id="ratios" width="800" height="220"></svg></div>
 <div class="chart"><h2>Parameter histograms (latest report)</h2>
   <div id="hists"></div></div>
+<div class="chart"><h2>Conv activations (latest report)</h2>
+  <div id="acts"></div></div>
+<div class="chart"><h2>t-SNE</h2>
+  <svg id="tsne" width="500" height="500"></svg></div>
 <script>
 function histogram(container, name, h) {
   const W = 240, H = 110, n = h.counts.length;
@@ -89,21 +98,51 @@ async function refresh() {
   const updates = await (await fetch('/api/updates?session=' + sid)).json();
   document.getElementById('meta').textContent =
     `session ${sid} — ${updates.length} reports`;
-  for (const id of ['score', 'tput', 'params'])
+  for (const id of ['score', 'tput', 'lr', 'params', 'ratios'])
     document.getElementById(id).innerHTML = '';
   const it = updates.map(u => u.iteration);
   line('score', it, updates.map(u => u.score), '#d33');
   line('tput', it, updates.map(u => u.samples_per_sec), '#36c');
+  line('lr', it, updates.map(u => u.learning_rate || 0), '#a50');
+  const colors = ['#283', '#c63', '#639', '#366', '#933', '#369'];
   const names = Object.keys(updates[updates.length-1]
                             .param_mean_magnitudes || {});
-  const colors = ['#283', '#c63', '#639', '#366', '#933', '#369'];
   names.forEach((n, i) => line('params', it,
     updates.map(u => u.param_mean_magnitudes[n] || 0),
+    colors[i % colors.length]));
+  const rnames = Object.keys(updates[updates.length-1]
+                             .update_ratios || {});
+  rnames.forEach((n, i) => line('ratios', it,
+    updates.map(u => Math.log10((u.update_ratios || {})[n] || 1e-12)),
     colors[i % colors.length]));
   const hd = document.getElementById('hists');
   hd.innerHTML = '';
   const hs = updates[updates.length-1].histograms || {};
   Object.keys(hs).slice(0, 12).forEach(n => histogram(hd, n, hs[n]));
+  // conv activations: newest report in any session carrying images
+  const ad = document.getElementById('acts');
+  ad.innerHTML = '';
+  const imgs = await (await fetch('/api/activations')).json();
+  Object.keys(imgs).forEach(n => { ad.innerHTML +=
+    `<div style="display:inline-block;margin:4px;text-align:center">
+     <img src="data:image/png;base64,${imgs[n]}"/><br/>
+     <small>${n}</small></div>`; });
+  const ts = await (await fetch('/api/tsne')).json();
+  const tsvg = document.getElementById('tsne');
+  tsvg.innerHTML = '';
+  if (ts.points && ts.points.length) {
+    const xs2 = ts.points.map(p => p[0]), ys2 = ts.points.map(p => p[1]);
+    const xmin = Math.min(...xs2), xmax = Math.max(...xs2);
+    const ymin = Math.min(...ys2), ymax = Math.max(...ys2);
+    let dots = '';
+    ts.points.forEach((p, i) => {
+      const x = 10 + (p[0] - xmin) / Math.max(xmax - xmin, 1e-9) * 480;
+      const y = 10 + (p[1] - ymin) / Math.max(ymax - ymin, 1e-9) * 480;
+      const c = colors[(ts.labels ? ts.labels[i] : 0) % colors.length];
+      dots += `<circle cx="${x}" cy="${y}" r="2.5" fill="${c}"/>`;
+    });
+    tsvg.innerHTML = dots;
+  }
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>
@@ -121,6 +160,7 @@ class UIServer:
         self.storage = InMemoryStatsStorage()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._tsne = {"points": [], "labels": None}
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -132,8 +172,26 @@ class UIServer:
     def attach(self, storage) -> None:
         self.storage = storage
 
+    def upload_tsne(self, data, labels=None, *, already_2d=None):
+        """Feed the t-SNE tab (the Play UI's tsne module, reusing
+        clustering/tsne.py). ``data``: (N, D) features — reduced to 2-d
+        with Barnes-Hut t-SNE unless D == 2 (override via
+        ``already_2d``)."""
+        import numpy as np
+        data = np.asarray(data)
+        if already_2d is None:
+            already_2d = data.shape[1] == 2
+        if not already_2d:
+            from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+            data = BarnesHutTsne(n_components=2).fit_transform(data)
+        self._tsne = {
+            "points": np.asarray(data).tolist(),
+            "labels": (None if labels is None
+                       else [int(l) for l in np.asarray(labels)])}
+
     def start(self) -> None:
         storage_ref = lambda: self.storage      # noqa: E731
+        server_ref = lambda: self               # noqa: E731
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
@@ -164,6 +222,19 @@ class UIServer:
                     ups = [dataclasses.asdict(u)
                            for u in storage.get_all_updates(sid)]
                     self._send(200, json.dumps(ups))
+                elif url.path == "/api/activations":
+                    # newest report (any session) carrying conv images
+                    imgs = {}
+                    for sid in reversed(storage.list_session_ids()):
+                        for u in reversed(storage.get_all_updates(sid)):
+                            if u.activation_images:
+                                imgs = u.activation_images
+                                break
+                        if imgs:
+                            break
+                    self._send(200, json.dumps(imgs))
+                elif url.path == "/api/tsne":
+                    self._send(200, json.dumps(server_ref()._tsne))
                 else:
                     self._send(404, json.dumps({"error": "not found"}))
 
@@ -174,6 +245,13 @@ class UIServer:
                     body = self.rfile.read(n).decode()
                     report = StatsReport.from_json(body)
                     storage_ref().put_update(report)
+                    self._send(200, json.dumps({"ok": True}))
+                elif url.path == "/api/tsne":
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n).decode())
+                    server_ref()._tsne = {
+                        "points": body.get("points", []),
+                        "labels": body.get("labels")}
                     self._send(200, json.dumps({"ok": True}))
                 else:
                     self._send(404, json.dumps({"error": "not found"}))

@@ -42,7 +42,15 @@ class StatsReport:
         default_factory=dict)
     update_mean_magnitudes: Dict[str, float] = dataclasses.field(
         default_factory=dict)
+    # per-layer mean|update|/mean|param| — TrainModule's update:param
+    # ratio chart (healthy training ~1e-3)
+    update_ratios: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    learning_rate: Optional[float] = None
     histograms: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    # layer name -> base64 PNG of tiled conv activations
+    activation_images: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
     duration_ms: float = 0.0
     samples_per_sec: float = 0.0
     memory_bytes: Optional[int] = None
@@ -116,7 +124,25 @@ class StatsListener(TrainingListener):
         self.worker_id = worker_id
         self.collect_histograms = collect_histograms
         self._last_time = None
-        self._prev_params: Optional[np.ndarray] = None
+        self._prev_params: Optional[Dict[str, np.ndarray]] = None
+
+    @staticmethod
+    def _current_lr(model, iteration) -> Optional[float]:
+        """Schedule-aware current learning rate (TrainModule's LR
+        chart)."""
+        try:
+            cfg = model.conf.conf.updater_cfg
+            if cfg is None:
+                return None
+            lr = cfg.get("lr")
+            sched = cfg.get("schedule")
+            if sched:
+                from deeplearning4j_tpu.nn.conf import updaters
+                fn = updaters.make_schedule(lr, sched)
+                return float(fn(iteration)) if callable(fn) else float(fn)
+            return float(lr) if lr is not None else None
+        except Exception:
+            return None
 
     def iteration_done(self, model, iteration, score, batch_size):
         if iteration % self.freq != 0:
@@ -130,27 +156,48 @@ class StatsListener(TrainingListener):
             iteration=iteration, timestamp=time.time(),
             score=float(score), duration_ms=duration,
             samples_per_sec=(batch_size * 1000.0 / duration
-                             if duration > 0 else 0.0))
-        flat_now = []
+                             if duration > 0 else 0.0),
+            learning_rate=self._current_lr(model, iteration))
+        now_params: Dict[str, np.ndarray] = {}
+        per_layer: Dict[str, list] = {}     # layer -> [(name, flat)]
         for i, layer_params in enumerate(self._iter_params(model)):
             for k, p in layer_params.items():
                 arr = np.asarray(p)
                 name = f"{i}_{k}"
+                now_params[name] = arr.ravel()
+                per_layer.setdefault(str(i), []).append(
+                    (name, now_params[name]))
                 report.param_mean_magnitudes[name] = float(
                     np.mean(np.abs(arr)))
                 if self.collect_histograms:
                     report.histograms[f"param/{name}"] = _histogram(arr)
-                flat_now.append(arr.ravel())
-        if flat_now:
-            fp = np.concatenate(flat_now)
+        if now_params:
             if self._prev_params is not None and \
-                    fp.shape == self._prev_params.shape:
-                upd = fp - self._prev_params
-                report.update_mean_magnitudes["all"] = float(
-                    np.mean(np.abs(upd)))
-                if self.collect_histograms:
-                    report.histograms["update/all"] = _histogram(upd)
-            self._prev_params = fp
+                    set(now_params) == set(self._prev_params):
+                all_upd = []
+                for layer, entries in per_layer.items():
+                    # skip the whole layer if ANY param changed shape
+                    # (e.g. transfer-learning surgery) — a partial
+                    # ratio would mislead
+                    if any(self._prev_params[n].shape != a.shape
+                           for n, a in entries):
+                        continue
+                    u = np.concatenate(
+                        [a - self._prev_params[n] for n, a in entries])
+                    p = np.concatenate([a for _, a in entries])
+                    mu, mp = np.mean(np.abs(u)), np.mean(np.abs(p))
+                    report.update_mean_magnitudes[layer] = float(mu)
+                    # update:param ratio per layer (TrainModule)
+                    report.update_ratios[layer] = float(
+                        mu / mp) if mp > 0 else 0.0
+                    all_upd.append(u)
+                if all_upd:
+                    u = np.concatenate(all_upd)
+                    report.update_mean_magnitudes["all"] = float(
+                        np.mean(np.abs(u)))
+                    if self.collect_histograms:
+                        report.histograms["update/all"] = _histogram(u)
+            self._prev_params = now_params
         self.storage.put_update(report)
 
     @staticmethod

@@ -4,8 +4,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["tree_copy"]
+__all__ = ["tree_copy", "tree_flat_vector", "tree_from_flat_vector"]
 
 
 def tree_copy(tree):
@@ -13,3 +14,25 @@ def tree_copy(tree):
     steps donate their param/state buffers, so an aliasing 'copy' would
     be deleted by the next fit() on either network."""
     return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+def tree_flat_vector(tree) -> np.ndarray:
+    """Concatenate all leaves into one flat host vector (the reference's
+    flat params view; shared by both executors' params_flat)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return np.zeros((0,))
+    return np.concatenate([np.asarray(l).ravel() for l in leaves])
+
+
+def tree_from_flat_vector(tree, flat):
+    """Inverse of tree_flat_vector: rebuild a tree with the template's
+    structure/shapes/dtypes from a flat vector."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out, off = [], 0
+    for l in leaves:
+        n = int(l.size)
+        out.append(jnp.asarray(flat[off:off + n],
+                               l.dtype).reshape(l.shape))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -76,13 +76,44 @@ class ZooModel:
                          "deeplearning4j_tpu", "zoo"))
         return os.path.join(base, f"{self.name}.zip")
 
-    def init_pretrained(self):
+    def init_pretrained(self, checksum: Optional[str] = None):
+        """Load cached pretrained weights, verifying integrity first —
+        the reference downloads then checks a checksum and deletes the
+        corrupt file (zoo/ZooModel.java:40-75). The expected sha256
+        comes from (in order) the ``checksum`` argument, a
+        ``<name>.zip.sha256`` sidecar next to the artifact, or the
+        class attribute ``pretrained_checksum``. With none of those,
+        the file loads unverified (a warning is logged)."""
         path = self.pretrained_path()
         if not os.path.exists(path):
             raise FileNotFoundError(
                 f"No pretrained weights for {self.name}: expected {path} "
                 f"(this environment has no network egress; place the "
                 f"checkpoint there manually)")
+        expected = checksum or getattr(self, "pretrained_checksum", None)
+        sidecar = path + ".sha256"
+        if expected is None and os.path.exists(sidecar):
+            with open(sidecar) as f:
+                parts = f.read().split()
+            if not parts:
+                raise IOError(f"Malformed checksum sidecar {sidecar}: "
+                              f"empty file")
+            expected = parts[0].strip()
+        if expected:
+            import hashlib
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            actual = h.hexdigest()
+            if actual != expected:
+                raise IOError(
+                    f"Checksum mismatch for {path}: expected {expected}, "
+                    f"got {actual} — corrupt or stale artifact; delete "
+                    f"it and re-fetch")
+        else:
+            logger.warning("loading %s without checksum verification "
+                           "(no sidecar %s)", path, sidecar)
         from deeplearning4j_tpu.util.model_serializer import restore_model
         return restore_model(path)
 
@@ -365,65 +396,202 @@ class GoogLeNet(ZooModel):
 
 
 class InceptionResNetV1(ZooModel):
-    """(zoo/model/InceptionResNetV1.java) — compact faithful variant:
-    stem + residual inception-A/B blocks with scaled residual adds."""
+    """(zoo/model/InceptionResNetV1.java:104-316 + helper/
+    InceptionResNetHelper.java) — FULL architecture: 7-conv stem,
+    5x Inception-ResNet-A (scale 0.17), Reduction-A, 10x B (scale
+    0.10), Reduction-B, 5x C (scale 0.20), then the reference head
+    (128-d bottleneck -> L2-normalized embeddings -> center-loss
+    softmax, InceptionResNetV1.java:77-92). Deviations from the
+    reference, chosen deliberately: conv->BN->activation ordering
+    (the reference's global RELU applies activations both on convs and
+    BNs — a double-activation quirk of that snapshot), block output
+    activation kept ReLU (reference uses TANH there, another snapshot
+    quirk), and global average pooling before the bottleneck instead
+    of flattening the 2x2 spatial grid (TPU-friendly; head width 1344
+    vs reference 5376)."""
 
     name = "inception_resnet_v1"
 
     def default_input_shape(self):
         return (160, 160, 3)
 
-    def _block_a(self, g, name, inp, scale=0.17):
+    def _residual_block(self, g, name, inp, branches, up_channels,
+                        up_kernel, scale):
+        """Shared A/B/C skeleton (InceptionResNetHelper: branch convs
+        -> merge -> up-conv -> ScaleVertex -> residual add ->
+        activation)."""
         from deeplearning4j_tpu.nn.conf.graph import ScaleVertex
-        b0 = _conv_bn(g, f"{name}_b0", inp, 32, kernel=(1, 1))
-        b1 = _conv_bn(g, f"{name}_b1a", inp, 32, kernel=(1, 1))
-        b1 = _conv_bn(g, f"{name}_b1b", b1, 32, kernel=(3, 3))
-        b2 = _conv_bn(g, f"{name}_b2a", inp, 32, kernel=(1, 1))
-        b2 = _conv_bn(g, f"{name}_b2b", b2, 32, kernel=(3, 3))
-        b2 = _conv_bn(g, f"{name}_b2c", b2, 32, kernel=(3, 3))
-        g.add_vertex(f"{name}_cat", MergeVertex(), b0, b1, b2)
-        up = _conv_bn(g, f"{name}_up", f"{name}_cat", 256, kernel=(1, 1),
-                      activation="identity")
+        ends = []
+        for bi, branch in enumerate(branches):
+            last = inp
+            for li, (n_out, kernel) in enumerate(branch):
+                last = _conv_bn(g, f"{name}_b{bi}_{li}", last, n_out,
+                                kernel=kernel)
+            ends.append(last)
+        g.add_vertex(f"{name}_cat", MergeVertex(), *ends)
+        up = _conv_bn(g, f"{name}_up", f"{name}_cat", up_channels,
+                      kernel=up_kernel, activation="identity")
         g.add_vertex(f"{name}_scale", ScaleVertex(scale=scale), up)
         g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp,
                      f"{name}_scale")
-        g.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+        g.add_layer(f"{name}_act", ActivationLayer(activation="relu"),
                     f"{name}_add")
-        return f"{name}_relu"
+        return f"{name}_act"
+
+    def _block_a(self, g, name, inp):
+        # 1x1->32 | 1x1->32,3x3->32 | 1x1->32,3x3->32,3x3->32; up 3x3->192
+        return self._residual_block(
+            g, name, inp,
+            [[(32, (1, 1))],
+             [(32, (1, 1)), (32, (3, 3))],
+             [(32, (1, 1)), (32, (3, 3)), (32, (3, 3))]],
+            192, (3, 3), 0.17)
+
+    def _block_b(self, g, name, inp):
+        # 1x1->128 | 1x1->128,1x3->128,3x1->128; up 1x1->576
+        return self._residual_block(
+            g, name, inp,
+            [[(128, (1, 1))],
+             [(128, (1, 1)), (128, (1, 3)), (128, (3, 1))]],
+            576, (1, 1), 0.10)
+
+    def _block_c(self, g, name, inp):
+        # 1x1->192 | 1x1->192,1x3->192,3x1->192; up 1x1->1344
+        return self._residual_block(
+            g, name, inp,
+            [[(192, (1, 1))],
+             [(192, (1, 1)), (192, (1, 3)), (192, (3, 1))]],
+            1344, (1, 1), 0.20)
 
     def conf(self):
+        from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer
+        from deeplearning4j_tpu.nn.conf.graph import L2NormalizeVertex
         h, w, c = self.input_shape
         g = (self._builder().graph_builder()
              .add_inputs("in")
              .set_input_types(InputType.convolutional(h, w, c)))
-        last = _conv_bn(g, "s1", "in", 32, kernel=(3, 3), stride=(2, 2))
-        last = _conv_bn(g, "s2", last, 64, kernel=(3, 3))
-        g.add_layer("sp", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
-                                           convolution_mode="same"), last)
-        last = _conv_bn(g, "s3", "sp", 128, kernel=(3, 3))
-        last = _conv_bn(g, "s4", last, 256, kernel=(3, 3), stride=(2, 2))
-        for i in range(3):
-            last = self._block_a(g, f"a{i}", last)
+        # stem (InceptionResNetV1.java:114-166); 'truncate' = the
+        # reference's default ConvolutionMode for this model
+        last = _conv_bn(g, "s1", "in", 32, kernel=(3, 3), stride=(2, 2),
+                        mode="truncate")
+        last = _conv_bn(g, "s2", last, 32, kernel=(3, 3), mode="truncate")
+        last = _conv_bn(g, "s3", last, 64, kernel=(3, 3), mode="same")
+        g.add_layer("s_pool", SubsamplingLayer(kernel=(3, 3),
+                                               stride=(2, 2)), last)
+        last = _conv_bn(g, "s5", "s_pool", 80, kernel=(1, 1),
+                        mode="truncate")
+        last = _conv_bn(g, "s6", last, 128, kernel=(3, 3),
+                        mode="truncate")
+        last = _conv_bn(g, "s7", last, 192, kernel=(3, 3), stride=(2, 2),
+                        mode="truncate")
+        # 5x Inception-ResNet-A (InceptionResNetV1.java:169)
+        for i in range(5):
+            last = self._block_a(g, f"a{i + 1}", last)
+        # Reduction-A (:173-221): 3x3s2->192 | 1x1->128,3x3->128,
+        # 3x3s2->192 | maxpool3x3s2  => 576 channels
+        ra0 = _conv_bn(g, "rA_c1", last, 192, kernel=(3, 3),
+                       stride=(2, 2), mode="truncate")
+        ra1 = _conv_bn(g, "rA_c2", last, 128, kernel=(1, 1))
+        ra1 = _conv_bn(g, "rA_c3", ra1, 128, kernel=(3, 3))
+        ra1 = _conv_bn(g, "rA_c4", ra1, 192, kernel=(3, 3),
+                       stride=(2, 2), mode="truncate")
+        g.add_layer("rA_pool", SubsamplingLayer(kernel=(3, 3),
+                                                stride=(2, 2)), last)
+        g.add_vertex("reduceA", MergeVertex(), ra0, ra1, "rA_pool")
+        last = "reduceA"
+        # 10x Inception-ResNet-B (:222)
+        for i in range(10):
+            last = self._block_b(g, f"b{i + 1}", last)
+        # Reduction-B (:226-300): maxpool | 1x1->256,3x3s2->256 |
+        # 1x1->256,3x3s2->256 | 1x1->256,3x3->256,3x3s2->256  => 1344
+        g.add_layer("rB_pool", SubsamplingLayer(kernel=(3, 3),
+                                                stride=(2, 2)), last)
+        rb1 = _conv_bn(g, "rB_c2", last, 256, kernel=(1, 1))
+        rb1 = _conv_bn(g, "rB_c3", rb1, 256, kernel=(3, 3),
+                       stride=(2, 2), mode="truncate")
+        rb2 = _conv_bn(g, "rB_c4", last, 256, kernel=(1, 1))
+        rb2 = _conv_bn(g, "rB_c5", rb2, 256, kernel=(3, 3),
+                       stride=(2, 2), mode="truncate")
+        rb3 = _conv_bn(g, "rB_c6", last, 256, kernel=(1, 1))
+        rb3 = _conv_bn(g, "rB_c7", rb3, 256, kernel=(3, 3))
+        rb3 = _conv_bn(g, "rB_c8", rb3, 256, kernel=(3, 3),
+                       stride=(2, 2), mode="truncate")
+        g.add_vertex("reduceB", MergeVertex(), "rB_pool", rb1, rb2, rb3)
+        last = "reduceB"
+        # 5x Inception-ResNet-C (:304)
+        for i in range(5):
+            last = self._block_c(g, f"c{i + 1}", last)
+        # head (:77-92)
         g.add_layer("avgpool", GlobalPoolingLayer(pooling=PoolingType.AVG),
                     last)
         g.add_layer("bottleneck", DenseLayer(n_out=128,
                                              activation="identity"),
                     "avgpool")
-        g.add_layer("out", OutputLayer(n_out=self.n_classes,
-                                       loss="mcxent"), "bottleneck")
+        g.add_vertex("embeddings", L2NormalizeVertex(eps=1e-10),
+                     "bottleneck")
+        g.add_layer("out", CenterLossOutputLayer(
+            n_out=self.n_classes, loss="mcxent", alpha=0.9,
+            lambda_=1e-4), "embeddings")
         g.set_outputs("out")
         return g.build()
 
 
 class FaceNetNN4Small2(ZooModel):
-    """(zoo/model/FaceNetNN4Small2.java) — embedding net ending in an
-    L2-normalized 128-d bottleneck; center-loss output as in the
-    reference."""
+    """(zoo/model/FaceNetNN4Small2.java:80-341 + helper/
+    FaceNetHelper.java:148-244) — FULL NN4.small2 inception stack:
+    7x7 stem + LRN, inception-2, modules 3a/3b (4-branch), 3c
+    (stride-2, 3-branch), 4a, 4e (stride-2), 5a (pnorm pool), 5b (max
+    pool), then 128-d bottleneck -> L2-normalized embeddings ->
+    center-loss SQUARED_LOSS softmax head. Deviation: global average
+    pooling before the bottleneck instead of the reference's 3x3s3
+    avg-pool + flatten (head width 736 vs 2944) — TPU-friendly and
+    spatial-size-agnostic."""
 
     name = "facenet_nn4_small2"
 
     def default_input_shape(self):
         return (96, 96, 3)
+
+    def _inception(self, g, name, inp, kernels, outputs, reduces,
+                   pool_type, pool_pnorm=2):
+        """FaceNetHelper.appendGraph (:148-244): per-kernel
+        1x1-reduce -> NxN conv branches, then optional pool->1x1
+        branch (reduces[len(kernels)]) and optional bare 1x1 branch
+        (reduces[len(kernels)+1])."""
+        ends = []
+        for i, (k, n_out, red) in enumerate(zip(kernels, outputs,
+                                                reduces)):
+            b = _conv_bn(g, f"{name}_r{i}", inp, red, kernel=(1, 1))
+            b = _conv_bn(g, f"{name}_k{i}", b, n_out, kernel=(k, k))
+            ends.append(b)
+        idx = len(kernels)
+        if len(reduces) > idx:
+            g.add_layer(f"{name}_pool",
+                        SubsamplingLayer(pooling=pool_type, kernel=(3, 3),
+                                         stride=(1, 1), pnorm=pool_pnorm,
+                                         convolution_mode="same"), inp)
+            ends.append(_conv_bn(g, f"{name}_poolr", f"{name}_pool",
+                                 reduces[idx], kernel=(1, 1)))
+        if len(reduces) > idx + 1:
+            ends.append(_conv_bn(g, f"{name}_1x1", inp, reduces[idx + 1],
+                                 kernel=(1, 1)))
+        g.add_vertex(name, MergeVertex(), *ends)
+        return name
+
+    def _reduction(self, g, name, inp, reduce1, out1, reduce2, out2):
+        """The 3c/4e stride-2 modules (FaceNetNN4Small2.java:148-232):
+        1x1->3x3s2 | 1x1->5x5s2 | maxpool3x3s2."""
+        b0 = _conv_bn(g, f"{name}_r0", inp, reduce1, kernel=(1, 1))
+        b0 = _conv_bn(g, f"{name}_k0", b0, out1, kernel=(3, 3),
+                      stride=(2, 2))
+        b1 = _conv_bn(g, f"{name}_r1", inp, reduce2, kernel=(1, 1))
+        b1 = _conv_bn(g, f"{name}_k1", b1, out2, kernel=(5, 5),
+                      stride=(2, 2))
+        g.add_layer(f"{name}_pool",
+                    SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                     convolution_mode="same"), inp)
+        g.add_vertex(name, MergeVertex(), b0, b1, f"{name}_pool")
+        return name
 
     def conf(self):
         from deeplearning4j_tpu.nn.conf.graph import L2NormalizeVertex
@@ -432,22 +600,51 @@ class FaceNetNN4Small2(ZooModel):
         g = (self._builder().graph_builder()
              .add_inputs("in")
              .set_input_types(InputType.convolutional(h, w, c)))
-        last = _conv_bn(g, "c1", "in", 64, kernel=(7, 7), stride=(2, 2))
-        g.add_layer("p1", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
-                                           convolution_mode="same"), last)
-        last = _conv_bn(g, "c2", "p1", 64, kernel=(1, 1))
-        last = _conv_bn(g, "c3", last, 192, kernel=(3, 3))
-        g.add_layer("p2", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
-                                           convolution_mode="same"), last)
-        last = _conv_bn(g, "c4", "p2", 256, kernel=(3, 3), stride=(2, 2))
-        last = _conv_bn(g, "c5", last, 512, kernel=(3, 3), stride=(2, 2))
+        # stem (:85-103): 7x7s2 conv + BN + relu, maxpool, LRN
+        last = _conv_bn(g, "stem_c1", "in", 64, kernel=(7, 7),
+                        stride=(2, 2))
+        g.add_layer("stem_pool", SubsamplingLayer(
+            kernel=(3, 3), stride=(2, 2), padding=(1, 1)), last)
+        g.add_layer("stem_lrn", LocalResponseNormalization(
+            k=1, n=5, alpha=1e-4, beta=0.75), "stem_pool")
+        # inception-2 (:105-133): 1x1->64, 3x3->192, LRN, maxpool
+        last = _conv_bn(g, "i2_c1", "stem_lrn", 64, kernel=(1, 1))
+        last = _conv_bn(g, "i2_c2", last, 192, kernel=(3, 3))
+        g.add_layer("i2_lrn", LocalResponseNormalization(
+            k=1, n=5, alpha=1e-4, beta=0.75), last)
+        g.add_layer("i2_pool", SubsamplingLayer(
+            kernel=(3, 3), stride=(2, 2), padding=(1, 1)), "i2_lrn")
+        # 3a (:136): 192 -> [3x3:96->128, 5x5:16->32, maxpool->32,
+        # 1x1->64] = 256
+        last = self._inception(g, "i3a", "i2_pool", [3, 5], [128, 32],
+                               [96, 16, 32, 64], PoolingType.MAX)
+        # 3b (:140): 256 -> [128, 64, 64, 64] = 320, pnorm pool
+        last = self._inception(g, "i3b", last, [3, 5], [128, 64],
+                               [96, 32, 64, 64], PoolingType.PNORM)
+        # 3c (:148-184): stride-2 reduction -> 256+64+320 = 640
+        last = self._reduction(g, "i3c", last, 128, 256, 32, 64)
+        # 4a (:187): 640 -> [192, 64, 128, 256] = 640, pnorm pool
+        last = self._inception(g, "i4a", last, [3, 5], [192, 64],
+                               [96, 32, 128, 256], PoolingType.PNORM)
+        # 4e (:196-232): stride-2 reduction -> 256+128+640 = 1024
+        last = self._reduction(g, "i4e", last, 160, 256, 64, 128)
+        # 5a (:239-276): [1x1->256, 3x3:96->384, pnorm-pool->96] = 736
+        last = self._inception(g, "i5a", last, [3], [384], [96, 96, 256],
+                               PoolingType.PNORM)
+        # 5b (:283-322): same shape with max pool = 736
+        last = self._inception(g, "i5b", last, [3], [384], [96, 96, 256],
+                               PoolingType.MAX)
+        # head (:324-338)
         g.add_layer("avgpool", GlobalPoolingLayer(pooling=PoolingType.AVG),
                     last)
-        g.add_layer("embed", DenseLayer(n_out=128, activation="identity"),
+        g.add_layer("bottleneck", DenseLayer(n_out=128,
+                                             activation="identity"),
                     "avgpool")
-        g.add_vertex("l2norm", L2NormalizeVertex(), "embed")
-        g.add_layer("out", CenterLossOutputLayer(n_out=self.n_classes,
-                                                 loss="mcxent"), "l2norm")
+        g.add_vertex("embeddings", L2NormalizeVertex(eps=1e-6),
+                     "bottleneck")
+        g.add_layer("out", CenterLossOutputLayer(
+            n_out=self.n_classes, loss="squared_loss", alpha=0.9,
+            lambda_=1e-4), "embeddings")
         g.set_outputs("out")
         return g.build()
 

@@ -202,3 +202,144 @@ class TestImportErrors:
             f.create_dataset("x", data=np.zeros(3))
         with pytest.raises(KerasImportError, match="model_config"):
             import_keras_model_and_weights(p)
+
+
+class TestKeras1LegacyImport:
+    """Keras 1.x legacy configs (reference
+    config/Keras1LayerConfiguration.java field tables): hand-written
+    h5 files in Keras-1 layout (bare-list Sequential config,
+    output_dim/nb_filter/border_mode/subsample/inner_activation
+    fields, 12-array per-gate LSTM weights) must import and produce
+    the SAME outputs as the equivalent modern-Keras model."""
+
+    def _write_k1(self, path, model_cfg, layer_weights):
+        """layer_weights: {layer_name: [arrays]} written in Keras-1
+        h5 layout (model_weights/<name> + weight_names attr)."""
+        import json
+
+        import h5py
+        with h5py.File(path, "w") as f:
+            f.attrs["model_config"] = json.dumps(model_cfg)
+            f.attrs["keras_version"] = "1.2.2"
+            mw = f.create_group("model_weights")
+            for lname, arrays in layer_weights.items():
+                grp = mw.create_group(lname)
+                names = []
+                for i, arr in enumerate(arrays):
+                    n = f"{lname}_param_{i}"
+                    grp.create_dataset(n, data=arr)
+                    names.append(n.encode())
+                grp.attrs["weight_names"] = names
+
+    def test_mlp_keras1_matches_keras2(self, tmp_path, rng):
+        from keras import layers
+        m2 = keras.Sequential([
+            keras.Input((4,)),
+            layers.Dense(8, activation="relu", name="d1"),
+            layers.Dense(3, activation="softmax", name="d2")])
+        x = rng.normal(0, 1, (6, 4)).astype(np.float32)
+        ref = np.asarray(m2.predict(x, verbose=0))
+
+        W1, b1 = m2.get_layer("d1").get_weights()
+        W2, b2 = m2.get_layer("d2").get_weights()
+        cfg1 = {"class_name": "Sequential", "config": [
+            {"class_name": "Dense", "config": {
+                "name": "d1", "output_dim": 8, "activation": "relu",
+                "batch_input_shape": [None, 4],
+                "init": "glorot_uniform", "bias": True}},
+            {"class_name": "Dropout", "config": {
+                "name": "drop", "p": 0.25}},
+            {"class_name": "Dense", "config": {
+                "name": "d2", "output_dim": 3,
+                "activation": "softmax", "init": "glorot_uniform",
+                "bias": True}},
+        ]}
+        p1 = os.path.join(tmp_path, "k1_mlp.h5")
+        self._write_k1(p1, cfg1, {"d1": [W1, b1], "d2": [W2, b2]})
+        ours = import_keras_model_and_weights(p1)
+        got = np.asarray(ours.output(x))
+        np.testing.assert_allclose(got, ref, rtol=RTOL, atol=ATOL)
+
+    def test_cnn_keras1_matches_keras2(self, tmp_path, rng):
+        from keras import layers
+        m2 = keras.Sequential([
+            keras.Input((12, 12, 3)),
+            layers.Conv2D(4, 3, padding="valid", activation="relu",
+                          name="c1"),
+            layers.MaxPooling2D(2, 2, name="p1"),
+            layers.Flatten(name="fl"),
+            layers.Dense(5, activation="softmax", name="d1")])
+        x = rng.normal(0, 1, (3, 12, 12, 3)).astype(np.float32)
+        ref = np.asarray(m2.predict(x, verbose=0))
+
+        Wc, bc = m2.get_layer("c1").get_weights()
+        Wd, bd = m2.get_layer("d1").get_weights()
+        cfg1 = {"class_name": "Sequential", "config": [
+            {"class_name": "Convolution2D", "config": {
+                "name": "c1", "nb_filter": 4, "nb_row": 3, "nb_col": 3,
+                "border_mode": "valid", "subsample": [1, 1],
+                "dim_ordering": "tf", "activation": "relu",
+                "batch_input_shape": [None, 12, 12, 3], "bias": True}},
+            {"class_name": "MaxPooling2D", "config": {
+                "name": "p1", "pool_size": [2, 2], "strides": [2, 2],
+                "border_mode": "valid", "dim_ordering": "tf"}},
+            {"class_name": "Flatten", "config": {"name": "fl"}},
+            {"class_name": "Dense", "config": {
+                "name": "d1", "output_dim": 5,
+                "activation": "softmax", "bias": True}},
+        ]}
+        p1 = os.path.join(tmp_path, "k1_cnn.h5")
+        self._write_k1(p1, cfg1, {"c1": [Wc, bc], "d1": [Wd, bd]})
+        ours = import_keras_model_and_weights(p1)
+        got = np.asarray(ours.output(x))
+        np.testing.assert_allclose(got, ref, rtol=RTOL, atol=ATOL)
+
+    def test_lstm_keras1_per_gate_weights(self, tmp_path, rng):
+        from keras import layers
+        m2 = keras.Sequential([
+            keras.Input((5, 4)),
+            layers.LSTM(6, activation="tanh",
+                        recurrent_activation="sigmoid",
+                        return_sequences=False, name="l1"),
+            layers.Dense(3, activation="softmax", name="d1")])
+        x = rng.normal(0, 1, (4, 5, 4)).astype(np.float32)
+        ref = np.asarray(m2.predict(x, verbose=0))
+
+        kernel, recurrent, bias = m2.get_layer("l1").get_weights()
+        Wd, bd = m2.get_layer("d1").get_weights()
+        u = 6
+        # split keras-2 packed [i,f,c,o] into keras-1 per-gate arrays
+        # ordered [W_i,U_i,b_i, W_c,U_c,b_c, W_f,U_f,b_f, W_o,U_o,b_o]
+        sl = {g: slice(i * u, (i + 1) * u)
+              for i, g in enumerate("ifco")}
+        per_gate = []
+        for g in "icfo":
+            per_gate += [kernel[:, sl[g]], recurrent[:, sl[g]],
+                         bias[sl[g]]]
+        cfg1 = {"class_name": "Sequential", "config": [
+            {"class_name": "LSTM", "config": {
+                "name": "l1", "output_dim": 6, "activation": "tanh",
+                "inner_activation": "sigmoid",
+                "return_sequences": False,
+                "batch_input_shape": [None, 5, 4]}},
+            {"class_name": "Dense", "config": {
+                "name": "d1", "output_dim": 3,
+                "activation": "softmax", "bias": True}},
+        ]}
+        p1 = os.path.join(tmp_path, "k1_lstm.h5")
+        self._write_k1(p1, cfg1, {"l1": per_gate, "d1": [Wd, bd]})
+        ours = import_keras_model_and_weights(p1)
+        got = np.asarray(ours.output(x))
+        np.testing.assert_allclose(got, ref, rtol=RTOL, atol=ATOL)
+
+    def test_keras1_th_ordering_rejected(self, tmp_path):
+        cfg1 = {"class_name": "Sequential", "config": [
+            {"class_name": "Convolution2D", "config": {
+                "name": "c1", "nb_filter": 4, "nb_row": 3, "nb_col": 3,
+                "border_mode": "valid", "dim_ordering": "th",
+                "batch_input_shape": [None, 3, 12, 12]}},
+        ]}
+        p1 = os.path.join(tmp_path, "k1_th.h5")
+        self._write_k1(p1, cfg1, {})
+        with pytest.raises(KerasImportError, match="th"):
+            import_keras_model_and_weights(p1)

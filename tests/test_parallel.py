@@ -288,6 +288,84 @@ class TestPipeline:
                                    rtol=1e-5, atol=1e-6)
 
 
+class TestSpmdPipeline:
+    """Device-resident shard_map + ppermute pipeline (pipeline_spmd):
+    must equal the single-device math exactly — and, unlike the GPipe
+    scheduler, the whole microbatch loop is one XLA program."""
+
+    def _setup(self, S=4, M=8, H=16, F=8, C=3):
+        import optax
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu.parallel.pipeline_spmd import SpmdPipeline
+
+        mesh = Mesh(np.array(jax.devices()[:S]), ("pipe",))
+
+        def stage_apply(p, h):
+            return jnp.tanh(h @ p["W"] + p["b"])
+
+        def embed_apply(p, x):
+            return jnp.tanh(x @ p["W"])
+
+        def head_loss(p, h, y):
+            logp = jax.nn.log_softmax(h @ p["W"] + p["b"])
+            return -jnp.mean(jnp.sum(y * logp, axis=-1))
+
+        key = jax.random.PRNGKey(0)
+        k1, k2, k3 = jax.random.split(key, 3)
+        stage_params = {"W": jax.random.normal(k1, (S, H, H)) * 0.3,
+                        "b": jnp.zeros((S, H))}
+        embed_params = {"W": jax.random.normal(k2, (F, H)) * 0.3}
+        head_params = {"W": jax.random.normal(k3, (H, C)) * 0.3,
+                       "b": jnp.zeros((C,))}
+        pipe = SpmdPipeline(mesh, stage_apply, embed_apply, head_loss,
+                            n_microbatches=M)
+        return (pipe, optax.sgd(0.2), stage_params, embed_params,
+                head_params, S, M, F, C)
+
+    def test_matches_single_device(self):
+        import optax
+        (pipe, tx, stage_params, embed_params, head_params,
+         S, M, F, C) = self._setup()
+        B = 32
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (B, F)).astype(np.float32)
+        y = np.eye(C, dtype=np.float32)[rng.integers(0, C, B)]
+
+        sp = pipe.shard_stage_params(stage_params)
+        ep = pipe.replicate(embed_params)
+        hp = pipe.replicate(head_params)
+        opt_s, opt_e, opt_h = pipe.init_opt_states(
+            tx, stage_params, embed_params, head_params)
+        step = pipe.make_train_step(tx)
+        xs, ys = pipe.microbatch(x, y)
+
+        def ref_loss(params):
+            sp0, ep0, hp0 = params
+            losses = []
+            per = B // M
+            for m in range(M):
+                h = jnp.tanh(jnp.asarray(x[m * per:(m + 1) * per])
+                             @ ep0["W"])
+                for s in range(S):
+                    h = jnp.tanh(h @ sp0["W"][s] + sp0["b"][s])
+                logp = jax.nn.log_softmax(h @ hp0["W"] + hp0["b"])
+                losses.append(-jnp.mean(jnp.sum(
+                    jnp.asarray(y[m * per:(m + 1) * per]) * logp,
+                    axis=-1)))
+            return jnp.mean(jnp.asarray(losses))
+
+        ref_params = (stage_params, embed_params, head_params)
+        ref_opt = tx.init(ref_params)
+        for it in range(10):
+            l_ref, g = jax.value_and_grad(ref_loss)(ref_params)
+            up, ref_opt = tx.update(g, ref_opt, ref_params)
+            ref_params = optax.apply_updates(ref_params, up)
+            (sp, ep, hp, opt_s, opt_e, opt_h,
+             l_pipe) = step(sp, ep, hp, opt_s, opt_e, opt_h, xs, ys)
+            np.testing.assert_allclose(float(l_pipe), float(l_ref),
+                                       rtol=1e-5, atol=1e-6)
+
+
 class TestParallelInference:
     def test_batched_inference_matches_direct(self):
         import threading

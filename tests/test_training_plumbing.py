@@ -246,3 +246,56 @@ class TestCenterLossGraph:
         centers1 = np.asarray(cg.state["out"]["centers"])
         assert np.abs(centers1 - centers0).max() > 1e-3
         assert cg.evaluate(DataSet(xs[120:], ys[120:])).accuracy() > 0.75
+
+
+class TestSecondOrderOptimizers:
+    """OptimizationAlgorithm parity (reference nn/api/
+    OptimizationAlgorithm.java:26 + BackTrackLineSearch): LBFGS, CG,
+    and line gradient descent must all fit iris to high accuracy."""
+
+    def _net(self):
+        # small L2 keeps the full-batch optimizers out of sharp
+        # overfit minima (the reference pairs these with regularization)
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.sgd(0.1)).l2(1e-3).list()
+                .layer(DenseLayer(n_out=12, activation="tanh"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    @pytest.mark.parametrize("algo", ["lbfgs", "conjugate_gradient",
+                                      "line_gradient_descent"])
+    def test_fits_iris(self, algo):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.train.second_order import optimize
+        xs, ys = iris_data()
+        net = self._net()
+        hist = optimize(net, DataSet(xs[:120], ys[:120]),
+                        algorithm=algo, iterations=150)
+        assert hist[-1] < hist[0] * 0.5, hist[:3] + hist[-3:]
+        floor = 0.75 if algo == "line_gradient_descent" else 0.85
+        assert net.evaluate(xs[120:], ys[120:]).accuracy() > floor
+
+    def test_lbfgs_on_graph(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.train.second_order import optimize
+        xs, ys = iris_data()
+        g = (NeuralNetConfiguration.builder().set_seed(0)
+             .updater(updaters.sgd(0.1)).l2(1e-3).graph_builder()
+             .add_inputs("in")
+             .add_layer("h", DenseLayer(n_out=12, activation="tanh"),
+                        "in")
+             .add_layer("out", OutputLayer(n_out=3), "h")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(4)).build())
+        cg = ComputationGraph(g).init()
+        optimize(cg, DataSet(xs[:120], ys[:120]), algorithm="lbfgs",
+                 iterations=150)
+        assert cg.evaluate(DataSet(xs[120:], ys[120:])).accuracy() > 0.85
+
+    def test_unknown_algorithm_raises(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.train.second_order import optimize
+        xs, ys = iris_data()
+        with pytest.raises(ValueError, match="newton"):
+            optimize(self._net(), DataSet(xs, ys), algorithm="newton")

@@ -113,3 +113,144 @@ class TestNearestNeighborsService:
                 client.knn([1.0, 2.0], k=3)     # wrong dim
         finally:
             server.stop()
+
+
+class TestTrainModuleDepth:
+    """VERDICT round-1 weak #9: per-layer update:param-ratio and LR
+    charts (reference TrainModule)."""
+
+    def test_update_ratios_and_lr_collected(self):
+        storage = InMemoryStatsStorage()
+        _fit_with_listener(storage, freq=1)
+        ups = storage.get_all_updates("s1")
+        assert len(ups) >= 4
+        latest = ups[-1]
+        assert latest.learning_rate == pytest.approx(0.05)
+        # both layers have a finite positive ratio
+        assert set(latest.update_ratios) == {"0", "1"}
+        for v in latest.update_ratios.values():
+            assert 0 < v < 1.0
+        # per-layer update magnitudes too
+        assert "0" in latest.update_mean_magnitudes
+        assert "all" in latest.update_mean_magnitudes
+
+    def test_scheduled_lr_reported(self):
+        from deeplearning4j_tpu.ui.stats import StatsListener
+        storage = InMemoryStatsStorage()
+        xs, ys = iris_data()
+        conf = (NeuralNetConfiguration.builder()
+                .updater(updaters.sgd(
+                    0.1, schedule={"type": "step", "decay_rate": 0.5,
+                                   "step": 2}))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.set_listeners(StatsListener(storage, frequency=1,
+                                        session_id="sched"))
+        net.fit(xs[:120], ys[:120], epochs=6, batch_size=120)
+        ups = storage.get_all_updates("sched")
+        lrs = [u.learning_rate for u in ups]
+        assert lrs[0] == pytest.approx(0.1)
+        assert lrs[-1] < lrs[0]          # schedule decayed
+
+
+class TestConvolutionalListener:
+    def test_activation_images_png(self):
+        import base64
+
+        from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                       SubsamplingLayer)
+        from deeplearning4j_tpu.ui.convolutional import (
+            ConvolutionalIterationListener, encode_png_gray,
+            tile_channels)
+        # png encoder sanity
+        img = np.arange(64, dtype=np.uint8).reshape(8, 8)
+        png = encode_png_gray(img)
+        assert png.startswith(b"\x89PNG")
+        tiled = tile_channels(np.random.default_rng(0)
+                              .normal(size=(6, 6, 5)).astype(np.float32))
+        assert tiled.dtype == np.uint8 and tiled.ndim == 2
+
+        rng = np.random.default_rng(0)
+        xs = rng.normal(0, 1, (16, 64)).astype(np.float32)
+        ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        conf = (NeuralNetConfiguration.builder()
+                .updater(updaters.adam(0.01)).list()
+                .layer(ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.convolutional_flat(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        storage = InMemoryStatsStorage()
+        net.set_listeners(ConvolutionalIterationListener(
+            storage, xs[:1], frequency=1, session_id="conv"))
+        net.fit(xs, ys, epochs=2, batch_size=16)
+        ups = storage.get_all_updates("conv")
+        assert ups, "no activation reports"
+        imgs = ups[-1].activation_images
+        assert imgs, "no conv images"
+        for b64 in imgs.values():
+            assert base64.b64decode(b64).startswith(b"\x89PNG")
+
+
+class TestTsneTab:
+    def test_tsne_endpoint_round_trip(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        srv = UIServer(port=0)
+        srv.start()
+        try:
+            pts = [[0.0, 1.0], [2.0, 3.0], [4.0, 5.0]]
+            body = json.dumps({"points": pts,
+                               "labels": [0, 1, 0]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/api/tsne", data=body,
+                headers={"Content-Type": "application/json"})
+            assert json.loads(urllib.request.urlopen(req).read())["ok"]
+            got = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/api/tsne").read())
+            assert got["points"] == pts
+            assert got["labels"] == [0, 1, 0]
+        finally:
+            srv.stop()
+
+    def test_upload_tsne_reduces_highdim(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        srv = UIServer(port=0)
+        rng = np.random.default_rng(0)
+        # two separated clusters in 10-d
+        a = rng.normal(0, 0.1, (20, 10)) + 5
+        b = rng.normal(0, 0.1, (20, 10)) - 5
+        srv.upload_tsne(np.vstack([a, b]).astype(np.float32),
+                        labels=[0] * 20 + [1] * 20)
+        pts = np.asarray(srv._tsne["points"])
+        assert pts.shape == (40, 2)
+        # clusters stay separated in the embedding
+        ca, cb = pts[:20].mean(0), pts[20:].mean(0)
+        spread = max(pts[:20].std(), pts[20:].std())
+        assert np.linalg.norm(ca - cb) > spread
+
+    def test_activations_endpoint(self):
+        import base64
+
+        from deeplearning4j_tpu.ui.convolutional import encode_png_gray
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.stats import StatsReport
+        srv = UIServer(port=0)
+        srv.start()
+        try:
+            png = base64.b64encode(encode_png_gray(
+                np.zeros((4, 4), np.uint8))).decode()
+            srv.storage.put_update(StatsReport(
+                session_id="s", worker_id="w", iteration=0,
+                timestamp=0.0, score=1.0,
+                activation_images={"layer_0": png}))
+            got = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/api/activations").read())
+            assert got == {"layer_0": png}
+        finally:
+            srv.stop()

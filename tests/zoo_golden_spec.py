@@ -1,0 +1,62 @@
+"""Shared spec for zoo golden forward-output fixtures.
+
+Each entry: (key, model factory kwargs, input shape). The generator
+(generate_zoo_goldens.py) instantiates every model with seed 123,
+feeds a deterministic input, and stores the outputs; the regression
+test re-runs the same forwards and compares — any unintentional
+architecture/init change shows up as a golden mismatch (the zoo analog
+of the reference's serialization regression tests, RegressionTest050).
+"""
+
+SEED = 0
+N = 2
+
+# key -> (class name, ctor kwargs, input shape)
+SPECS = {
+    "lenet": ("LeNet", {"n_classes": 7}, (28, 28, 1)),
+    "simplecnn": ("SimpleCNN", {"n_classes": 7,
+                                "input_shape": (32, 32, 3)}, (32, 32, 3)),
+    "alexnet": ("AlexNet", {"n_classes": 7,
+                            "input_shape": (96, 96, 3)}, (96, 96, 3)),
+    "vgg16": ("VGG16", {"n_classes": 7,
+                        "input_shape": (48, 48, 3)}, (48, 48, 3)),
+    "vgg19": ("VGG19", {"n_classes": 7,
+                        "input_shape": (48, 48, 3)}, (48, 48, 3)),
+    "resnet50": ("ResNet50", {"n_classes": 7,
+                              "input_shape": (64, 64, 3)}, (64, 64, 3)),
+    "googlenet": ("GoogLeNet", {"n_classes": 7,
+                                "input_shape": (64, 64, 3)}, (64, 64, 3)),
+    "inception_resnet_v1": ("InceptionResNetV1",
+                            {"n_classes": 7,
+                             "input_shape": (96, 96, 3)}, (96, 96, 3)),
+    "facenet_nn4_small2": ("FaceNetNN4Small2",
+                           {"n_classes": 7,
+                            "input_shape": (64, 64, 3)}, (64, 64, 3)),
+    "textgen_lstm": ("TextGenerationLSTM",
+                     {"vocab_size": 30, "max_length": 16}, None),
+    "tinyyolo": ("TinyYOLO", {"n_classes": 4,
+                              "input_shape": (64, 64, 3)}, (64, 64, 3)),
+    "darknet19": ("Darknet19", {"n_classes": 7,
+                                "input_shape": (64, 64, 3)}, (64, 64, 3)),
+    "unet": ("UNet", {"n_classes": 1,
+                      "input_shape": (32, 32, 3)}, (32, 32, 3)),
+}
+
+
+def make_input(key, shape):
+    import numpy as np
+    rng = np.random.default_rng(SEED)
+    if key == "textgen_lstm":
+        ids = rng.integers(0, 30, (N, 16))
+        return np.eye(30, dtype=np.float32)[ids]
+    return rng.normal(0, 1, (N,) + tuple(shape)).astype(np.float32)
+
+
+def run_forward(key):
+    import numpy as np
+
+    from deeplearning4j_tpu import zoo
+    cls_name, kwargs, shape = SPECS[key]
+    model = getattr(zoo, cls_name)(**kwargs).init()
+    x = make_input(key, shape)
+    return np.asarray(model.output(x))
