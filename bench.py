@@ -127,11 +127,14 @@ def bench_ours(batch=BATCH, img=IMG, steps=STEPS, prep=False):
     return steps * batch / m()
 
 
-def bench_flax_resnet50(batch=BATCH, img=IMG, steps=STEPS, prep=False):
+def bench_flax_resnet50(batch=BATCH, img=IMG, steps=STEPS, prep=False,
+                        dtype=None):
     import jax
     import jax.numpy as jnp
     import optax
     from flax import linen as nn
+
+    dt = dtype or jnp.float32
 
     class Bottleneck(nn.Module):
         mid: int
@@ -143,16 +146,16 @@ def bench_flax_resnet50(batch=BATCH, img=IMG, steps=STEPS, prep=False):
         def __call__(self, x, train=True):
             r = x
             y = nn.Conv(self.mid, (1, 1), (self.stride, self.stride),
-                        use_bias=False)(x)
+                        use_bias=False, dtype=dt)(x)
             y = nn.relu(nn.BatchNorm(use_running_average=not train)(y))
             y = nn.Conv(self.mid, (3, 3), padding="SAME",
-                        use_bias=False)(y)
+                        use_bias=False, dtype=dt)(y)
             y = nn.relu(nn.BatchNorm(use_running_average=not train)(y))
-            y = nn.Conv(self.out, (1, 1), use_bias=False)(y)
+            y = nn.Conv(self.out, (1, 1), use_bias=False, dtype=dt)(y)
             y = nn.BatchNorm(use_running_average=not train)(y)
             if self.project:
                 r = nn.Conv(self.out, (1, 1), (self.stride, self.stride),
-                            use_bias=False)(x)
+                            use_bias=False, dtype=dt)(x)
                 r = nn.BatchNorm(use_running_average=not train)(r)
             return nn.relu(y + r)
 
@@ -160,7 +163,7 @@ def bench_flax_resnet50(batch=BATCH, img=IMG, steps=STEPS, prep=False):
         @nn.compact
         def __call__(self, x, train=True):
             x = nn.Conv(64, (7, 7), (2, 2), padding="SAME",
-                        use_bias=False)(x)
+                        use_bias=False, dtype=dt)(x)
             x = nn.relu(nn.BatchNorm(use_running_average=not train)(x))
             x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
             for blocks, mid, out, stride in ((3, 64, 256, 1),
@@ -204,6 +207,13 @@ def bench_flax_resnet50(batch=BATCH, img=IMG, steps=STEPS, prep=False):
     if prep:
         return m
     return steps * batch / m()
+
+
+def bench_flax_resnet50_bf16(batch=BATCH, img=IMG, steps=STEPS,
+                             prep=False):
+    import jax.numpy as jnp
+    return bench_flax_resnet50(batch, img, steps, prep,
+                               dtype=jnp.bfloat16)
 
 
 # ---------------------------------------------------------------------------
@@ -554,6 +564,27 @@ def main():
     print(json.dumps(out), flush=True)
 
     if not headline_only:
+        # bf16 mixed precision (beyond-parity headroom): ours under the
+        # MXU-native policy vs the same flax model at bf16 compute
+        from deeplearning4j_tpu import dtypes
+        with dtypes.policy_scope(dtypes.tpu_bf16()):
+            m_ours = bench_ours(prep=True)
+        m_ref = bench_flax_resnet50_bf16(prep=True)
+        dt_o, dt_r = _interleave(m_ours, m_ref, repeats=2)
+        ours16 = STEPS * BATCH / dt_o
+        ref16 = STEPS * BATCH / dt_r
+        print(f"resnet50 bf16 ours: {ours16:.1f} img/s, flax bf16: "
+              f"{ref16:.1f}", file=sys.stderr)
+        detail["configs"].append({
+            "metric": ("ResNet50 train throughput bf16 compute (batch "
+                       "128, 224x224)"),
+            "value": round(ours16, 1), "unit": "images/sec/chip",
+            "baseline": round(ref16, 1),
+            "vs_baseline": round(ours16 / ref16, 3),
+            "vs_f32_self": round(ours16 / ours, 3),
+            "mfu": round(_mfu(RESNET50_FWD_FLOPS, ours16, True, peak),
+                         4) if peak else None})
+
         m_ours = bench_ours_lenet(prep=True)
         m_ref = bench_flax_lenet(prep=True)
         dt_o, dt_r = _interleave(m_ours, m_ref, repeats=3)

@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.ml.estimators import (NetworkEstimator,
+                                              NetworkModel)
+
+__all__ = ["NetworkEstimator", "NetworkModel"]

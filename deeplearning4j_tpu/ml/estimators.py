@@ -1,0 +1,150 @@
+"""Estimator-style ML pipeline wrappers.
+
+Mirrors dl4j-spark-ml's Spark ML integration (dl4j-spark-ml/src/main/
+spark-2/scala/.../SparkDl4jNetwork.scala: an Estimator whose ``fit``
+returns a Model with ``transform``/``predict``). Spark's DataFrame
+becomes plain arrays / DataSet; the mesh data-parallel trainer replaces
+Spark executors. The fit→model→transform contract (and sklearn-style
+get_params/set_params for grid searching) is what survives.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["NetworkEstimator", "NetworkModel"]
+
+
+class NetworkModel:
+    """Fitted model (SparkDl4jModel equivalent): transform/predict over
+    arrays."""
+
+    def __init__(self, network, normalizer=None):
+        self.network = network
+        self.normalizer = normalizer
+
+    def _prep(self, x):
+        x = np.asarray(x)
+        if self.normalizer is not None:
+            x = np.asarray(self.normalizer.transform_features(x))
+        return x
+
+    def transform(self, x) -> np.ndarray:
+        """Class-probability outputs (Spark ML transform adds a
+        probability column; here: the array)."""
+        out = self.network.output(self._prep(x))
+        if isinstance(out, tuple):
+            out = out[0]
+        return np.asarray(out)
+
+    def predict(self, x) -> np.ndarray:
+        """argmax class ids."""
+        return self.transform(x).argmax(axis=-1)
+
+    def score(self, x, y) -> float:
+        """Accuracy against one-hot or index labels."""
+        y = np.asarray(y)
+        if y.ndim > 1:
+            y = y.argmax(axis=-1)
+        return float((self.predict(x) == y).mean())
+
+    def save(self, path: str):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(self.network, path,
+                    normalizer=(self.normalizer.to_dict()
+                                if self.normalizer is not None else None))
+
+    @staticmethod
+    def load(path: str) -> "NetworkModel":
+        from deeplearning4j_tpu.util.model_serializer import (
+            restore_model, restore_normalizer)
+        return NetworkModel(restore_model(path),
+                            restore_normalizer(path))
+
+
+class NetworkEstimator:
+    """Unfitted estimator (SparkDl4jNetwork equivalent).
+
+    Parameters
+    ----------
+    conf_factory: zero-arg callable returning a fresh
+        MultiLayerConfiguration / ComputationGraphConfiguration (a new
+        config per fit, like the Scala wrapper re-broadcasting a fresh
+        net per run).
+    epochs / batch_size: training loop knobs.
+    normalize: fit a NormalizerStandardize on the training features.
+    mesh: optional jax Mesh — train data-parallel via ParallelWrapper
+        (the Spark-executors analog).
+    """
+
+    def __init__(self, conf_factory, *, epochs: int = 10,
+                 batch_size: Optional[int] = None,
+                 normalize: bool = False, mesh=None, seed: int = 0):
+        self.conf_factory = conf_factory
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.normalize = normalize
+        self.mesh = mesh
+        self.seed = seed
+
+    # sklearn-style param plumbing (grid-search friendly)
+    def get_params(self) -> dict:
+        return {"epochs": self.epochs, "batch_size": self.batch_size,
+                "normalize": self.normalize, "seed": self.seed}
+
+    def set_params(self, **kw) -> "NetworkEstimator":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown param '{k}'")
+            setattr(self, k, v)
+        return self
+
+    def fit(self, x, y) -> NetworkModel:
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration)
+
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y, np.float32)
+        normalizer = None
+        if self.normalize:
+            from deeplearning4j_tpu.data.dataset import DataSet
+            from deeplearning4j_tpu.data.normalizers import (
+                NormalizerStandardize)
+            normalizer = NormalizerStandardize().fit(DataSet(x, None))
+            x = np.asarray(normalizer.transform_features(x))
+
+        conf = self.conf_factory()
+        if isinstance(conf, ComputationGraphConfiguration):
+            net = ComputationGraph(conf).init(self.seed)
+        else:
+            net = MultiLayerNetwork(conf).init(self.seed)
+
+        if self.mesh is not None:
+            from deeplearning4j_tpu.data.dataset import DataSet
+            from deeplearning4j_tpu.data.iterators import (
+                ListDataSetIterator)
+            from deeplearning4j_tpu.parallel.wrapper import (
+                ParallelWrapper)
+            bs = self.batch_size or x.shape[0]
+            it = ListDataSetIterator(DataSet(x, y).batch_by(bs))
+            ParallelWrapper(net, self.mesh, prefetch_buffer=0).fit(
+                it, epochs=self.epochs)
+        elif isinstance(net, ComputationGraph):
+            from deeplearning4j_tpu.data.dataset import DataSet
+            ds = DataSet(x, y)
+            data = (ds.batch_by(self.batch_size)
+                    if self.batch_size else [ds])
+            net.fit(data, epochs=self.epochs)
+        else:
+            net.fit(x, y, epochs=self.epochs,
+                    batch_size=self.batch_size)
+        return NetworkModel(net, normalizer)

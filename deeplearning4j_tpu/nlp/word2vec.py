@@ -287,7 +287,14 @@ class SequenceVectors:
 
         return step
 
-    def fit(self, sequences: List[List[str]]):
+    def fit(self, sequences: List[List[str]], mesh=None):
+        """Train. With ``mesh`` (a jax Mesh with a 'data' axis) the
+        pair batches are sharded over the axis and embeddings stay
+        replicated — XLA inserts the cross-device reduction for the
+        scatter updates. This is the TPU-native replacement for the
+        reference's Spark Word2Vec/TextPipeline data-parallel training
+        (dl4j-spark-nlp/.../TextPipeline.java: word counting and
+        training distributed over executors)."""
         if self.vocab is None:
             self.build_vocab(sequences)
         if self.algorithm == "cbow":
@@ -296,6 +303,23 @@ class SequenceVectors:
         step = self._make_hs_step() if self.hs else self._make_ns_step()
         syn0 = jnp.asarray(self.syn0)
         syn1 = jnp.asarray(self.syn1)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ndata = mesh.shape["data"]
+            if self.batch_size % ndata:
+                raise ValueError(
+                    f"batch_size {self.batch_size} not divisible by "
+                    f"mesh data axis {ndata}")
+            repl = NamedSharding(mesh, P())
+            shard = NamedSharding(mesh, P("data"))
+            syn0 = jax.device_put(syn0, repl)
+            syn1 = jax.device_put(syn1, repl)
+
+            def put(a):
+                return jax.device_put(a, shard)
+        else:
+            def put(a):
+                return a
         V = len(self.vocab)
         B = self.batch_size
         # total pair estimate for lr decay
@@ -315,10 +339,10 @@ class SequenceVectors:
                 order = np.resize(order, B)
             for s in range(0, len(order) - B + 1, B):
                 sel = order[s:s + B]
-                centers = jnp.asarray([pairs[i][0] for i in sel],
-                                      jnp.int32)
-                contexts = jnp.asarray([pairs[i][1] for i in sel],
-                                       jnp.int32)
+                centers = put(jnp.asarray([pairs[i][0] for i in sel],
+                                          jnp.int32))
+                contexts = put(jnp.asarray([pairs[i][1] for i in sel],
+                                           jnp.int32))
                 frac = step_i / total_steps
                 lr = max(self.min_learning_rate,
                          self.learning_rate * (1 - frac))
@@ -330,7 +354,8 @@ class SequenceVectors:
                                       p=self._unigram_table)
                     syn0, syn1, loss = step(
                         syn0, syn1, centers, contexts,
-                        jnp.asarray(negs, jnp.int32), jnp.float32(lr))
+                        put(jnp.asarray(negs, jnp.int32)),
+                        jnp.float32(lr))
                 step_i += 1
                 last_loss = loss
         self.syn0 = np.asarray(syn0)
@@ -479,10 +504,10 @@ class Word2Vec(SequenceVectors):
         self._iterator = None
         self._tokenizer = DefaultTokenizerFactory()
 
-    def fit(self, sequences=None):
+    def fit(self, sequences=None, mesh=None):
         if sequences is None:
             if self._iterator is None:
                 raise ValueError("No sentence iterator configured")
             sequences = [self._tokenizer.create(s).get_tokens()
                          for s in self._iterator]
-        return super().fit(sequences)
+        return super().fit(sequences, mesh=mesh)

@@ -98,16 +98,21 @@ class ConvolutionLayer(BaseLayer):
         return p, {}
 
     def _conv(self, x, w):
+        # compute-dtype in, cast out AFTER the conv: upcasting via
+        # preferred_element_type breaks the conv transpose under bf16
+        # (f32 cotangent vs bf16 saved operands); an explicit convert
+        # has a clean transpose and XLA's MXU path still accumulates
+        # in f32 internally
         pol = dtypes.policy()
-        return lax.conv_general_dilated(
+        y = lax.conv_general_dilated(
             pol.cast_to_compute(x), pol.cast_to_compute(w),
             window_strides=self.stride,
             padding=_conv_padding(self.convolution_mode, self.padding,
                                   self.kernel, self.dilation),
             rhs_dilation=self.dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=pol.output_dtype,
         )
+        return pol.cast_to_output(y)
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         x = self.apply_input_dropout(x, training=training, rng=rng)

@@ -45,7 +45,10 @@ class DenseLayer(FeedForwardLayer):
         x = self.apply_input_dropout(x, training=training, rng=rng)
         if x.ndim > 2 and x.shape[-1] != params["W"].shape[0]:
             x = x.reshape(x.shape[0], -1)   # cnn -> flatten
-        y = x @ params["W"]
+        # MXU-native compute dtype (no-op casts under the f32 default)
+        pol = dtypes.policy()
+        y = pol.cast_to_output(
+            pol.cast_to_compute(x) @ pol.cast_to_compute(params["W"]))
         if self.has_bias:
             y = y + params["b"]
         return self.activation_fn()(y), state

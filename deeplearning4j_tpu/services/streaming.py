@@ -22,8 +22,8 @@ import numpy as np
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["InProcessBroker", "NDArrayPublisher", "NDArrayConsumer",
-           "InferenceRoute"]
+__all__ = ["InProcessBroker", "SocketBroker", "SocketBrokerServer",
+           "NDArrayPublisher", "NDArrayConsumer", "InferenceRoute"]
 
 
 class InProcessBroker:
@@ -43,6 +43,160 @@ class InProcessBroker:
         q: "queue.Queue[bytes]" = queue.Queue()
         with self._lock:
             self._topics.setdefault(topic, []).append(q)
+        return q
+
+
+class SocketBrokerServer:
+    """A real network pub/sub broker over TCP (the embedded-Kafka
+    analog the reference tests against, EmbeddedKafkaCluster — here a
+    self-contained server, no external install). Wire format per
+    message: 4-byte length + JSON {op: publish|subscribe, topic,
+    payload_b64?}. Subscribers hold their connection open and receive
+    length-prefixed {topic, payload_b64} frames."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import socket
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.host, self.port = self._srv.getsockname()
+        self._subs: Dict[str, List] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _recv_frame(conn) -> Optional[bytes]:
+        import struct
+        head = b""
+        while len(head) < 4:
+            chunk = conn.recv(4 - len(head))
+            if not chunk:
+                return None
+            head += chunk
+        (n,) = struct.unpack(">I", head)
+        body = b""
+        while len(body) < n:
+            chunk = conn.recv(n - len(body))
+            if not chunk:
+                return None
+            body += chunk
+        return body
+
+    @staticmethod
+    def _send_frame(conn, payload: bytes):
+        import struct
+        conn.sendall(struct.pack(">I", len(payload)) + payload)
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        import base64
+        while True:
+            frame = self._recv_frame(conn)
+            if frame is None:
+                return
+            msg = json.loads(frame.decode())
+            if msg["op"] == "subscribe":
+                # each subscriber gets a dedicated send lock:
+                # concurrent publishers would otherwise interleave
+                # partial sendall() writes and corrupt the framing
+                entry = (conn, threading.Lock())
+                with self._lock:
+                    self._subs.setdefault(msg["topic"],
+                                          []).append(entry)
+                # ack AFTER registration so the client's subscribe()
+                # returning guarantees delivery of later publishes
+                self._send_frame(conn, b'{"op": "subscribed"}')
+                # connection now belongs to the subscription
+                return
+            if msg["op"] == "publish":
+                payload = base64.b64decode(msg["payload_b64"])
+                out = json.dumps({
+                    "topic": msg["topic"],
+                    "payload_b64": base64.b64encode(
+                        payload).decode()}).encode()
+                with self._lock:
+                    subs = list(self._subs.get(msg["topic"], []))
+                for s, send_lock in subs:
+                    try:
+                        with send_lock:
+                            self._send_frame(s, out)
+                    except OSError:
+                        with self._lock:
+                            try:
+                                self._subs[msg["topic"]].remove(
+                                    (s, send_lock))
+                            except ValueError:
+                                pass
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class SocketBroker:
+    """Client side of SocketBrokerServer with the same publish/
+    subscribe surface as InProcessBroker, so every route/publisher/
+    consumer works unchanged over a real network transport."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+
+    def _connect(self):
+        import socket
+        c = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        c.connect((self.host, self.port))
+        return c
+
+    def publish(self, topic: str, payload: bytes):
+        import base64
+        c = self._connect()
+        try:
+            SocketBrokerServer._send_frame(c, json.dumps({
+                "op": "publish", "topic": topic,
+                "payload_b64": base64.b64encode(payload).decode()}
+            ).encode())
+        finally:
+            c.close()
+
+    def subscribe(self, topic: str) -> "queue.Queue[bytes]":
+        import base64
+        c = self._connect()
+        SocketBrokerServer._send_frame(c, json.dumps(
+            {"op": "subscribe", "topic": topic}).encode())
+        # block for the server's ack: after subscribe() returns, any
+        # later publish is guaranteed to reach this queue — the same
+        # synchronous contract InProcessBroker.subscribe has
+        ack = SocketBrokerServer._recv_frame(c)
+        if ack is None or json.loads(ack.decode()).get("op") != \
+                "subscribed":
+            raise IOError("broker did not acknowledge subscription")
+        q: "queue.Queue[bytes]" = queue.Queue()
+
+        def pump():
+            while True:
+                frame = SocketBrokerServer._recv_frame(c)
+                if frame is None:
+                    return
+                msg = json.loads(frame.decode())
+                q.put(base64.b64decode(msg["payload_b64"]))
+
+        threading.Thread(target=pump, daemon=True).start()
         return q
 
 

@@ -36,35 +36,26 @@ def _flat_oracle(net, ds) -> Tuple[Callable, np.ndarray]:
     from deeplearning4j_tpu.models.computation_graph import (
         ComputationGraph)
 
+    from deeplearning4j_tpu.util.tree import (tree_flat_vector,
+                                              tree_from_flat_vector)
+
     if isinstance(net, ComputationGraph):
         batch = net._batch_tuple(net._as_multi(ds))
     else:
         batch = net._batch_tuple(ds)
-    leaves, treedef = jax.tree_util.tree_flatten(net.params)
-    shapes = [l.shape for l in leaves]
-    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
-    dtypes_ = [l.dtype for l in leaves]
-    state = net.state
-
-    def unflatten(flat):
-        out, off = [], 0
-        for shp, n, dt in zip(shapes, sizes, dtypes_):
-            out.append(flat[off:off + n].reshape(shp).astype(dt))
-            off += n
-        return jax.tree_util.tree_unflatten(treedef, out)
+    template = net.params          # shapes/dtypes/order contract lives
+    state = net.state              # in util/tree's flat-vector helpers
 
     @jax.jit
     def value_and_grad(flat):
         def loss_fn(fl):
-            loss, _ = net._loss(unflatten(fl), state, batch, None,
-                                training=False)
+            loss, _ = net._loss(tree_from_flat_vector(template, fl),
+                                state, batch, None, training=False)
             return loss
         return jax.value_and_grad(loss_fn)(flat)
 
-    x0 = np.concatenate([np.asarray(l, np.float32).ravel()
-                         for l in leaves]) if leaves else np.zeros(0,
-                                                                   "f4")
-    return value_and_grad, jnp.asarray(x0)
+    return value_and_grad, jnp.asarray(tree_flat_vector(net.params),
+                                       jnp.float32)
 
 
 class BackTrackLineSearch:

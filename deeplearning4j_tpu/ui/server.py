@@ -56,6 +56,8 @@ _PAGE = """<!DOCTYPE html>
   <div id="hists"></div></div>
 <div class="chart"><h2>Conv activations (latest report)</h2>
   <div id="acts"></div></div>
+<div class="chart"><h2>Network flow</h2>
+  <svg id="flow" width="800" height="10"></svg></div>
 <div class="chart"><h2>t-SNE</h2>
   <svg id="tsne" width="500" height="500"></svg></div>
 <script>
@@ -127,6 +129,42 @@ async function refresh() {
     `<div style="display:inline-block;margin:4px;text-align:center">
      <img src="data:image/png;base64,${imgs[n]}"/><br/>
      <small>${n}</small></div>`; });
+  // network-flow diagram: layered DAG of the attached model
+  const flow = await (await fetch('/api/flow')).json();
+  const fsvg = document.getElementById('flow');
+  if (flow.nodes && flow.nodes.length) {
+    const ROWH = 54, BW = 130, BH = 34;
+    const rows = Math.max(...flow.nodes.map(n => n.row)) + 1;
+    fsvg.setAttribute('height', rows * ROWH + 10);
+    const pos = {};
+    const byRow = {};
+    flow.nodes.forEach(n => {
+      (byRow[n.row] = byRow[n.row] || []).push(n); });
+    let body = '';
+    Object.values(byRow).forEach(ns => {
+      ns.forEach((n, i) => {
+        const x = 20 + i * (BW + 24), y = 8 + n.row * ROWH;
+        pos[n.name] = [x + BW / 2, y, y + BH];
+      });
+    });
+    flow.edges.forEach(([a, b]) => {
+      if (pos[a] && pos[b]) body +=
+        `<line x1="${pos[a][0]}" y1="${pos[a][2]}" x2="${pos[b][0]}"
+         y2="${pos[b][1]}" stroke="#aaa"/>`;
+    });
+    Object.values(byRow).forEach(ns => {
+      ns.forEach((n, i) => {
+        const x = 20 + i * (BW + 24), y = 8 + n.row * ROWH;
+        const col = n.kind === 'input' ? '#def' :
+                    (n.kind === 'vertex' ? '#efe' : '#fff');
+        body += `<rect x="${x}" y="${y}" width="${BW}" height="${BH}"
+                 fill="${col}" stroke="#888" rx="4"/>
+                 <text x="${x+6}" y="${y+14}">${n.name}</text>
+                 <text x="${x+6}" y="${y+28}" fill="#999">${n.type}</text>`;
+      });
+    });
+    fsvg.innerHTML = body;
+  }
   const ts = await (await fetch('/api/tsne')).json();
   const tsvg = document.getElementById('tsne');
   tsvg.innerHTML = '';
@@ -161,6 +199,7 @@ class UIServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._tsne = {"points": [], "labels": None}
+        self._flow = {"nodes": [], "edges": []}
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -171,6 +210,43 @@ class UIServer:
 
     def attach(self, storage) -> None:
         self.storage = storage
+
+    def attach_model(self, model) -> None:
+        """Feed the network-flow view (the Play UI's flow module /
+        FlowIterationListener: an architecture diagram). Accepts either
+        executor; rows = longest-path depth in the DAG."""
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
+        nodes, edges = [], []
+        if isinstance(model, ComputationGraph):
+            conf = model.conf
+            depth = {n: 0 for n in conf.network_inputs}
+            for name in conf.network_inputs:
+                nodes.append({"name": name, "type": "Input",
+                              "kind": "input", "row": 0})
+            from deeplearning4j_tpu.nn.conf.layers.base import Layer
+            for name in conf.topological_order():
+                obj, ins = conf.vertices[name]
+                depth[name] = 1 + max((depth.get(i, 0) for i in ins),
+                                      default=0)
+                nodes.append({
+                    "name": name, "type": type(obj).__name__,
+                    "kind": ("layer" if isinstance(obj, Layer)
+                             else "vertex"),
+                    "row": depth[name]})
+                edges.extend([i, name] for i in ins)
+        else:
+            nodes.append({"name": "input", "type": "Input",
+                          "kind": "input", "row": 0})
+            prev = "input"
+            for i, layer in enumerate(model.layers):
+                name = f"layer_{i}"
+                nodes.append({"name": name,
+                              "type": type(layer).__name__,
+                              "kind": "layer", "row": i + 1})
+                edges.append([prev, name])
+                prev = name
+        self._flow = {"nodes": nodes, "edges": edges}
 
     def upload_tsne(self, data, labels=None, *, already_2d=None):
         """Feed the t-SNE tab (the Play UI's tsne module, reusing
@@ -235,6 +311,8 @@ class UIServer:
                     self._send(200, json.dumps(imgs))
                 elif url.path == "/api/tsne":
                     self._send(200, json.dumps(server_ref()._tsne))
+                elif url.path == "/api/flow":
+                    self._send(200, json.dumps(server_ref()._flow))
                 else:
                     self._send(404, json.dumps({"error": "not found"}))
 

@@ -90,7 +90,8 @@ class ZooModel:
                 f"No pretrained weights for {self.name}: expected {path} "
                 f"(this environment has no network egress; place the "
                 f"checkpoint there manually)")
-        expected = checksum or getattr(self, "pretrained_checksum", None)
+        # precedence per the docstring: argument > sidecar > class attr
+        expected = checksum
         sidecar = path + ".sha256"
         if expected is None and os.path.exists(sidecar):
             with open(sidecar) as f:
@@ -99,6 +100,8 @@ class ZooModel:
                 raise IOError(f"Malformed checksum sidecar {sidecar}: "
                               f"empty file")
             expected = parts[0].strip()
+        if expected is None:
+            expected = getattr(self, "pretrained_checksum", None)
         if expected:
             import hashlib
             h = hashlib.sha256()

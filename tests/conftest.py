@@ -21,3 +21,23 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# test tiering: smoke (`pytest -m "not slow"`) vs full. Heavy files are
+# marked wholesale; a few heavyweight classes are marked in place.
+# ---------------------------------------------------------------------------
+_SLOW_FILES = {
+    "test_examples.py",        # subprocess examples recompile everything
+    "test_end_to_end.py",      # full train/checkpoint/resume cycles
+    "test_gradientcheck.py",   # float64 central differences
+    "test_zoo.py",             # builds all 13 archs + goldens
+    "test_computation_graph_parity.py",   # tBPTT training to accuracy
+    "test_keras_import.py",    # live keras forward goldens
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if os.path.basename(str(item.fspath)) in _SLOW_FILES:
+            item.add_marker(pytest.mark.slow)

@@ -343,3 +343,22 @@ class TestKeras1LegacyImport:
         self._write_k1(p1, cfg1, {})
         with pytest.raises(KerasImportError, match="th"):
             import_keras_model_and_weights(p1)
+
+
+class TestKerasApplicationsImport:
+    """Full keras.applications architectures as import oracles
+    (BASELINE.md item 4: Keras-imported InceptionV3/VGG16 inference;
+    the reference's KerasModelEndToEndTest pattern at real-model
+    scale)."""
+
+    def test_inception_v3_end_to_end(self, tmp_path, rng):
+        m = keras.applications.InceptionV3(weights=None,
+                                           input_shape=(96, 96, 3),
+                                           classes=10)
+        path = os.path.join(tmp_path, "iv3.h5")
+        m.save(path)
+        net = import_keras_model_and_weights(path)
+        x = rng.normal(0, 1, (2, 96, 96, 3)).astype(np.float32)
+        ref = np.asarray(m.predict(x, verbose=0))
+        ours = np.asarray(net.output(x))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-6)

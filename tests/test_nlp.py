@@ -301,3 +301,47 @@ class TestWordsNearestBatch:
         assert len(batch[0]) == 3
         # unknown word → empty list, not a crash
         assert w2v.words_nearest_batch(["zzz_missing"], n=3) == [[]]
+
+
+class TestDataParallelEmbeddings:
+    """Spark NLP parity (dl4j-spark-nlp TextPipeline / Spark Word2Vec):
+    embedding training distributed over the data mesh axis must work
+    and closely match single-device training."""
+
+    def test_mesh_fit_matches_single(self):
+        import jax
+
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, build_mesh
+        corpus = ["the quick brown fox jumps over the lazy dog",
+                  "a quick red fox runs past a lazy cat",
+                  "dogs and cats and foxes run fast"] * 20
+
+        def build():
+            return (Word2Vec.builder().iterate(corpus)
+                    .layer_size(16).min_word_frequency(1).epochs(2)
+                    .batch_size(64).seed(0).build())
+
+        single = build()
+        single.fit()
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        dp = build()
+        dp.fit(mesh=mesh)
+        # same data order + same math; only the cross-device reduction
+        # order differs
+        np.testing.assert_allclose(dp.syn0, single.syn0, rtol=1e-3,
+                                   atol=1e-4)
+        assert dp.words_nearest("fox", n=3) == \
+            single.words_nearest("fox", n=3)
+
+    def test_mesh_fit_indivisible_batch_raises(self):
+        import jax
+
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, build_mesh
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        w = (Word2Vec.builder().iterate(["a b c d e"] * 5)
+             .layer_size(8).min_word_frequency(1).batch_size(30)
+             .seed(0).build())
+        with pytest.raises(ValueError, match="not divisible"):
+            w.fit(mesh=mesh)

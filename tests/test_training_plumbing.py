@@ -299,3 +299,52 @@ class TestSecondOrderOptimizers:
         xs, ys = iris_data()
         with pytest.raises(ValueError, match="newton"):
             optimize(self._net(), DataSet(xs, ys), algorithm="newton")
+
+
+class TestBf16Policy:
+    """The MXU-native mixed-precision policy (dtypes.tpu_bf16: bf16
+    compute, f32 params) must train to the same quality as f32."""
+
+    def test_bf16_trains_iris(self):
+        from deeplearning4j_tpu import dtypes
+        xs, ys = iris_data()
+        with dtypes.policy_scope(dtypes.tpu_bf16()):
+            conf = (NeuralNetConfiguration.builder().set_seed(0)
+                    .updater(updaters.adam(0.05)).list()
+                    .layer(DenseLayer(n_out=16, activation="relu"))
+                    .layer(OutputLayer(n_out=3))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            net = MultiLayerNetwork(conf).init()
+            net.fit(xs[:120], ys[:120], epochs=150)
+            acc = net.evaluate(xs[120:], ys[120:]).accuracy()
+        assert acc > 0.85, acc
+        # params stayed f32 (the policy split)
+        import jax.numpy as jnp
+        assert net.params[0]["W"].dtype == jnp.float32
+
+    def test_bf16_conv_forward_close_to_f32(self):
+        from deeplearning4j_tpu import dtypes
+        from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                       SubsamplingLayer)
+
+        def build():
+            conf = (NeuralNetConfiguration.builder().set_seed(0)
+                    .updater(updaters.adam(0.01)).list()
+                    .layer(ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                            activation="relu"))
+                    .layer(SubsamplingLayer(kernel=(2, 2),
+                                            stride=(2, 2)))
+                    .layer(DenseLayer(n_out=16, activation="relu"))
+                    .layer(OutputLayer(n_out=3))
+                    .set_input_type(
+                        InputType.convolutional_flat(8, 8, 1)).build())
+            return MultiLayerNetwork(conf).init()
+
+        x = np.random.default_rng(0).normal(
+            0, 1, (4, 64)).astype(np.float32)
+        f32_out = np.asarray(build().output(x))
+        with dtypes.policy_scope(dtypes.tpu_bf16()):
+            bf16_out = np.asarray(build().output(x))
+        # same init (f32 params) — bf16 compute rounds to ~2-3 decimals
+        np.testing.assert_allclose(bf16_out, f32_out, rtol=0.05,
+                                   atol=0.02)

@@ -254,3 +254,102 @@ class TestTsneTab:
             assert got == {"layer_0": png}
         finally:
             srv.stop()
+
+
+class TestNetworkFlowView:
+    def test_flow_endpoint_graph(self):
+        from deeplearning4j_tpu import (ComputationGraph,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+        from deeplearning4j_tpu.ui.server import UIServer
+        g = (NeuralNetConfiguration.builder().set_seed(0)
+             .updater(updaters.adam(0.01)).graph_builder()
+             .add_inputs("in")
+             .add_layer("a", DenseLayer(n_out=4, activation="relu"),
+                        "in")
+             .add_layer("b", DenseLayer(n_out=4, activation="relu"),
+                        "in")
+             .add_vertex("m", MergeVertex(), "a", "b")
+             .add_layer("out", OutputLayer(n_out=3), "m")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(4)).build())
+        cg = ComputationGraph(g).init()
+        srv = UIServer(port=0)
+        srv.attach_model(cg)
+        srv.start()
+        try:
+            flow = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/api/flow").read())
+            names = {n["name"]: n for n in flow["nodes"]}
+            assert set(names) == {"in", "a", "b", "m", "out"}
+            assert names["in"]["row"] == 0
+            assert names["a"]["row"] == names["b"]["row"] == 1
+            assert names["m"]["row"] == 2
+            assert names["out"]["row"] == 3
+            assert names["m"]["kind"] == "vertex"
+            assert ["a", "m"] in flow["edges"]
+        finally:
+            srv.stop()
+
+    def test_flow_endpoint_mln(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        net = _fit_with_listener(InMemoryStatsStorage())
+        srv = UIServer(port=0)
+        srv.attach_model(net)
+        assert len(srv._flow["nodes"]) == 3   # input + 2 layers
+        assert srv._flow["edges"] == [["input", "layer_0"],
+                                      ["layer_0", "layer_1"]]
+
+
+class TestEstimatorAPI:
+    """Spark ML wrapper parity (dl4j-spark-ml SparkDl4jNetwork):
+    estimator.fit -> model.transform/predict/score + save/load."""
+
+    def _factory(self):
+        def conf_factory():
+            return (NeuralNetConfiguration.builder().set_seed(0)
+                    .updater(updaters.adam(0.05)).list()
+                    .layer(DenseLayer(n_out=12, activation="relu"))
+                    .layer(OutputLayer(n_out=3))
+                    .set_input_type(InputType.feed_forward(4)).build())
+        return conf_factory
+
+    def test_fit_transform_predict_score(self, tmp_path):
+        import os
+
+        from deeplearning4j_tpu.ml import NetworkEstimator, NetworkModel
+        xs, ys = iris_data()
+        est = NetworkEstimator(self._factory(), epochs=100,
+                               normalize=True)
+        model = est.fit(xs[:120], ys[:120])
+        probs = model.transform(xs[120:])
+        assert probs.shape == (30, 3)
+        np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-4)
+        assert model.score(xs[120:], ys[120:]) > 0.85
+        # save / load round trip (normalizer travels along)
+        p = os.path.join(tmp_path, "model.zip")
+        model.save(p)
+        back = NetworkModel.load(p)
+        np.testing.assert_allclose(back.transform(xs[120:]), probs,
+                                   rtol=1e-5)
+
+    def test_grid_search_params(self):
+        from deeplearning4j_tpu.ml import NetworkEstimator
+        est = NetworkEstimator(self._factory(), epochs=5)
+        assert est.get_params()["epochs"] == 5
+        est.set_params(epochs=7)
+        assert est.epochs == 7
+        with pytest.raises(ValueError, match="bogus"):
+            est.set_params(bogus=1)
+
+    def test_mesh_parallel_fit(self):
+        import jax
+
+        from deeplearning4j_tpu.ml import NetworkEstimator
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, build_mesh
+        xs, ys = iris_data()
+        mesh = build_mesh(MeshSpec(data=8), jax.devices()[:8])
+        est = NetworkEstimator(self._factory(), epochs=60,
+                               batch_size=40, mesh=mesh)
+        model = est.fit(xs[:120], ys[:120])
+        assert model.score(xs[120:], ys[120:]) > 0.85
