@@ -619,6 +619,52 @@ def main():
             "note": ("ours = GravesLSTM (peepholes: +25% gate FLOPs); "
                      "baseline = flax OptimizedLSTMCell nn.scan")})
 
+        # long-context attention: the Pallas flash kernel vs naive
+        # attention, fwd+bwd at T=4096 (the long-context capability
+        # extension; naive materializes the (T, T) scores)
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from deeplearning4j_tpu.ops.attention import flash_attention
+            B, T, H, D = 4, 4096, 8, 64
+            rngk = jax.random.PRNGKey(0)
+            q = jax.random.normal(rngk, (B, T, H, D), jnp.float32)
+
+            def naive(q, k, v):
+                qh = jnp.swapaxes(q, 1, 2)
+                kh = jnp.swapaxes(k, 1, 2)
+                vh = jnp.swapaxes(v, 1, 2)
+                s = qh @ jnp.swapaxes(kh, -1, -2) / np.sqrt(D)
+                return jnp.swapaxes(jax.nn.softmax(s) @ vh, 1, 2)
+
+            def mk(fn):
+                @jax.jit
+                def loss(q):
+                    return jnp.sum(fn(q, q, q) ** 2)
+                g = jax.jit(jax.grad(loss))
+
+                def step(qq, _):
+                    return qq, g(qq)
+                return _make_measure(step, (q, None), 10, 2,
+                                     lambda a: a[1])
+
+            m_flash = mk(lambda a, b, c: flash_attention(a, b, c))
+            m_naive = mk(naive)
+            dt_f, dt_n = _interleave(m_flash, m_naive, repeats=3)
+            toks = 10 * B * T
+            print(f"flash attention T=4096 fwd+bwd: {toks/dt_f:.0f} "
+                  f"tok/s vs naive {toks/dt_n:.0f}", file=sys.stderr)
+            detail["configs"].append({
+                "metric": ("flash attention fwd+bwd (B=4, T=4096, "
+                           "H=8, D=64, f32)"),
+                "value": round(toks / dt_f, 0), "unit": "tokens/sec",
+                "baseline": round(toks / dt_n, 0),
+                "vs_baseline": round(dt_n / dt_f, 3),
+                "note": "baseline = naive attention (materializes TxT)"})
+        except Exception as e:
+            print(f"attention bench skipped: {e}", file=sys.stderr)
+
         if time.perf_counter() - t_start > budget:
             print("vgg16 keras-import bench skipped: over time budget",
                   file=sys.stderr)

@@ -22,7 +22,7 @@ from typing import Callable, Iterable, List, Optional
 
 __all__ = ["Tokenizer", "DefaultTokenizerFactory",
            "NGramTokenizerFactory", "CJKTokenizerFactory",
-           "CommonPreprocessor", "STOP_WORDS",
+           "CommonPreprocessor", "EndingPreProcessor", "STOP_WORDS",
            "SentenceIterator", "ListSentenceIterator",
            "FileSentenceIterator"]
 
@@ -31,6 +31,19 @@ __all__ = ["Tokenizer", "DefaultTokenizerFactory",
 STOP_WORDS = frozenset("""a an and are as at be but by for if in into is it
 no not of on or such that the their then there these they this to was will
 with""".split())
+
+
+class EndingPreProcessor:
+    """Strips common English suffixes (text/tokenization/
+    tokenizerfactory EndingPreProcessor: s/ed/ing/ly/.)."""
+
+    _SUFFIXES = ("ing", "ed", "ly", "s", ".")
+
+    def pre_process(self, token: str) -> str:
+        for suf in self._SUFFIXES:
+            if token.endswith(suf) and len(token) > len(suf) + 1:
+                return token[:-len(suf)]
+        return token
 
 
 class CommonPreprocessor:

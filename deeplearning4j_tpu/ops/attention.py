@@ -131,17 +131,47 @@ def pallas_flash_attention(q, k, v, *, causal: bool = False,
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
-def flash_attention(q, k, v, *, causal: bool = False,
-                    block_q: int = 128, block_k: int = 128):
-    """Dispatch: Pallas kernel on TPU, pure-jnp blockwise elsewhere.
-    Backend is decided process-wide (works under jit, where traced
-    arrays carry no device)."""
+def _blockwise(q, k, v, causal, block):
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        blockwise_attention)
+    return blockwise_attention(q, k, v, causal=causal, block_size=block)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
     platform = jax.default_backend()
     T = q.shape[1]
     if platform == "tpu" and T % block_q == 0 and T % block_k == 0:
         return pallas_flash_attention(q, k, v, causal=causal,
                                       block_q=block_q, block_k=block_k)
-    from deeplearning4j_tpu.parallel.ring_attention import (
-        blockwise_attention)
-    return blockwise_attention(q, k, v, causal=causal,
-                               block_size=min(block_k, T))
+    return _blockwise(q, k, v, causal, min(block_k, T))
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    # backward recomputes through the memory-efficient pure-jnp
+    # blockwise formulation (flash-style recomputation: no (T, T)
+    # scores live past a block) — the Pallas kernel stays
+    # forward-only, the pair is end-to-end differentiable
+    q, k, v = res
+    T = q.shape[1]
+    _, vjp = jax.vjp(
+        lambda a, b, c: _blockwise(a, b, c, causal, min(block_k, T)),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128):
+    """Dispatch: Pallas kernel on TPU, pure-jnp blockwise elsewhere.
+    Backend is decided process-wide (works under jit, where traced
+    arrays carry no device). Differentiable: forward runs the Pallas
+    kernel; backward recomputes via the blockwise formulation
+    (custom_vjp above)."""
+    return _flash(q, k, v, causal, block_q, block_k)

@@ -1,0 +1,156 @@
+"""Elastic / fault-tolerant training.
+
+The reference's failure handling is minimal by design (SURVEY §5:
+InvalidScoreIterationTerminationCondition + Spark task retry; no
+elastic membership). On TPU pods the real-world failure modes are
+preemption (SIGTERM with a grace window) and numeric blow-ups; the
+idiomatic recovery is checkpoint-based restart. :class:`ElasticTrainer`
+packages that loop:
+
+- periodic ATOMIC checkpoints (tmp + rename; a preemption mid-write
+  never corrupts the latest checkpoint), pruned to ``keep`` newest;
+- automatic resume from the newest valid checkpoint on construction;
+- SIGTERM → checkpoint immediately and stop cleanly (the TPU
+  preemption grace-window contract);
+- non-finite loss → roll back to the last checkpoint and continue
+  (InvalidScore semantics, but recovering instead of terminating),
+  bounded by ``max_rollbacks``.
+
+Works with both executors via the zip serializer.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import signal
+import time
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["ElasticTrainer"]
+
+_CKPT_RE = re.compile(r"ckpt_(\d+)\.zip$")
+
+
+class ElasticTrainer:
+    def __init__(self, model, checkpoint_dir: str, *,
+                 save_every: int = 100, keep: int = 3,
+                 max_rollbacks: int = 5, handle_sigterm: bool = True):
+        self.model = model
+        self.dir = checkpoint_dir
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self.save_every = max(1, save_every)
+        self.keep = max(1, keep)
+        self.max_rollbacks = max_rollbacks
+        self.handle_sigterm = handle_sigterm
+        self.rollbacks = 0
+        self._stop_requested = False
+        self._resume()
+
+    # -- checkpoint plumbing ----------------------------------------------
+    def _ckpts(self):
+        out = []
+        for f in os.listdir(self.dir):
+            m = _CKPT_RE.match(f)
+            if m:
+                out.append((int(m.group(1)), os.path.join(self.dir, f)))
+        return sorted(out)
+
+    def latest_checkpoint(self) -> Optional[str]:
+        cks = self._ckpts()
+        return cks[-1][1] if cks else None
+
+    def save_checkpoint(self):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        it = self.model.iteration_count
+        final = os.path.join(self.dir, f"ckpt_{it}.zip")
+        tmp = final + f".tmp{os.getpid()}"
+        write_model(self.model, tmp)
+        os.replace(tmp, final)          # atomic on POSIX
+        for _, path in self._ckpts()[:-self.keep]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        logger.info("checkpoint at iteration %d -> %s", it, final)
+        return final
+
+    def _restore_into_model(self, path: str):
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        loaded = restore_model(path)
+        m = self.model
+        m.params = loaded.params
+        m.state = loaded.state
+        m.opt_state = loaded.opt_state
+        m.iteration_count = loaded.iteration_count
+        m.epoch_count = loaded.epoch_count
+
+    def _resume(self):
+        path = self.latest_checkpoint()
+        if path is not None:
+            if self.model.params is None:
+                self.model.init()
+            self._restore_into_model(path)
+            logger.info("resumed from %s (iteration %d)", path,
+                        self.model.iteration_count)
+
+    # -- the loop -----------------------------------------------------------
+    def fit(self, iterator, *, epochs: int = 1) -> "ElasticTrainer":
+        model = self.model
+        if model.params is None:
+            model.init()
+        prev_handler = None
+        if self.handle_sigterm:
+            def on_term(signum, frame):
+                # preemption grace window: persist, then stop cleanly
+                self._stop_requested = True
+            prev_handler = signal.signal(signal.SIGTERM, on_term)
+        try:
+            if self.latest_checkpoint() is None:
+                self.save_checkpoint()       # iteration-0 restart point
+            for _ in range(epochs):
+                if self._stop_requested:
+                    break
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                for ds in iterator:
+                    if self._stop_requested:
+                        break
+                    model.fit(ds)
+                    loss = float(model.score_value)
+                    if not np.isfinite(loss):
+                        self._rollback()
+                        continue
+                    if model.iteration_count % self.save_every == 0:
+                        self.save_checkpoint()
+            if self._stop_requested:
+                self.save_checkpoint()
+                logger.warning("stop requested (preemption?): "
+                               "checkpointed at iteration %d",
+                               model.iteration_count)
+        finally:
+            if prev_handler is not None:
+                signal.signal(signal.SIGTERM, prev_handler)
+        return self
+
+    def _rollback(self):
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise RuntimeError(
+                f"non-finite loss persisted through "
+                f"{self.max_rollbacks} rollbacks — aborting (bad data "
+                f"or divergent learning rate)")
+        path = self.latest_checkpoint()
+        if path is None:
+            raise RuntimeError("non-finite loss and no checkpoint to "
+                               "roll back to")
+        logger.warning("non-finite loss at iteration %d: rolling back "
+                       "to %s (rollback %d/%d)",
+                       self.model.iteration_count, path, self.rollbacks,
+                       self.max_rollbacks)
+        self._restore_into_model(path)
