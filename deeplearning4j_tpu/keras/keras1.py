@@ -77,9 +77,14 @@ _RENAMES = {
 
 
 def is_keras1(model_cfg: dict, keras_version: str) -> bool:
-    if str(keras_version).startswith("1"):
-        return True
-    # structural hint: Keras-1 Sequential config is a bare list
+    # trust the keras_version attribute when the file carries one —
+    # Keras 2.0-2.1 ALSO saved Sequential configs as bare lists, so the
+    # structural hint alone would misroute early-Keras-2 files through
+    # the Keras-1 rename pass (round-2 advisor)
+    v = str(keras_version)
+    if v and v[0].isdigit():
+        return v.startswith("1")
+    # no/unparseable version attribute: fall back to the structural hint
     return (model_cfg.get("class_name") == "Sequential"
             and isinstance(model_cfg.get("config"), list))
 
@@ -100,8 +105,10 @@ def _normalize_layer(lc: dict) -> dict:
                                   int(cfg.pop("nb_col"))]
     if cfg.get("data_format") in ("th", "channels_first"):
         raise KerasImportError(
-            f"{cname}: Keras-1 dim_ordering='th' (channels-first) is "
-            f"not supported; re-save the model with 'tf' ordering")
+            f"{cname}: channels-first layout (Keras-1 "
+            f"dim_ordering='th' / early-Keras-2 "
+            f"data_format='channels_first') is not supported; re-save "
+            f"the model with channels-last ('tf') ordering")
     if cfg.get("data_format") == "tf":
         cfg["data_format"] = "channels_last"
     out = dict(lc)

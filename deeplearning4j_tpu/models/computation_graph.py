@@ -167,7 +167,16 @@ class ComputationGraph:
                                          mask=in_mask)
                 new_state[name] = s
                 acts[name] = y
-                masks[name] = in_mask
+                # a layer that collapses the time dimension (e.g.
+                # GlobalPooling) must null the propagated (B, T) mask —
+                # mirrors the reference's per-layer feedForwardMaskArray
+                # (round-2 advisor): downstream consumers would get a
+                # stale wrong-shaped mask otherwise
+                if (in_mask is not None and (y.ndim < 3
+                        or y.shape[1] != in_mask.shape[1])):
+                    masks[name] = None
+                else:
+                    masks[name] = in_mask
             else:
                 from deeplearning4j_tpu.nn.errors import (
                     layer_error_context)
@@ -404,17 +413,25 @@ class ComputationGraph:
                                            fmasks=fmasks)
                 return tuple(acts[o] for o in self.conf.network_outputs)
             self._jit_output[key] = fwd
-        rng = self._rng_key if training else None
+        rng = self._next_call_rng() if training else None
         outs = self._jit_output[key](self.params, self.state, xs, rng,
                                      fmasks)
         return outs if len(outs) > 1 else outs[0]
+
+    def _next_call_rng(self):
+        # fold a per-call counter into the key: repeated training-mode
+        # forward passes (MC-dropout sampling) must draw FRESH dropout
+        # masks, not N identical ones (round-2 advisor, medium)
+        self._output_calls = getattr(self, "_output_calls", 0) + 1
+        return jax.random.fold_in(self._rng_key, self._output_calls)
 
     def feed_forward(self, *inputs, training: bool = False,
                      input_masks=None):
         xs = tuple(jnp.asarray(x) for x in inputs)
         acts, _, _ = self._forward(self.params, self.state, xs,
                                    training=training,
-                                   rng=self._rng_key if training else None,
+                                   rng=(self._next_call_rng()
+                                        if training else None),
                                    fmasks=input_masks)
         return acts
 

@@ -1,15 +1,29 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 This is the framework's hand-written-kernel seam — the TPU analog of
 the reference's cuDNN helper hook (ConvolutionLayer.java:75 reflective
-helper load): XLA handles conv/pool/BN/LSTM, but O(T²)-memory attention
-benefits from an explicit VMEM-tiled kernel. The kernel computes exact
-softmax attention with the flash running-max/denominator recurrence,
-tiled (block_q × block_k) so only O(block²) ever sits in VMEM.
+helper load; CudnnConvolutionHelper.java:156-192 picks the *fastest*
+algorithm in both directions): XLA handles conv/pool/BN/LSTM, but
+O(T²)-memory attention benefits from explicit VMEM-tiled kernels. The
+kernels compute exact softmax attention with the flash running-max /
+denominator recurrence, tiled (block_q × block_k) so only O(block²)
+ever sits in VMEM, in both directions:
 
-Grid: (batch*heads, q_blocks, k_blocks), k innermost ('arbitrary' =
-sequential) with VMEM scratch carrying (m, l, acc) across k steps —
+- forward: (q,k,v) → (o, lse) where lse = m + log(l) is the per-row
+  logsumexp, persisted for the backward pass;
+- backward: the standard recompute-from-(q,k,v,o,lse) scheme —
+  delta = rowsum(do·o) precomputed, then a dq kernel (grid over q
+  blocks, sequential over k) and a fused dk/dv kernel (grid over k
+  blocks, sequential over q). p = exp(s − lse) is recomputed per tile,
+  so no (T,T) tensor ever exists in either direction.
+
+Grids put the contraction dimension innermost ('arbitrary' =
+sequential) with VMEM scratch carrying the accumulators across steps —
 the double-buffering pattern from the Pallas guide.
+
+``precision`` selects the MXU mode: 'default' (bf16 passes — what XLA
+gives a plain f32 ``jnp.einsum``, so flash-vs-naive benches are
+apples-to-apples) or 'highest' (exact f32, 6-pass).
 
 ``flash_attention`` dispatches: Pallas on TPU, the pure-jnp blockwise
 implementation elsewhere (same math, same results — checked by tests).
@@ -24,13 +38,30 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["flash_attention", "pallas_flash_attention"]
+__all__ = ["flash_attention", "pallas_flash_attention",
+           "pallas_flash_attention_bwd"]
 
 _NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-            scale, causal, block_q, block_k, nk, precision):
+def _prec(precision):
+    return (jax.lax.Precision.HIGHEST if precision == "highest"
+            else jax.lax.Precision.DEFAULT)
+
+
+def _causal_mask(qi, ki, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return k_pos <= q_pos
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, scale, causal, block_q, block_k, nk,
+                precision):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
@@ -41,21 +72,17 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32)              # (bq, d)
-    k = k_ref[0].astype(jnp.float32)              # (bk, d)
-    v = v_ref[0].astype(jnp.float32)
+    q = q_ref[0]                                  # (bq, d)
+    k = k_ref[0]                                  # (bk, d)
+    v = v_ref[0]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32,
                             precision=precision) * scale
 
     if causal:
-        qi = pl.program_id(1)
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        s = jnp.where(_causal_mask(pl.program_id(1), ki,
+                                   block_q, block_k), s, _NEG_INF)
 
     m_prev = m_scr[:, 0]                          # (bq,)
     m_cur = jnp.max(s, axis=1)
@@ -67,7 +94,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
     l_new = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
     acc = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32, precision=precision)
 
     m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
@@ -76,21 +103,33 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(ki == nk - 1)
     def _finish():
-        denom = jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
+        l_fin = l_scr[:, 0]
+        m_fin = m_scr[:, 0]
+        denom = jnp.maximum(l_fin, 1e-30)[:, None]
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        # lse = m + log(l); -inf (clamped) when the row saw no keys.
+        # Stored (block_q, 8): rows on sublanes, lanes replicated —
+        # Mosaic requires the trailing block dims be (8k, 128k) or
+        # equal to the array dims, and scalars-per-row need a lane dim.
+        lse = jnp.where(l_fin > 0.0, m_fin + jnp.log(
+            jnp.maximum(l_fin, 1e-30)), _NEG_INF)
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref[0].shape
+                                      ).astype(lse_ref.dtype)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret", "precision"))
+                                    "interpret", "precision",
+                                    "return_lse"))
 def pallas_flash_attention(q, k, v, *, causal: bool = False,
                            block_q: int = 128, block_k: int = 128,
                            interpret: bool = False,
-                           precision: str = "highest"):
-    """q,k,v: (B, T, H, D) → (B, T, H, D). T must be divisible by
-    the block sizes (the layer wrapper pads). precision: 'highest' =
-    exact f32 (6-pass MXU); 'default' = bf16 MXU (~2.5x faster,
-    ~1e-2 abs error — the standard training tradeoff)."""
+                           precision: str = "default",
+                           return_lse: bool = False):
+    """q,k,v: (B, T, H, D) → (B, T, H, D) [, lse (B, H, T)]. T must be
+    divisible by the block sizes (the layer wrapper pads). precision:
+    'default' = bf16 MXU passes (what XLA gives plain f32 einsum);
+    'highest' = exact f32 (6-pass MXU, ~2.5x slower)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -103,22 +142,25 @@ def pallas_flash_attention(q, k, v, *, causal: bool = False,
     nq = T // block_q
     nk = T // block_k
 
-    prec = (jax.lax.Precision.HIGHEST if precision == "highest"
-            else jax.lax.Precision.DEFAULT)
-    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, nk=nk,
-                               precision=prec)
-    out = pl.pallas_call(
+                               precision=_prec(precision))
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 8), jnp.float32),
+        ],
         grid=(B * H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D),
-                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),      # running max
             pltpu.VMEM((block_q, 128), jnp.float32),      # running denom
@@ -128,8 +170,183 @@ def pallas_flash_attention(q, k, v, *, causal: bool = False,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qb, kb, vb)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    o = out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    if return_lse:
+        return o, lse[:, :, 0].reshape(B, H, T)
+    return o
 
+
+# --------------------------------------------------------------- backward
+
+def _recompute_p(q, k, lse, scale, causal, qi, ki, block_q, block_k,
+                 precision):
+    """Recompute the (bq, bk) probability tile from q, k and the saved
+    per-row logsumexp — exact softmax weights, no running max needed."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=precision) * scale
+    p = jnp.exp(s - lse[:, None])
+    # rows that saw no keys have lse = -inf (clamped): exp would blow up
+    p = jnp.where(lse[:, None] <= _NEG_INF / 2, 0.0, p)
+    if causal:
+        p = jnp.where(_causal_mask(qi, ki, block_q, block_k), p, 0.0)
+    return p
+
+
+def _row_delta(do, o):
+    """delta = rowsum(do · o) for one (block_q, D) tile — (bq,)."""
+    return jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                   axis=1)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+               dq_scr, delta_scr, *, scale, causal, block_q, block_k,
+               nk, precision):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+        delta_scr[:] = jnp.broadcast_to(
+            _row_delta(do_ref[0], o_ref[0])[:, None], delta_scr.shape)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, 0]                        # (bq,)
+    delta = delta_scr[:, 0]
+
+    p = _recompute_p(q, k, lse, scale, causal, pl.program_id(1), ki,
+                     block_q, block_k, precision)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=precision)
+    ds = p * (dp - delta[:, None]) * scale
+    dq_scr[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                block_q, block_k, nq, precision):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, 0]
+    delta = _row_delta(do, o_ref[0])              # per q tile — cheap
+
+    p = _recompute_p(q, k, lse, scale, causal, qi, pl.program_id(1),
+                     block_q, block_k, precision)
+    # dv += p^T @ do
+    dv_scr[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=precision)
+    ds = p * (dp - delta[:, None]) * scale
+    # dk += ds^T @ q
+    dk_scr[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret", "precision"))
+def pallas_flash_attention_bwd(q, k, v, o, lse, do, *,
+                               causal: bool = False,
+                               block_q: int = 128, block_k: int = 128,
+                               interpret: bool = False,
+                               precision: str = "default"):
+    """Backward pass: (q,k,v,o,lse,do) → (dq, dk, dv), all (B,T,H,D)
+    (lse: (B,H,T) from the forward). Standard flash backward:
+    delta = rowsum(do·o), p recomputed per tile from the saved lse."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+
+    def to_bht(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    qb, kb, vb = to_bht(q), to_bht(k), to_bht(v)
+    ob, dob = to_bht(o), to_bht(do)
+    # rows-on-sublanes layout with an 8-wide lane dim (see _fwd note)
+    lseb = jnp.broadcast_to(lse.reshape(B * H, T)[:, :, None],
+                            (B * H, T, 8))
+    nq = T // block_q
+    nk = T // block_k
+    prec = _prec(precision)
+
+    qspec = pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0))
+    kspec = pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0))
+    rowq = pl.BlockSpec((1, block_q, 8), lambda bh, qi, ki: (bh, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          precision=prec),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        grid=(B * H, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, qspec, rowq],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32),
+                        pltpu.VMEM((block_q, 128), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qb, kb, vb, ob, dob, lseb)
+
+    # dk/dv grid: (bh, k block, q block) — q innermost, sequential
+    qspec2 = pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0))
+    kspec2 = pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0))
+    rowq2 = pl.BlockSpec((1, block_q, 8), lambda bh, ki, qi: (bh, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          precision=prec),
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, T, D), v.dtype)],
+        grid=(B * H, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, rowq2],
+        out_specs=[kspec2, kspec2],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qb, kb, vb, ob, dob, lseb)
+
+    def from_bht(x):
+        return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return from_bht(dq), from_bht(dk), from_bht(dv)
+
+
+# --------------------------------------------------------------- dispatch
 
 def _blockwise(q, k, v, causal, block):
     from deeplearning4j_tpu.parallel.ring_attention import (
@@ -137,29 +354,56 @@ def _blockwise(q, k, v, causal, block):
     return blockwise_attention(q, k, v, causal=causal, block_size=block)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
-    platform = jax.default_backend()
-    T = q.shape[1]
-    if platform == "tpu" and T % block_q == 0 and T % block_k == 0:
+def _auto_block(T, D):
+    """Largest power-of-two tile dividing T. Benched on v5e (B=4,
+    T=4096, H=8, D=64, f32): 1024² tiles run fwd+bwd 4.4x faster than
+    naive and 1.7x faster than 128² tiles — per-step grid overhead
+    dominates small tiles, while 2048² overflows the 16M VMEM scoped
+    allocation. Cap at 512 for D > 64 (five (block, D) operand tiles
+    live in the backward kernels)."""
+    cap = 1024 if D <= 64 else 512
+    b = cap
+    while b > 8 and T % b:
+        b //= 2
+    return b if T % b == 0 else 0
+
+
+def _use_pallas(T, block_q, block_k):
+    return (jax.default_backend() == "tpu" and block_q > 0
+            and T % block_q == 0 and T % block_k == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, precision):
+    if _use_pallas(q.shape[1], block_q, block_k):
         return pallas_flash_attention(q, k, v, causal=causal,
-                                      block_q=block_q, block_k=block_k)
-    return _blockwise(q, k, v, causal, min(block_k, T))
+                                      block_q=block_q, block_k=block_k,
+                                      precision=precision)
+    return _blockwise(q, k, v, causal, min(max(block_k, 8), q.shape[1]))
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k):
-    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+def _flash_fwd(q, k, v, causal, block_q, block_k, precision):
+    if _use_pallas(q.shape[1], block_q, block_k):
+        o, lse = pallas_flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            precision=precision, return_lse=True)
+        return o, (q, k, v, o, lse)
+    o = _blockwise(q, k, v, causal, min(max(block_k, 8), q.shape[1]))
+    return o, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, block_q, block_k, res, g):
-    # backward recomputes through the memory-efficient pure-jnp
-    # blockwise formulation (flash-style recomputation: no (T, T)
-    # scores live past a block) — the Pallas kernel stays
-    # forward-only, the pair is end-to-end differentiable
-    q, k, v = res
+def _flash_bwd(causal, block_q, block_k, precision, res, g):
+    q, k, v, o, lse = res
+    if lse is not None:
+        return pallas_flash_attention_bwd(
+            q, k, v, o, lse, g, causal=causal, block_q=block_q,
+            block_k=block_k, precision=precision)
+    # non-TPU fallback: recompute through the memory-efficient pure-jnp
+    # blockwise formulation (no (T, T) scores live past a block)
     T = q.shape[1]
     _, vjp = jax.vjp(
-        lambda a, b, c: _blockwise(a, b, c, causal, min(block_k, T)),
+        lambda a, b, c: _blockwise(a, b, c, causal,
+                                   min(max(block_k, 8), T)),
         q, k, v)
     return vjp(g)
 
@@ -168,10 +412,16 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
-                    block_q: int = 128, block_k: int = 128):
-    """Dispatch: Pallas kernel on TPU, pure-jnp blockwise elsewhere.
-    Backend is decided process-wide (works under jit, where traced
-    arrays carry no device). Differentiable: forward runs the Pallas
-    kernel; backward recomputes via the blockwise formulation
-    (custom_vjp above)."""
-    return _flash(q, k, v, causal, block_q, block_k)
+                    block_q: int = 0, block_k: int = 0,
+                    precision: str = "default"):
+    """Dispatch: Pallas kernels on TPU (forward AND backward — the lse
+    is persisted from the forward and p is recomputed per tile), the
+    pure-jnp blockwise formulation elsewhere. Backend is decided
+    process-wide (works under jit, where traced arrays carry no
+    device). block_q/block_k = 0 → auto (largest tile dividing T,
+    VMEM-capped — see _auto_block)."""
+    if block_q <= 0:
+        block_q = _auto_block(q.shape[1], q.shape[3])
+    if block_k <= 0:
+        block_k = _auto_block(q.shape[1], q.shape[3])
+    return _flash(q, k, v, causal, block_q, block_k, precision)

@@ -32,6 +32,27 @@ logger = logging.getLogger("deeplearning4j_tpu")
 __all__ = ["PipelineParallel"]
 
 
+def _balance_boundaries(params, n_stages: int) -> List[int]:
+    """Contiguous stage boundaries balanced by PARAM COUNT (layer
+    count splits put all of ResNet's heavy late blocks on one device;
+    parameters are the memory and roughly the compute). Greedy
+    cumulative split at multiples of total/n_stages."""
+    sizes = [sum(int(np.prod(a.shape))
+                 for a in jax.tree_util.tree_leaves(p)) or 1
+             for p in params]
+    total = sum(sizes)
+    target = total / n_stages
+    boundaries = [0]
+    acc = 0.0
+    for i, s in enumerate(sizes):
+        if (len(boundaries) < n_stages
+                and acc + s / 2 >= target * len(boundaries)
+                and i > boundaries[-1]):
+            boundaries.append(i)
+        acc += s
+    return boundaries
+
+
 class PipelineParallel:
     """Split a MultiLayerNetwork across devices by layer ranges.
 
@@ -47,9 +68,10 @@ class PipelineParallel:
                             else jax.devices())
         n_stages = len(self.devices)
         n_layers = len(net.layers)
+        if net.params is None:
+            net.init()
         if boundaries is None:
-            per = -(-n_layers // n_stages)
-            boundaries = list(range(0, n_layers, per))
+            boundaries = _balance_boundaries(net.params, n_stages)
         self.boundaries = boundaries
         self.n_microbatches = n_microbatches
         self._stage_ranges = [

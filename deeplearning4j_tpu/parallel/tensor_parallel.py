@@ -3,14 +3,20 @@
 Absent from the 2017 reference (data parallelism only — SURVEY §2.3);
 a required capability of the TPU rebuild. Implementation is the
 idiomatic JAX one: *sharding annotations, not rewritten math*. A rule
-table maps layer param names to PartitionSpecs (Megatron-style
-column/row split for consecutive dense layers, head-split for
-attention); ``shard_params`` applies them, and XLA inserts the
-all-gathers/reduce-scatters when the jitted train step runs.
+table maps layer params to PartitionSpecs — Megatron-style column/row
+split for consecutive dense layers, and the Megatron attention split
+for SelfAttention/TransformerEncoder layers (Wq/Wk/Wv column = heads
+partitioned across shards, Wo row; valid when n_heads % shards == 0).
+``shard_params`` applies them to a MultiLayerNetwork's param list,
+``shard_graph_params`` to a ComputationGraph's vertex-name-keyed param
+dict, and XLA's GSPMD partitioner inserts the all-gathers /
+reduce-scatters when the jitted train step runs. ``ParallelWrapper``
+preserves these shardings, so dp x tp is just a mesh with both axes.
 
 Usage:
     mesh = build_mesh(MeshSpec(data=4, model=2))
-    net.params = shard_params(net.params, net, mesh)
+    net.params = shard_params(net.params, net, mesh)      # MLN
+    cg.params = shard_graph_params(cg.params, cg, mesh)   # CG
     pw = ParallelWrapper(net, mesh)     # batch over 'data', params over
     pw.fit(...)                         # 'model' where rules apply
 """
@@ -25,44 +31,87 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["TPRule", "default_tp_rules", "shard_params",
-           "replicate_params"]
+__all__ = ["TPRule", "default_tp_rules", "graph_tp_rules",
+           "shard_params", "shard_graph_params", "replicate_params"]
 
 
 class TPRule:
     COLUMN = "column"     # split output dim  (Megatron first linear)
     ROW = "row"           # split input dim   (Megatron second linear)
+    ATTENTION = "attention_heads"   # Megatron MHA: qkv column, out row
     REPLICATE = "replicate"
+
+
+def _rule_for_layer(layer, parity: int):
+    """(rule, new_parity) for one layer object."""
+    from deeplearning4j_tpu.nn.conf.layers.attention import (
+        SelfAttentionLayer, TransformerEncoderLayer)
+    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+        ConvolutionLayer)
+    from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer
+    from deeplearning4j_tpu.nn.conf.layers.output import OutputLayer
+
+    if isinstance(layer, OutputLayer):
+        return TPRule.REPLICATE, parity
+    if isinstance(layer, (SelfAttentionLayer, TransformerEncoderLayer)):
+        return TPRule.ATTENTION, 0      # attn block resets the pairing
+    if isinstance(layer, DenseLayer):
+        return (TPRule.COLUMN if parity == 0 else TPRule.ROW), parity ^ 1
+    if isinstance(layer, ConvolutionLayer):
+        return TPRule.COLUMN, parity
+    return TPRule.REPLICATE, parity
 
 
 def default_tp_rules(layers) -> Dict[int, str]:
     """Alternate column/row splits over consecutive Dense layers — the
     Megatron pairing that avoids resharding between them. Conv layers
-    shard output channels (column-like). Output layers replicate (their
-    softmax/loss needs the full feature dim)."""
-    from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer
-    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
-        ConvolutionLayer)
-    from deeplearning4j_tpu.nn.conf.layers.output import OutputLayer
-
+    shard output channels (column-like); attention layers take the
+    Megatron head split; output layers replicate (their softmax/loss
+    needs the full feature dim)."""
     rules: Dict[int, str] = {}
     parity = 0
     for i, layer in enumerate(layers):
-        if isinstance(layer, OutputLayer):
-            rules[i] = TPRule.REPLICATE
-        elif isinstance(layer, DenseLayer):
-            rules[i] = TPRule.COLUMN if parity == 0 else TPRule.ROW
-            parity ^= 1
-        elif isinstance(layer, ConvolutionLayer):
-            rules[i] = TPRule.COLUMN
-        else:
-            rules[i] = TPRule.REPLICATE
+        rules[i], parity = _rule_for_layer(layer, parity)
     return rules
 
 
-def _spec_for(param_name: str, ndim: int, rule: str,
-              axis: str) -> P:
+def graph_tp_rules(graph) -> Dict[str, str]:
+    """TP rules for a ComputationGraph, keyed by VERTEX NAME (the
+    reference addresses graph components by name everywhere —
+    ComputationGraph.java getLayer(String)); layer vertices get the
+    same Megatron pairing as the sequential table, walked in
+    topological order so consecutive dense vertices pair up."""
+    from deeplearning4j_tpu.nn.conf.layers.base import BaseLayer
+    rules: Dict[str, str] = {}
+    parity = 0
+    for name in graph.conf.topological_order():
+        entry = graph.conf.vertices.get(name)
+        if entry is None:
+            continue                     # graph input: no params
+        obj = entry[0]
+        if not isinstance(obj, BaseLayer):
+            continue                     # op vertex: no params
+        rules[name], parity = _rule_for_layer(obj, parity)
+    return rules
+
+
+# Megatron attention: qkv projections column-split (= heads
+# partitioned), output projection row-split; everything else in the
+# block (biases of Wo, layer norms, positional params) replicated.
+_ATTN_COLUMN = {"Wq", "Wk", "Wv", "W1"}      # W1/W2: transformer MLP
+_ATTN_ROW = {"Wo", "W2"}
+
+
+def _spec_for(param_name: str, ndim: int, rule: str, axis: str) -> P:
     if rule == TPRule.REPLICATE:
+        return P()
+    if rule == TPRule.ATTENTION:
+        if param_name in _ATTN_COLUMN:
+            return P(None, axis)
+        if param_name in _ATTN_ROW:
+            return P(axis, None)
+        if param_name == "b1":           # follows W1's output split
+            return P(axis)
         return P()
     if param_name in ("b", "beta", "gamma"):
         # bias/scale follow the output dim: sharded under COLUMN
@@ -75,6 +124,32 @@ def _spec_for(param_name: str, ndim: int, rule: str,
     return P()
 
 
+def _heads_divisible(layer, n_model: int) -> bool:
+    n_heads = getattr(layer, "n_heads", None)
+    return n_heads is None or n_heads % n_model == 0
+
+
+def _place_tree(layer_params, rule, mesh, axis, n_model, *, where=""):
+    """Apply ``rule`` to one layer's param dict (recursing into nested
+    blocks like TransformerEncoder's 'attn'), with a divisibility
+    guard that falls back to replication."""
+    placed = {}
+    for name, arr in layer_params.items():
+        if isinstance(arr, dict):
+            placed[name] = _place_tree(arr, rule, mesh, axis, n_model,
+                                       where=f"{where}{name}.")
+            continue
+        spec = _spec_for(name, arr.ndim, rule, axis)
+        ok = all(ax is None or dim % n_model == 0
+                 for dim, ax in zip(arr.shape, spec))
+        if not ok:
+            logger.debug("param %s%s %s not divisible by %d; "
+                         "replicating", where, name, arr.shape, n_model)
+            spec = P()
+        placed[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return placed
+
+
 def shard_params(params, model, mesh: Mesh, *, axis: str = "model",
                  rules: Optional[Dict[int, str]] = None):
     """Apply TP shardings to a MultiLayerNetwork's param list."""
@@ -84,20 +159,33 @@ def shard_params(params, model, mesh: Mesh, *, axis: str = "model",
     out = []
     for i, layer_params in enumerate(params):
         rule = rules.get(i, TPRule.REPLICATE)
-        placed = {}
-        for name, arr in layer_params.items():
-            spec = _spec_for(name, arr.ndim, rule, axis)
-            # divisibility guard: fall back to replication
-            ok = True
-            for dim, ax in zip(arr.shape, spec):
-                if ax is not None and dim % n_model:
-                    ok = False
-            if not ok:
-                logger.debug("layer %d param %s %s not divisible by %d; "
-                             "replicating", i, name, arr.shape, n_model)
-                spec = P()
-            placed[name] = jax.device_put(arr, NamedSharding(mesh, spec))
-        out.append(placed)
+        if (rule == TPRule.ATTENTION
+                and not _heads_divisible(layers[i], n_model)):
+            logger.debug("layer %d: %d heads not divisible by %d "
+                         "shards; replicating", i,
+                         layers[i].n_heads, n_model)
+            rule = TPRule.REPLICATE
+        out.append(_place_tree(layer_params, rule, mesh, axis, n_model,
+                               where=f"layer{i}."))
+    return out
+
+
+def shard_graph_params(params, graph, mesh: Mesh, *,
+                       axis: str = "model",
+                       rules: Optional[Dict[str, str]] = None):
+    """Apply TP shardings to a ComputationGraph's {vertex_name: params}
+    dict (rules keyed by vertex name; unknown names replicate)."""
+    rules = rules if rules is not None else graph_tp_rules(graph)
+    n_model = mesh.shape[axis]
+    out = {}
+    for name, layer_params in params.items():
+        rule = rules.get(name, TPRule.REPLICATE)
+        entry = graph.conf.vertices.get(name)
+        if (rule == TPRule.ATTENTION and entry is not None
+                and not _heads_divisible(entry[0], n_model)):
+            rule = TPRule.REPLICATE
+        out[name] = _place_tree(layer_params, rule, mesh, axis, n_model,
+                                where=f"{name}.")
     return out
 
 

@@ -208,6 +208,25 @@ class ParallelWrapper:
     def _replicated(self):
         return NamedSharding(self.mesh, P())
 
+    def _on_mesh(self, tree):
+        """Replicate leaves over this mesh — but PRESERVE any existing
+        tensor-parallel placement (tensor_parallel.shard_params /
+        shard_graph_params) already on the same mesh: dp x tp is the
+        wrapper's mesh carrying both axes, with GSPMD inserting the
+        collectives."""
+        repl = self._replicated()
+
+        def place(a):
+            sh = getattr(a, "sharding", None)
+            if (isinstance(sh, NamedSharding)
+                    and sh.mesh.shape == self.mesh.shape
+                    and tuple(sh.mesh.axis_names)
+                    == tuple(self.mesh.axis_names)):
+                return a                 # already placed on this mesh
+            return jax.device_put(a, repl)
+
+        return jax.tree_util.tree_map(place, tree)
+
     def _shard_leaf(self, a):
         return jax.device_put(
             a, NamedSharding(self.mesh, P("data", *([None] * (a.ndim - 1)))))
@@ -231,10 +250,9 @@ class ParallelWrapper:
             if model._jit_train_step is None:
                 model._jit_train_step = model._make_train_step()
             step = model._jit_train_step
-        repl = self._replicated()
-        model.params = jax.device_put(model.params, repl)
-        model.state = jax.device_put(model.state, repl)
-        model.opt_state = jax.device_put(model.opt_state, repl)
+        model.params = self._on_mesh(model.params)
+        model.state = self._on_mesh(model.state)
+        model.opt_state = self._on_mesh(model.opt_state)
         if compressed and self._residual is None:
             self._residual = self._init_residual()
         it = AsyncDataSetIterator(iterator, self.prefetch) \

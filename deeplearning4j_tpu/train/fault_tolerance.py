@@ -9,23 +9,37 @@ packages that loop:
 
 - periodic ATOMIC checkpoints (tmp + rename; a preemption mid-write
   never corrupts the latest checkpoint), pruned to ``keep`` newest;
+- the DATA POSITION (epoch index, batch index) rides inside the
+  checkpoint zip, so a resumed or rolled-back run fast-forwards the
+  iterator to exactly where the checkpointed model stopped —
+  kill-at-iteration-k + resume reproduces the uninterrupted run
+  bit-for-bit for a deterministic iterator (the reference's
+  serialization-regression discipline, SURVEY §4.3, applied here);
 - automatic resume from the newest valid checkpoint on construction;
 - SIGTERM → checkpoint immediately and stop cleanly (the TPU
-  preemption grace-window contract);
-- non-finite loss → roll back to the last checkpoint and continue
-  (InvalidScore semantics, but recovering instead of terminating),
-  bounded by ``max_rollbacks``.
+  preemption grace-window contract); the handler is only installed on
+  the main thread (signal.signal raises elsewhere);
+- non-finite loss → roll back to the last checkpoint (model AND data
+  position), REPLAY the batches in between, and skip exactly the one
+  batch that produced the non-finite loss (InvalidScore-skip semantics:
+  a deterministic poison batch must not re-diverge the replay forever).
+  Bounded by ``max_rollbacks`` per incident: the rollback counter
+  decays to zero after ``heal_after`` consecutive healthy iterations,
+  so the bound is per-divergence, not per-lifetime.
 
 Works with both executors via the zip serializer.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import re
 import signal
+import threading
 import time
+import zipfile
 from typing import Optional
 
 import numpy as np
@@ -35,21 +49,35 @@ logger = logging.getLogger("deeplearning4j_tpu")
 __all__ = ["ElasticTrainer"]
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.zip$")
+_POS_ENTRY = "data_position.json"
 
 
 class ElasticTrainer:
     def __init__(self, model, checkpoint_dir: str, *,
                  save_every: int = 100, keep: int = 3,
-                 max_rollbacks: int = 5, handle_sigterm: bool = True):
+                 max_rollbacks: int = 5, heal_after: Optional[int] = None,
+                 handle_sigterm: bool = True, wrapper=None):
+        # wrapper: optional ParallelWrapper around ``model`` — batches
+        # then train data-parallel while checkpoint/restore still talks
+        # to the underlying model (ParallelWrapper.java analog: the
+        # wrapper composes with, not replaces, the model's lifecycle)
         self.model = model
+        self.wrapper = wrapper
         self.dir = checkpoint_dir
         os.makedirs(checkpoint_dir, exist_ok=True)
         self.save_every = max(1, save_every)
         self.keep = max(1, keep)
         self.max_rollbacks = max_rollbacks
+        self.heal_after = (save_every if heal_after is None
+                           else max(1, heal_after))
         self.handle_sigterm = handle_sigterm
-        self.rollbacks = 0
+        self.rollbacks = 0           # current incident (decays)
+        self.total_rollbacks = 0     # lifetime (never decays)
+        self._healthy_streak = 0
         self._stop_requested = False
+        self._epoch = 0          # data position: epoch index
+        self._batch = 0          # batches consumed within that epoch
+        self._skip = set()       # (epoch, batch) ordinals to skip
         self._resume()
 
     # -- checkpoint plumbing ----------------------------------------------
@@ -71,13 +99,19 @@ class ElasticTrainer:
         final = os.path.join(self.dir, f"ckpt_{it}.zip")
         tmp = final + f".tmp{os.getpid()}"
         write_model(self.model, tmp)
+        # the data position rides in the same zip: one atomic artifact,
+        # no model/position skew after a mid-write preemption
+        with zipfile.ZipFile(tmp, "a") as z:
+            z.writestr(_POS_ENTRY, json.dumps(
+                {"epoch": self._epoch, "batch": self._batch}))
         os.replace(tmp, final)          # atomic on POSIX
         for _, path in self._ckpts()[:-self.keep]:
             try:
                 os.remove(path)
             except OSError:
                 pass
-        logger.info("checkpoint at iteration %d -> %s", it, final)
+        logger.info("checkpoint at iteration %d (epoch %d, batch %d) "
+                    "-> %s", it, self._epoch, self._batch, final)
         return final
 
     def _restore_into_model(self, path: str):
@@ -89,6 +123,14 @@ class ElasticTrainer:
         m.opt_state = loaded.opt_state
         m.iteration_count = loaded.iteration_count
         m.epoch_count = loaded.epoch_count
+        try:
+            with zipfile.ZipFile(path, "r") as z:
+                pos = json.loads(z.read(_POS_ENTRY))
+            self._epoch = int(pos["epoch"])
+            self._batch = int(pos["batch"])
+        except (KeyError, json.JSONDecodeError):
+            # pre-position checkpoint (older format): restart the epoch
+            self._epoch, self._batch = 0, 0
 
     def _resume(self):
         path = self.latest_checkpoint()
@@ -96,38 +138,73 @@ class ElasticTrainer:
             if self.model.params is None:
                 self.model.init()
             self._restore_into_model(path)
-            logger.info("resumed from %s (iteration %d)", path,
-                        self.model.iteration_count)
+            logger.info("resumed from %s (iteration %d, epoch %d, "
+                        "batch %d)", path, self.model.iteration_count,
+                        self._epoch, self._batch)
 
     # -- the loop -----------------------------------------------------------
-    def fit(self, iterator, *, epochs: int = 1) -> "ElasticTrainer":
+    def fit(self, iterator, *, epochs: int = 1,
+            until_epoch: Optional[int] = None) -> "ElasticTrainer":
+        """``epochs`` is RELATIVE (train N more epochs from wherever
+        the trainer is — a resumed trainer continues); ``until_epoch``
+        is an ABSOLUTE target epoch index: rerunning the same
+        ``fit(until_epoch=N)`` command after a kill produces exactly
+        the uninterrupted run (restart == uninterrupted)."""
+        target = (self._epoch + max(0, epochs)
+                  if until_epoch is None else until_epoch)
         model = self.model
         if model.params is None:
             model.init()
         prev_handler = None
-        if self.handle_sigterm:
+        if (self.handle_sigterm
+                and threading.current_thread() is threading.main_thread()):
             def on_term(signum, frame):
                 # preemption grace window: persist, then stop cleanly
                 self._stop_requested = True
             prev_handler = signal.signal(signal.SIGTERM, on_term)
+        elif self.handle_sigterm:
+            logger.info("fit() on a non-main thread: SIGTERM handler "
+                        "not installed (signal.signal would raise)")
         try:
             if self.latest_checkpoint() is None:
                 self.save_checkpoint()       # iteration-0 restart point
-            for _ in range(epochs):
-                if self._stop_requested:
-                    break
+            while self._epoch < target and not self._stop_requested:
                 if hasattr(iterator, "reset"):
                     iterator.reset()
-                for ds in iterator:
+                it = iter(iterator)
+                # fast-forward a resumed/rolled-back run to the
+                # checkpointed batch — restart == uninterrupted for a
+                # deterministic iterator
+                for _ in range(self._batch):
+                    if next(it, None) is None:
+                        break
+                rolled_back = False
+                for ds in it:
                     if self._stop_requested:
                         break
-                    model.fit(ds)
+                    if (self._epoch, self._batch) in self._skip:
+                        self._batch += 1     # the poisoned batch
+                        continue
+                    if self.wrapper is not None:
+                        self.wrapper.fit([ds])
+                    else:
+                        model.fit(ds)
+                    self._batch += 1
                     loss = float(model.score_value)
                     if not np.isfinite(loss):
                         self._rollback()
-                        continue
+                        rolled_back = True
+                        break            # re-enter at restored position
+                    self._healthy_streak += 1
+                    if (self.rollbacks
+                            and self._healthy_streak >= self.heal_after):
+                        self.rollbacks = 0   # incident over
                     if model.iteration_count % self.save_every == 0:
                         self.save_checkpoint()
+                if rolled_back or self._stop_requested:
+                    continue
+                self._epoch += 1
+                self._batch = 0
             if self._stop_requested:
                 self.save_checkpoint()
                 logger.warning("stop requested (preemption?): "
@@ -140,6 +217,8 @@ class ElasticTrainer:
 
     def _rollback(self):
         self.rollbacks += 1
+        self.total_rollbacks += 1
+        self._healthy_streak = 0
         if self.rollbacks > self.max_rollbacks:
             raise RuntimeError(
                 f"non-finite loss persisted through "
@@ -153,4 +232,7 @@ class ElasticTrainer:
                        "to %s (rollback %d/%d)",
                        self.model.iteration_count, path, self.rollbacks,
                        self.max_rollbacks)
+        # the batch just consumed (ordinal _batch - 1) produced the
+        # non-finite loss: skip it on replay, replay everything else
+        self._skip.add((self._epoch, self._batch - 1))
         self._restore_into_model(path)

@@ -154,6 +154,42 @@ class TestFlashAttention:
         ref = np.asarray(attention_reference(q, k, v, causal=True))
         np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_interpret_backward_matches_autodiff(self, rng, causal):
+        """The backward Pallas kernels (dq + fused dk/dv, recomputing p
+        from the persisted lse) must match autodiff through exact
+        attention — the seam contract is both directions (reference
+        CudnnConvolutionHelper.java:156-192)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.attention import (
+            pallas_flash_attention, pallas_flash_attention_bwd)
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            attention_reference)
+        q, k, v = (rng.normal(0, 1, (2, 16, 2, 8)).astype(np.float32)
+                   for _ in range(3))
+        do = rng.normal(0, 1, (2, 16, 2, 8)).astype(np.float32)
+
+        o, lse = pallas_flash_attention(
+            q, k, v, block_q=8, block_k=8, causal=causal,
+            interpret=True, precision="highest", return_lse=True)
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, o, lse, do, block_q=8, block_k=8, causal=causal,
+            interpret=True, precision="highest")
+
+        def loss(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal)
+                           * do)
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rq),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rk),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rv),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_dispatcher_cpu_fallback(self, rng):
         from deeplearning4j_tpu.ops.attention import flash_attention
         from deeplearning4j_tpu.parallel.ring_attention import (
