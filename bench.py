@@ -539,11 +539,43 @@ def main():
     # risk the driver's timeout eating the headline)
     budget = float(os.environ.get("BENCH_BUDGET_SECONDS", "900"))
     t_start = time.perf_counter()
+    # persistent XLA compilation cache: the tunnel'd AOT compile of the
+    # ResNet50 train step alone is ~5 min; with the cache a repeat run's
+    # legs are seconds. Measured on this terminal: 46s -> 13s for a
+    # 30-layer MLP grad compile.
+    import jax
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_cache",
+        "xla")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
     peak, kind = _peak_flops()
     detail = {"device_kind": kind,
               "mfu_note": ("model-FLOPs MFU vs bf16 peak "
                            f"{peak/1e12:.0f} TFLOP/s" if peak else
                            "unknown device; MFU omitted"),
+              "mfu_analysis": (
+                  "What bounds MFU at the ResNet50 batch-128 224^2 "
+                  "config (~9% f32 / ~13% bf16): not framework "
+                  "overhead — flax measures the same (vs_baseline "
+                  "~1.0), so the ceiling is model-shape x hardware. "
+                  "(1) The stem and early stages have 64-256 channels: "
+                  "contraction dims below the 128x128 MXU tile leave "
+                  "lanes idle (the 7x7/2 stem contracts over just "
+                  "3x49=147 values). (2) ~53 BatchNorm+ReLU+residual "
+                  "elementwise passes move the full activation set "
+                  "through HBM; XLA fuses them into neighbors but the "
+                  "conv outputs still round-trip. (3) bf16 halves "
+                  "matmul passes (9->13% MFU, 1.44x step speedup) but "
+                  "the elementwise HBM traffic is dtype-bound, not "
+                  "flop-bound, so MFU does not double. Levers, in "
+                  "expected order of effect: batch 256 (deeper MXU "
+                  "pipelines per weight load), channel-padded stem, "
+                  "conv-fused activation quantization. VGG16's dense "
+                  "4096-wide layers show what the MXU does when "
+                  "shapes cooperate (see its MFU in this file)."),
               "configs": []}
     detail_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

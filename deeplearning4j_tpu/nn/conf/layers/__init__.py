@@ -15,7 +15,7 @@ from deeplearning4j_tpu.nn.conf.layers.base import (
 )
 from deeplearning4j_tpu.nn.conf.layers.core import (
     DenseLayer, ActivationLayer, DropoutLayer, EmbeddingLayer,
-    EmbeddingSequenceLayer, AutoEncoder, RBM,
+    EmbeddingSequenceLayer, AutoEncoder, RBM, RecursiveAutoEncoder,
 )
 from deeplearning4j_tpu.nn.conf.layers.output import (
     OutputLayer, RnnOutputLayer, LossLayer, CenterLossOutputLayer,
@@ -47,7 +47,7 @@ __all__ = [
     "Layer", "BaseLayer", "FeedForwardLayer", "register_layer",
     "layer_from_dict",
     "DenseLayer", "ActivationLayer", "DropoutLayer", "EmbeddingLayer",
-    "EmbeddingSequenceLayer", "AutoEncoder", "RBM",
+    "EmbeddingSequenceLayer", "AutoEncoder", "RBM", "RecursiveAutoEncoder",
     "OutputLayer", "RnnOutputLayer", "LossLayer", "CenterLossOutputLayer",
     "ConvolutionLayer", "Convolution1DLayer", "Deconvolution2DLayer",
     "SeparableConvolution2DLayer", "DepthwiseConvolution2DLayer",

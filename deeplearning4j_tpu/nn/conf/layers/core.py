@@ -257,3 +257,83 @@ class AutoEncoder(FeedForwardLayer):
         h = act(xc @ params["W"] + params["b"])
         recon = act(h @ params["W"].T + params["vb"])
         return jnp.mean(losses_mod.get(self.loss)(x, recon, None))
+
+
+@register_layer
+@dataclasses.dataclass
+class RecursiveAutoEncoder(FeedForwardLayer):
+    """Recursive autoencoder over sequences
+    (nn/conf/layers/RecursiveAutoEncoder... — reference impl
+    nn/layers/feedforward/recursive/RecursiveAutoEncoder.java): the
+    hidden code folds the sequence left to right — at each step the
+    carry and the next input are jointly encoded, and pretraining
+    reconstructs the [carry; input] pair from the code. TPU-native
+    shape: the fold is a ``lax.scan`` (sequential by definition; the
+    matmuls inside still batch over B on the MXU).
+
+    Supervised forward = the final code (B, n_out) — a
+    sequence-collapsing encoder. ``pretrain_loss`` = mean
+    reconstruction error across steps, driven by
+    MultiLayerNetwork.pretrain like the other BasePretrainNetwork
+    analogs (AutoEncoder/RBM/VAE).
+    """
+
+    loss: str = "mse"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.n_in = input_type.size
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        k1, k2 = jax.random.split(key)
+        pd = dtypes.policy().param_dtype
+        z = self.n_out + self.n_in          # [carry; x_t]
+        return {
+            "W": self._sample_w(k1, (z, self.n_out), z, self.n_out),
+            "b": jnp.full((self.n_out,), self.bias_init, pd),
+            "Wd": self._sample_w(k2, (self.n_out, z), self.n_out, z),
+            "vb": jnp.zeros((z,), pd),       # decode bias
+        }, {}
+
+    def _fold(self, params, x, mask=None):
+        """x: (B, T, C) → (final code (B, n_out), mean recon loss).
+        ``mask`` (B, T) 0/1: padded steps neither advance the carry
+        nor contribute reconstruction loss (same state-gating contract
+        as the recurrent layers)."""
+        from deeplearning4j_tpu.nn import losses as losses_mod
+        act = self.activation_fn()
+        loss_fn = losses_mod.get(self.loss)
+        B = x.shape[0]
+        h0 = jnp.zeros((B, self.n_out), x.dtype)
+        if mask is None:
+            m_t = jnp.ones((x.shape[1], B), x.dtype)
+        else:
+            m_t = jnp.swapaxes(jnp.asarray(mask, x.dtype), 0, 1)
+
+        def step(h, inp):
+            xt, mt = inp
+            z = jnp.concatenate([h, xt], axis=-1)
+            code = act(z @ params["W"] + params["b"])
+            recon = act(code @ params["Wd"] + params["vb"])
+            h_new = jnp.where(mt[:, None] > 0, code, h)
+            per_ex = jnp.mean(loss_fn(z, recon, None).reshape(B, -1),
+                              axis=-1)
+            return h_new, (jnp.sum(per_ex * mt), jnp.sum(mt))
+
+        h, (lsum, msum) = jax.lax.scan(step, h0,
+                                       (jnp.swapaxes(x, 0, 1), m_t))
+        mean_loss = jnp.sum(lsum) / jnp.maximum(jnp.sum(msum), 1.0)
+        return h, mean_loss
+
+    def apply(self, params, state, x, *, training=False, rng=None,
+              mask=None):
+        x = self.apply_input_dropout(x, training=training, rng=rng)
+        h, _ = self._fold(params, x, mask)
+        return h, state
+
+    def pretrain_loss(self, params, x, rng, mask=None):
+        _, mean_loss = self._fold(params, x, mask)
+        return mean_loss

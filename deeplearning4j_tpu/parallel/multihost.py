@@ -37,6 +37,21 @@ def initialize_distributed(coordinator: Optional[str] = None,
     """
     coordinator = coordinator or os.environ.get("DL4J_TPU_COORDINATOR")
     if coordinator is None:
+        # TPU-VM pod slices: bare jax.distributed.initialize()
+        # auto-discovers peers (GCE metadata server / GKE-injected
+        # vars). Plain gcloud-created VMs expose no distinguishing env
+        # var in an ssh shell, so auto mode is an explicit opt-in
+        # (DL4J_TPU_AUTO=1 — what the COMPONENTS.md recipe exports);
+        # GKE TPU pods are also recognized by their injected vars.
+        if (os.environ.get("DL4J_TPU_AUTO") == "1"
+                or os.environ.get("TPU_WORKER_HOSTNAMES")
+                or os.environ.get("CLOUD_TPU_TASK_ID")):
+            jax.distributed.initialize()
+            logger.info("distributed runtime up via TPU-VM "
+                        "auto-discovery: process %d/%d, %d devices",
+                        jax.process_index(), jax.process_count(),
+                        jax.device_count())
+            return True
         return False
     num_processes = num_processes or int(
         os.environ.get("DL4J_TPU_NUM_PROCESSES", "1"))

@@ -21,6 +21,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <filesystem>
 #include <fstream>
 #include <mutex>
 #include <queue>
@@ -28,6 +29,10 @@
 #include <thread>
 #include <unordered_map>
 #include <vector>
+
+#ifndef DL4J_NO_PNG
+#include <png.h>
+#endif
 
 namespace {
 
@@ -197,6 +202,235 @@ struct Loader {
 };
 
 // ---------------------------------------------------------------------------
+// native image ETL: directory-per-label PNG tree -> (B,H,W,C) float
+// batches + one-hot labels, decoded by a worker pool (libpng). The
+// DataVec ImageRecordReader path (reference
+// deeplearning4j-core/.../RecordReaderDataSetIterator.java:52 over
+// datavec-data-image) — justified by measurement: single-thread PIL
+// decodes a 224x224 PNG in ~1.4 ms => 174 ms per batch-128, twice
+// the ~88 ms TPU ResNet50 step; the native pool decodes in parallel
+// outside the GIL and stays ahead of the device.
+
+#ifndef DL4J_NO_PNG
+// Decode a PNG into tightly packed 8-bit gray or RGB rows.
+bool read_png(const char* path, int channels,
+              std::vector<unsigned char>& out, unsigned* w,
+              unsigned* h) {
+  png_image image;
+  std::memset(&image, 0, sizeof image);
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_file(&image, path)) return false;
+  image.format = channels == 1 ? PNG_FORMAT_GRAY : PNG_FORMAT_RGB;
+  out.resize(PNG_IMAGE_SIZE(image));
+  if (!png_image_finish_read(&image, nullptr, out.data(), 0, nullptr)) {
+    png_image_free(&image);
+    return false;
+  }
+  *w = image.width;
+  *h = image.height;
+  return true;
+}
+#endif
+
+struct ImageLoader {
+  int batch_size, H, W, C, queue_capacity;
+  std::vector<std::pair<std::string, int>> items;  // path, label idx
+  std::vector<std::string> classes;
+  std::atomic<size_t> next_item{0};
+  std::queue<Batch*> ready;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::vector<std::thread> workers;
+  std::atomic<int> active_workers{0};
+  std::atomic<int64_t> skipped{0};
+  bool stopped = false;
+
+  ~ImageLoader() { stop(); }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      stopped = true;
+    }
+    cv_space.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    workers.clear();
+    std::lock_guard<std::mutex> lock(mu);
+    while (!ready.empty()) {
+      delete ready.front();
+      ready.pop();
+    }
+  }
+
+  bool scan(const std::string& root) {
+    namespace fs = std::filesystem;
+    std::error_code ec;
+    if (!fs::is_directory(root, ec)) return false;
+    for (auto& d : fs::directory_iterator(root, ec)) {
+      if (d.is_directory()) classes.push_back(d.path().filename());
+    }
+    std::sort(classes.begin(), classes.end());
+    for (size_t li = 0; li < classes.size(); ++li) {
+      std::vector<std::string> files;
+      for (auto& f :
+           fs::directory_iterator(fs::path(root) / classes[li], ec)) {
+        std::string ext = f.path().extension();
+        std::transform(ext.begin(), ext.end(), ext.begin(), ::tolower);
+        if (ext == ".png") files.push_back(f.path());
+      }
+      std::sort(files.begin(), files.end());
+      for (auto& f : files) items.emplace_back(f, (int)li);
+    }
+    return !items.empty();
+  }
+
+  // bilinear resize (src 8-bit HxWxC) into the row-th slot as float
+  void resize_into(const unsigned char* src, unsigned sw, unsigned sh,
+                   Batch* b, int row) {
+    float* dst = b->features.data() + (size_t)row * H * W * C;
+    if ((int)sw == W && (int)sh == H) {
+      const size_t n = (size_t)H * W * C;
+      for (size_t i = 0; i < n; ++i) dst[i] = (float)src[i];
+      return;
+    }
+    const float sx = (float)sw / W, sy = (float)sh / H;
+    for (int y = 0; y < H; ++y) {
+      float fy = (y + 0.5f) * sy - 0.5f;
+      int y0 = (int)fy;
+      y0 = std::max(0, std::min((int)sh - 1, y0));
+      int y1 = std::min((int)sh - 1, y0 + 1);
+      float wy = fy - y0;
+      if (wy < 0) wy = 0;
+      for (int x = 0; x < W; ++x) {
+        float fx = (x + 0.5f) * sx - 0.5f;
+        int x0 = (int)fx;
+        x0 = std::max(0, std::min((int)sw - 1, x0));
+        int x1 = std::min((int)sw - 1, x0 + 1);
+        float wx = fx - x0;
+        if (wx < 0) wx = 0;
+        for (int c = 0; c < C; ++c) {
+          float v00 = src[((size_t)y0 * sw + x0) * C + c];
+          float v01 = src[((size_t)y0 * sw + x1) * C + c];
+          float v10 = src[((size_t)y1 * sw + x0) * C + c];
+          float v11 = src[((size_t)y1 * sw + x1) * C + c];
+          dst[(((size_t)y * W) + x) * C + c] =
+              v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+              v10 * wy * (1 - wx) + v11 * wy * wx;
+        }
+      }
+    }
+  }
+
+  // One coordinator walks batches in order; each batch's decodes are
+  // split across a scoped thread team (parallelism WITHIN the batch —
+  // claiming whole batches per worker serializes the common
+  // one-batch-in-flight training loop).
+  void coordinator(int n_threads) {
+#ifndef DL4J_NO_PNG
+    const int n_classes = (int)classes.size();
+    for (size_t start = 0; start < items.size();
+         start += (size_t)batch_size) {
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        if (stopped) break;
+      }
+      size_t end_i = std::min(start + (size_t)batch_size, items.size());
+      const int expected = (int)(end_i - start);
+      Batch* b = new Batch();
+      b->features.resize((size_t)batch_size * H * W * C, 0.0f);
+      b->labels.resize((size_t)batch_size * n_classes, 0.0f);
+      std::vector<char> ok((size_t)expected, 0);
+      std::atomic<int> cursor{0};
+      const int nt = std::max(1, std::min(n_threads, expected));
+      std::vector<std::thread> team;
+      for (int t = 0; t < nt; ++t) {
+        team.emplace_back([&, this] {
+          std::vector<unsigned char> buf;
+          for (;;) {
+            int j = cursor.fetch_add(1);
+            if (j >= expected) break;
+            unsigned sw = 0, sh = 0;
+            if (!read_png(items[start + j].first.c_str(), C, buf, &sw,
+                          &sh))
+              continue;
+            resize_into(buf.data(), sw, sh, b, j);
+            b->labels[(size_t)j * n_classes + items[start + j].second] =
+                1.0f;
+            ok[(size_t)j] = 1;
+          }
+        });
+      }
+      for (auto& t : team) t.join();
+      // compact failed rows out
+      const size_t fstride = (size_t)H * W * C;
+      int row = 0;
+      for (int j = 0; j < expected; ++j) {
+        if (!ok[(size_t)j]) {
+          skipped.fetch_add(1);
+          continue;
+        }
+        if (row != j) {
+          std::memmove(b->features.data() + (size_t)row * fstride,
+                       b->features.data() + (size_t)j * fstride,
+                       fstride * sizeof(float));
+          std::memmove(b->labels.data() + (size_t)row * n_classes,
+                       b->labels.data() + (size_t)j * n_classes,
+                       (size_t)n_classes * sizeof(float));
+        }
+        ++row;
+      }
+      b->n = row;
+      if (row == 0) {
+        delete b;
+        continue;
+      }
+      std::unique_lock<std::mutex> lock(mu);
+      cv_space.wait(lock, [&] {
+        return stopped || (int)ready.size() < queue_capacity;
+      });
+      if (stopped) {
+        delete b;
+        break;
+      }
+      ready.push(b);
+      cv_ready.notify_one();
+    }
+#endif
+    if (active_workers.fetch_sub(1) == 1) cv_ready.notify_all();
+  }
+
+  void start(int n_threads) {
+    active_workers = 1;
+    workers.emplace_back([this, n_threads] { coordinator(n_threads); });
+  }
+
+  int next(float* feat_out, float* lab_out) {
+    Batch* b = nullptr;
+    {
+      std::unique_lock<std::mutex> lock(mu);
+      cv_ready.wait(lock, [&] {
+        return stopped || !ready.empty() || active_workers.load() == 0;
+      });
+      if (stopped) return -1;
+      if (ready.empty()) return 0;
+      b = ready.front();
+      ready.pop();
+      cv_space.notify_one();
+    }
+    std::memcpy(feat_out, b->features.data(),
+                b->features.size() * sizeof(float));
+    if (lab_out && !b->labels.empty())
+      std::memcpy(lab_out, b->labels.data(),
+                  b->labels.size() * sizeof(float));
+    int n = b->n;
+    delete b;
+    return n;
+  }
+};
+
+// ---------------------------------------------------------------------------
 // fast word counting for vocab construction (NLP VocabConstructor's
 // hot loop; the reference parallelizes this across threads too)
 struct WordCounts {
@@ -244,6 +478,63 @@ int dl4j_loader_next(void* handle, float* feat_out, float* lab_out) {
 
 void dl4j_loader_destroy(void* handle) {
   delete static_cast<Loader*>(handle);
+}
+
+// Image-tree loader (PNG via libpng; 0/nullptr when built without it)
+void* dl4j_image_loader_create(const char* root, int batch_size,
+                               int height, int width, int channels,
+                               int n_threads, int queue_capacity) {
+#ifdef DL4J_NO_PNG
+  (void)root; (void)batch_size; (void)height; (void)width;
+  (void)channels; (void)n_threads; (void)queue_capacity;
+  return nullptr;
+#else
+  auto* l = new ImageLoader();
+  l->batch_size = batch_size;
+  l->H = height;
+  l->W = width;
+  l->C = channels == 1 ? 1 : 3;
+  l->queue_capacity = queue_capacity > 0 ? queue_capacity : 4;
+  if (!l->scan(root)) {
+    delete l;
+    return nullptr;
+  }
+  l->start(n_threads > 0 ? n_threads : 4);
+  return l;
+#endif
+}
+
+int dl4j_image_loader_available() {
+#ifdef DL4J_NO_PNG
+  return 0;
+#else
+  return 1;
+#endif
+}
+
+int64_t dl4j_image_loader_num_items(void* handle) {
+  return (int64_t) static_cast<ImageLoader*>(handle)->items.size();
+}
+
+int dl4j_image_loader_num_classes(void* handle) {
+  return (int)static_cast<ImageLoader*>(handle)->classes.size();
+}
+
+const char* dl4j_image_loader_class_name(void* handle, int i) {
+  return static_cast<ImageLoader*>(handle)->classes[i].c_str();
+}
+
+int64_t dl4j_image_loader_skipped(void* handle) {
+  return static_cast<ImageLoader*>(handle)->skipped.load();
+}
+
+int dl4j_image_loader_next(void* handle, float* feat_out,
+                           float* lab_out) {
+  return static_cast<ImageLoader*>(handle)->next(feat_out, lab_out);
+}
+
+void dl4j_image_loader_destroy(void* handle) {
+  delete static_cast<ImageLoader*>(handle);
 }
 
 // Count whitespace-separated tokens in a text file using n_threads.

@@ -103,6 +103,109 @@ class TestNativeLoader:
         net.fit(it, epochs=30)
         assert net.evaluate(xs, ys).accuracy() > 0.9
 
+    def _write_png_tree(self, root, n_per=6, hw=24, classes=("a", "b")):
+        from PIL import Image
+        rng = np.random.default_rng(3)
+        for li, lab in enumerate(classes):
+            d = os.path.join(root, lab)
+            os.makedirs(d, exist_ok=True)
+            for i in range(n_per):
+                arr = rng.integers(0, 255, (hw, hw, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(
+                    os.path.join(d, f"img{i:02d}.png"))
+
+    def test_native_image_loader_matches_pil(self, tmp_path):
+        """The libpng worker pool decodes exactly what PIL decodes
+        (same-size images: no resampling in play). Justification for
+        the native path is the measured 174 ms/batch-128 Python decode
+        vs the 88 ms TPU step (see module docstring)."""
+        from deeplearning4j_tpu.data.native_loader import (
+            NativeImageDataSetIterator, native_image_available)
+        from deeplearning4j_tpu.data.records import ImageRecordReader
+        if not native_image_available():
+            pytest.skip("no native toolchain / libpng")
+        root = str(tmp_path / "imgs")
+        self._write_png_tree(root)
+        it = NativeImageDataSetIterator(root, batch_size=4, height=24,
+                                        width=24, n_threads=2)
+        assert it.num_examples() == 12
+        assert it.labels() == ["a", "b"]
+        feats, labs = [], []
+        for ds in it:
+            feats.append(ds.features)
+            labs.append(ds.labels)
+        gf = np.concatenate(feats)
+        gl = np.concatenate(labs).argmax(1)
+        assert gf.shape == (12, 24, 24, 3)
+        # PIL reference via the Python reader
+        rr = ImageRecordReader(24, 24, 3).initialize(root)
+        ref = {}
+        for (arr, li), (path, _) in zip(iter(rr), rr._items):
+            ref[arr.tobytes()] = li
+        # batches may arrive in any order: match by content
+        for row, lab in zip(gf, gl):
+            key = row.astype(np.float32).tobytes()
+            assert key in ref, "native decode differs from PIL"
+            assert ref[key] == lab
+
+    def test_native_image_loader_resizes(self, tmp_path):
+        from deeplearning4j_tpu.data.native_loader import (
+            NativeImageDataSetIterator, native_image_available)
+        if not native_image_available():
+            pytest.skip("no native toolchain / libpng")
+        root = str(tmp_path / "imgs")
+        self._write_png_tree(root, n_per=3, hw=32)
+        it = NativeImageDataSetIterator(root, batch_size=3, height=16,
+                                        width=16)
+        ds = next(iter(it))
+        assert ds.features.shape == (3, 16, 16, 3)
+        assert np.isfinite(ds.features).all()
+        assert ds.features.max() > 1.0      # 0-255 range, not empty
+
+    def test_native_image_decode_throughput(self, tmp_path):
+        """The point of the native path: the measured decode rate must
+        beat single-threaded PIL (GIL-free worker pool)."""
+        import time
+
+        from PIL import Image
+
+        from deeplearning4j_tpu.data.native_loader import (
+            NativeImageDataSetIterator, native_image_available)
+        if not native_image_available():
+            pytest.skip("no native toolchain / libpng")
+        # the justification config: 224x224, one ResNet50 batch
+        root = str(tmp_path / "imgs")
+        self._write_png_tree(root, n_per=128, hw=224, classes=("a",))
+        t0 = time.perf_counter()
+        it = NativeImageDataSetIterator(root, batch_size=128,
+                                        height=224, width=224,
+                                        n_threads=4)
+        n_native = sum(ds.num_examples() for ds in it)
+        dt_native = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        n_pil = 0
+        for f in sorted(os.listdir(os.path.join(root, "a"))):
+            img = Image.open(os.path.join(root, "a", f)).convert("RGB")
+            np.asarray(img, dtype=np.float32)
+            n_pil += 1
+        dt_pil = time.perf_counter() - t0
+        assert n_native == n_pil == 128
+        print(f"native {n_native / dt_native:.0f} img/s vs PIL "
+              f"{n_pil / dt_pil:.0f} img/s "
+              f"(batch-128 ETL: native {dt_native * 1e3:.0f} ms vs "
+              f"PIL {dt_pil * 1e3:.0f} ms vs ~88 ms TPU step)")
+        cores = os.cpu_count() or 1
+        if cores >= 4:
+            # GIL-free decode team vs 1 Python thread: the native
+            # path must win where parallelism exists (TPU-VM hosts
+            # have dozens of cores)
+            assert dt_native < dt_pil
+        else:
+            # this box cannot demonstrate parallel decode (e.g. the
+            # 1-core CI container); correctness checked above, and
+            # single-core native must at least be same order as PIL
+            assert dt_native < dt_pil * 3
+
     def test_word_count(self, tmp_path):
         from deeplearning4j_tpu.data.native_loader import (
             native_available, native_count_words)

