@@ -30,7 +30,7 @@ IMG = 224
 STEPS = 40
 WARMUP = 5
 LENET_BATCH = 128
-LENET_STEPS = 300
+LENET_STEPS = 600
 
 # bf16 peak FLOP/s per chip by device kind (prefix match). Used only
 # for the MFU side-metric; throughput vs flax is the headline.
@@ -54,14 +54,20 @@ def _peak_flops():
 
 def _make_measure(step_fn, args, steps, warmup, get_loss):
     """Compile + warm up now; return a zero-arg measure() giving the
-    wall time of one ``steps``-burst. The tunnel'd chip's throughput
-    drifts minute to minute, so ours/baseline bursts are INTERLEAVED
-    by the caller (same drift window on both sides) and the best of N
-    bursts taken per side."""
+    wall time of one ``steps``-burst. Measurement discipline for the
+    tunnel'd chip: (a) bursts are LARGE (seconds of compute) so the
+    tunnel's fixed ~130 ms per-burst sync cost is a few percent — and
+    it lands on ours and baseline equally, so the ratio is unbiased;
+    (b) noise here is additive-positive (sync cost, drift, host
+    contention), so the caller takes the MIN of N interleaved bursts —
+    the robust estimator. (Two-point subtraction of burst pairs was
+    tried and rejected: subtracting makes the noise signed, and under
+    heavy drift the difference can even go negative.)"""
     import jax
+    import jax.numpy as jnp
     for _ in range(warmup):
         args = step_fn(*args)
-    jax.block_until_ready(get_loss(args))
+    float(jnp.sum(get_loss(args)))
     holder = {"args": args}
 
     def measure() -> float:
@@ -69,7 +75,10 @@ def _make_measure(step_fn, args, steps, warmup, get_loss):
         t0 = time.perf_counter()
         for _ in range(steps):
             a = step_fn(*a)
-        jax.block_until_ready(get_loss(a))
+        # host FETCH, not block_until_ready: the tunnel's block is a
+        # no-op for non-donated arrays (see _time_infer note); a fetch
+        # of the loss scalar is the only reliable end-of-burst sync
+        float(jnp.sum(get_loss(a)))
         holder["args"] = a
         return time.perf_counter() - t0
 
@@ -86,14 +95,46 @@ def _interleave(measure_ours, measure_ref, repeats=3):
 
 
 def _time_infer(fn, x, steps, warmup):
+    """Inference timing with a data dependency chaining step N+1 on
+    step N's output — the tunnel'd runtime dedupes identical in-flight
+    calls, which times as ~0. Large single bursts + caller min-of-N
+    (see _make_measure's noise note). ``chained`` is deliberately NOT
+    jitted: fn may close over big weights, and a jit here would bake
+    them into the HLO as constants (see bench_flax_vgg16_infer); the
+    tiny select runs as a second dispatch instead."""
     import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _link(out, x):
+        # the tunnel'd runtime memoizes (executable, input CONTENT):
+        # returning x bitwise-identical made all 60 steps one cached
+        # call (implied MFU 50+). The next input must (a) depend on
+        # this step's output — isnan is runtime-only, uncomputable at
+        # compile — and (b) actually drift: +1e-4/step is irrelevant
+        # to N(0,1) image stats but defeats content-keyed caching.
+        bump = jnp.where(jnp.isnan(jnp.mean(out)),
+                         jnp.asarray(2e-4, x.dtype),
+                         jnp.asarray(1e-4, x.dtype))
+        return x + bump
+
+    def chained(x):
+        out = fn(x)
+        return _link(out, x), out
+
+    xx = jnp.asarray(x)
     for _ in range(warmup):
-        out = fn(x)
-    jax.block_until_ready(out)
+        xx, out = chained(xx)
+    float(jnp.sum(out))
+
     t0 = time.perf_counter()
+    a = xx
     for _ in range(steps):
-        out = fn(x)
-    jax.block_until_ready(out)
+        a, out = chained(a)
+    # block_until_ready is a no-op for non-donated arrays through the
+    # tunnel (training steps donate, which forces real backpressure;
+    # inference doesn't) — a host FETCH is the only reliable sync
+    float(jnp.sum(out))
     return time.perf_counter() - t0
 
 
@@ -317,7 +358,7 @@ CHAR_BATCH = 32
 CHAR_T = 64
 CHAR_VOCAB = 80
 CHAR_HIDDEN = 256
-CHAR_STEPS = 30
+CHAR_STEPS = 300
 
 
 def bench_ours_char_rnn(batch=CHAR_BATCH, t=CHAR_T, vocab=CHAR_VOCAB,
@@ -408,7 +449,7 @@ def bench_flax_char_rnn(batch=CHAR_BATCH, t=CHAR_T, vocab=CHAR_VOCAB,
 # ---------------------------------------------------------------------------
 
 VGG_BATCH = 32
-VGG_STEPS = 20
+VGG_STEPS = 60
 
 
 _KERAS_VGG16_SCRIPT = r"""
@@ -456,7 +497,8 @@ def bench_keras_imported_vgg16(batch=VGG_BATCH, steps=VGG_STEPS,
     os.makedirs(cache_dir, exist_ok=True)
     path = os.path.join(cache_dir, "vgg16.h5")
     if not os.path.exists(path):
-        tmp = path + ".tmp"
+        # keras validates the extension, so the temp name must end .h5
+        tmp = os.path.join(cache_dir, "vgg16.build-tmp.h5")
         _build_keras_vgg16(tmp)
         os.replace(tmp, path)
     net = import_keras_model_and_weights(path)
@@ -496,13 +538,17 @@ def bench_flax_vgg16_infer(batch=VGG_BATCH, steps=VGG_STEPS,
                     .astype("float32"))
     model = VGG16F()
     params = model.init(jax.random.PRNGKey(0), x)
+    # params as an ARGUMENT, never a closure: closed-over arrays bake
+    # into the HLO as literals, and VGG16's 554MB of constants breaks
+    # the tunnel's compile endpoint (the recurring remote_compile
+    # broken-pipe — our side passes params as args and never failed)
+    infer = jax.jit(model.apply)
 
-    @jax.jit
-    def infer(x):
-        return model.apply(params, x)
+    def fn(x):
+        return infer(params, x)
 
     def m():
-        return _time_infer(infer, x, steps, 1)
+        return _time_infer(fn, x, steps, 1)
     if prep:
         return m
     return steps * batch / m()
@@ -531,18 +577,218 @@ def _mfu(per_item_fwd_flops, items_per_sec, train, peak):
     return items_per_sec * flops / peak
 
 
-def main():
-    headline_only = ("--headline-only" in sys.argv
-                     or os.environ.get("BENCH_HEADLINE_ONLY") == "1")
-    # wall budget for the non-headline extras (the VGG leg ships 554MB
-    # of imported weights over the tunnel — skip extras rather than
-    # risk the driver's timeout eating the headline)
-    budget = float(os.environ.get("BENCH_BUDGET_SECONDS", "900"))
-    t_start = time.perf_counter()
-    # persistent XLA compilation cache: the tunnel'd AOT compile of the
-    # ResNet50 train step alone is ~5 min; with the cache a repeat run's
-    # legs are seconds. Measured on this terminal: 46s -> 13s for a
-    # 30-layer MLP grad compile.
+
+# ---------------------------------------------------------------------------
+# legs — each returns one BENCH_DETAIL config dict. Legs run in their
+# own SUBPROCESS (``--leg NAME``): the tunnel'd TPU terminal degrades
+# inside long-lived processes (observed: remote_compile broken-pipe and
+# async-no-block timings after ~30 min), so each leg gets a fresh
+# connection and its own timeout, and a crashed leg cannot take the
+# others down. The persistent XLA cache keeps repeat compiles fast.
+# ---------------------------------------------------------------------------
+
+def _check_plausible(mfu_like, what):
+    """A degraded tunnel sometimes stops blocking on results and legs
+     'measure' physically impossible throughput. Reject anything that
+    implies >90% of peak so the orchestrator can retry the leg."""
+    if mfu_like is not None and mfu_like > 0.9:
+        raise RuntimeError(
+            f"implausible timing for {what}: implied MFU "
+            f"{mfu_like:.2f} — tunnel degraded (non-blocking sync?)")
+
+
+def _leg_resnet_f32(peak):
+    m_ours = bench_ours(prep=True)
+    m_ref = bench_flax_resnet50(prep=True)
+    dt_o, dt_r = _interleave(m_ours, m_ref, repeats=2)
+    ours = STEPS * BATCH / dt_o
+    ref = STEPS * BATCH / dt_r
+    print(f"resnet50 ours: {ours:.1f} img/s, flax ref: {ref:.1f}",
+          file=sys.stderr)
+    if peak:
+        _check_plausible(_mfu(RESNET50_FWD_FLOPS, max(ours, ref), True,
+                              peak), "resnet50 f32")
+    return {
+        "metric": "ResNet50 train throughput (batch 128, 224x224, f32)",
+        "value": round(ours, 1), "unit": "images/sec/chip",
+        "baseline": round(ref, 1), "vs_baseline": round(ours / ref, 3),
+        "mfu": round(_mfu(RESNET50_FWD_FLOPS, ours, True, peak), 4)
+        if peak else None}
+
+
+def _leg_resnet_bf16(peak):
+    from deeplearning4j_tpu import dtypes
+    with dtypes.policy_scope(dtypes.tpu_bf16()):
+        m_ours = bench_ours(prep=True)
+    m_ref = bench_flax_resnet50_bf16(prep=True)
+    dt_o, dt_r = _interleave(m_ours, m_ref, repeats=3)
+    ours16 = STEPS * BATCH / dt_o
+    ref16 = STEPS * BATCH / dt_r
+    print(f"resnet50 bf16 ours: {ours16:.1f} img/s, flax bf16: "
+          f"{ref16:.1f}", file=sys.stderr)
+    if peak:
+        _check_plausible(_mfu(RESNET50_FWD_FLOPS, max(ours16, ref16),
+                              True, peak), "resnet50 bf16")
+    return {
+        "metric": ("ResNet50 train throughput bf16 compute (batch "
+                   "128, 224x224)"),
+        "value": round(ours16, 1), "unit": "images/sec/chip",
+        "baseline": round(ref16, 1),
+        "vs_baseline": round(ours16 / ref16, 3),
+        "mfu": round(_mfu(RESNET50_FWD_FLOPS, ours16, True, peak), 4)
+        if peak else None,
+        "note": ("ours: bf16 compute AND bf16 hidden activations "
+                 "(f32 params/BN-stats/logits); baseline: flax "
+                 "modules with dtype=bfloat16")}
+
+
+def _leg_lenet(peak):
+    m_ours = bench_ours_lenet(prep=True)
+    m_ref = bench_flax_lenet(prep=True)
+    dt_o, dt_r = _interleave(m_ours, m_ref, repeats=3)
+    lenet = LENET_STEPS * LENET_BATCH / dt_o
+    lenet_ref = LENET_STEPS * LENET_BATCH / dt_r
+    print(f"lenet ours: {lenet:.0f} img/s, flax: {lenet_ref:.0f}",
+          file=sys.stderr)
+    if peak:
+        _check_plausible(_mfu(LENET_FWD_FLOPS, max(lenet, lenet_ref),
+                              True, peak), "lenet")
+    return {
+        "metric": "LeNet MNIST train throughput (batch 128)",
+        "value": round(lenet, 0), "unit": "images/sec/chip",
+        "baseline": round(lenet_ref, 0),
+        "vs_baseline": round(lenet / lenet_ref, 3),
+        "mfu": round(_mfu(LENET_FWD_FLOPS, lenet, True, peak), 5)
+        if peak else None}
+
+
+def _leg_char_rnn(peak):
+    m_ours = bench_ours_char_rnn(prep=True)
+    m_ref = bench_flax_char_rnn(prep=True)
+    dt_o, dt_r = _interleave(m_ours, m_ref, repeats=3)
+    chars = CHAR_STEPS * CHAR_BATCH * CHAR_T / dt_o
+    chars_ref = CHAR_STEPS * CHAR_BATCH * CHAR_T / dt_r
+    print(f"char-rnn ours: {chars:.0f} chars/s, flax scan: "
+          f"{chars_ref:.0f}", file=sys.stderr)
+    if peak:
+        _check_plausible(_mfu(CHAR_RNN_FWD_FLOPS_PER_CHAR,
+                              max(chars, chars_ref), True, peak),
+                         "char-rnn")
+    return {
+        "metric": ("GravesLSTM char-RNN train throughput (batch "
+                   f"{CHAR_BATCH}, T={CHAR_T}, 2x{CHAR_HIDDEN}, "
+                   f"vocab {CHAR_VOCAB})"),
+        "value": round(chars, 0), "unit": "chars/sec/chip",
+        "baseline": round(chars_ref, 0),
+        "vs_baseline": round(chars / chars_ref, 3),
+        "mfu": round(_mfu(CHAR_RNN_FWD_FLOPS_PER_CHAR, chars, True,
+                          peak), 5) if peak else None,
+        "note": ("ours = GravesLSTM (peepholes: +25% gate FLOPs); "
+                 "baseline = flax OptimizedLSTMCell nn.scan")}
+
+
+def _leg_vgg16_import(peak):
+    m_ours = bench_keras_imported_vgg16(prep=True)
+    m_ref = bench_flax_vgg16_infer(prep=True)
+    dt_o, dt_r = _interleave(m_ours, m_ref, repeats=2)
+    vgg = VGG_STEPS * VGG_BATCH / dt_o
+    vgg_ref = VGG_STEPS * VGG_BATCH / dt_r
+    print(f"vgg16 infer ours(keras-import): {vgg:.1f} img/s, "
+          f"flax: {vgg_ref:.1f}", file=sys.stderr)
+    if peak:
+        _check_plausible(_mfu(VGG16_FWD_FLOPS, max(vgg, vgg_ref),
+                              False, peak), "vgg16")
+    return {
+        "metric": ("Keras-imported VGG16 inference (batch "
+                   f"{VGG_BATCH}, 224x224, f32)"),
+        "value": round(vgg, 1), "unit": "images/sec/chip",
+        "baseline": round(vgg_ref, 1),
+        "vs_baseline": round(vgg / vgg_ref, 3),
+        "mfu": round(_mfu(VGG16_FWD_FLOPS, vgg, False, peak), 4)
+        if peak else None}
+
+
+def _leg_flash_attention(peak):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.attention import flash_attention
+    B, T, H, D = 4, 4096, 8, 64
+    rngk = jax.random.PRNGKey(0)
+    q = jax.random.normal(rngk, (B, T, H, D), jnp.float32)
+
+    def naive(q, k, v):
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        s = qh @ jnp.swapaxes(kh, -1, -2) / np.sqrt(D)
+        return jnp.swapaxes(jax.nn.softmax(s) @ vh, 1, 2)
+
+    def mk(fn):
+        # CHAIN the gradient through the next input — identical
+        # repeated calls get deduped by the tunnel'd runtime and
+        # time as ~0. grad(q) has q's shape, so it feeds back.
+        g = jax.jit(jax.grad(lambda x: jnp.sum(fn(x, x, x) ** 2)))
+        import jax.numpy as _jnp
+        float(_jnp.sum(g(q)))               # compile + warm (fetch-sync)
+
+        def measure():
+            # large burst: the tunnel's ~130 ms fixed sync cost is a
+            # few percent of 100 chained steps; min-of-N by the
+            # caller; host FETCH as the end-of-burst sync (block is a
+            # no-op for non-donated arrays through the tunnel)
+            a = q
+            t0 = time.perf_counter()
+            for _ in range(100):
+                a = g(a)
+            float(jnp.sum(a))
+            return (time.perf_counter() - t0) / 100
+        return measure
+
+    m_flash = mk(lambda a, b, c: flash_attention(a, b, c))
+    m_naive = mk(naive)
+    dt_f, dt_n = _interleave(m_flash, m_naive, repeats=3)
+    toks = B * T
+    # fwd (2 matmuls) + bwd (5 matmuls), each 2*T^2*D MACs per bh
+    attn_flops = 14 * T * T * D * B * H
+    print(f"flash attention T=4096 fwd+bwd: {toks/dt_f:.0f} "
+          f"tok/s vs naive {toks/dt_n:.0f}", file=sys.stderr)
+    if peak:
+        _check_plausible(attn_flops / min(dt_f, dt_n) / peak,
+                         "flash attention")
+    return {
+        "metric": ("flash attention fwd+bwd (B=4, T=4096, "
+                   "H=8, D=64, f32)"),
+        "value": round(toks / dt_f, 0), "unit": "tokens/sec",
+        "baseline": round(toks / dt_n, 0),
+        "vs_baseline": round(dt_n / dt_f, 3),
+        "mfu": round(attn_flops / dt_f / peak, 4) if peak else None,
+        "note": ("baseline = naive attention (materializes TxT); "
+                 "both at XLA default matmul precision; Pallas "
+                 "fwd+bwd kernels, auto 1024^2 tiles")}
+
+
+# (name, fn, warm-cache wall estimate sec). Order = priority: the five
+# BASELINE.md configs first (VGG before the informational flash leg —
+# round-2 lost config 4 to the wall clock with the legs the other way).
+_LEGS = [
+    ("resnet_f32", _leg_resnet_f32, 420),
+    ("resnet_bf16", _leg_resnet_bf16, 420),
+    # config 4 runs EARLY: it is the heaviest leg and the tunnel
+    # degrades under sustained load — round 2 (and two round-3 runs)
+    # lost this number by scheduling it late
+    ("vgg16_import", _leg_vgg16_import, 600),
+    ("lenet", _leg_lenet, 180),
+    ("char_rnn", _leg_char_rnn, 240),
+    ("flash_attention", _leg_flash_attention, 300),
+]
+
+
+def _setup_xla_cache():
+    """Persistent XLA compilation cache — the tunnel'd AOT compile of
+    the ResNet50 train step alone is ~5 min cold; with the cache a
+    repeat run's legs compile in seconds. Must run in EVERY leg
+    subprocess (config is per-process), before first backend use."""
     import jax
     cache_dir = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".bench_cache",
@@ -551,6 +797,35 @@ def main():
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+
+def _run_leg_inprocess(name):
+    _setup_xla_cache()
+    peak, _ = _peak_flops()
+    fn = dict((n, f) for n, f, _ in _LEGS)[name]
+    try:
+        cfg = fn(peak)
+    except ImportError as e:
+        # missing optional dependency (keras/h5py): a clean SKIP, not
+        # a transient failure — rc 3 tells the orchestrator not to
+        # burn a cooldown + retry on it
+        print(f"{name}: dependency unavailable: {e}", file=sys.stderr)
+        raise SystemExit(3)
+    print(json.dumps(cfg), flush=True)
+
+
+def main():
+    if "--leg" in sys.argv:
+        _run_leg_inprocess(sys.argv[sys.argv.index("--leg") + 1])
+        return
+
+    headline_only = ("--headline-only" in sys.argv
+                     or os.environ.get("BENCH_HEADLINE_ONLY") == "1")
+    budget = float(os.environ.get("BENCH_BUDGET_SECONDS", "900"))
+    t_start = time.perf_counter()
+    import subprocess
+    here = os.path.abspath(__file__)
+    _setup_xla_cache()                 # for the in-process fallback
     peak, kind = _peak_flops()
     detail = {"device_kind": kind,
               "mfu_note": ("model-FLOPs MFU vs bf16 peak "
@@ -568,13 +843,12 @@ def main():
                   "elementwise passes move the full activation set "
                   "through HBM; XLA fuses them into neighbors but the "
                   "conv outputs still round-trip. (3) bf16 halves "
-                  "matmul passes (9->13% MFU, 1.44x step speedup) but "
-                  "the elementwise HBM traffic is dtype-bound, not "
-                  "flop-bound, so MFU does not double. Levers, in "
-                  "expected order of effect: batch 256 (deeper MXU "
-                  "pipelines per weight load), channel-padded stem, "
-                  "conv-fused activation quantization. VGG16's dense "
-                  "4096-wide layers show what the MXU does when "
+                  "matmul passes (9->13% MFU, 1.44x step speedup) and "
+                  "since round 3 the hidden activations ride bf16 too "
+                  "(halved elementwise HBM traffic, +1.4% step). "
+                  "Remaining levers: batch 256 (deeper MXU pipelines "
+                  "per weight load), channel-padded stem. VGG16's "
+                  "dense 4096-wide layers show what the MXU does when "
                   "shapes cooperate (see its MFU in this file)."),
               "configs": []}
     detail_path = os.path.join(
@@ -582,39 +856,59 @@ def main():
 
     def flush():
         # write incrementally after EVERY leg — a driver wall-kill
-        # mid-leg must not lose captured configs (round-2 lesson:
-        # rc=124 left a stale file because the only write was at the
-        # end of main)
+        # mid-leg must not lose captured configs
         tmp = detail_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(detail, f, indent=2)
         os.replace(tmp, detail_path)
 
-    def leg_fits(estimate, name):
+    def _run_leg_once(name, estimate):
         left = budget - (time.perf_counter() - t_start)
-        if left < estimate:
-            print(f"{name} skipped: {left:.0f}s left < ~{estimate}s "
-                  "leg estimate", file=sys.stderr)
-            return False
-        return True
+        if left < min(estimate, 120):
+            print(f"{name} skipped: {left:.0f}s left < leg estimate "
+                  f"{estimate}s", file=sys.stderr)
+            return "skip"
+        try:
+            # never let one leg eat more than half the remaining budget
+            r = subprocess.run(
+                [sys.executable, here, "--leg", name],
+                capture_output=True,
+                timeout=max(120, min(left * 0.5, estimate * 2)))
+            sys.stderr.write(r.stderr.decode(errors="replace"))
+            if r.returncode == 3:       # clean dependency skip
+                return "skip"
+            if r.returncode != 0:
+                print(f"{name} leg failed rc={r.returncode}",
+                      file=sys.stderr)
+                return None
+            line = r.stdout.decode().strip().splitlines()[-1]
+            return json.loads(line)
+        except subprocess.TimeoutExpired:
+            print(f"{name} leg timed out", file=sys.stderr)
+            return None
+        except Exception as e:
+            print(f"{name} leg error: {e}", file=sys.stderr)
+            return None
 
-    m_ours = bench_ours(prep=True)
-    m_ref = bench_flax_resnet50(prep=True)
-    dt_o, dt_r = _interleave(m_ours, m_ref, repeats=2)
-    ours = STEPS * BATCH / dt_o
-    ref = STEPS * BATCH / dt_r
-    print(f"resnet50 ours: {ours:.1f} img/s, flax ref: {ref:.1f}",
-          file=sys.stderr)
-    detail["configs"].append({
-        "metric": "ResNet50 train throughput (batch 128, 224x224, f32)",
-        "value": round(ours, 1), "unit": "images/sec/chip",
-        "baseline": round(ref, 1), "vs_baseline": round(ours / ref, 3),
-        "mfu": round(_mfu(RESNET50_FWD_FLOPS, ours, True, peak), 4)
-        if peak else None})
+    def run_leg(name, estimate):
+        cfg = _run_leg_once(name, estimate)
+        if cfg is None:
+            # the tunnel recovers from transient transport failures /
+            # degraded-sync episodes within a minute; one retry
+            print(f"{name}: cooling down 60s then retrying",
+                  file=sys.stderr)
+            time.sleep(60)
+            cfg = _run_leg_once(name, estimate)
+        return None if cfg == "skip" else cfg
+
+    # headline first; fall back to in-process if the subprocess dies
+    head = run_leg("resnet_f32", 420)
+    if head is None:
+        head = _leg_resnet_f32(peak)
+    detail["configs"].append(head)
     flush()
     # the driver consumes stdout's single JSON line — emit it NOW so a
     # timeout in the (informational) extras can't lose the headline
-    head = detail["configs"][0]
     out = {"metric": head["metric"], "value": head["value"],
            "unit": head["unit"], "vs_baseline": head["vs_baseline"]}
     if head.get("mfu") is not None:
@@ -622,155 +916,11 @@ def main():
     print(json.dumps(out), flush=True)
 
     if not headline_only:
-        # bf16 mixed precision (beyond-parity headroom): ours under the
-        # MXU-native policy vs the same flax model at bf16 compute
-        from deeplearning4j_tpu import dtypes
-        with dtypes.policy_scope(dtypes.tpu_bf16()):
-            m_ours = bench_ours(prep=True)
-        m_ref = bench_flax_resnet50_bf16(prep=True)
-        dt_o, dt_r = _interleave(m_ours, m_ref, repeats=2)
-        ours16 = STEPS * BATCH / dt_o
-        ref16 = STEPS * BATCH / dt_r
-        print(f"resnet50 bf16 ours: {ours16:.1f} img/s, flax bf16: "
-              f"{ref16:.1f}", file=sys.stderr)
-        detail["configs"].append({
-            "metric": ("ResNet50 train throughput bf16 compute (batch "
-                       "128, 224x224)"),
-            "value": round(ours16, 1), "unit": "images/sec/chip",
-            "baseline": round(ref16, 1),
-            "vs_baseline": round(ours16 / ref16, 3),
-            "vs_f32_self": round(ours16 / ours, 3),
-            "mfu": round(_mfu(RESNET50_FWD_FLOPS, ours16, True, peak),
-                         4) if peak else None})
-        flush()
-
-        m_ours = bench_ours_lenet(prep=True)
-        m_ref = bench_flax_lenet(prep=True)
-        dt_o, dt_r = _interleave(m_ours, m_ref, repeats=3)
-        lenet = LENET_STEPS * LENET_BATCH / dt_o
-        lenet_ref = LENET_STEPS * LENET_BATCH / dt_r
-        print(f"lenet ours: {lenet:.0f} img/s, flax: {lenet_ref:.0f}",
-              file=sys.stderr)
-        detail["configs"].append({
-            "metric": "LeNet MNIST train throughput (batch 128)",
-            "value": round(lenet, 0), "unit": "images/sec/chip",
-            "baseline": round(lenet_ref, 0),
-            "vs_baseline": round(lenet / lenet_ref, 3),
-            "mfu": round(_mfu(LENET_FWD_FLOPS, lenet, True, peak), 5)
-            if peak else None})
-        flush()
-
-        m_ours = bench_ours_char_rnn(prep=True)
-        m_ref = bench_flax_char_rnn(prep=True)
-        dt_o, dt_r = _interleave(m_ours, m_ref, repeats=3)
-        chars = CHAR_STEPS * CHAR_BATCH * CHAR_T / dt_o
-        chars_ref = CHAR_STEPS * CHAR_BATCH * CHAR_T / dt_r
-        print(f"char-rnn ours: {chars:.0f} chars/s, flax scan: "
-              f"{chars_ref:.0f}", file=sys.stderr)
-        detail["configs"].append({
-            "metric": ("GravesLSTM char-RNN train throughput (batch "
-                       f"{CHAR_BATCH}, T={CHAR_T}, 2x{CHAR_HIDDEN}, "
-                       f"vocab {CHAR_VOCAB})"),
-            "value": round(chars, 0), "unit": "chars/sec/chip",
-            "baseline": round(chars_ref, 0),
-            "vs_baseline": round(chars / chars_ref, 3),
-            "mfu": round(_mfu(CHAR_RNN_FWD_FLOPS_PER_CHAR, chars, True,
-                              peak), 5) if peak else None,
-            "note": ("ours = GravesLSTM (peepholes: +25% gate FLOPs); "
-                     "baseline = flax OptimizedLSTMCell nn.scan")})
-        flush()
-
-        # BASELINE config 4 (Keras-imported VGG16 inference) runs
-        # BEFORE the informational flash leg — round 2 lost this
-        # number to the driver wall-kill with the legs the other way
-        if leg_fits(300, "vgg16 keras-import bench"):
-            try:
-                m_ours = bench_keras_imported_vgg16(prep=True)
-                m_ref = bench_flax_vgg16_infer(prep=True)
-                dt_o, dt_r = _interleave(m_ours, m_ref, repeats=2)
-                vgg = VGG_STEPS * VGG_BATCH / dt_o
-                vgg_ref = VGG_STEPS * VGG_BATCH / dt_r
-                print(f"vgg16 infer ours(keras-import): {vgg:.1f} "
-                      f"img/s, flax: {vgg_ref:.1f}", file=sys.stderr)
-                detail["configs"].append({
-                    "metric": ("Keras-imported VGG16 inference (batch "
-                               f"{VGG_BATCH}, 224x224, f32)"),
-                    "value": round(vgg, 1), "unit": "images/sec/chip",
-                    "baseline": round(vgg_ref, 1),
-                    "vs_baseline": round(vgg / vgg_ref, 3),
-                    "mfu": round(_mfu(VGG16_FWD_FLOPS, vgg, False,
-                                      peak), 4) if peak else None})
+        for name, _fn, estimate in _LEGS[1:]:
+            cfg = run_leg(name, estimate)
+            if cfg is not None:
+                detail["configs"].append(cfg)
                 flush()
-            except Exception as e:     # keras/h5py unavailable
-                print(f"vgg16 keras-import bench skipped: {e}",
-                      file=sys.stderr)
-
-        # long-context attention: the Pallas flash kernel vs naive
-        # attention, fwd+bwd at T=4096 (the long-context capability
-        # extension; naive materializes the (T, T) scores)
-        try:
-            if not leg_fits(180, "attention bench"):
-                raise TimeoutError("over budget")
-            import jax
-            import jax.numpy as jnp
-
-            from deeplearning4j_tpu.ops.attention import flash_attention
-            B, T, H, D = 4, 4096, 8, 64
-            rngk = jax.random.PRNGKey(0)
-            q = jax.random.normal(rngk, (B, T, H, D), jnp.float32)
-
-            def naive(q, k, v):
-                qh = jnp.swapaxes(q, 1, 2)
-                kh = jnp.swapaxes(k, 1, 2)
-                vh = jnp.swapaxes(v, 1, 2)
-                s = qh @ jnp.swapaxes(kh, -1, -2) / np.sqrt(D)
-                return jnp.swapaxes(jax.nn.softmax(s) @ vh, 1, 2)
-
-            def mk(fn):
-                # CHAIN the gradient through the next input — identical
-                # repeated calls get deduped by the tunnel'd runtime and
-                # time as ~0. grad(q) has q's shape, so it feeds back.
-                g = jax.jit(jax.grad(
-                    lambda x: jnp.sum(fn(x, x, x) ** 2)))
-                g(q).block_until_ready()            # compile + warm
-
-                def burst(n):
-                    a = q
-                    t0 = time.perf_counter()
-                    for _ in range(n):
-                        a = g(a)
-                    jax.block_until_ready(a)
-                    return time.perf_counter() - t0
-
-                def measure():
-                    # two-point: the tunnel adds a fixed ~130 ms per
-                    # burst; (T(30)-T(5))/25 cancels it exactly
-                    return (burst(30) - burst(5)) / 25
-                return measure
-
-            m_flash = mk(lambda a, b, c: flash_attention(a, b, c))
-            m_naive = mk(naive)
-            dt_f, dt_n = _interleave(m_flash, m_naive, repeats=3)
-            toks = B * T
-            # fwd (2 matmuls) + bwd (5 matmuls), each 2·T²·D MACs/bh
-            attn_flops = 14 * T * T * D * B * H
-            print(f"flash attention T=4096 fwd+bwd: {toks/dt_f:.0f} "
-                  f"tok/s vs naive {toks/dt_n:.0f}", file=sys.stderr)
-            detail["configs"].append({
-                "metric": ("flash attention fwd+bwd (B=4, T=4096, "
-                           "H=8, D=64, f32)"),
-                "value": round(toks / dt_f, 0), "unit": "tokens/sec",
-                "baseline": round(toks / dt_n, 0),
-                "vs_baseline": round(dt_n / dt_f, 3),
-                "mfu": round(attn_flops / dt_f / peak, 4)
-                if peak else None,
-                "note": ("baseline = naive attention (materializes "
-                         "TxT); both at XLA default matmul precision; "
-                         "Pallas fwd+bwd kernels, auto 1024^2 tiles")})
-            flush()
-        except Exception as e:
-            print(f"attention bench skipped: {e}", file=sys.stderr)
-
     flush()
 
 

@@ -189,6 +189,9 @@ class VariationalAutoencoder(FeedForwardLayer):
         """Negative ELBO (mean over batch), MC-estimated with
         ``num_samples`` draws — the quantity the reference minimises in
         VariationalAutoencoder.computeGradientAndScore."""
+        # exp/log ELBO math must not run at bf16 activation precision
+        # (promote_half: never downcasts the checker's f64)
+        x = dtypes.promote_half(x)
         mu, logvar = self._encode(params, x)
         kl = -0.5 * jnp.sum(1 + logvar - mu ** 2 - jnp.exp(logvar), axis=-1)
         rec = 0.0
@@ -274,6 +277,11 @@ class Yolo2OutputLayer(Layer):
         return out.reshape(b, h, w, c), state
 
     def loss_from_input(self, params, x, labels, *, training, rng, mask=None):
+        # the YOLO loss does exp/sqrt/log_softmax — promote out of the
+        # bf16 activation dtype before any of it (never downcasting
+        # the gradient checker's f64)
+        x = dtypes.promote_half(x)
+        labels = dtypes.promote_half(labels)
         b, h, w, c = x.shape
         a = len(self.anchors)
         depth = c // a

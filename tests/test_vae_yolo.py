@@ -282,3 +282,51 @@ class TestRecursiveAutoEncoder:
         l_long = float(rae.pretrain_loss(params, x_long, None,
                                          mask=mask))
         np.testing.assert_allclose(l_long, l_short, rtol=1e-5)
+
+
+class TestBf16LossPromotion:
+    """Under dtypes.tpu_bf16() hidden activations are bfloat16; every
+    loss head must promote to f32 before exp/log/sqrt math (round-3
+    advisor finding on the bf16-activations policy)."""
+
+    def test_vae_elbo_f32_under_bf16_policy(self, rng):
+        from deeplearning4j_tpu import dtypes
+        x = (rng.random((32, 12)) > 0.5).astype(np.float32)
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(VariationalAutoencoder(
+                    n_out=4, encoder_layer_sizes=(16,),
+                    decoder_layer_sizes=(16,)))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf).init()
+        vae = net.layers[0]
+        l32 = float(vae.pretrain_loss(net.params[0],
+                                      x, jax.random.PRNGKey(0)))
+        with dtypes.policy_scope(dtypes.tpu_bf16()):
+            l16 = float(vae.pretrain_loss(
+                net.params[0], jax.numpy.asarray(x, jax.numpy.bfloat16),
+                jax.random.PRNGKey(0)))
+        # promoted internally: bf16-activation input changes the loss
+        # only at bf16 input-rounding level, not exp/log level
+        np.testing.assert_allclose(l16, l32, rtol=5e-2)
+
+    def test_yolo_loss_finite_and_close_under_bf16_policy(self, rng):
+        from deeplearning4j_tpu import dtypes
+        g, a, c = 4, 2, 3
+        anchors = ((1.0, 1.5), (2.0, 1.0))
+        layer = Yolo2OutputLayer(anchors=anchors)
+        depth = a * (5 + c)
+        x = rng.normal(0, 1, (2, g, g, depth)).astype(np.float32)
+        t = np.zeros((2, g, g, depth), np.float32)
+        t[:, 1, 1, 4] = 1.0
+        t[:, 1, 1, 0:2] = 0.4
+        t[:, 1, 1, 2:4] = 0.8
+        t[:, 1, 1, 5] = 1.0
+        l32 = float(layer.loss_from_input(
+            {}, x, t, training=True, rng=None))
+        l16 = float(layer.loss_from_input(
+            {}, jax.numpy.asarray(x, jax.numpy.bfloat16), t,
+            training=True, rng=None))
+        assert np.isfinite(l16)
+        np.testing.assert_allclose(l16, l32, rtol=5e-2)
