@@ -42,14 +42,18 @@ _PEAK_BF16 = {
 }
 
 
-def _peak_flops():
-    import jax
-    kind = jax.devices()[0].device_kind
+def _peak_for_kind(kind):
     for prefix, peak in sorted(_PEAK_BF16.items(),
                                key=lambda kv: -len(kv[0])):
         if kind.startswith(prefix):
-            return peak, kind
-    return None, kind
+            return peak
+    return None
+
+
+def _peak_flops():
+    import jax
+    kind = jax.devices()[0].device_kind
+    return _peak_for_kind(kind), kind
 
 
 def _make_measure(step_fn, args, steps, warmup, get_loss):
@@ -490,6 +494,13 @@ def bench_keras_imported_vgg16(batch=VGG_BATCH, steps=VGG_STEPS,
     from deeplearning4j_tpu.keras.importer import (
         import_keras_model_and_weights)
 
+    import importlib.util
+    if (importlib.util.find_spec("keras") is None
+            or importlib.util.find_spec("h5py") is None):
+        # clean dependency skip (rc 3 in leg mode), not a retryable
+        # failure: the build subprocess would die with
+        # CalledProcessError otherwise and burn a cooldown + retry
+        raise ImportError("keras/h5py not installed")
     # cache the 554MB generated h5 across runs — the keras-subprocess
     # build is ~2 min of the leg and identical every time
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -729,8 +740,7 @@ def _leg_flash_attention(peak):
         # repeated calls get deduped by the tunnel'd runtime and
         # time as ~0. grad(q) has q's shape, so it feeds back.
         g = jax.jit(jax.grad(lambda x: jnp.sum(fn(x, x, x) ** 2)))
-        import jax.numpy as _jnp
-        float(_jnp.sum(g(q)))               # compile + warm (fetch-sync)
+        float(jnp.sum(g(q)))                # compile + warm (fetch-sync)
 
         def measure():
             # large burst: the tunnel's ~130 ms fixed sync cost is a
@@ -825,8 +835,18 @@ def main():
     t_start = time.perf_counter()
     import subprocess
     here = os.path.abspath(__file__)
-    _setup_xla_cache()                 # for the in-process fallback
-    peak, kind = _peak_flops()
+    # device kind via a SUBPROCESS: the orchestrator must not hold a
+    # TPU client itself — on exclusively-locked TPUs (plain TPU VMs,
+    # no tunnel) that would lock every --leg subprocess out
+    try:
+        kind = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, timeout=300, check=True,
+        ).stdout.decode().strip().splitlines()[-1]
+    except Exception:
+        kind = "unknown"
+    peak = _peak_for_kind(kind)
     detail = {"device_kind": kind,
               "mfu_note": ("model-FLOPs MFU vs bf16 peak "
                            f"{peak/1e12:.0f} TFLOP/s" if peak else
@@ -893,6 +913,13 @@ def main():
     def run_leg(name, estimate):
         cfg = _run_leg_once(name, estimate)
         if cfg is None:
+            left = budget - (time.perf_counter() - t_start)
+            if left < 60 + min(estimate, 120):
+                # no room for cooldown + retry: don't burn the budget
+                # a later cheap leg could still use
+                print(f"{name}: failed and {left:.0f}s left — "
+                      "skipping retry", file=sys.stderr)
+                return None
             # the tunnel recovers from transient transport failures /
             # degraded-sync episodes within a minute; one retry
             print(f"{name}: cooling down 60s then retrying",
@@ -904,6 +931,9 @@ def main():
     # headline first; fall back to in-process if the subprocess dies
     head = run_leg("resnet_f32", 420)
     if head is None:
+        # last resort: in-process (initializes the backend here — the
+        # subprocess legs already failed, so holding the client is moot)
+        _setup_xla_cache()
         head = _leg_resnet_f32(peak)
     detail["configs"].append(head)
     flush()

@@ -34,9 +34,8 @@ def _stable_ce(logits, labels, mask, kind):
     the hidden activations are bfloat16 (MXU/HBM-native) but exp/log
     at the loss must not be. promote_half never DOWNcasts — the f64
     gradient checker must stay f64."""
-    from deeplearning4j_tpu.dtypes import promote_half
-    logits = promote_half(logits)
-    labels = promote_half(labels)
+    logits = dtypes.promote_half(logits)
+    labels = dtypes.promote_half(labels)
     if kind == "softmax":
         logp = jax.nn.log_softmax(logits, axis=-1)
         per = -labels * logp
@@ -77,9 +76,8 @@ class OutputLayer(FeedForwardLayer):
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         # predictions/softmax never in half precision — under the
         # bf16 policy only HIDDEN activations ride bfloat16
-        from deeplearning4j_tpu.dtypes import promote_half
-        z = promote_half(self._pre_output(params, x, training=training,
-                                          rng=rng))
+        z = dtypes.promote_half(
+            self._pre_output(params, x, training=training, rng=rng))
         return self.activation_fn()(z), state
 
     def has_loss(self) -> bool:
@@ -100,8 +98,7 @@ class OutputLayer(FeedForwardLayer):
         if kind is not None:
             per_ex = _stable_ce(z, labels, mask, kind)
         else:
-            from deeplearning4j_tpu.dtypes import promote_half
-            preds = self.activation_fn()(promote_half(z))
+            preds = self.activation_fn()(dtypes.promote_half(z))
             per_ex = losses_mod.get(self.loss)(labels, preds, mask)
         return jnp.mean(per_ex)
 
@@ -125,8 +122,7 @@ class RnnOutputLayer(OutputLayer):
         if kind is not None:
             per = _stable_ce(z, labels, m, kind)      # (B,) summed over T,F
         else:
-            from deeplearning4j_tpu.dtypes import promote_half
-            preds = self.activation_fn()(promote_half(z))
+            preds = self.activation_fn()(dtypes.promote_half(z))
             per = losses_mod.get(self.loss)(labels, preds, m)
         if mask is not None:
             # DL4J averages over *present* timesteps across the batch
@@ -173,9 +169,8 @@ class CenterLossOutputLayer(OutputLayer):
     def center_loss(self, state, x, labels):
         # x: (B, n_in) features; labels one-hot (B, n_out): squared
         # distances must not inherit bf16 activation precision
-        from deeplearning4j_tpu.dtypes import promote_half
-        x = promote_half(x)
-        labels = promote_half(labels)
+        x = dtypes.promote_half(x)
+        labels = dtypes.promote_half(labels)
         assigned = labels @ state["centers"]           # (B, n_in)
         return 0.5 * jnp.mean(jnp.sum((x - assigned) ** 2, axis=-1))
 

@@ -64,7 +64,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 precision):
     from jax.experimental import pallas as pl
 
-    ki = pl.program_id(2)
+    qi = pl.program_id(1)       # hoisted: program_id cannot be
+    ki = pl.program_id(2)       # called inside a pl.when body
 
     @pl.when(ki == 0)
     def _init():
@@ -72,34 +73,44 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]                                  # (bq, d)
-    k = k_ref[0]                                  # (bk, d)
-    v = v_ref[0]
-
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32,
-                            precision=precision) * scale
-
+    # causal tile skipping: a (qi, ki) tile entirely ABOVE the
+    # diagonal (every key after every query) contributes nothing —
+    # skip both matmuls. ~2x for long causal sequences.
     if causal:
-        s = jnp.where(_causal_mask(pl.program_id(1), ki,
-                                   block_q, block_k), s, _NEG_INF)
+        needed = ki * block_k <= qi * block_q + block_q - 1
+    else:
+        needed = ki >= 0          # trivially true, keeps one codepath
 
-    m_prev = m_scr[:, 0]                          # (bq,)
-    m_cur = jnp.max(s, axis=1)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new[:, None])
-    # rows where everything is masked: keep p at 0
-    p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
-    corr = jnp.exp(m_prev - m_new)
-    corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
-    l_new = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
-    acc = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision)
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0]                              # (bq, d)
+        k = k_ref[0]                              # (bk, d)
+        v = v_ref[0]
 
-    m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
-    acc_scr[:] = acc
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=precision) * scale
+
+        if causal:
+            s = jnp.where(_causal_mask(qi, ki, block_q, block_k),
+                          s, _NEG_INF)
+
+        m_prev = m_scr[:, 0]                      # (bq,)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        # rows where everything is masked: keep p at 0
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
+        l_new = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
+        acc = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+        acc_scr[:] = acc
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -204,6 +215,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                nk, precision):
     from jax.experimental import pallas as pl
 
+    qi = pl.program_id(1)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -212,22 +224,29 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         delta_scr[:] = jnp.broadcast_to(
             _row_delta(do_ref[0], o_ref[0])[:, None], delta_scr.shape)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0][:, 0]                        # (bq,)
-    delta = delta_scr[:, 0]
+    if causal:      # tiles fully above the diagonal: p = 0, skip
+        needed = ki * block_k <= qi * block_q + block_q - 1
+    else:
+        needed = ki >= 0
 
-    p = _recompute_p(q, k, lse, scale, causal, pl.program_id(1), ki,
-                     block_q, block_k, precision)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32,
-                             precision=precision)
-    ds = p * (dp - delta[:, None]) * scale
-    dq_scr[:] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision)
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0]                    # (bq,)
+        delta = delta_scr[:, 0]
+
+        p = _recompute_p(q, k, lse, scale, causal, qi, ki,
+                         block_q, block_k, precision)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=precision)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -239,6 +258,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 block_q, block_k, nq, precision):
     from jax.experimental import pallas as pl
 
+    kb = pl.program_id(1)       # key-block index (grid dim 1)
     qi = pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -246,27 +266,34 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0][:, 0]
-    delta = _row_delta(do, o_ref[0])              # per q tile — cheap
+    if causal:      # queries entirely before this key block: p = 0
+        needed = (qi + 1) * block_q - 1 >= kb * block_k
+    else:
+        needed = qi >= 0
 
-    p = _recompute_p(q, k, lse, scale, causal, qi, pl.program_id(1),
-                     block_q, block_k, precision)
-    # dv += p^T @ do
-    dv_scr[:] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32,
-                             precision=precision)
-    ds = p * (dp - delta[:, None]) * scale
-    # dk += ds^T @ q
-    dk_scr[:] += jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision)
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0]
+        delta = _row_delta(do, o_ref[0])          # per q tile — cheap
+
+        p = _recompute_p(q, k, lse, scale, causal, qi, kb,
+                         block_q, block_k, precision)
+        # dv += p^T @ do
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=precision)
+        ds = p * (dp - delta[:, None]) * scale
+        # dk += ds^T @ q
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
 
     @pl.when(qi == nq - 1)
     def _finish():
