@@ -44,6 +44,15 @@ __all__ = ["flash_attention", "pallas_flash_attention",
 _NEG_INF = -1e30
 
 
+def _sds(sh, dt, vma):
+    """ShapeDtypeStruct, declaring varying mesh axes when the kernel
+    runs inside a checked shard_map (ring attention passes the ring
+    axis)."""
+    if vma:
+        return jax.ShapeDtypeStruct(sh, dt, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(sh, dt)
+
+
 def _prec(precision):
     return (jax.lax.Precision.HIGHEST if precision == "highest"
             else jax.lax.Precision.DEFAULT)
@@ -131,12 +140,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "precision",
-                                    "return_lse"))
+                                    "return_lse", "vma"))
 def pallas_flash_attention(q, k, v, *, causal: bool = False,
                            block_q: int = 128, block_k: int = 128,
                            interpret: bool = False,
                            precision: str = "default",
-                           return_lse: bool = False):
+                           return_lse: bool = False,
+                           vma=None):
     """q,k,v: (B, T, H, D) → (B, T, H, D) [, lse (B, H, T)]. T must be
     divisible by the block sizes (the layer wrapper pads). precision:
     'default' = bf16 MXU passes (what XLA gives plain f32 einsum);
@@ -159,8 +169,8 @@ def pallas_flash_attention(q, k, v, *, causal: bool = False,
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, T, 8), jnp.float32),
+            _sds((B * H, T, D), q.dtype, vma),
+            _sds((B * H, T, 8), jnp.float32, vma),
         ],
         grid=(B * H, nq, nk),
         in_specs=[
@@ -303,12 +313,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret", "precision"))
+                                    "interpret", "precision", "vma"))
 def pallas_flash_attention_bwd(q, k, v, o, lse, do, *,
                                causal: bool = False,
                                block_q: int = 128, block_k: int = 128,
                                interpret: bool = False,
-                               precision: str = "default"):
+                               precision: str = "default",
+                               vma=None):
     """Backward pass: (q,k,v,o,lse,do) → (dq, dk, dv), all (B,T,H,D)
     (lse: (B,H,T) from the forward). Standard flash backward:
     delta = rowsum(do·o), p recomputed per tile from the saved lse."""
@@ -337,7 +348,7 @@ def pallas_flash_attention_bwd(q, k, v, o, lse, do, *,
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nk=nk,
                           precision=prec),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_shape=_sds((B * H, T, D), q.dtype, vma),
         grid=(B * H, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, qspec, rowq],
         out_specs=qspec,
@@ -356,8 +367,8 @@ def pallas_flash_attention_bwd(q, k, v, o, lse, do, *,
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, nq=nq,
                           precision=prec),
-        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, T, D), v.dtype)],
+        out_shape=[_sds((B * H, T, D), k.dtype, vma),
+                   _sds((B * H, T, D), v.dtype, vma)],
         grid=(B * H, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, rowq2],
         out_specs=[kspec2, kspec2],

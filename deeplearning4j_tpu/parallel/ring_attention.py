@@ -10,6 +10,17 @@ block it currently holds while K/V blocks rotate around the ICI ring
 via ``ppermute``, with flash-style running-max/denominator accumulation
 so the result is EXACT attention at O(T/n) memory per device.
 
+Two interchangeable local-chunk engines drive the ring:
+
+- pure-jnp blockwise accumulation (any backend — the dryrun/CPU path);
+- the Pallas flash kernels (``ops/attention.py``) per chunk, FORWARD
+  AND BACKWARD (``make_ring_attention_fn(use_kernels='auto')``, the
+  TPU default): each chunk returns (o, lse), chunks merge exactly via
+  logsumexp weights, and the backward ring feeds the same global lse
+  to the dq / fused dk-dv kernels while the dk/dv accumulators rotate
+  home with their K/V blocks. Validated against the oracle on real
+  TPU (fwd and all three grads).
+
 Also exports ``blockwise_attention`` (single-device chunked attention,
 the memory-efficient fallback) and a ``MultiHeadAttention`` layer
 config usable in networks.
@@ -138,16 +149,251 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
     return out.transpose(0, 2, 1, 3)
 
 
+# ---------------------------------------------------------------------------
+# ring FLASH attention: the Pallas kernels drive each local chunk, in
+# BOTH directions. Per ring step a device computes its q block against
+# the K/V chunk it currently holds with the hand kernel; per-chunk
+# (o, lse) pairs merge with logsumexp weights (associative, so a
+# running merge is exact). The backward ring reuses the dq / fused
+# dk-dv kernels with the GLOBAL lse — p = exp(s - lse) is already the
+# correct global softmax weight per tile — and the dk/dv accumulators
+# ROTATE with the K/V chunks, arriving home after the full cycle.
+# ---------------------------------------------------------------------------
+
+def _merge_chunks(o_a, lse_a, o_b, lse_b):
+    """Merge two partial attention results (o: (B,T,H,D),
+    lse: (B,H,T)). Exact: o = Σ o_i · exp(lse_i − lse_total)."""
+    lse = jnp.logaddexp(lse_a, lse_b)
+    # fully-empty chunks carry lse = -inf: weight 0, never nan
+    wa = jnp.where(jnp.isneginf(lse_a), 0.0, jnp.exp(lse_a - lse))
+    wb = jnp.where(jnp.isneginf(lse_b), 0.0, jnp.exp(lse_b - lse))
+    to_btH = lambda w: jnp.moveaxis(w, 1, 2)[..., None]   # (B,T,H,1)
+    # accumulate in f32, return in the carry dtype — bf16 inputs must
+    # not promote the fori_loop carry (trace-time dtype mismatch)
+    o = (o_a.astype(jnp.float32) * to_btH(wa)
+         + o_b.astype(jnp.float32) * to_btH(wb))
+    return o.astype(o_a.dtype), lse
+
+
+def _jnp_chunk(q, k, v, causal):
+    """Pure-jnp (o, lse) for one chunk — the kernel's test double and
+    the CPU-path equivalent; same math, same outputs."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        T = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None],
+                      s, -jnp.inf)
+    lse = jax.nn.logsumexp(s, axis=-1)                     # (B,H,Tq)
+    p = jnp.exp(s - jnp.where(jnp.isneginf(lse), 0.0, lse)[..., None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype), lse
+
+
+def _jnp_chunk_bwd(q, k, v, o, lse, do, causal):
+    """Pure-jnp per-chunk backward with the GLOBAL lse — mirrors the
+    Pallas dq/dk/dv kernel math exactly."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    f32 = lambda a: a.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", f32(q), f32(k)) * scale
+    if causal:
+        T = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None],
+                      s, -jnp.inf)
+    p = jnp.exp(s - jnp.where(jnp.isneginf(lse), 0.0, lse)[..., None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    delta = jnp.einsum("bqhd,bqhd->bhq", f32(do), f32(o))
+    dp = jnp.einsum("bqhd,bkhd->bhqk", f32(do), f32(v))
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, f32(k)).astype(q.dtype)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, f32(q)).astype(k.dtype)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, f32(do)).astype(v.dtype)
+    return dq, dk, dv
+
+
+def _chunk_branches(causal, impl, axis_name=None):
+    """(full, diagonal, skip) forward branches for one ring chunk.
+    The kernel's causal flag is static, so the runtime three-way
+    (src before / at / after my block) is a lax.switch over
+    statically-compiled variants. impl: 'pallas' (TPU kernels) or
+    'jnp' (test double / CPU)."""
+    from deeplearning4j_tpu.ops.attention import pallas_flash_attention
+
+    vma = (axis_name,) if axis_name else None
+
+    def full(q, k, v):
+        if impl == "jnp":
+            return _jnp_chunk(q, k, v, False)
+        return pallas_flash_attention(q, k, v, causal=False,
+                                      block_q=_blk(q), block_k=_blk(q),
+                                      return_lse=True, vma=vma)
+
+    def diag(q, k, v):
+        if impl == "jnp":
+            return _jnp_chunk(q, k, v, causal)
+        return pallas_flash_attention(q, k, v, causal=causal,
+                                      block_q=_blk(q), block_k=_blk(q),
+                                      return_lse=True, vma=vma)
+
+    def skip(q, k, v):
+        B, T, H, D = q.shape
+        # derive lse from q (+0·x keeps -inf) so the branch output
+        # carries the same varying-axes type as the kernel branches
+        zero = 0.0 * jnp.moveaxis(q[..., 0], 1, 2).astype(jnp.float32)
+        return (jnp.zeros_like(q),
+                jnp.full((B, H, T), -jnp.inf, jnp.float32) + zero)
+
+    return full, diag, skip
+
+
+def _blk(q):
+    from deeplearning4j_tpu.ops.attention import _auto_block
+    return _auto_block(q.shape[1], q.shape[3])
+
+
+def _ring_flash_sharded(q, k, v, *, axis_name: str, causal: bool,
+                        impl: str = "pallas"):
+    """Forward ring with Pallas local chunks; returns (o, lse)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    full, diag, skip = _chunk_branches(
+        causal, impl, axis_name if impl == "pallas" else None)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    o = jnp.zeros_like(q)            # zeros_like(q): already varying
+    lse = lax.pcast(jnp.full((B, H, Tl), -jnp.inf, jnp.float32),
+                    axis_name, to="varying")
+
+    def body(step, carry):
+        o, lse, k_cur, v_cur = carry
+        src = (idx - step) % n
+        if causal:
+            branch = jnp.where(src < idx, 0, jnp.where(src == idx,
+                                                       1, 2))
+            o_c, lse_c = lax.switch(branch, (full, diag, skip),
+                                    q, k_cur, v_cur)
+        else:   # every chunk is a full chunk: no switch, one kernel
+            o_c, lse_c = full(q, k_cur, v_cur)
+        o, lse = _merge_chunks(o, lse, o_c, lse_c)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o, lse, k_nxt, v_nxt
+
+    o, lse, _, _ = lax.fori_loop(0, n, body, (o, lse, k, v))
+    return o, lse
+
+
+def _ring_flash_bwd_sharded(q, k, v, o, lse, do, *, axis_name: str,
+                            causal: bool, impl: str = "pallas"):
+    """Backward ring: the dq / fused dk-dv Pallas kernels per chunk
+    with the GLOBAL lse; dk/dv accumulators rotate with k/v."""
+    from deeplearning4j_tpu.ops.attention import (
+        pallas_flash_attention_bwd)
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    blk = _blk(q)
+
+    vma = (axis_name,) if impl == "pallas" else None
+
+    def bwd_full(q, k, v, o, lse, do):
+        if impl == "jnp":
+            return _jnp_chunk_bwd(q, k, v, o, lse, do, False)
+        return pallas_flash_attention_bwd(q, k, v, o, lse, do,
+                                          causal=False, block_q=blk,
+                                          block_k=blk, vma=vma)
+
+    def bwd_diag(q, k, v, o, lse, do):
+        if impl == "jnp":
+            return _jnp_chunk_bwd(q, k, v, o, lse, do, causal)
+        return pallas_flash_attention_bwd(q, k, v, o, lse, do,
+                                          causal=causal, block_q=blk,
+                                          block_k=blk, vma=vma)
+
+    def bwd_skip(q, k, v, o, lse, do):
+        return (jnp.zeros_like(q), jnp.zeros_like(k),
+                jnp.zeros_like(v))
+
+    # zeros_like of the (varying) inputs: accumulators start varying
+    dq = jnp.zeros_like(q)
+    dkr = jnp.zeros_like(k)
+    dvr = jnp.zeros_like(v)
+
+    def body(step, carry):
+        dq, dkr, dvr, k_cur, v_cur = carry
+        src = (idx - step) % n
+        if causal:
+            branch = jnp.where(src < idx, 0, jnp.where(src == idx,
+                                                       1, 2))
+            dq_c, dk_c, dv_c = lax.switch(
+                branch, (bwd_full, bwd_diag, bwd_skip),
+                q, k_cur, v_cur, o, lse, do)
+        else:
+            dq_c, dk_c, dv_c = bwd_full(q, k_cur, v_cur, o, lse, do)
+        dq = dq + dq_c
+        dkr = dkr + dk_c
+        dvr = dvr + dv_c
+        # rotate K/V and their gradient accumulators together — after
+        # the full cycle (n rotations) each dk/dv is back at its owner
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = lax.ppermute(dkr, axis_name, perm)
+        dv_nxt = lax.ppermute(dvr, axis_name, perm)
+        return dq, dk_nxt, dv_nxt, k_nxt, v_nxt
+
+    dq, dkr, dvr, _, _ = lax.fori_loop(
+        0, n, body, (dq, dkr, dvr, k, v))
+    return dq, dkr, dvr
+
+
+def _make_ring_flash_inner(axis_name: str, causal: bool,
+                           impl: str = "pallas"):
+    @functools.partial(jax.custom_vjp)
+    def ring_flash(q, k, v):
+        o, _ = _ring_flash_sharded(q, k, v, axis_name=axis_name,
+                                   causal=causal, impl=impl)
+        return o
+
+    def fwd(q, k, v):
+        o, lse = _ring_flash_sharded(q, k, v, axis_name=axis_name,
+                                     causal=causal, impl=impl)
+        return o, (q, k, v, o, lse)
+
+    def bwd(res, g):
+        q, k, v, o, lse = res
+        return _ring_flash_bwd_sharded(q, k, v, o, lse, g,
+                                       axis_name=axis_name,
+                                       causal=causal, impl=impl)
+
+    ring_flash.defvjp(fwd, bwd)
+    return ring_flash
+
+
 def make_ring_attention_fn(mesh: Mesh, *, axis: str = "seq",
-                           causal: bool = False, scale=None):
+                           causal: bool = False, scale=None,
+                           use_kernels: str = "auto"):
     """Build a jitted ring-attention fn over ``mesh``: inputs
-    (B, T, H, D) sharded on T over ``axis``; output sharded the same."""
+    (B, T, H, D) sharded on T over ``axis``; output sharded the same.
+
+    ``use_kernels``: 'auto' drives each local chunk through the Pallas
+    flash kernels (forward AND backward) when running on TPU with
+    tile-divisible local lengths and the default 1/sqrt(D) scale;
+    'never' keeps the pure-jnp blockwise accumulation (any backend)."""
     from jax import shard_map
 
     spec = P(None, axis, None, None)
 
     def inner(q, k, v):
         s = scale or (1.0 / math.sqrt(q.shape[-1]))
+        use = (use_kernels == "auto"
+               and jax.default_backend() == "tpu"
+               and scale is None
+               and _blk(q) > 0)    # _auto_block returns 0 unless it
+                                   # divides the local length
+        if use:
+            return _make_ring_flash_inner(axis, causal)(q, k, v)
         return _ring_attention_sharded(q, k, v, axis_name=axis,
                                        causal=causal, scale=s)
 
