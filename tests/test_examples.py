@@ -40,6 +40,11 @@ class TestExamples:
         assert "nearest(king):" in out
         assert "vectors written" in out
 
+    def test_elastic_transformer(self):
+        out = _run("elastic_transformer.py", "--epochs", "4")
+        assert "restart == uninterrupted: OK" in out
+        assert "Accuracy after resume" in out
+
     def test_keras_import_finetune(self):
         pytest.importorskip("keras")
         out = _run("keras_import_finetune.py")
