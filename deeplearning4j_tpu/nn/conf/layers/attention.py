@@ -58,6 +58,8 @@ class SelfAttentionLayer(BaseLayer):
     n_heads: int = 4
     causal: bool = False
 
+    seq_parallelizable = True          # attention rides the ring
+
     def set_n_in(self, input_type: InputType) -> None:
         if self.n_in is None:
             self.n_in = input_type.size
@@ -99,7 +101,23 @@ class SelfAttentionLayer(BaseLayer):
         q = split_heads(x @ params["Wq"])
         k = split_heads(x @ params["Wk"])
         v = split_heads(x @ params["Wv"])
-        if mask is not None:
+        from deeplearning4j_tpu.parallel.seq_context import (
+            current_seq_axis)
+        seq_axis = current_seq_axis()
+        if seq_axis is not None:
+            # sequence-parallel step: x is the LOCAL (B, T/n, C) chunk
+            # of a sequence sharded over `seq_axis`; attention must span
+            # the whole distributed sequence, so ride the ring (exact,
+            # differentiable, kernels on TPU).
+            if mask is not None:
+                raise NotImplementedError(
+                    "masked attention under sequence parallelism is not "
+                    "supported yet — drop the seq axis or the mask")
+            from deeplearning4j_tpu.parallel.ring_attention import (
+                ring_self_attention)
+            out = ring_self_attention(q, k, v, axis_name=seq_axis,
+                                      causal=self.causal)
+        elif mask is not None:
             # padded keys must leave the softmax DENOMINATOR, not just
             # contribute zero values — zeroing k/v would still give each
             # masked position weight exp(0) and dilute every real token.
@@ -122,6 +140,10 @@ class TransformerEncoderLayer(BaseLayer):
     ffn_multiplier: int = 4
     causal: bool = False
     activation: str = "gelu"
+
+    # LN + residual + per-token MLP are pointwise in time; the inner
+    # attention routes itself through the ring (seq_context)
+    seq_parallelizable = True
 
     def set_n_in(self, input_type: InputType) -> None:
         if self.n_in is None:

@@ -63,6 +63,13 @@ class Layer:
     dropout: float = 0.0
     constraints: Tuple[dict, ...] = ()
 
+    # True iff apply() on a (B, T, ...) input is exact when T is only a
+    # LOCAL chunk of the sequence — i.e. the layer is pointwise in time
+    # (or, like attention, routes itself through the ring). Gates the
+    # wrapper's sequence-parallel train step. Plain class attribute
+    # (no annotation) so dataclasses don't treat it as a field.
+    seq_parallelizable = False
+
     # ---- shape inference ----
     def output_type(self, input_type: InputType) -> InputType:
         return input_type

@@ -32,6 +32,9 @@ class DenseLayer(FeedForwardLayer):
     """Fully connected layer (reference nn/conf/layers/DenseLayer.java,
     impl nn/layers/feedforward/dense/DenseLayer.java)."""
 
+    # on (B,T,C) recurrent input the matmul is per-timestep
+    seq_parallelizable = True
+
     def initialize(self, key, input_type: InputType):
         self.set_n_in(input_type)
         p = {"W": self._sample_w(key, (self.n_in, self.n_out),
@@ -66,6 +69,8 @@ class DenseLayer(FeedForwardLayer):
 class ActivationLayer(BaseLayer):
     """Activation-only layer (nn/conf/layers/ActivationLayer.java)."""
 
+    seq_parallelizable = True          # elementwise
+
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         return self.activation_fn()(x), state
 
@@ -75,6 +80,8 @@ class ActivationLayer(BaseLayer):
 class DropoutLayer(Layer):
     """Standalone dropout (nn/conf/layers/DropoutLayer.java). Identity at
     inference; inverted-dropout scaling at train time."""
+
+    seq_parallelizable = True          # elementwise
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         return self.apply_input_dropout(x, training=training, rng=rng), state
@@ -116,6 +123,8 @@ class EmbeddingLayer(FeedForwardLayer):
 class EmbeddingSequenceLayer(FeedForwardLayer):
     """Sequence of ids (B,T) → (B,T,n_out) (reference added this in
     later versions; capability parity with Keras Embedding import)."""
+
+    seq_parallelizable = True          # per-token gather
 
     def initialize(self, key, input_type: InputType):
         if self.n_in is None:

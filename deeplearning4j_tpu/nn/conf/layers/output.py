@@ -111,6 +111,10 @@ class RnnOutputLayer(OutputLayer):
     to 2-d ((B*T),F) internally (FeedForwardToRnnPreProcessor) — here the
     matmul is applied directly on the 3-d array."""
 
+    # per-timestep logits; local-chunk mean loss pmeans to the global
+    # mean under uniform shards (the wrapper enforces divisibility)
+    seq_parallelizable = True
+
     def output_type(self, input_type: InputType) -> InputType:
         return InputType.recurrent(self.n_out, input_type.timesteps)
 

@@ -358,7 +358,13 @@ class LastTimeStep(Layer):
 @dataclasses.dataclass
 class RnnLossLayer(LossLayer):
     """Time-distributed loss layer without weights
-    (nn/conf/layers/RnnLossLayer semantics)."""
+    (nn/conf/layers/RnnLossLayer semantics).
+
+    NOT seq_parallelizable: the inherited loss SUMS over timesteps per
+    example (DL4J score convention) instead of averaging, so the seq
+    step's mean-of-local-means normalization would shrink gradients by
+    the seq-axis factor. Use RnnOutputLayer (which normalizes by T)
+    for sequence-parallel training."""
 
     def output_type(self, input_type: InputType) -> InputType:
         return input_type

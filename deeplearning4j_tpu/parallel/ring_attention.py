@@ -39,7 +39,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ring_attention", "blockwise_attention", "attention_reference",
-           "make_ring_attention_fn"]
+           "make_ring_attention_fn", "ring_self_attention"]
 
 
 def attention_reference(q, k, v, *, causal: bool = False, scale=None):
@@ -117,14 +117,10 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
-    m = jnp.full((B, H, Tl), -jnp.inf, q.dtype)
-    num = jnp.zeros((B, H, Tl, D), q.dtype)
-    den = jnp.zeros((B, H, Tl), q.dtype)
-    # mark accumulators as device-varying over the ring axis so the
-    # fori_loop carry types line up (jax>=0.9 VMA typing; pcast is the
-    # non-deprecated spelling of pvary)
-    m, num, den = jax.tree_util.tree_map(
-        lambda a: lax.pcast(a, axis_name, to="varying"), (m, num, den))
+    zero_bht = _varying_zero_bht(q, q.dtype)
+    m = jnp.full((B, H, Tl), -jnp.inf, q.dtype) + zero_bht
+    num = jnp.zeros((B, H, Tl, D), q.dtype) + zero_bht[..., None]
+    den = jnp.zeros((B, H, Tl), q.dtype) + zero_bht
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_global = idx * Tl + jnp.arange(Tl)
 
@@ -213,15 +209,31 @@ def _jnp_chunk_bwd(q, k, v, o, lse, do, causal):
     return dq, dk, dv
 
 
-def _chunk_branches(causal, impl, axis_name=None):
+def _vma_of(x):
+    """The tracer's varying mesh axes (empty outside checked
+    shard_map / on older jax)."""
+    try:
+        return tuple(sorted(jax.typeof(x).vma))
+    except Exception:
+        return ()
+
+
+def _varying_zero_bht(q, dtype=jnp.float32):
+    """A (B, H, Tl) zero derived from q (+0·x), so it carries q's FULL
+    varying-axes set — under a dp×sp mesh the batch varies over
+    ('data','seq'), not just the ring axis, and fori_loop carry /
+    lax.switch branch types must line up (jax>=0.9 VMA typing)."""
+    return (0.0 * jnp.moveaxis(q[..., 0], 1, 2)).astype(dtype)
+
+
+def _chunk_branches(causal, impl, vma=None):
     """(full, diagonal, skip) forward branches for one ring chunk.
     The kernel's causal flag is static, so the runtime three-way
     (src before / at / after my block) is a lax.switch over
     statically-compiled variants. impl: 'pallas' (TPU kernels) or
-    'jnp' (test double / CPU)."""
+    'jnp' (test double / CPU). ``vma``: varying mesh axes of the
+    operands, declared on the kernel outputs."""
     from deeplearning4j_tpu.ops.attention import pallas_flash_attention
-
-    vma = (axis_name,) if axis_name else None
 
     def full(q, k, v):
         if impl == "jnp":
@@ -239,11 +251,9 @@ def _chunk_branches(causal, impl, axis_name=None):
 
     def skip(q, k, v):
         B, T, H, D = q.shape
-        # derive lse from q (+0·x keeps -inf) so the branch output
-        # carries the same varying-axes type as the kernel branches
-        zero = 0.0 * jnp.moveaxis(q[..., 0], 1, 2).astype(jnp.float32)
         return (jnp.zeros_like(q),
-                jnp.full((B, H, T), -jnp.inf, jnp.float32) + zero)
+                jnp.full((B, H, T), -jnp.inf, jnp.float32)
+                + _varying_zero_bht(q))
 
     return full, diag, skip
 
@@ -260,11 +270,11 @@ def _ring_flash_sharded(q, k, v, *, axis_name: str, causal: bool,
     idx = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
     full, diag, skip = _chunk_branches(
-        causal, impl, axis_name if impl == "pallas" else None)
+        causal, impl, _vma_of(q) if impl == "pallas" else None)
     perm = [(i, (i + 1) % n) for i in range(n)]
     o = jnp.zeros_like(q)            # zeros_like(q): already varying
-    lse = lax.pcast(jnp.full((B, H, Tl), -jnp.inf, jnp.float32),
-                    axis_name, to="varying")
+    lse = (jnp.full((B, H, Tl), -jnp.inf, jnp.float32)
+           + _varying_zero_bht(q))
 
     def body(step, carry):
         o, lse, k_cur, v_cur = carry
@@ -296,7 +306,7 @@ def _ring_flash_bwd_sharded(q, k, v, o, lse, do, *, axis_name: str,
     perm = [(i, (i + 1) % n) for i in range(n)]
     blk = _blk(q)
 
-    vma = (axis_name,) if impl == "pallas" else None
+    vma = _vma_of(q) if impl == "pallas" else None
 
     def bwd_full(q, k, v, o, lse, do):
         if impl == "jnp":
@@ -369,6 +379,26 @@ def _make_ring_flash_inner(axis_name: str, causal: bool,
 
     ring_flash.defvjp(fwd, bwd)
     return ring_flash
+
+
+def ring_self_attention(q, k, v, *, axis_name: str,
+                        causal: bool = False):
+    """Ring flash attention for use INSIDE an existing ``shard_map``
+    whose mesh carries ``axis_name``: q, k, v are the LOCAL
+    (B, T/n, H, D) blocks of a sequence sharded over that axis; the
+    return value is the local block of EXACT global attention, with a
+    custom VJP whose backward ring rotates dk/dv home — so it is safe
+    to differentiate through inside an SPMD train step.
+
+    This is the entry point ``SelfAttentionLayer`` routes through when
+    ``parallel.seq_context`` marks a seq axis active (the wrapper's
+    sequence-parallel train step). Kernel selection matches
+    ``make_ring_attention_fn(use_kernels='auto')``: Pallas chunks on
+    TPU with tile-divisible local lengths, pure-jnp chunks elsewhere.
+    """
+    impl = ("pallas" if jax.default_backend() == "tpu" and _blk(q) > 0
+            else "jnp")
+    return _make_ring_flash_inner(axis_name, causal, impl)(q, k, v)
 
 
 def make_ring_attention_fn(mesh: Mesh, *, axis: str = "seq",

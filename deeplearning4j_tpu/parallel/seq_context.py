@@ -1,0 +1,48 @@
+"""Trace-time sequence-parallel context.
+
+When ``ParallelWrapper`` trains over a mesh with a ``seq`` axis it
+shards the time dimension of every (B, T, ...) activation across
+devices and traces the model's loss INSIDE a ``shard_map``. Layers
+whose math spans timesteps (attention) must then compute over the
+distributed sequence rather than their local chunk. This module is the
+signal: the wrapper activates the context around tracing, and
+``SelfAttentionLayer.apply`` consults it to route through the ring
+flash attention path (``parallel/ring_attention.py``) instead of the
+single-device kernel.
+
+This is the seam that makes sequence parallelism reachable from the
+framework surface — the config-built network stays unchanged; only the
+wrapper's mesh decides the execution strategy (reference bar: the
+wrapper runs any Model, deeplearning4j-scaleout-parallelwrapper/
+ParallelWrapper.java:58).
+
+A thread-local suffices because the context only needs to be live
+while JAX traces the step (tracing is single-threaded per step build);
+the traced computation itself carries no Python state.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+__all__ = ["sequence_parallel", "current_seq_axis"]
+
+_tls = threading.local()
+
+
+def current_seq_axis() -> Optional[str]:
+    """Mesh axis name the sequence dim is sharded over, or None."""
+    return getattr(_tls, "axis", None)
+
+
+@contextlib.contextmanager
+def sequence_parallel(axis_name: str):
+    """Activate sequence-parallel routing while tracing a step."""
+    prev = getattr(_tls, "axis", None)
+    _tls.axis = axis_name
+    try:
+        yield
+    finally:
+        _tls.axis = prev

@@ -42,6 +42,43 @@ logger = logging.getLogger("deeplearning4j_tpu")
 __all__ = ["ParallelWrapper", "GraphParallelWrapper"]
 
 
+def _spmd_update_tail(model, is_graph, optimizer, grads, new_state,
+                      loss, opt_state, params, axes):
+    """Shared per-device tail of the explicit shard_map train steps
+    (compressed-DCN and sequence-parallel): gradient normalization →
+    optimizer → per-layer constraints, then merge the per-device aux
+    state (BN stats, centers — average floats / max ints) and pmean
+    the loss so the replicated out-specs hold."""
+    import optax
+
+    from deeplearning4j_tpu.train.constraints import (
+        apply_layer_constraints)
+    from deeplearning4j_tpu.train.gradnorm import (
+        apply_gradient_normalization)
+
+    if is_graph:
+        layer_cfgs = {n: v[0] for n, v in model.conf.vertices.items()
+                      if n in params}
+    else:
+        layer_cfgs = model.layers
+    grads = apply_gradient_normalization(layer_cfgs, grads)
+    updates, new_opt = optimizer.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    if is_graph:
+        new_params = {
+            n: apply_layer_constraints(model.conf.vertices[n][0], p)
+            for n, p in new_params.items()}
+    else:
+        new_params = [apply_layer_constraints(l, p)
+                      for l, p in zip(model.layers, new_params)]
+    new_state = jax.tree_util.tree_map(
+        lambda s: (jax.lax.pmean(s, axes)
+                   if jnp.issubdtype(s.dtype, jnp.floating)
+                   else jax.lax.pmax(s, axes)), new_state)
+    loss = jax.lax.pmean(loss, axes)
+    return new_params, new_state, new_opt, loss
+
+
 class ParallelWrapper:
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  prefetch_buffer: int = 2,
@@ -59,6 +96,7 @@ class ParallelWrapper:
         self.prefetch = prefetch_buffer
         self.dcn_compression = dcn_compression
         self._compressed_step = None
+        self._seq_step = None
         self._residual = None
 
     # ---- builder parity ----
@@ -114,18 +152,10 @@ class ParallelWrapper:
         .java:161-195 attaches the encoding accumulator to the local
         wrapper). The residual rides along as per-device state with a
         leading mesh axis."""
-        import functools
-
-        import optax
-
         from deeplearning4j_tpu.models.computation_graph import (
             ComputationGraph)
         from deeplearning4j_tpu.parallel.compression import (
             make_compressed_psum_ef)
-        from deeplearning4j_tpu.train.constraints import (
-            apply_layer_constraints)
-        from deeplearning4j_tpu.train.gradnorm import (
-            apply_gradient_normalization)
         try:
             from jax import shard_map
         except ImportError:       # older jax
@@ -162,31 +192,9 @@ class ParallelWrapper:
             # device count so the compressed psum yields the global mean
             grads = jax.tree_util.tree_map(lambda g: g / ndata, grads)
             grads, new_residual = psum_ef(grads, residual, "data")
-            if is_graph:
-                layer_cfgs = {n: v[0]
-                              for n, v in model.conf.vertices.items()
-                              if n in params}
-            else:
-                layer_cfgs = model.layers
-            grads = apply_gradient_normalization(layer_cfgs, grads)
-            updates, new_opt = optimizer.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            if is_graph:
-                new_params = {
-                    n: apply_layer_constraints(model.conf.vertices[n][0],
-                                               p)
-                    for n, p in new_params.items()}
-            else:
-                new_params = [apply_layer_constraints(l, p)
-                              for l, p in zip(model.layers, new_params)]
-            # per-device aux state (BN stats, centers) diverges across
-            # shards — average (floats) / max (ints) so the replicated
-            # out-spec holds
-            new_state = jax.tree_util.tree_map(
-                lambda s: (jax.lax.pmean(s, "data")
-                           if jnp.issubdtype(s.dtype, jnp.floating)
-                           else jax.lax.pmax(s, "data")), new_state)
-            loss = jax.lax.pmean(loss, "data")
+            new_params, new_state, new_opt, loss = _spmd_update_tail(
+                model, is_graph, optimizer, grads, new_state, loss,
+                opt_state, params, ("data",))
             new_residual = jax.tree_util.tree_map(lambda r: r[None],
                                                   new_residual)
             return new_params, new_state, new_opt, new_residual, loss
@@ -196,6 +204,127 @@ class ParallelWrapper:
             in_specs=(P(), P(), P(), P("data"), P("data"), P(), P()),
             out_specs=(P(), P(), P(), P("data"), P()))
         return jax.jit(smapped, donate_argnums=(0, 1, 2, 3))
+
+    # ---- sequence-parallel train step ----
+    def _seq_axis_size(self) -> int:
+        return (self.mesh.shape["seq"]
+                if "seq" in self.mesh.axis_names else 1)
+
+    def _validate_seq_model(self):
+        """Sequence parallelism shards TIME: every layer must be exact
+        on a local chunk (pointwise in time, or self-routing through
+        the ring like attention). Fail loudly otherwise — a silently
+        wrong chunked LSTM would be far worse than an error."""
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            MultiLayerNetwork)
+        if not isinstance(self.model, MultiLayerNetwork):
+            raise NotImplementedError(
+                "sequence-parallel training currently supports "
+                "MultiLayerNetwork stacks (transformer-style); got "
+                f"{type(self.model).__name__}")
+        if self.dcn_compression is not None:
+            raise NotImplementedError("dcn_compression + seq axis not "
+                                      "supported yet")
+        bad = [f"layer {i} ({type(l).__name__})"
+               for i, l in enumerate(self.model.layers)
+               if not getattr(l, "seq_parallelizable", False)]
+        if bad:
+            raise ValueError(
+                "these layers cannot train over a 'seq' mesh axis (not "
+                "pointwise in time): " + ", ".join(bad)
+                + " — use attention/dense/time-distributed layers, or "
+                  "drop the seq axis from the mesh")
+        # input preprocessors reshape with GLOBAL timestep counts
+        # (e.g. FeedForwardToRnn) — wrong on a local time chunk
+        pps = getattr(self.model.conf, "preprocessors", None) or {}
+        if pps:
+            names = ", ".join(f"layer {i}: {type(p).__name__}"
+                              for i, p in sorted(pps.items()))
+            raise ValueError(
+                "input preprocessors are not supported under sequence "
+                f"parallelism ({names}) — they reshape with global "
+                "timestep counts; restructure the net so activations "
+                "stay (B, T, ...) end to end, or drop the seq axis")
+
+    def _make_seq_step(self):
+        """Explicit shard_map train step over a mesh with a ``seq``
+        axis: (B, T, ...) batches sharded B→'data', T→'seq'; the model
+        is traced under ``sequence_parallel`` so attention layers ride
+        the ring (``parallel/ring_attention.ring_self_attention``)
+        while every other layer computes its local time chunk. Params
+        stay replicated; AD psums their cotangents over every mesh
+        axis, so dividing by the shard count yields the exact global
+        mean gradient — sp training matches the single-device step to
+        float tolerance (dryrun regime 8 asserts it)."""
+        from deeplearning4j_tpu.parallel.seq_context import (
+            sequence_parallel)
+        try:
+            from jax import shard_map
+        except ImportError:       # older jax
+            from jax.experimental.shard_map import shard_map
+
+        model = self.model
+        mesh = self.mesh
+        optimizer = model._optimizer
+        axes = tuple(a for a in ("data", "seq") if a in mesh.axis_names)
+        nshards = 1
+        for a in axes:
+            nshards *= mesh.shape[a]
+
+        def per_device(params, state, opt_state, batch, base_rng, step):
+            rng = jax.random.fold_in(base_rng, step)
+            # decorrelate dropout across every shard (data AND seq —
+            # two time-chunks of one example are distinct positions)
+            for ax in axes:
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+            with sequence_parallel("seq"):
+                def loss_fn(p):
+                    return model._loss(p, state, batch, rng,
+                                       training=True)
+
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+            # params are mesh-invariant, so AD already psummed the
+            # cotangent over every axis: grads == Σ_shards ∂(local
+            # mean loss); the global loss is the MEAN of the uniform
+            # local means — normalize
+            grads = jax.tree_util.tree_map(lambda g: g / nshards, grads)
+            return _spmd_update_tail(model, False, optimizer, grads,
+                                     new_state, loss, opt_state, params,
+                                     axes)
+
+        bspec = P("data" if "data" in mesh.axis_names else None, "seq")
+        smapped = shard_map(per_device, mesh=mesh,
+                            in_specs=(P(), P(), P(), bspec, P(), P()),
+                            out_specs=(P(), P(), P(), P()))
+        return jax.jit(smapped, donate_argnums=(0, 1, 2))
+
+    def _shard_seq_batch(self, batch):
+        """(features, labels, fmask, lmask) → B over 'data', T over
+        'seq'. Seq-parallel batches must be mask-free and time-major
+        beyond the batch dim."""
+        f, l, fm, lm = batch
+        if fm is not None or lm is not None:
+            raise NotImplementedError(
+                "masked batches are not supported under sequence "
+                "parallelism yet — pad-free uniform sequences only")
+        nseq = self._seq_axis_size()
+        ndata = self.mesh.shape.get("data", 1)
+        for name, a in (("features", f), ("labels", l)):
+            if a is None:
+                continue
+            if a.ndim < 2:
+                raise ValueError(f"seq-parallel {name} must be "
+                                 f"(B, T, ...); got shape {a.shape}")
+            if a.shape[0] % ndata or a.shape[1] % nseq:
+                raise ValueError(
+                    f"seq-parallel {name} shape {a.shape} not divisible "
+                    f"by mesh (data={ndata}, seq={nseq})")
+        spec = P("data" if "data" in self.mesh.axis_names else None,
+                 "seq")
+        put = lambda a: None if a is None else jax.device_put(
+            a, NamedSharding(self.mesh, spec))
+        return (put(f), put(l), None, None)
 
     def _init_residual(self):
         ndev = self.mesh.shape["data"]
@@ -242,7 +371,13 @@ class ParallelWrapper:
             model.init()
         is_graph = isinstance(model, ComputationGraph)
         compressed = self.dcn_compression is not None
-        if compressed:
+        seq_parallel = self._seq_axis_size() > 1
+        if seq_parallel:
+            self._validate_seq_model()
+            if self._seq_step is None:
+                self._seq_step = self._make_seq_step()
+            step = self._seq_step
+        elif compressed:
             if self._compressed_step is None:
                 self._compressed_step = self._make_compressed_step()
             step = self._compressed_step
@@ -257,7 +392,7 @@ class ParallelWrapper:
             self._residual = self._init_residual()
         it = AsyncDataSetIterator(iterator, self.prefetch) \
             if self.prefetch > 0 else iterator
-        ndata = self.mesh.shape["data"]
+        ndata = self.mesh.shape.get("data", 1)
         for _ in range(epochs):
             for lst in model.listeners:
                 lst.on_epoch_start(model)
@@ -276,7 +411,8 @@ class ParallelWrapper:
                     batch = model._batch_tuple(model._as_multi(ds))
                 else:
                     batch = model._batch_tuple(ds)
-                batch = self._shard_batch(batch)
+                batch = (self._shard_seq_batch(batch) if seq_parallel
+                         else self._shard_batch(batch))
                 if compressed:
                     (model.params, model.state, model.opt_state,
                      self._residual, loss) = step(

@@ -214,6 +214,47 @@ net4.params = p4
 if is_coordinator():
     np.save(os.path.join(out_dir, "resumed.npy"), net4.params_flat())
 print("CKPT_OK", pid)
+
+# --- scenario D: SEQUENCE-parallel transformer across processes ---
+# the ring's ppermute now spans the process boundary: each process
+# owns half of the time axis (T=32 -> 16 per proc, 4 per device)
+from deeplearning4j_tpu.nn.conf.layers import (RnnOutputLayer,
+                                               TransformerEncoderLayer)
+
+def _transformer():
+    conf = (NeuralNetConfiguration.builder().set_seed(13)
+            .updater(updaters.adam(1e-2)).list()
+            .layer(TransformerEncoderLayer(n_heads=4, causal=True))
+            .layer(RnnOutputLayer(n_out=11, loss="mcxent"))
+            .set_input_type(InputType.recurrent(16, 32)).build())
+    return MultiLayerNetwork(conf).init()
+
+rngs = np.random.default_rng(21)
+xs5 = rngs.normal(0, 1, (4, 32, 16)).astype("float32")
+ys5 = np.eye(11, dtype="float32")[rngs.integers(0, 11, (4, 32))]
+smesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices())
+sshard = NamedSharding(smesh, P(None, "seq"))
+tlo, thi = pid * 16, (pid + 1) * 16
+
+def make_seq_global(local, g_shape):
+    return jax.make_array_from_process_local_data(
+        sshard, np.ascontiguousarray(local), g_shape)
+
+net5 = _transformer()
+pw5 = ParallelWrapper(net5, smesh, prefetch_buffer=0)
+sstep = pw5._make_seq_step()
+srepl = NamedSharding(smesh, P())
+p5 = jax.device_put(net5.params, srepl)
+s5 = jax.device_put(net5.state, srepl)
+o5 = jax.device_put(net5.opt_state, srepl)
+b5 = (make_seq_global(xs5[:, tlo:thi], (4, 32, 16)),
+      make_seq_global(ys5[:, tlo:thi], (4, 32, 11)), None, None)
+for i in range(2):
+    p5, s5, o5, loss5 = sstep(p5, s5, o5, b5, net5._rng_key, np.int32(i))
+net5.params = p5
+if is_coordinator():
+    np.save(os.path.join(out_dir, "seq.npy"), net5.params_flat())
+print("SEQ_OK", pid)
 """
 
 
@@ -317,7 +358,7 @@ class TestMultiProcessDistributed:
             outs.append(out.decode())
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {i} failed:\n{out}"
-            for tag in ("CG_OK", "COMP_OK", "CKPT_OK"):
+            for tag in ("CG_OK", "COMP_OK", "CKPT_OK", "SEQ_OK"):
                 assert f"{tag} {i}" in out, out
 
         import jax
@@ -391,3 +432,24 @@ class TestMultiProcessDistributed:
         np.testing.assert_allclose(
             np.load(os.path.join(tmp_path, "resumed.npy")),
             net3.params_flat(), rtol=1e-5, atol=1e-6)
+
+        # D: single-process transformer == 2-process seq-parallel run
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, TransformerEncoderLayer)
+        rngs = np.random.default_rng(21)
+        xs5 = rngs.normal(0, 1, (4, 32, 16)).astype("float32")
+        ys5 = np.eye(11, dtype="float32")[
+            rngs.integers(0, 11, (4, 32))]
+        net5 = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder().set_seed(13)
+             .updater(updaters.adam(1e-2)).list()
+             .layer(TransformerEncoderLayer(n_heads=4, causal=True))
+             .layer(RnnOutputLayer(n_out=11, loss="mcxent"))
+             .set_input_type(InputType.recurrent(16, 32))
+             .build())).init()
+        ds5 = DataSet(xs5, ys5)
+        net5.fit(ds5)
+        net5.fit(ds5)
+        np.testing.assert_allclose(
+            np.load(os.path.join(tmp_path, "seq.npy")),
+            net5.params_flat(), rtol=2e-4, atol=2e-5)

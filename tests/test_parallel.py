@@ -617,3 +617,144 @@ class TestRingFlashAttention:
         np.testing.assert_allclose(np.asarray(o, np.float32),
                                    np.asarray(ref), rtol=5e-2,
                                    atol=5e-2)
+
+
+class TestSequenceParallelWrapper:
+    """Executor-integrated sequence parallelism: a CONFIG-BUILT
+    transformer trains over a mesh with a 'seq' axis through the
+    standard ParallelWrapper — activations sharded (B→data, T→seq),
+    attention routed through the ring-flash path (seq_context seam).
+    The reference bar is 'the wrapper runs any Model'
+    (deeplearning4j-scaleout-parallelwrapper/.../ParallelWrapper.java:58);
+    the TPU analog is: any time-distributed config trains over seq."""
+
+    B, T, C, V = 4, 32, 16, 11
+
+    def _transformer(self, seed=3, causal=True):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, TransformerEncoderLayer)
+        conf = (NeuralNetConfiguration.builder().set_seed(seed)
+                .updater(updaters.adam(1e-2)).list()
+                .layer(TransformerEncoderLayer(n_heads=4, causal=causal))
+                .layer(TransformerEncoderLayer(n_heads=4, causal=causal))
+                .layer(RnnOutputLayer(n_out=self.V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.C, self.T))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def _batch(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype("float32")
+        y = np.eye(self.V, dtype="float32")[
+            rng.integers(0, self.V, (self.B, self.T))]
+        return DataSet(x, y)
+
+    @pytest.mark.parametrize("ndata,nseq", [(1, 8), (2, 4)])
+    def test_matches_single_device(self, ndata, nseq):
+        ds = self._batch()
+        single = self._transformer()
+        single.fit(ds, epochs=2)
+        sp = self._transformer()
+        mesh = build_mesh(MeshSpec(data=ndata, seq=nseq),
+                          jax.devices()[:8])
+        ParallelWrapper(sp, mesh, prefetch_buffer=0).fit(
+            ListDataSetIterator([ds]), epochs=2)
+        np.testing.assert_allclose(
+            np.asarray(sp.params_flat()),
+            np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
+
+    def test_non_causal(self):
+        ds = self._batch()
+        single = self._transformer(causal=False)
+        single.fit(ds, epochs=1)
+        sp = self._transformer(causal=False)
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        ParallelWrapper(sp, mesh, prefetch_buffer=0).fit(
+            ListDataSetIterator([ds]), epochs=1)
+        np.testing.assert_allclose(
+            np.asarray(sp.params_flat()),
+            np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
+
+    def test_rejects_time_mixing_layers(self):
+        """An LSTM's carry spans timesteps — chunking time would be
+        silently wrong, so the wrapper must refuse."""
+        from deeplearning4j_tpu.nn.conf.layers import (LSTM,
+                                                       RnnOutputLayer)
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=self.V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.C, self.T))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        with pytest.raises(ValueError, match="seq"):
+            ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
+                ListDataSetIterator([self._batch()]), epochs=1)
+
+    def test_rejects_masked_batches(self):
+        ds = self._batch()
+        masked = DataSet(ds.features, ds.labels,
+                         np.ones((self.B, self.T), "float32"), None)
+        net = self._transformer()
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        with pytest.raises(NotImplementedError, match="mask"):
+            ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
+                ListDataSetIterator([masked]), epochs=1)
+
+    def test_rejects_indivisible_time(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1, (self.B, 30, self.C)).astype("float32")
+        y = np.eye(self.V, dtype="float32")[
+            rng.integers(0, self.V, (self.B, 30))]
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, TransformerEncoderLayer)
+        conf = (NeuralNetConfiguration.builder().set_seed(3)
+                .updater(updaters.adam(1e-2)).list()
+                .layer(TransformerEncoderLayer(n_heads=4))
+                .layer(RnnOutputLayer(n_out=self.V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.C, 30)).build())
+        net = MultiLayerNetwork(conf).init()
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        with pytest.raises(ValueError, match="divisible"):
+            ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
+                ListDataSetIterator([DataSet(x, y)]), epochs=1)
+
+    def test_rejects_preprocessors(self):
+        """Time-reshaping preprocessors use GLOBAL timestep counts —
+        must be refused loudly, not die inside the trace."""
+        from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            FeedForwardToRnnPreProcessor, RnnToFeedForwardPreProcessor)
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(DenseLayer(n_out=self.C, activation="relu"))
+                .layer(RnnOutputLayer(n_out=self.V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.C, self.T))
+                .build())
+        conf.preprocessors[0] = RnnToFeedForwardPreProcessor()
+        conf.preprocessors[1] = FeedForwardToRnnPreProcessor(
+            timesteps=self.T)
+        net = MultiLayerNetwork(conf).init()
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        with pytest.raises(ValueError, match="preprocessor"):
+            ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
+                ListDataSetIterator([self._batch()]), epochs=1)
+
+    def test_rejects_rnn_loss_layer(self):
+        """RnnLossLayer SUMS loss over timesteps (DL4J score
+        convention) — the seq step's mean-of-means normalization would
+        silently shrink gradients by the seq factor, so it must be
+        refused."""
+        from deeplearning4j_tpu.nn.conf.layers import RnnLossLayer
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(DenseLayer(n_out=self.V, activation="identity"))
+                .layer(RnnLossLayer(loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.C, self.T))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        with pytest.raises(ValueError, match="seq"):
+            ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
+                ListDataSetIterator([self._batch()]), epochs=1)
