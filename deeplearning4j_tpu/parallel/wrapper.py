@@ -225,6 +225,16 @@ class ParallelWrapper:
         if self.dcn_compression is not None:
             raise NotImplementedError("dcn_compression + seq axis not "
                                       "supported yet")
+        extra = [a for a in self.mesh.axis_names
+                 if a not in ("data", "seq") and self.mesh.shape[a] > 1]
+        if extra:
+            # param cotangents psum over EVERY mesh axis; axes the seq
+            # step doesn't normalize for would silently scale gradients
+            raise NotImplementedError(
+                "sequence-parallel training supports 'data' x 'seq' "
+                f"meshes only; mesh also carries {extra} — combine "
+                "seq with tensor/pipeline parallelism via the "
+                "functional APIs for now")
         bad = [f"layer {i} ({type(l).__name__})"
                for i, l in enumerate(self.model.layers)
                if not getattr(l, "seq_parallelizable", False)]

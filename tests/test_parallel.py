@@ -758,3 +758,14 @@ class TestSequenceParallelWrapper:
         with pytest.raises(ValueError, match="seq"):
             ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
                 ListDataSetIterator([self._batch()]), epochs=1)
+
+    def test_rejects_extra_mesh_axes(self):
+        """Param cotangents psum over EVERY mesh axis; a 'model' axis
+        the seq step doesn't normalize for would silently scale
+        gradients — must be refused."""
+        net = self._transformer()
+        mesh = build_mesh(MeshSpec(data=2, model=2, seq=2),
+                          jax.devices()[:8])
+        with pytest.raises(NotImplementedError, match="model"):
+            ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
+                ListDataSetIterator([self._batch()]), epochs=1)
