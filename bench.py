@@ -941,14 +941,13 @@ def _leg_flash_attention(peak):
             return jnp.swapaxes(o, 1, 2)
 
         m_prod = mk(prod)
-        # interleave against OURS (not reuse dt_f from the naive
-        # window): host drift between windows lands asymmetrically,
-        # so the ratio must come from alternating bursts
+        # interleave against OURS in its own window (host drift
+        # between windows lands asymmetrically, so each ratio comes
+        # from alternating bursts within ONE window): vs_baseline
+        # stays (dt_f, dt_n) from window 1, vs_production_kernel is
+        # (dt_f2, dt_p) from window 2 — dt_f2 is NOT folded into the
+        # headline value
         dt_f2, dt_p = _interleave(m_flash, m_prod, repeats=3)
-        dt_f = min(dt_f, dt_f2)
-        if peak:
-            _check_plausible(attn_flops / dt_p / peak,
-                             "flash production-kernel baseline")
         prod_ratio = dt_p / dt_f2
         prod_note = (f"vs jax.experimental.pallas.ops.tpu."
                      f"flash_attention (tuned to the same 1024^2 "
@@ -957,8 +956,16 @@ def _leg_flash_attention(peak):
               f"tok/s, prod {toks/dt_p:.0f} tok/s "
               f"(ours/prod {prod_ratio:.3f}x)", file=sys.stderr)
     except Exception as e:           # older jax layouts: informational
+        dt_f2 = dt_p = None
         prod_ratio = None
         prod_note = f"production-kernel comparison unavailable: {e}"
+    if peak and dt_p is not None:
+        # OUTSIDE the except: a degraded-tunnel window must abort the
+        # leg (orchestrator retries), not demote to a note
+        _check_plausible(attn_flops / dt_p / peak,
+                         "flash production-kernel baseline")
+        _check_plausible(attn_flops / dt_f2 / peak,
+                         "flash (production-comparison window)")
     print(f"flash attention T=4096 fwd+bwd: {toks/dt_f:.0f} "
           f"tok/s vs naive {toks/dt_n:.0f}", file=sys.stderr)
     if peak:

@@ -31,14 +31,38 @@ This module is that architecture, TPU-framework-sized:
 
 from __future__ import annotations
 
+import gzip
 import math
+import os
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
 from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
                                                  Tokenizer, _is_cjk)
 
 __all__ = ["LatticeDictionary", "ViterbiSegmenter",
-           "LatticeCJKTokenizerFactory", "small_cjk_dictionary"]
+           "LatticeCJKTokenizerFactory", "small_cjk_dictionary",
+           "chinese_dictionary", "japanese_dictionary",
+           "compile_dictionary"]
+
+# ---------------------------------------------------------------------------
+# Dictionary file format (the Kuromoji TSV → binary pipeline analog;
+# reference compiles feature TSVs via DictionaryField.java /
+# kuromoji-compile into binary dictionaries):
+#
+#   # comment
+#   word<TAB>count<TAB>tag          entries (tag optional, default *)
+#   @conn<TAB>left<TAB>right<TAB>cost   tag-pair connection costs
+#
+# Counts become word costs via -log(count/total) at load. `.tsv` and
+# `.tsv.gz` are the source format; `compile_dictionary()` bakes the
+# normalized costs into a `.npz` that loads without re-parsing — the
+# binary-dictionary analog. Two non-toy dictionaries ship with the
+# package (`nlp/data/`): zh_core (~65k entries derived from jieba's
+# MIT-licensed frequency dictionary — tools/build_zh_dictionary.py)
+# and ja_core (~560 curated morphemes: the closed-class particles and
+# auxiliaries that drive Japanese segmentation, plus common content
+# words and a tag-pair connection matrix).
+# ---------------------------------------------------------------------------
 
 
 class LatticeDictionary:
@@ -64,6 +88,83 @@ class LatticeDictionary:
         total = float(sum(counts.values())) or 1.0
         return cls({w: -math.log(c / total)
                     for w, c in counts.items() if c > 0}, **kw)
+
+    @classmethod
+    def from_tsv(cls, path: str) -> "LatticeDictionary":
+        """Load the TSV source format (module docstring above);
+        transparently handles ``.gz``."""
+        counts: Dict[str, float] = {}
+        tags: Dict[str, str] = {}
+        conns: Dict[Tuple[str, str], float] = {}
+        op = gzip.open if str(path).endswith(".gz") else open
+        with op(path, "rt", encoding="utf-8") as f:
+            for ln, line in enumerate(f, 1):
+                line = line.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split("\t")
+                if parts[0] == "@conn":
+                    if len(parts) != 4:
+                        raise ValueError(
+                            f"{path}:{ln}: @conn needs left, right, "
+                            f"cost — got {line!r}")
+                    try:
+                        conns[(parts[1], parts[2])] = float(parts[3])
+                    except ValueError:
+                        raise ValueError(f"{path}:{ln}: bad @conn "
+                                         f"cost {parts[3]!r}") from None
+                    continue
+                if len(parts) < 2:
+                    raise ValueError(f"{path}:{ln}: expected "
+                                     f"word<TAB>count — got {line!r}")
+                word = parts[0]
+                try:
+                    count = float(parts[1])
+                except ValueError:
+                    raise ValueError(f"{path}:{ln}: bad count "
+                                     f"{parts[1]!r} for {word!r}") \
+                        from None
+                counts[word] = counts.get(word, 0.0) + count
+                if len(parts) > 2 and parts[2] != "*":
+                    tags[word] = parts[2]
+        return cls.from_counts(counts, tags=tags, connections=conns)
+
+    @classmethod
+    def load(cls, path: str) -> "LatticeDictionary":
+        """Dispatch on extension: ``.npz`` compiled, else TSV."""
+        if str(path).endswith(".npz"):
+            import numpy as np
+            z = np.load(path, allow_pickle=False)
+            words = [str(w) for w in z["words"]]
+            costs = z["costs"]
+            tags = {str(w): str(t)
+                    for w, t in zip(z["tag_words"], z["tag_values"])}
+            conns = {(str(l), str(r)): float(c)
+                     for l, r, c in zip(z["conn_left"], z["conn_right"],
+                                        z["conn_cost"])}
+            return cls(dict(zip(words, costs.tolist())), tags=tags,
+                       connections=conns)
+        return cls.from_tsv(path)
+
+    def save_compiled(self, path: str) -> str:
+        """Bake into the `.npz` compiled form (normalized costs, no
+        re-parse at load) — the binary-dictionary analog of
+        kuromoji-compile."""
+        import numpy as np
+        words = sorted(self._cost)
+        np.savez_compressed(
+            path,
+            words=np.array(words),
+            costs=np.array([self._cost[w] for w in words], np.float64),
+            tag_words=np.array(sorted(self._tag)),
+            tag_values=np.array([self._tag[w]
+                                 for w in sorted(self._tag)]),
+            conn_left=np.array([k[0] for k in sorted(self._conn)]),
+            conn_right=np.array([k[1] for k in sorted(self._conn)]),
+            conn_cost=np.array([self._conn[k]
+                                for k in sorted(self._conn)],
+                               np.float64))
+        return path if str(path).endswith(".npz") else path + ".npz"
 
     @property
     def max_len(self) -> int:
@@ -198,6 +299,39 @@ class ViterbiSegmenter:
         return out[::-1]
 
 
+def compile_dictionary(tsv_path: str, out_path: str) -> str:
+    """TSV source → compiled ``.npz`` (counts normalized to costs;
+    the kuromoji-compile analog)."""
+    return LatticeDictionary.from_tsv(tsv_path).save_compiled(out_path)
+
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "data")
+_bundled_cache: Dict[str, LatticeDictionary] = {}
+
+
+def _bundled(name: str) -> LatticeDictionary:
+    if name not in _bundled_cache:
+        _bundled_cache[name] = LatticeDictionary.from_tsv(
+            os.path.join(_DATA_DIR, f"{name}.tsv.gz"))
+    return _bundled_cache[name]
+
+
+def chinese_dictionary() -> LatticeDictionary:
+    """The bundled ~65k-entry Chinese dictionary (derived from jieba's
+    MIT-licensed frequency list; tools/build_zh_dictionary.py) — the
+    ansj-language-pack analog: real text segments out of the box."""
+    return _bundled("zh_core")
+
+
+def japanese_dictionary() -> LatticeDictionary:
+    """The bundled Japanese core dictionary (~560 curated morphemes:
+    closed-class particles/auxiliaries + common content words + a
+    tag-pair connection matrix) — the Kuromoji-language-pack analog,
+    relying on character-class unknown grouping for open-class OOV."""
+    return _bundled("ja_core")
+
+
 def small_cjk_dictionary() -> LatticeDictionary:
     """A small bundled dictionary (counts → costs) exercising the
     classic segmentation ambiguities. A real deployment loads a corpus
@@ -222,13 +356,24 @@ def small_cjk_dictionary() -> LatticeDictionary:
 class LatticeCJKTokenizerFactory:
     """TokenizerFactory SPI plug-in: Viterbi-lattice segmentation for
     CJK runs (the Kuromoji-class replacement for the greedy
-    CJKTokenizerFactory), DefaultTokenizerFactory for Latin text."""
+    CJKTokenizerFactory), DefaultTokenizerFactory for Latin text.
 
-    def __init__(self, dictionary: Optional[LatticeDictionary] = None,
-                 *, unknown_cost: float = 12.0):
-        self.segmenter = ViterbiSegmenter(
-            dictionary if dictionary is not None
-            else small_cjk_dictionary(), unknown_cost=unknown_cost)
+    ``dictionary``: a LatticeDictionary, a path to a ``.tsv``/
+    ``.tsv.gz``/compiled ``.npz`` dictionary file, or a bundled
+    language pack name (``"zh"`` — default — / ``"ja"``). Out of the
+    box this segments real Chinese with the 65k-entry bundled
+    dictionary (reference parity: the ansj/Kuromoji packs ship inside
+    the language-pack jars)."""
+
+    def __init__(self, dictionary=None, *, unknown_cost: float = 12.0):
+        if dictionary is None or dictionary == "zh":
+            dictionary = chinese_dictionary()
+        elif dictionary == "ja":
+            dictionary = japanese_dictionary()
+        elif isinstance(dictionary, (str, os.PathLike)):
+            dictionary = LatticeDictionary.load(dictionary)
+        self.segmenter = ViterbiSegmenter(dictionary,
+                                          unknown_cost=unknown_cost)
         self._latin = DefaultTokenizerFactory()
         self._pre = None
 

@@ -1,0 +1,63 @@
+"""Build the bundled Chinese lattice dictionary from jieba's dict.txt.
+
+jieba (https://github.com/fxsjy/jieba, MIT license) ships a 349k-entry
+frequency dictionary `word count tag`. We derive the framework's
+bundled core: the top-N entries by count, plus EVERY single-character
+entry (single chars keep the lattice connected when a compound is
+missing), re-written in the framework's dictionary TSV format
+(see deeplearning4j_tpu/nlp/lattice.py docstring).
+
+Reproducible: `python tools/build_zh_dictionary.py` regenerates
+deeplearning4j_tpu/nlp/data/zh_core.tsv.gz byte-for-byte given the
+same jieba version (0.42.1 in this image).
+"""
+
+import gzip
+import os
+
+TOP_N = 60_000
+
+HEADER = """\
+# Chinese core dictionary for the lattice segmenter.
+# Derived from jieba 0.42.1 dict.txt (MIT license,
+# https://github.com/fxsjy/jieba): top {n} entries by corpus count
+# plus all single-character entries. Format: word<TAB>count<TAB>tag.
+# Regenerate with: python tools/build_zh_dictionary.py
+"""
+
+
+def main():
+    import jieba
+    src = os.path.join(os.path.dirname(jieba.__file__), "dict.txt")
+    entries = []
+    with open(src, encoding="utf-8") as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                word, count = parts[0], int(parts[1])
+                tag = parts[2] if len(parts) > 2 else "*"
+                entries.append((word, count, tag))
+    entries.sort(key=lambda e: -e[1])
+    keep = entries[:TOP_N] + [e for e in entries[TOP_N:]
+                              if len(e[0]) == 1]
+    keep.sort(key=lambda e: (-e[1], e[0]))     # deterministic output
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deeplearning4j_tpu", "nlp",
+        "data", "zh_core.tsv.gz")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    import io
+    buf = io.StringIO()
+    buf.write(HEADER.format(n=TOP_N))
+    for word, count, tag in keep:
+        buf.write(f"{word}\t{count}\t{tag}\n")
+    with open(out, "wb") as raw:
+        # mtime=0 → byte-reproducible output across rebuilds
+        with gzip.GzipFile(fileobj=raw, mode="wb", compresslevel=9,
+                           mtime=0) as f:
+            f.write(buf.getvalue().encode("utf-8"))
+    print(f"{out}: {len(keep)} entries, "
+          f"{os.path.getsize(out) / 1e6:.2f} MB")
+
+
+if __name__ == "__main__":
+    main()
