@@ -13,10 +13,16 @@ automatically (XLA transposes ppermute to the opposite rotation), so
 forward and backward both run device-resident with zero host
 involvement.
 
-Scope: the stages must be shape-homogeneous (the classic SPMD-pipeline
-requirement — e.g. N identical transformer blocks / MLP blocks).
-Heterogeneous input projection and loss head run replicated outside
-the rotating loop. For arbitrary heterogeneous layer stacks, the GPipe
+Scope: the rotating stages must be shape-homogeneous (the classic
+SPMD-pipeline requirement — e.g. N identical transformer blocks / MLP
+blocks). Heterogeneous input projection and loss head run replicated
+outside the rotating loop. :class:`NetworkSpmdPipeline` bridges a
+CONFIG-BUILT network onto this schedule automatically: it finds the
+longest run of structurally identical layers (a transformer stack),
+folds them N/S-per-stage into the rotation, and runs the prefix
+(embedding) and suffix (output/loss) layers replicated — so a real
+transformer config trains device-resident pp=S with the host out of
+the loop. For arbitrary heterogeneous layer stacks, the GPipe
 scheduler in pipeline.py remains the fallback.
 
 References: reference repo has NO pipeline parallelism (SURVEY §2.3 —
@@ -44,7 +50,7 @@ except ImportError:                      # older jax
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["SpmdPipeline"]
+__all__ = ["SpmdPipeline", "NetworkSpmdPipeline"]
 
 
 class SpmdPipeline:
@@ -180,3 +186,184 @@ class SpmdPipeline:
         ys = y.reshape((M, y.shape[0] // M) + y.shape[1:])
         return self.replicate(jnp.asarray(xs)), \
             self.replicate(jnp.asarray(ys))
+
+
+def _layer_signature(layer, params):
+    """Structural identity of a layer: config + param tree + shapes.
+    Two layers with equal signatures compute the same function shape-
+    wise, so their params can stack into one rotating stage tensor."""
+    leaves = jax.tree_util.tree_leaves(params)
+    return (type(layer).__name__,
+            tuple(sorted(layer.to_dict().items(),
+                         key=lambda kv: kv[0])) if hasattr(
+                layer, "to_dict") else (),
+            jax.tree_util.tree_structure(params),
+            tuple((tuple(a.shape), str(a.dtype)) for a in leaves))
+
+
+def _longest_identical_run(sigs):
+    best = (0, 0)
+    i = 0
+    while i < len(sigs):
+        j = i
+        while j < len(sigs) and sigs[j] == sigs[i]:
+            j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = j
+    return best
+
+
+class NetworkSpmdPipeline:
+    """Device-resident pipeline for a CONFIG-BUILT MultiLayerNetwork.
+
+    Bridges the network onto :class:`SpmdPipeline`: the longest run of
+    structurally identical layers (e.g. a TransformerEncoderLayer
+    stack) becomes the rotating stage stack — N layers folded N/S per
+    stage — while prefix layers (embedding) and the suffix (any
+    remaining layers + the loss head) run replicated. Gradients and
+    the optimizer update live entirely inside the one jitted
+    shard_map program; microbatch loss averaging equals the full-batch
+    mean for uniform microbatches, so training MATCHES the
+    single-device step (asserted by dryrun regime 9 / tests).
+
+    Limits (fail loudly): the net must end in a loss layer, carry no
+    input preprocessors, masks, stateful layers (BN), dropout (the
+    bridge runs rng-free), or gradient normalization; the identical
+    run must cover at least S layers.
+    """
+
+    def __init__(self, model, mesh, *, axis: str = "pipe",
+                 n_microbatches: int = 8):
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            MultiLayerNetwork)
+        if not isinstance(model, MultiLayerNetwork):
+            raise NotImplementedError(
+                "NetworkSpmdPipeline bridges MultiLayerNetwork stacks; "
+                f"got {type(model).__name__}")
+        if model.params is None:
+            model.init()
+        if getattr(model.conf, "preprocessors", None):
+            raise ValueError("input preprocessors are not supported on "
+                             "the device-resident pipeline")
+        layers = model.layers
+        if not layers[-1].has_loss():
+            raise ValueError("last layer has no loss — the pipeline "
+                             "head needs one")
+        for i, (l, s) in enumerate(zip(layers, model.state)):
+            if jax.tree_util.tree_leaves(s):
+                raise ValueError(
+                    f"layer {i} ({type(l).__name__}) carries state "
+                    "(e.g. BatchNorm) — not supported device-resident")
+            if getattr(l, "dropout", 0.0):
+                raise ValueError(
+                    f"layer {i} ({type(l).__name__}) uses dropout — "
+                    "the device-resident bridge runs rng-free")
+            if getattr(l, "gradient_normalization", None):
+                raise ValueError(
+                    f"layer {i} ({type(l).__name__}) configures "
+                    "gradient normalization — not supported on the "
+                    "pipeline bridge")
+            if getattr(l, "updater", None) is not None:
+                raise ValueError(
+                    f"layer {i} ({type(l).__name__}) overrides the "
+                    "updater (optax.multi_transform labels are shaped "
+                    "for the full layer list, which the partitioned "
+                    "stage/embed/head update cannot match) — use one "
+                    "network-level updater on the pipeline bridge")
+        if getattr(model.conf.conf, "gradient_clip", None) is not None:
+            raise ValueError(
+                "network-level gradient clipping is not supported on "
+                "the pipeline bridge: the stage/embed/head partitions "
+                "update separately, so a 'global' norm would be "
+                "computed per-partition per-device and silently "
+                "diverge from the single-device step")
+
+        S = mesh.shape[axis]
+        sigs = [_layer_signature(l, p)
+                for l, p in zip(layers, model.params)]
+        start, end = _longest_identical_run(sigs)
+        n_run = ((end - start) // S) * S     # trailing extras → suffix
+        if n_run < S:
+            raise ValueError(
+                f"no run of >= {S} structurally identical layers to "
+                f"pipeline over {S} stages (longest: {end - start}) — "
+                "use the GPipe scheduler (parallel/pipeline.py) for "
+                "heterogeneous stacks")
+        end = start + n_run
+        self.model = model
+        self.mesh = mesh
+        self._start, self._end = start, end
+        self._n_per = n_run // S
+        self._S = S
+        block_layer = layers[start]
+        prefix = layers[:start]
+        suffix = layers[end:-1]
+        out_layer = layers[-1]
+        n_per = self._n_per
+
+        def stage_apply(p, h):
+            # p leaves: (n_per, ...) — apply the folded layers in order
+            for i in range(n_per):
+                pi = jax.tree_util.tree_map(lambda a: a[i], p)
+                h, _ = block_layer.apply(pi, {}, h, training=True,
+                                         rng=None)
+            return h
+
+        def embed_apply(ep, x):
+            h = x
+            for l, p in zip(prefix, ep):
+                h, _ = l.apply(p, {}, h, training=True, rng=None)
+            return h
+
+        def head_loss(hp, h, y):
+            for l, p in zip(suffix, hp[:-1]):
+                h, _ = l.apply(p, {}, h, training=True, rng=None)
+            return out_layer.loss_from_input(hp[-1], h, y,
+                                             training=True, rng=None)
+
+        self.pipe = SpmdPipeline(mesh, stage_apply, embed_apply,
+                                 head_loss, axis=axis,
+                                 n_microbatches=n_microbatches)
+        # stack the run's params: leaves (N, ...) → (S, n_per, ...)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *model.params[start:end])
+        stacked = jax.tree_util.tree_map(
+            lambda a: a.reshape((S, n_per) + a.shape[1:]), stacked)
+        self._stage = self.pipe.shard_stage_params(stacked)
+        self._embed = self.pipe.replicate(
+            tuple(model.params[:start]))
+        self._head = self.pipe.replicate(
+            tuple(model.params[end:]))
+        opt = model._optimizer
+        self._opt_s, self._opt_e, self._opt_h = \
+            self.pipe.init_opt_states(opt, stacked,
+                                      tuple(model.params[:start]),
+                                      tuple(model.params[end:]))
+        self._step = self.pipe.make_train_step(opt)
+
+    def train_batch(self, x, y) -> float:
+        """One optimizer step over (B, ...) arrays; B must divide by
+        n_microbatches. Returns the batch mean loss."""
+        xs, ys = self.pipe.microbatch(x, y)
+        (self._stage, self._embed, self._head, self._opt_s,
+         self._opt_e, self._opt_h, loss) = self._step(
+            self._stage, self._embed, self._head, self._opt_s,
+            self._opt_e, self._opt_h, xs, ys)
+        self.model.iteration_count += 1
+        self.model.score_value = loss
+        return float(loss)
+
+    def collect_params(self):
+        """Write the trained params back into ``model.params`` in
+        layer order (the PipelineParallel.collect_params analog)."""
+        stage = jax.device_get(self._stage)
+        flatwise = jax.tree_util.tree_map(
+            lambda a: a.reshape((self._S * self._n_per,) + a.shape[2:]),
+            stage)
+        run = [jax.tree_util.tree_map(lambda a: a[i], flatwise)
+               for i in range(self._S * self._n_per)]
+        embed = list(jax.device_get(self._embed))
+        head = list(jax.device_get(self._head))
+        self.model.params = embed + run + head
+        return self.model

