@@ -736,6 +736,130 @@ def _leg_vgg16_import(peak):
                  "0.944-1.059 across 5 runs), not a framework cost")}
 
 
+def _ensure_png_tree(root, n_classes=10, per_class=52, hw=224):
+    """Directory-per-label PNG tree for the ETL leg (cached across
+    runs; ~78MB of noise PNGs — noise compresses worst, so decode
+    cost is an upper bound)."""
+    import json
+    stamp = os.path.join(root, "stamp.json")
+    want = {"n_classes": n_classes, "per_class": per_class, "hw": hw}
+    if os.path.exists(stamp):
+        with open(stamp) as f:
+            if json.load(f) == want:
+                return root
+        # stale tree from a different config: clear it, or leftover
+        # files silently inflate the dataset the numbers claim
+        import shutil
+        shutil.rmtree(root)
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for c in range(n_classes):
+        d = os.path.join(root, f"class{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            a = rng.integers(0, 256, (hw, hw, 3), dtype=np.uint8)
+            Image.fromarray(a).save(os.path.join(d, f"im{i}.png"))
+    with open(stamp, "w") as f:
+        json.dump(want, f)
+    return root
+
+
+def _leg_resnet_native_etl(peak):
+    """Train ResNet50 FROM A PNG TREE through the native libpng worker
+    pool (round-3 verdict weak #4: the ETL claim must be end-to-end,
+    reference RecordReaderDataSetIterator.java:52). Reports decode,
+    step, and end-to-end times so exposed ETL is explicit."""
+    from deeplearning4j_tpu.data.native_loader import (
+        NativeImageDataSetIterator, native_image_available)
+    if not native_image_available():
+        raise ImportError("native image loader unavailable (g++/libpng)")
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_cache")
+    tree = _ensure_png_tree(os.path.join(cache_dir, "png_tree_224"))
+    batch = 128
+    it = NativeImageDataSetIterator(tree, batch, 224, 224, 3,
+                                    n_threads=4, queue_capacity=4)
+
+    # (a) pure decode, steady state: second full pass (the first
+    # amortizes directory scan + pool startup over only 4 batches)
+    decode_ms = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        n_batches = 0
+        for ds in it:
+            n_batches += 1
+        decode_ms = (time.perf_counter() - t0) / max(1, n_batches) * 1e3
+
+    # (b) training from the tree, loader prefetching in background
+    net = ResNet50(n_classes=10, input_shape=(224, 224, 3),
+                   updater=updaters.nesterovs(0.1, 0.9)).init()
+    step = net._make_train_step()
+    key = jax.random.PRNGKey(0)
+    # compile + warm on the first decoded batch
+    first = next(iter(it))
+    bt = net._batch_tuple(net._as_multi(first))
+    p, s, o, loss = step(net.params, net.state, net.opt_state, bt, key,
+                         np.int32(0))
+    float(jnp.sum(loss))
+
+    # (c) pure step: cached batch burst
+    t0 = time.perf_counter()
+    for _ in range(10):
+        p, s, o, loss = step(p, s, o, bt, key, np.int32(0))
+    float(jnp.sum(loss))
+    step_ms = (time.perf_counter() - t0) / 10 * 1e3
+
+    # (d) end-to-end epochs from PNGs
+    n_img = 0
+    t0 = time.perf_counter()
+    for _ in range(2):
+        for ds in it:
+            if ds.num_examples() != batch:
+                continue
+            bt2 = net._batch_tuple(net._as_multi(ds))
+            p, s, o, loss = step(p, s, o, bt2, key, np.int32(0))
+            n_img += batch
+    float(jnp.sum(loss))
+    e2e = time.perf_counter() - t0
+    e2e_ms = e2e / (n_img / batch) * 1e3
+    rate = n_img / e2e
+    exposed = max(0.0, e2e_ms - step_ms)
+    host_cores = os.cpu_count() or 1
+    print(f"native-etl: decode {decode_ms:.1f} ms/batch, step "
+          f"{step_ms:.1f} ms, e2e {e2e_ms:.1f} ms/batch "
+          f"({rate:.1f} img/s), host cores {host_cores}",
+          file=sys.stderr)
+    return {
+        "metric": ("ResNet50 train-from-PNG-tree via native ETL "
+                   "(batch 128, 224x224, f32)"),
+        "value": round(rate, 1), "unit": "images/sec/chip",
+        "baseline": None, "vs_baseline": None,
+        "decode_ms_per_batch": round(decode_ms, 1),
+        "step_ms_per_batch": round(step_ms, 1),
+        "e2e_ms_per_batch": round(e2e_ms, 1),
+        "exposed_etl_ms_per_batch": round(exposed, 1),
+        "note": (f"libpng worker pool (4 threads) on a "
+                 f"{host_cores}-core host. The pool decodes outside "
+                 f"the GIL and scales with cores, so keeping the "
+                 f"device fed (ETL < step) needs ceil(decode/step)="
+                 f"{max(1, int(np.ceil(decode_ms / max(step_ms, 1e-9))))} "
+                 f"cores at this config — this bench host has "
+                 f"{host_cores}, so decode is the bottleneck HERE by "
+                 f"construction, not by design; single-thread PIL "
+                 f"measured ~174 ms/batch-128 on the same host "
+                 f"(native/src/dataloader.cpp header note). The e2e "
+                 f"number also pays a ~77MB/batch host->device "
+                 f"upload through the axon TUNNEL (fresh features "
+                 f"per step; not present on a directly-attached "
+                 f"TPU-VM host where this is a PCIe copy)")}
+
+
 LM_B, LM_T, LM_D, LM_L, LM_H, LM_V = 8, 1024, 1024, 8, 16, 2048
 LM_STEPS = 20
 # causal-corrected model FLOPs per token, forward: per layer 24*D^2
@@ -999,6 +1123,9 @@ _LEGS = [
     ("char_rnn", _leg_char_rnn, 240),
     ("transformer_lm", _leg_transformer_lm, 300),
     ("flash_attention", _leg_flash_attention, 300),
+    # 480s: its ResNet executable (n_classes=10) is NOT covered by
+    # the other ResNet legs' compile cache — cold tunnel compile ~5min
+    ("resnet_native_etl", _leg_resnet_native_etl, 480),
 ]
 
 

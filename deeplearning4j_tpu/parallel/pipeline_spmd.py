@@ -264,6 +264,21 @@ class NetworkSpmdPipeline:
                     f"layer {i} ({type(l).__name__}) configures "
                     "gradient normalization — not supported on the "
                     "pipeline bridge")
+            if (getattr(l, "l1", 0.0) or getattr(l, "l2", 0.0)
+                    or getattr(l, "l1_bias", 0.0)
+                    or getattr(l, "l2_bias", 0.0)):
+                raise ValueError(
+                    f"layer {i} ({type(l).__name__}) configures l1/l2 "
+                    "regularization — the bridge's partitioned loss "
+                    "does not add the regularization term, so it "
+                    "would silently train differently; remove it or "
+                    "use the GPipe scheduler")
+            if getattr(l, "constraints", ()):
+                raise ValueError(
+                    f"layer {i} ({type(l).__name__}) configures "
+                    "parameter constraints — not applied by the "
+                    "bridge's partitioned update; remove them or use "
+                    "the GPipe scheduler")
             if getattr(l, "updater", None) is not None:
                 raise ValueError(
                     f"layer {i} ({type(l).__name__}) overrides the "

@@ -2,9 +2,11 @@ from deeplearning4j_tpu.zoo.models import (
     ZooModel, LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50,
     GoogLeNet, InceptionResNetV1, FaceNetNN4Small2, TextGenerationLSTM,
     TinyYOLO, Darknet19, UNet, available_models,
+    register_pretrained, load_manifest, export_pretrained,
 )
 
 __all__ = ["ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
            "ResNet50", "GoogLeNet", "InceptionResNetV1",
            "FaceNetNN4Small2", "TextGenerationLSTM", "TinyYOLO",
-           "Darknet19", "UNet", "available_models"]
+           "Darknet19", "UNet", "available_models",
+           "register_pretrained", "load_manifest", "export_pretrained"]

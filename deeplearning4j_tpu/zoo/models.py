@@ -37,7 +37,77 @@ logger = logging.getLogger("deeplearning4j_tpu")
 __all__ = ["ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
            "ResNet50", "GoogLeNet", "InceptionResNetV1",
            "FaceNetNN4Small2", "TextGenerationLSTM", "TinyYOLO",
-           "Darknet19", "UNet", "available_models"]
+           "Darknet19", "UNet", "available_models",
+           "register_pretrained", "load_manifest", "export_pretrained"]
+
+
+# ---------------------------------------------------------------------------
+# Pretrained-weights manifest: per-model (url, sha256) — the analog of
+# the reference's per-model download URLs + checksums
+# (zoo/ZooModel.java:40-75 pretrainedUrl/pretrainedChecksum). This
+# build environment has no egress, so no URLs are baked in; a
+# deployment registers artifacts (its own blob store, a shared
+# filesystem via file://, ...) through register_pretrained() or a
+# manifest JSON, and export_pretrained() produces the artifacts from
+# trained models. init_pretrained() then fetches + sha256-verifies on
+# first use, exactly like the reference.
+# ---------------------------------------------------------------------------
+
+_PRETRAINED_MANIFEST: dict = {}
+
+
+def register_pretrained(name: str, url: str, sha256: str) -> None:
+    """Register a weights artifact for ``name`` (a ZooModel.name):
+    any urllib-supported URL (https://, file://, ...)."""
+    _PRETRAINED_MANIFEST[name] = {"url": url, "sha256": sha256}
+
+
+def load_manifest(path: str) -> dict:
+    """Merge a manifest JSON file ``{name: {"url":…, "sha256":…}}``
+    into the registry; returns the merged registry."""
+    import json
+    with open(path) as f:
+        entries = json.load(f)
+    for name, e in entries.items():
+        register_pretrained(name, e["url"], e["sha256"])
+    return dict(_PRETRAINED_MANIFEST)
+
+
+def export_pretrained(net, name: str, out_dir: str) -> dict:
+    """Export a trained model as a zoo weights artifact: writes
+    ``<name>.zip`` (the framework checkpoint format), a
+    ``<name>.zip.sha256`` sidecar, and updates ``manifest.json`` in
+    ``out_dir`` with a ``file://`` URL — the artifact round-trips
+    through ``init_pretrained`` as-is, and the manifest entries can be
+    re-pointed at a blob store for distribution. Returns the entry."""
+    import hashlib
+    import json
+
+    from deeplearning4j_tpu.util.model_serializer import write_model
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"{name}.zip")
+    write_model(net, path)
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    digest = h.hexdigest()
+    with open(path + ".sha256", "w") as f:
+        f.write(digest + "\n")
+    entry = {"url": "file://" + os.path.abspath(path),
+             "sha256": digest}
+    mpath = os.path.join(out_dir, "manifest.json")
+    manifest = {}
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            manifest = json.load(f)
+    manifest[name] = entry
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, mpath)
+    logger.info("exported %s -> %s (sha256 %s)", name, path, digest)
+    return entry
 
 
 class ZooModel:
@@ -79,19 +149,31 @@ class ZooModel:
     def init_pretrained(self, checksum: Optional[str] = None):
         """Load cached pretrained weights, verifying integrity first —
         the reference downloads then checks a checksum and deletes the
-        corrupt file (zoo/ZooModel.java:40-75). The expected sha256
-        comes from (in order) the ``checksum`` argument, a
-        ``<name>.zip.sha256`` sidecar next to the artifact, or the
-        class attribute ``pretrained_checksum``. With none of those,
-        the file loads unverified (a warning is logged)."""
+        corrupt file (zoo/ZooModel.java:40-75). A missing artifact is
+        FETCHED from the manifest registry (register_pretrained /
+        load_manifest; any urllib URL incl. file://). The expected
+        sha256 comes from (in order) the ``checksum`` argument, the
+        manifest entry, a ``<name>.zip.sha256`` sidecar next to the
+        artifact, or the class attribute ``pretrained_checksum``.
+        With none of those, the file loads unverified (a warning is
+        logged)."""
         path = self.pretrained_path()
+        manifest = _PRETRAINED_MANIFEST.get(self.name)
+        fetched = False
         if not os.path.exists(path):
-            raise FileNotFoundError(
-                f"No pretrained weights for {self.name}: expected {path} "
-                f"(this environment has no network egress; place the "
-                f"checkpoint there manually)")
-        # precedence per the docstring: argument > sidecar > class attr
+            if manifest is None:
+                raise FileNotFoundError(
+                    f"No pretrained weights for {self.name}: expected "
+                    f"{path} and no manifest entry — register one via "
+                    f"zoo.register_pretrained()/load_manifest(), or "
+                    f"place the checkpoint there manually")
+            self._fetch(manifest["url"], path)
+            fetched = True
+        # precedence per the docstring: argument > manifest > sidecar
+        # > class attr
         expected = checksum
+        if expected is None and manifest is not None:
+            expected = manifest["sha256"]
         sidecar = path + ".sha256"
         if expected is None and os.path.exists(sidecar):
             with open(sidecar) as f:
@@ -110,15 +192,40 @@ class ZooModel:
                     h.update(chunk)
             actual = h.hexdigest()
             if actual != expected:
+                if fetched:
+                    # the reference deletes corrupt downloads
+                    # (ZooModel.java:40-75): a bad fetch must not
+                    # poison the cache and block every later attempt
+                    os.remove(path)
                 raise IOError(
                     f"Checksum mismatch for {path}: expected {expected}, "
-                    f"got {actual} — corrupt or stale artifact; delete "
-                    f"it and re-fetch")
+                    f"got {actual} — corrupt or stale artifact"
+                    + ("; the fetched file was deleted — fix the "
+                       "manifest source and retry" if fetched else
+                       "; delete it and re-fetch"))
         else:
             logger.warning("loading %s without checksum verification "
                            "(no sidecar %s)", path, sidecar)
         from deeplearning4j_tpu.util.model_serializer import restore_model
         return restore_model(path)
+
+    @staticmethod
+    def _fetch(url: str, path: str):
+        """Stream a manifest URL into the cache (tmp + rename, so a
+        failed fetch never leaves a partial artifact; the reference
+        deletes corrupt downloads, ZooModel.java:40-75)."""
+        import shutil
+        import urllib.request
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".fetch{os.getpid()}"
+        logger.info("fetching pretrained weights: %s -> %s", url, path)
+        try:
+            with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
 
     def _builder(self):
         return (NeuralNetConfiguration.builder()

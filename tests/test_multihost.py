@@ -255,6 +255,37 @@ net5.params = p5
 if is_coordinator():
     np.save(os.path.join(out_dir, "seq.npy"), net5.params_flat())
 print("SEQ_OK", pid)
+
+# --- scenario E: DEVICE-RESIDENT pipeline across processes ---
+# the shard_map+ppermute rotation spans the process boundary: pp=8
+# over 2 procs x 4 devices, a config-built transformer via
+# NetworkSpmdPipeline; the loss trajectory must equal the
+# single-process run (params are cross-process sharded, so the
+# replicated loss is the comparable artifact)
+from jax.sharding import Mesh as _Mesh
+from deeplearning4j_tpu.parallel.pipeline_spmd import NetworkSpmdPipeline
+from deeplearning4j_tpu.nn.conf.layers import EmbeddingSequenceLayer
+
+def _pp_lm():
+    b = (NeuralNetConfiguration.builder().set_seed(23)
+         .updater(updaters.adam(1e-2)).list()
+         .layer(EmbeddingSequenceLayer(n_in=7, n_out=8)))
+    for _ in range(8):
+        b = b.layer(TransformerEncoderLayer(n_heads=2, causal=True))
+    conf = (b.layer(RnnOutputLayer(n_out=7, loss="mcxent"))
+            .set_input_type(InputType.recurrent(7, 4)).build())
+    return MultiLayerNetwork(conf).init()
+
+rngp = np.random.default_rng(29)
+xp5 = rngp.integers(0, 7, (8, 4)).astype("float32")
+yp5 = np.eye(7, dtype="float32")[rngp.integers(0, 7, (8, 4))]
+pmesh = _Mesh(np.array(jax.devices()), ("pipe",))
+bridge = NetworkSpmdPipeline(_pp_lm(), pmesh, n_microbatches=2)
+losses = [bridge.train_batch(xp5, yp5) for _ in range(3)]
+if is_coordinator():
+    np.save(os.path.join(out_dir, "pp_losses.npy"),
+            np.array(losses))
+print("PP_OK", pid)
 """
 
 
@@ -358,7 +389,8 @@ class TestMultiProcessDistributed:
             outs.append(out.decode())
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {i} failed:\n{out}"
-            for tag in ("CG_OK", "COMP_OK", "CKPT_OK", "SEQ_OK"):
+            for tag in ("CG_OK", "COMP_OK", "CKPT_OK", "SEQ_OK",
+                        "PP_OK"):
                 assert f"{tag} {i}" in out, out
 
         import jax
@@ -453,3 +485,38 @@ class TestMultiProcessDistributed:
         np.testing.assert_allclose(
             np.load(os.path.join(tmp_path, "seq.npy")),
             net5.params_flat(), rtol=2e-4, atol=2e-5)
+
+        # E: single-process device-resident pp=8 == 2-process run
+        if jax.device_count() >= 8:
+            from jax.sharding import Mesh
+
+            from deeplearning4j_tpu.nn.conf.layers import (
+                EmbeddingSequenceLayer)
+            from deeplearning4j_tpu.parallel.pipeline_spmd import (
+                NetworkSpmdPipeline)
+
+            def _pp_lm():
+                b = (NeuralNetConfiguration.builder().set_seed(23)
+                     .updater(updaters.adam(1e-2)).list()
+                     .layer(EmbeddingSequenceLayer(n_in=7, n_out=8)))
+                for _ in range(8):
+                    b = b.layer(TransformerEncoderLayer(n_heads=2,
+                                                        causal=True))
+                conf = (b.layer(RnnOutputLayer(n_out=7,
+                                               loss="mcxent"))
+                        .set_input_type(InputType.recurrent(7, 4))
+                        .build())
+                return MultiLayerNetwork(conf).init()
+
+            rngp = np.random.default_rng(29)
+            xp5 = rngp.integers(0, 7, (8, 4)).astype("float32")
+            yp5 = np.eye(7, dtype="float32")[
+                rngp.integers(0, 7, (8, 4))]
+            pmesh = Mesh(np.array(jax.devices()[:8]), ("pipe",))
+            bridge = NetworkSpmdPipeline(_pp_lm(), pmesh,
+                                         n_microbatches=2)
+            ref_losses = [bridge.train_batch(xp5, yp5)
+                          for _ in range(3)]
+            np.testing.assert_allclose(
+                np.load(os.path.join(tmp_path, "pp_losses.npy")),
+                np.array(ref_losses), rtol=1e-5, atol=1e-6)
