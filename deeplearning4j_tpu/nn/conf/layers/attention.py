@@ -91,15 +91,16 @@ class SelfAttentionLayer(BaseLayer):
             # sequence-parallel step: x is the LOCAL (B, T/n, C) chunk
             # of a sequence sharded over `seq_axis`; attention must span
             # the whole distributed sequence, so ride the ring (exact,
-            # differentiable, kernels on TPU).
-            if mask is not None:
-                raise NotImplementedError(
-                    "masked attention under sequence parallelism is not "
-                    "supported yet — drop the seq axis or the mask")
+            # differentiable, kernels on TPU). A key-padding mask
+            # chunk rotates with its K/V block; padded query rows are
+            # zeroed here (Layer.java:317 contract).
             from deeplearning4j_tpu.parallel.ring_attention import (
                 ring_self_attention)
             out = ring_self_attention(q, k, v, axis_name=seq_axis,
-                                      causal=self.causal)
+                                      causal=self.causal,
+                                      kv_mask=mask)
+            if mask is not None:
+                out = out * mask[:, :, None, None]
         elif mask is not None:
             # padded keys must leave the softmax DENOMINATOR, not just
             # contribute zero values — zeroing k/v would still give each

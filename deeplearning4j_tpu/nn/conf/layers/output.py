@@ -129,6 +129,21 @@ class RnnOutputLayer(OutputLayer):
             preds = self.activation_fn()(dtypes.promote_half(z))
             per = losses_mod.get(self.loss)(labels, preds, m)
         if mask is not None:
+            from deeplearning4j_tpu.parallel.seq_context import (
+                current_loss_axes)
+            axes = current_loss_axes()
+            if axes:
+                # sequence-parallel trace: the masked mean's
+                # denominator is GLOBAL (shards hold different
+                # unmasked-step counts). Scale by the shard count so
+                # the wrapper's mean-of-local-losses equals
+                # Σ per / Σ mask over the whole batch.
+                import jax
+                total = jax.lax.psum(jnp.sum(mask), axes)
+                n_sh = 1
+                for a in axes:
+                    n_sh *= jax.lax.axis_size(a)
+                return jnp.sum(per) * n_sh / jnp.maximum(total, 1.0)
             # DL4J averages over *present* timesteps across the batch
             denom = jnp.maximum(jnp.sum(mask), 1.0)
             return jnp.sum(per) / denom

@@ -182,9 +182,11 @@ def _merge_chunks(o_a, lse_a, o_b, lse_b):
     return o.astype(o_a.dtype), lse
 
 
-def _jnp_chunk(q, k, v, causal):
+def _jnp_chunk(q, k, v, causal, kmask=None):
     """Pure-jnp (o, lse) for one chunk — the kernel's test double and
-    the CPU-path equivalent; same math, same outputs."""
+    the CPU-path equivalent; same math, same outputs. ``kmask``:
+    optional (B, Tk) 0/1 key-padding chunk (masked keys leave the
+    softmax)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
@@ -192,6 +194,8 @@ def _jnp_chunk(q, k, v, causal):
         T = q.shape[1]
         s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None],
                       s, -jnp.inf)
+    if kmask is not None:
+        s = jnp.where(kmask[:, None, None, :] > 0, s, -jnp.inf)
     lse = jax.nn.logsumexp(s, axis=-1)                     # (B,H,Tq)
     p = jnp.exp(s - jnp.where(jnp.isneginf(lse), 0.0, lse)[..., None])
     p = jnp.where(jnp.isneginf(s), 0.0, p)
@@ -199,9 +203,10 @@ def _jnp_chunk(q, k, v, causal):
     return o.astype(q.dtype), lse
 
 
-def _jnp_chunk_bwd(q, k, v, o, lse, do, causal):
+def _jnp_chunk_bwd(q, k, v, o, lse, do, causal, kmask=None):
     """Pure-jnp per-chunk backward with the GLOBAL lse — mirrors the
-    Pallas dq/dk/dv kernel math exactly."""
+    Pallas dq/dk/dv kernel math exactly (masked keys recompute to
+    p = 0, so no gradient leaks through them)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     f32 = lambda a: a.astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", f32(q), f32(k)) * scale
@@ -209,6 +214,8 @@ def _jnp_chunk_bwd(q, k, v, o, lse, do, causal):
         T = q.shape[1]
         s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None],
                       s, -jnp.inf)
+    if kmask is not None:
+        s = jnp.where(kmask[:, None, None, :] > 0, s, -jnp.inf)
     p = jnp.exp(s - jnp.where(jnp.isneginf(lse), 0.0, lse)[..., None])
     p = jnp.where(jnp.isneginf(s), 0.0, p)
     delta = jnp.einsum("bqhd,bqhd->bhq", f32(do), f32(o))
@@ -237,34 +244,42 @@ def _varying_zero_bht(q, dtype=jnp.float32):
     return (0.0 * jnp.moveaxis(q[..., 0], 1, 2)).astype(dtype)
 
 
-def _chunk_branches(causal, impl, vma=None):
+def _chunk_branches(causal, impl, vma=None, masked=False):
     """(full, diagonal, skip) forward branches for one ring chunk.
     The kernel's causal flag is static, so the runtime three-way
     (src before / at / after my block) is a lax.switch over
     statically-compiled variants. impl: 'pallas' (TPU kernels) or
     'jnp' (test double / CPU). ``vma``: varying mesh axes of the
-    operands, declared on the kernel outputs."""
+    operands, declared on the kernel outputs. ``masked``: branches
+    additionally take the (B, Tk) key-padding chunk that rotates with
+    its K/V block."""
     from deeplearning4j_tpu.ops.attention import pallas_flash_attention
 
-    def full(q, k, v):
+    def _run(q, k, v, km, c):
         if impl == "jnp":
-            return _jnp_chunk(q, k, v, False)
-        return pallas_flash_attention(q, k, v, causal=False,
+            return _jnp_chunk(q, k, v, c, km)
+        return pallas_flash_attention(q, k, v, km, causal=c,
                                       block_q=_blk(q), block_k=_blk(q),
                                       return_lse=True, vma=vma)
 
-    def diag(q, k, v):
-        if impl == "jnp":
-            return _jnp_chunk(q, k, v, causal)
-        return pallas_flash_attention(q, k, v, causal=causal,
-                                      block_q=_blk(q), block_k=_blk(q),
-                                      return_lse=True, vma=vma)
-
-    def skip(q, k, v):
+    def skip(q, k, v, *_):        # one body serves both arities
         B, T, H, D = q.shape
         return (jnp.zeros_like(q),
                 jnp.full((B, H, T), -jnp.inf, jnp.float32)
                 + _varying_zero_bht(q))
+
+    if masked:
+        def full(q, k, v, km):
+            return _run(q, k, v, km, False)
+
+        def diag(q, k, v, km):
+            return _run(q, k, v, km, causal)
+    else:
+        def full(q, k, v):
+            return _run(q, k, v, None, False)
+
+        def diag(q, k, v):
+            return _run(q, k, v, None, causal)
 
     return full, diag, skip
 
@@ -274,68 +289,84 @@ def _blk(q):
     return _auto_block(q.shape[1], q.shape[3])
 
 
-def _ring_flash_sharded(q, k, v, *, axis_name: str, causal: bool,
-                        impl: str = "pallas"):
-    """Forward ring with Pallas local chunks; returns (o, lse)."""
+def _ring_flash_sharded(q, k, v, kmask=None, *, axis_name: str,
+                        causal: bool, impl: str = "pallas"):
+    """Forward ring with Pallas local chunks; returns (o, lse).
+    ``kmask``: optional LOCAL (B, T/n) key-padding chunk — it rotates
+    around the ring WITH its K/V block."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
+    masked = kmask is not None
     full, diag, skip = _chunk_branches(
-        causal, impl, _vma_of(q) if impl == "pallas" else None)
+        causal, impl, _vma_of(q) if impl == "pallas" else None,
+        masked=masked)
     perm = [(i, (i + 1) % n) for i in range(n)]
     o = jnp.zeros_like(q)            # zeros_like(q): already varying
     lse = (jnp.full((B, H, Tl), -jnp.inf, jnp.float32)
            + _varying_zero_bht(q))
 
     def body(step, carry):
-        o, lse, k_cur, v_cur = carry
+        o, lse, k_cur, v_cur, km_cur = carry
         src = (idx - step) % n
+        ops = (q, k_cur, v_cur) + ((km_cur,) if masked else ())
         if causal:
             branch = jnp.where(src < idx, 0, jnp.where(src == idx,
                                                        1, 2))
-            o_c, lse_c = lax.switch(branch, (full, diag, skip),
-                                    q, k_cur, v_cur)
+            o_c, lse_c = lax.switch(branch, (full, diag, skip), *ops)
         else:   # every chunk is a full chunk: no switch, one kernel
-            o_c, lse_c = full(q, k_cur, v_cur)
+            o_c, lse_c = full(*ops)
         o, lse = _merge_chunks(o, lse, o_c, lse_c)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return o, lse, k_nxt, v_nxt
+        km_nxt = (lax.ppermute(km_cur, axis_name, perm) if masked
+                  else km_cur)
+        return o, lse, k_nxt, v_nxt, km_nxt
 
-    o, lse, _, _ = lax.fori_loop(0, n, body, (o, lse, k, v))
+    km0 = kmask if masked else jnp.zeros((), q.dtype)
+    o, lse, _, _, _ = lax.fori_loop(0, n, body, (o, lse, k, v, km0))
     return o, lse
 
 
-def _ring_flash_bwd_sharded(q, k, v, o, lse, do, *, axis_name: str,
-                            causal: bool, impl: str = "pallas"):
+def _ring_flash_bwd_sharded(q, k, v, o, lse, do, kmask=None, *,
+                            axis_name: str, causal: bool,
+                            impl: str = "pallas"):
     """Backward ring: the dq / fused dk-dv Pallas kernels per chunk
-    with the GLOBAL lse; dk/dv accumulators rotate with k/v."""
+    with the GLOBAL lse; dk/dv accumulators (and the mask chunk, when
+    present) rotate with k/v."""
     from deeplearning4j_tpu.ops.attention import (
         pallas_flash_attention_bwd)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     blk = _blk(q)
+    masked = kmask is not None
 
     vma = _vma_of(q) if impl == "pallas" else None
 
-    def bwd_full(q, k, v, o, lse, do):
+    def _run_bwd(q, k, v, o, lse, do, km, c):
         if impl == "jnp":
-            return _jnp_chunk_bwd(q, k, v, o, lse, do, False)
-        return pallas_flash_attention_bwd(q, k, v, o, lse, do,
-                                          causal=False, block_q=blk,
+            return _jnp_chunk_bwd(q, k, v, o, lse, do, c, km)
+        return pallas_flash_attention_bwd(q, k, v, o, lse, do, km,
+                                          causal=c, block_q=blk,
                                           block_k=blk, vma=vma)
 
-    def bwd_diag(q, k, v, o, lse, do):
-        if impl == "jnp":
-            return _jnp_chunk_bwd(q, k, v, o, lse, do, causal)
-        return pallas_flash_attention_bwd(q, k, v, o, lse, do,
-                                          causal=causal, block_q=blk,
-                                          block_k=blk, vma=vma)
-
-    def bwd_skip(q, k, v, o, lse, do):
+    def bwd_skip(q, k, v, *_):    # one body serves both arities
         return (jnp.zeros_like(q), jnp.zeros_like(k),
                 jnp.zeros_like(v))
+
+    if masked:
+        def bwd_full(q, k, v, o, lse, do, km):
+            return _run_bwd(q, k, v, o, lse, do, km, False)
+
+        def bwd_diag(q, k, v, o, lse, do, km):
+            return _run_bwd(q, k, v, o, lse, do, km, causal)
+    else:
+        def bwd_full(q, k, v, o, lse, do):
+            return _run_bwd(q, k, v, o, lse, do, None, False)
+
+        def bwd_diag(q, k, v, o, lse, do):
+            return _run_bwd(q, k, v, o, lse, do, None, causal)
 
     # zeros_like of the (varying) inputs: accumulators start varying
     dq = jnp.zeros_like(q)
@@ -343,16 +374,17 @@ def _ring_flash_bwd_sharded(q, k, v, o, lse, do, *, axis_name: str,
     dvr = jnp.zeros_like(v)
 
     def body(step, carry):
-        dq, dkr, dvr, k_cur, v_cur = carry
+        dq, dkr, dvr, k_cur, v_cur, km_cur = carry
         src = (idx - step) % n
+        ops = (q, k_cur, v_cur, o, lse, do) + (
+            (km_cur,) if masked else ())
         if causal:
             branch = jnp.where(src < idx, 0, jnp.where(src == idx,
                                                        1, 2))
             dq_c, dk_c, dv_c = lax.switch(
-                branch, (bwd_full, bwd_diag, bwd_skip),
-                q, k_cur, v_cur, o, lse, do)
+                branch, (bwd_full, bwd_diag, bwd_skip), *ops)
         else:
-            dq_c, dk_c, dv_c = bwd_full(q, k_cur, v_cur, o, lse, do)
+            dq_c, dk_c, dv_c = bwd_full(*ops)
         dq = dq + dq_c
         dkr = dkr + dk_c
         dvr = dvr + dv_c
@@ -362,10 +394,13 @@ def _ring_flash_bwd_sharded(q, k, v, o, lse, do, *, axis_name: str,
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         dk_nxt = lax.ppermute(dkr, axis_name, perm)
         dv_nxt = lax.ppermute(dvr, axis_name, perm)
-        return dq, dk_nxt, dv_nxt, k_nxt, v_nxt
+        km_nxt = (lax.ppermute(km_cur, axis_name, perm) if masked
+                  else km_cur)
+        return dq, dk_nxt, dv_nxt, k_nxt, v_nxt, km_nxt
 
-    dq, dkr, dvr, _, _ = lax.fori_loop(
-        0, n, body, (dq, dkr, dvr, k, v))
+    km0 = kmask if masked else jnp.zeros((), q.dtype)
+    dq, dkr, dvr, _, _, _ = lax.fori_loop(
+        0, n, body, (dq, dkr, dvr, k, v, km0))
     return dq, dkr, dvr
 
 
@@ -392,8 +427,34 @@ def _make_ring_flash_inner(axis_name: str, causal: bool,
     return ring_flash
 
 
+def _make_ring_flash_masked(axis_name: str, causal: bool,
+                            impl: str = "pallas"):
+    """Masked variant: the key-padding chunk is a 4th operand (data,
+    zero cotangent) whose block rotates with its K/V."""
+    @functools.partial(jax.custom_vjp)
+    def ring_flash(q, k, v, km):
+        o, _ = _ring_flash_sharded(q, k, v, km, axis_name=axis_name,
+                                   causal=causal, impl=impl)
+        return o
+
+    def fwd(q, k, v, km):
+        o, lse = _ring_flash_sharded(q, k, v, km, axis_name=axis_name,
+                                     causal=causal, impl=impl)
+        return o, (q, k, v, km, o, lse)
+
+    def bwd(res, g):
+        q, k, v, km, o, lse = res
+        dq, dk, dv = _ring_flash_bwd_sharded(
+            q, k, v, o, lse, g, km, axis_name=axis_name,
+            causal=causal, impl=impl)
+        return dq, dk, dv, jnp.zeros_like(km)
+
+    ring_flash.defvjp(fwd, bwd)
+    return ring_flash
+
+
 def ring_self_attention(q, k, v, *, axis_name: str,
-                        causal: bool = False):
+                        causal: bool = False, kv_mask=None):
     """Ring flash attention for use INSIDE an existing ``shard_map``
     whose mesh carries ``axis_name``: q, k, v are the LOCAL
     (B, T/n, H, D) blocks of a sequence sharded over that axis; the
@@ -406,9 +467,22 @@ def ring_self_attention(q, k, v, *, axis_name: str,
     sequence-parallel train step). Kernel selection matches
     ``make_ring_attention_fn(use_kernels='auto')``: Pallas chunks on
     TPU with tile-divisible local lengths, pure-jnp chunks elsewhere.
+    ``kv_mask``: optional LOCAL (B, T/n) key-padding chunk — it
+    rotates around the ring with its K/V block, so variable-length
+    batches train sequence-parallel too (padded QUERY rows stay the
+    caller's to zero).
     """
-    impl = ("pallas" if jax.default_backend() == "tpu" and _blk(q) > 0
+    blk = _blk(q)
+    impl = ("pallas" if jax.default_backend() == "tpu" and blk > 0
             else "jnp")
+    if kv_mask is not None:
+        # the mask kernel tile puts block_k on lanes: Mosaic needs it
+        # 128-divisible or equal to the (local) array dim
+        if impl == "pallas" and not (blk % 128 == 0
+                                     or blk == q.shape[1]):
+            impl = "jnp"
+        return _make_ring_flash_masked(axis_name, causal, impl)(
+            q, k, v, kv_mask)
     return _make_ring_flash_inner(axis_name, causal, impl)(q, k, v)
 
 

@@ -27,7 +27,8 @@ import contextlib
 import threading
 from typing import Optional
 
-__all__ = ["sequence_parallel", "current_seq_axis"]
+__all__ = ["sequence_parallel", "current_seq_axis",
+           "current_loss_axes"]
 
 _tls = threading.local()
 
@@ -37,12 +38,25 @@ def current_seq_axis() -> Optional[str]:
     return getattr(_tls, "axis", None)
 
 
+def current_loss_axes():
+    """Mesh axes the BATCH is sharded over (e.g. ('data', 'seq')), or
+    None outside a sequence-parallel trace. Masked time-distributed
+    losses consult this: the masked mean's denominator is a GLOBAL
+    count (shards hold different numbers of unmasked steps), so the
+    loss layer psums the count over these axes and scales so that the
+    wrapper's mean-of-local-losses equals the global masked mean."""
+    return getattr(_tls, "loss_axes", None)
+
+
 @contextlib.contextmanager
-def sequence_parallel(axis_name: str):
+def sequence_parallel(axis_name: str, loss_axes=None):
     """Activate sequence-parallel routing while tracing a step."""
     prev = getattr(_tls, "axis", None)
+    prev_axes = getattr(_tls, "loss_axes", None)
     _tls.axis = axis_name
+    _tls.loss_axes = loss_axes
     try:
         yield
     finally:
         _tls.axis = prev
+        _tls.loss_axes = prev_axes

@@ -287,7 +287,7 @@ class ParallelWrapper:
             # two time-chunks of one example are distinct positions)
             for ax in axes:
                 rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
-            with sequence_parallel("seq"):
+            with sequence_parallel("seq", loss_axes=axes):
                 def loss_fn(p):
                     return model._loss(p, state, batch, rng,
                                        training=True)
@@ -311,16 +311,14 @@ class ParallelWrapper:
 
     def _shard_seq_batch(self, batch):
         """(features, labels, fmask, lmask) → B over 'data', T over
-        'seq'. Seq-parallel batches must be mask-free and time-major
-        beyond the batch dim."""
+        'seq' — masks included (the attention layers rotate mask
+        chunks around the ring, and time-distributed losses psum the
+        masked denominator via seq_context.current_loss_axes)."""
         f, l, fm, lm = batch
-        if fm is not None or lm is not None:
-            raise NotImplementedError(
-                "masked batches are not supported under sequence "
-                "parallelism yet — pad-free uniform sequences only")
         nseq = self._seq_axis_size()
         ndata = self.mesh.shape.get("data", 1)
-        for name, a in (("features", f), ("labels", l)):
+        for name, a in (("features", f), ("labels", l),
+                        ("features_mask", fm), ("labels_mask", lm)):
             if a is None:
                 continue
             if a.ndim < 2:
@@ -334,7 +332,7 @@ class ParallelWrapper:
                  "seq")
         put = lambda a: None if a is None else jax.device_put(
             a, NamedSharding(self.mesh, spec))
-        return (put(f), put(l), None, None)
+        return (put(f), put(l), put(fm), put(lm))
 
     def _init_residual(self):
         ndev = self.mesh.shape["data"]

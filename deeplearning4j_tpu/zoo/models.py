@@ -73,6 +73,15 @@ def load_manifest(path: str) -> dict:
     return dict(_PRETRAINED_MANIFEST)
 
 
+def _sha256_file(path: str) -> str:
+    import hashlib
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
 def export_pretrained(net, name: str, out_dir: str) -> dict:
     """Export a trained model as a zoo weights artifact: writes
     ``<name>.zip`` (the framework checkpoint format), a
@@ -80,18 +89,13 @@ def export_pretrained(net, name: str, out_dir: str) -> dict:
     ``out_dir`` with a ``file://`` URL — the artifact round-trips
     through ``init_pretrained`` as-is, and the manifest entries can be
     re-pointed at a blob store for distribution. Returns the entry."""
-    import hashlib
     import json
 
     from deeplearning4j_tpu.util.model_serializer import write_model
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, f"{name}.zip")
     write_model(net, path)
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    digest = h.hexdigest()
+    digest = _sha256_file(path)
     with open(path + ".sha256", "w") as f:
         f.write(digest + "\n")
     entry = {"url": "file://" + os.path.abspath(path),
@@ -185,12 +189,7 @@ class ZooModel:
         if expected is None:
             expected = getattr(self, "pretrained_checksum", None)
         if expected:
-            import hashlib
-            h = hashlib.sha256()
-            with open(path, "rb") as f:
-                for chunk in iter(lambda: f.read(1 << 20), b""):
-                    h.update(chunk)
-            actual = h.hexdigest()
+            actual = _sha256_file(path)
             if actual != expected:
                 if fetched:
                     # the reference deletes corrupt downloads
@@ -220,7 +219,8 @@ class ZooModel:
         tmp = path + f".fetch{os.getpid()}"
         logger.info("fetching pretrained weights: %s -> %s", url, path)
         try:
-            with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
+            with urllib.request.urlopen(url, timeout=60) as r, \
+                    open(tmp, "wb") as f:
                 shutil.copyfileobj(r, f)
             os.replace(tmp, path)
         finally:

@@ -692,15 +692,26 @@ class TestSequenceParallelWrapper:
             ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
                 ListDataSetIterator([self._batch()]), epochs=1)
 
-    def test_rejects_masked_batches(self):
+    def test_masked_batches_match_single_device(self):
+        """Variable-length batches train sequence-parallel: the
+        key-padding mask chunk rotates around the ring with its K/V
+        block, and the masked loss denominator psums globally (shards
+        hold different unmasked-step counts)."""
         ds = self._batch()
-        masked = DataSet(ds.features, ds.labels,
-                         np.ones((self.B, self.T), "float32"), None)
-        net = self._transformer()
-        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
-        with pytest.raises(NotImplementedError, match="mask"):
-            ParallelWrapper(net, mesh, prefetch_buffer=0).fit(
-                ListDataSetIterator([masked]), epochs=1)
+        fm = np.ones((self.B, self.T), "float32")
+        fm[0, 20:] = 0.0          # ragged tails: shard counts differ
+        fm[1, 9:] = 0.0
+        fm[2, 27:] = 0.0
+        masked = DataSet(ds.features, ds.labels, fm, fm)
+        single = self._transformer()
+        single.fit(masked, epochs=2)
+        sp = self._transformer()
+        mesh = build_mesh(MeshSpec(data=2, seq=4), jax.devices()[:8])
+        ParallelWrapper(sp, mesh, prefetch_buffer=0).fit(
+            ListDataSetIterator([masked]), epochs=2)
+        np.testing.assert_allclose(
+            np.asarray(sp.params_flat()),
+            np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
 
     def test_rejects_indivisible_time(self):
         rng = np.random.default_rng(1)
