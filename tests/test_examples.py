@@ -50,3 +50,8 @@ class TestExamples:
         out = _run("keras_import_finetune.py")
         assert "max |keras - ours|" in out
         assert "fine-tuned accuracy" in out
+
+    def test_long_context_lm(self):
+        out = _run("long_context_lm.py", "--epochs", "8")
+        assert "data=2 x seq=2" in out
+        assert "matches single-device params: True" in out
