@@ -211,17 +211,16 @@ class ParallelWrapper:
                 if "seq" in self.mesh.axis_names else 1)
 
     def _validate_seq_model(self):
-        """Sequence parallelism shards TIME: every layer must be exact
-        on a local chunk (pointwise in time, or self-routing through
-        the ring like attention). Fail loudly otherwise — a silently
-        wrong chunked LSTM would be far worse than an error."""
+        """Sequence parallelism shards TIME: every layer/vertex must
+        be exact on a local chunk (pointwise in time, or self-routing
+        through the ring like attention). Fail loudly otherwise — a
+        silently wrong chunked LSTM would be far worse than an
+        error. Supports both executors: MultiLayerNetwork stacks and
+        ComputationGraphs whose vertices are all time-pointwise."""
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
         from deeplearning4j_tpu.models.multi_layer_network import (
             MultiLayerNetwork)
-        if not isinstance(self.model, MultiLayerNetwork):
-            raise NotImplementedError(
-                "sequence-parallel training currently supports "
-                "MultiLayerNetwork stacks (transformer-style); got "
-                f"{type(self.model).__name__}")
         if self.dcn_compression is not None:
             raise NotImplementedError("dcn_compression + seq axis not "
                                       "supported yet")
@@ -235,6 +234,50 @@ class ParallelWrapper:
                 f"meshes only; mesh also carries {extra} — combine "
                 "seq with tensor/pipeline parallelism via the "
                 "functional APIs for now")
+        if isinstance(self.model, ComputationGraph):
+            from deeplearning4j_tpu.nn.conf.graph import (
+                ElementWiseVertex, MergeVertex, ScaleVertex,
+                ShiftVertex, SubsetVertex)
+            from deeplearning4j_tpu.nn.conf.layers.base import Layer
+            # time-pointwise vertex whitelist (L2Normalize norms over
+            # TIME, Stack rides the batch axis, LastTimeStep /
+            # DuplicateToTimeSeries / Reshape / Preprocessor reshape
+            # time — all excluded)
+            ok = (ElementWiseVertex, MergeVertex, ScaleVertex,
+                  ShiftVertex, SubsetVertex)
+            bad = []
+            for name, (obj, _) in self.model.conf.vertices.items():
+                if isinstance(obj, Layer):
+                    if not getattr(obj, "seq_parallelizable", False):
+                        bad.append(f"vertex '{name}' "
+                                   f"({type(obj).__name__})")
+                elif not isinstance(obj, ok):
+                    bad.append(f"vertex '{name}' "
+                               f"({type(obj).__name__})")
+            if bad:
+                raise ValueError(
+                    "these graph vertices cannot train over a 'seq' "
+                    "mesh axis (not pointwise in time): "
+                    + ", ".join(bad)
+                    + " — or drop the seq axis from the mesh")
+            # every input must be TEMPORAL: the batch shards axis 1
+            # over 'seq', which is only time for recurrent inputs —
+            # a (B, F) static input would silently shard features
+            in_types = getattr(self.model.conf, "input_types",
+                               None) or []
+            non_rnn = [f"input {i} ({t.kind})"
+                       for i, t in enumerate(in_types)
+                       if t.kind != "rnn"]
+            if non_rnn:
+                raise ValueError(
+                    "sequence-parallel graphs need recurrent (B, T, "
+                    "...) inputs; got " + ", ".join(non_rnn))
+            return
+        if not isinstance(self.model, MultiLayerNetwork):
+            raise NotImplementedError(
+                "sequence-parallel training supports "
+                "MultiLayerNetwork and ComputationGraph; got "
+                f"{type(self.model).__name__}")
         bad = [f"layer {i} ({type(l).__name__})"
                for i, l in enumerate(self.model.layers)
                if not getattr(l, "seq_parallelizable", False)]
@@ -266,6 +309,8 @@ class ParallelWrapper:
         axis, so dividing by the shard count yields the exact global
         mean gradient — sp training matches the single-device step to
         float tolerance (dryrun regime 8 asserts it)."""
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
         from deeplearning4j_tpu.parallel.seq_context import (
             sequence_parallel)
         try:
@@ -275,6 +320,7 @@ class ParallelWrapper:
 
         model = self.model
         mesh = self.mesh
+        is_graph = isinstance(model, ComputationGraph)
         optimizer = model._optimizer
         axes = tuple(a for a in ("data", "seq") if a in mesh.axis_names)
         nshards = 1
@@ -299,7 +345,7 @@ class ParallelWrapper:
             # mean loss); the global loss is the MEAN of the uniform
             # local means — normalize
             grads = jax.tree_util.tree_map(lambda g: g / nshards, grads)
-            return _spmd_update_tail(model, False, optimizer, grads,
+            return _spmd_update_tail(model, is_graph, optimizer, grads,
                                      new_state, loss, opt_state, params,
                                      axes)
 
@@ -310,29 +356,29 @@ class ParallelWrapper:
         return jax.jit(smapped, donate_argnums=(0, 1, 2))
 
     def _shard_seq_batch(self, batch):
-        """(features, labels, fmask, lmask) → B over 'data', T over
-        'seq' — masks included (the attention layers rotate mask
-        chunks around the ring, and time-distributed losses psum the
-        masked denominator via seq_context.current_loss_axes)."""
-        f, l, fm, lm = batch
+        """Every batch leaf (B, T, ...) → B over 'data', T over 'seq'
+        — masks included (the attention layers rotate mask chunks
+        around the ring, and time-distributed losses psum the masked
+        denominator via seq_context.current_loss_axes). Handles both
+        executors' batch tuples: plain arrays (MLN) and per-input /
+        per-output lists (ComputationGraph MultiDataSet)."""
         nseq = self._seq_axis_size()
         ndata = self.mesh.shape.get("data", 1)
-        for name, a in (("features", f), ("labels", l),
-                        ("features_mask", fm), ("labels_mask", lm)):
-            if a is None:
-                continue
+        spec = P("data" if "data" in self.mesh.axis_names else None,
+                 "seq")
+        sharding = NamedSharding(self.mesh, spec)
+
+        def put(a):
             if a.ndim < 2:
-                raise ValueError(f"seq-parallel {name} must be "
+                raise ValueError(f"seq-parallel batch arrays must be "
                                  f"(B, T, ...); got shape {a.shape}")
             if a.shape[0] % ndata or a.shape[1] % nseq:
                 raise ValueError(
-                    f"seq-parallel {name} shape {a.shape} not divisible "
+                    f"seq-parallel batch shape {a.shape} not divisible "
                     f"by mesh (data={ndata}, seq={nseq})")
-        spec = P("data" if "data" in self.mesh.axis_names else None,
-                 "seq")
-        put = lambda a: None if a is None else jax.device_put(
-            a, NamedSharding(self.mesh, spec))
-        return (put(f), put(l), put(fm), put(lm))
+            return jax.device_put(a, sharding)
+
+        return jax.tree_util.tree_map(put, batch)
 
     def _init_residual(self):
         ndev = self.mesh.shape["data"]

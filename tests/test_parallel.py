@@ -927,3 +927,114 @@ class TestBlockwiseBf16Accumulation:
         # accumulation drift growing with T
         err = np.max(np.abs(np.asarray(out, np.float32) - ref))
         assert err < 0.05, err
+
+
+class TestSequenceParallelGraph:
+    """Sequence parallelism on the ComputationGraph executor: a graph
+    with attention vertices and a time-pointwise ElementWise residual
+    trains over a 'seq' mesh axis and matches single-device (the
+    'wrapper runs any Model' bar, ParallelWrapper.java:58)."""
+
+    B, T, C, V = 4, 32, 16, 11
+
+    def _graph(self, seed=7):
+        from deeplearning4j_tpu import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, TransformerEncoderLayer)
+        from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+        conf = (NeuralNetConfiguration.builder().set_seed(seed)
+                .updater(updaters.adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("t1", TransformerEncoderLayer(
+                    n_heads=4, causal=True), "in")
+                .add_layer("t2", TransformerEncoderLayer(
+                    n_heads=4, causal=True), "t1")
+                .add_vertex("res", ElementWiseVertex(op="add"),
+                            "t1", "t2")
+                .add_layer("out", RnnOutputLayer(n_out=self.V,
+                                                 loss="mcxent"), "res")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(self.C, self.T))
+                .build())
+        return ComputationGraph(conf).init()
+
+    def _batch(self, masked=False):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype("float32")
+        y = np.eye(self.V, dtype="float32")[
+            rng.integers(0, self.V, (self.B, self.T))]
+        fm = None
+        if masked:
+            fm = np.ones((self.B, self.T), "float32")
+            fm[0, 20:] = 0.0
+            fm[1, 9:] = 0.0
+        return DataSet(x, y, fm, fm)
+
+    @pytest.mark.parametrize("masked", [False, True])
+    def test_matches_single_device(self, masked):
+        from deeplearning4j_tpu.parallel.wrapper import (
+            GraphParallelWrapper)
+        ds = self._batch(masked)
+        single = self._graph()
+        single.fit(ds)
+        single.fit(ds)
+        sp = self._graph()
+        mesh = build_mesh(MeshSpec(data=2, seq=4), jax.devices()[:8])
+        GraphParallelWrapper(sp, mesh, prefetch_buffer=0).fit(
+            ListDataSetIterator([ds]), epochs=2)
+        np.testing.assert_allclose(
+            np.asarray(sp.params_flat()),
+            np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
+
+    def test_rejects_time_mixing_vertex(self):
+        from deeplearning4j_tpu import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.layers import (
+            OutputLayer, TransformerEncoderLayer)
+        from deeplearning4j_tpu.nn.conf.graph import LastTimeStepVertex
+        from deeplearning4j_tpu.parallel.wrapper import (
+            GraphParallelWrapper)
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-3))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("t1", TransformerEncoderLayer(
+                    n_heads=4, causal=True), "in")
+                .add_vertex("last", LastTimeStepVertex(), "t1")
+                .add_layer("out", OutputLayer(n_out=self.V), "last")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(self.C, self.T))
+                .build())
+        cg = ComputationGraph(conf).init()
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        with pytest.raises(ValueError, match="last"):
+            GraphParallelWrapper(cg, mesh, prefetch_buffer=0).fit(
+                ListDataSetIterator([self._batch()]), epochs=1)
+
+    def test_rejects_non_temporal_input(self):
+        """A (B, F) static input would silently shard FEATURES over
+        the seq axis — must be refused before tracing."""
+        from deeplearning4j_tpu import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       RnnOutputLayer)
+        from deeplearning4j_tpu.parallel.wrapper import (
+            GraphParallelWrapper)
+        from deeplearning4j_tpu.data.dataset import DataSet
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-3))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_out=8,
+                                           activation="relu"), "in")
+                .add_layer("out", RnnOutputLayer(n_out=3), "h")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(16)).build())
+        cg = ComputationGraph(conf).init()
+        mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
+        x = np.random.default_rng(0).normal(0, 1, (4, 16)).astype(
+            "float32")
+        y = np.eye(3, dtype="float32")[[0, 1, 2, 0]]
+        with pytest.raises(ValueError, match="recurrent"):
+            GraphParallelWrapper(cg, mesh, prefetch_buffer=0).fit(
+                ListDataSetIterator([DataSet(x, y)]), epochs=1)
