@@ -227,6 +227,23 @@ _SKIP = ("InputLayer", "Flatten", "Reshape")   # structural; handled by
                                                # auto-preprocessors
 
 
+def _map_layernorm(cfg):
+    from deeplearning4j_tpu.nn.conf.layers import LayerNormalization
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)) and len(axis) == 1:
+        axis = axis[0]
+    if axis != -1:
+        raise KerasImportError(
+            f"LayerNormalization axis={cfg.get('axis')} unsupported "
+            "(last-axis only)")
+    if cfg.get("rms_scaling"):
+        raise KerasImportError(
+            "LayerNormalization rms_scaling=True unsupported (RMS "
+            "norm skips the mean subtraction this layer performs)")
+    return LayerNormalization(name=cfg.get("name"),
+                              eps=float(cfg.get("epsilon", 1e-3)))
+
+
 def map_keras_layer(class_name: str, cfg: dict, *, is_output=False,
                     sequence_input=False):
     """Returns a layer config, or None for structural layers."""
@@ -261,6 +278,8 @@ def map_keras_layer(class_name: str, cfg: dict, *, is_output=False,
         return _map_global_pool(cfg, "max")
     if class_name == "BatchNormalization":
         return _map_batchnorm(cfg)
+    if class_name == "LayerNormalization":
+        return _map_layernorm(cfg)
     if class_name == "Activation":
         return _map_activation(cfg)
     if class_name in ("Dropout", "SpatialDropout2D", "SpatialDropout1D"):
@@ -409,6 +428,14 @@ def _assign_weights(layer, params: dict, state: dict,
         put(params, "b", _lstm_gate_permute(arrays[2], units))
     elif class_name == "Embedding":
         put(params, "W", arrays[0])
+    elif class_name == "LayerNormalization":
+        # keras order: [gamma if scale][beta if center]
+        arrs = list(arrays)
+        kcfg = kcfg or {}
+        if bool(kcfg.get("scale", True)) and arrs:
+            put(params, "gamma", arrs.pop(0))
+        if bool(kcfg.get("center", True)) and arrs:
+            put(params, "beta", arrs.pop(0))
     elif arrays:
         raise KerasImportError(
             f"Don't know how to assign weights for '{class_name}'")

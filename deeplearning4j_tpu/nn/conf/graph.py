@@ -55,6 +55,14 @@ def vertex_from_dict(d: dict):
 
 @dataclasses.dataclass
 class GraphVertex:
+    # True iff apply() on (B, T, ...) inputs is exact when T is only a
+    # LOCAL chunk of the sequence (pointwise in time) — gates the
+    # wrapper's sequence-parallel step; see Layer.seq_parallelizable.
+    # L2Normalize norms over TIME, Stack rides the batch axis,
+    # LastTimeStep/DuplicateToTimeSeries/Reshape/Preprocessor reshape
+    # time: those stay False.
+    seq_parallelizable = False
+
     def apply(self, inputs, *, mask=None):
         raise NotImplementedError
 
@@ -92,6 +100,8 @@ class ElementWiseVertex(GraphVertex):
     """(nn/conf/graph/ElementWiseVertex.java:42-43). op ∈ {add,
     subtract, product, average, max}."""
 
+    seq_parallelizable = True          # elementwise
+
     op: str = "add"
 
     def apply(self, inputs, *, mask=None):
@@ -127,6 +137,8 @@ class MergeVertex(GraphVertex):
     (nn/conf/graph/MergeVertex.java — reference concatenates on dim 1 =
     channels under NCHW; channel-last here)."""
 
+    seq_parallelizable = True          # feature-axis concat
+
     def apply(self, inputs, *, mask=None):
         return jnp.concatenate(inputs, axis=-1)
 
@@ -145,6 +157,8 @@ class MergeVertex(GraphVertex):
 class SubsetVertex(GraphVertex):
     """Feature-range slice [from_, to_] inclusive
     (nn/conf/graph/SubsetVertex.java)."""
+
+    seq_parallelizable = True          # feature-axis slice
 
     from_: int = 0
     to_: int = 0
@@ -221,6 +235,8 @@ class UnstackVertex(GraphVertex):
 class ScaleVertex(GraphVertex):
     """(nn/conf/graph/ScaleVertex.java)."""
 
+    seq_parallelizable = True          # elementwise
+
     scale: float = 1.0
 
     def apply(self, inputs, *, mask=None):
@@ -231,6 +247,8 @@ class ScaleVertex(GraphVertex):
 @dataclasses.dataclass
 class ShiftVertex(GraphVertex):
     """(nn/conf/graph/ShiftVertex.java)."""
+
+    seq_parallelizable = True          # elementwise
 
     shift: float = 0.0
 

@@ -30,7 +30,8 @@ from deeplearning4j_tpu.nn.conf.layers.pooling import (
     SubsamplingLayer, Subsampling1DLayer, GlobalPoolingLayer, PoolingType,
 )
 from deeplearning4j_tpu.nn.conf.layers.normalization import (
-    BatchNormalization, LocalResponseNormalization,
+    BatchNormalization, LayerNormalization,
+    LocalResponseNormalization,
 )
 from deeplearning4j_tpu.nn.conf.layers.recurrent import (
     LSTM, GravesLSTM, GravesBidirectionalLSTM, Bidirectional, SimpleRnn,
@@ -55,7 +56,8 @@ __all__ = [
     "CroppingLayer", "SpaceToDepthLayer", "SpaceToBatchLayer",
     "SubsamplingLayer", "Subsampling1DLayer", "GlobalPoolingLayer",
     "PoolingType",
-    "BatchNormalization", "LocalResponseNormalization",
+    "BatchNormalization", "LayerNormalization",
+    "LocalResponseNormalization",
     "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "Bidirectional",
     "SimpleRnn", "LastTimeStep", "RnnLossLayer",
     "FrozenLayer", "VariationalAutoencoder", "Yolo2OutputLayer",

@@ -25,10 +25,8 @@ from deeplearning4j_tpu.nn.conf.layers.base import (BaseLayer,
 __all__ = ["SelfAttentionLayer", "TransformerEncoderLayer"]
 
 
-def _layer_norm(x, gamma, beta, eps=1e-5):
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * gamma + beta
+from deeplearning4j_tpu.nn.conf.layers.normalization import (
+    layer_norm as _layer_norm)
 
 
 @register_layer

@@ -26,7 +26,8 @@ from deeplearning4j_tpu.nn.conf.layers.base import (
     BaseLayer, Layer, register_layer,
 )
 
-__all__ = ["BatchNormalization", "LocalResponseNormalization"]
+__all__ = ["BatchNormalization", "LayerNormalization",
+           "LocalResponseNormalization"]
 
 
 @register_layer
@@ -109,3 +110,44 @@ class LocalResponseNormalization(Layer):
         pad = [(0, 0)] * (x.ndim - 1) + [(half, half)]
         ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, pad)
         return x / (self.k + self.alpha * ssum) ** self.beta, state
+
+
+def layer_norm(x, gamma, beta, eps=1e-5):
+    """Canonical last-axis layer norm — shared by the standalone
+    LayerNormalization layer and TransformerEncoderLayer's inlined
+    pre-LN blocks (one implementation, no drift)."""
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * gamma + beta
+
+
+@register_layer
+@dataclasses.dataclass
+class LayerNormalization(Layer):
+    """Per-example feature normalization (Ba et al. 2016): normalize
+    over the LAST axis with learned gamma/beta. Stateless (unlike
+    BatchNormalization — no running stats), so it composes with every
+    parallelism mode including sequence sharding (pointwise in time)
+    and the device-resident pipeline. The reference predates LN; this
+    is a capability extension matching the Keras/transformer-era
+    surface (TransformerEncoderLayer inlines the same math)."""
+
+    n_in: Optional[int] = None
+    eps: float = 1e-5
+
+    seq_parallelizable = True          # per-token normalization
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.size
+
+    def initialize(self, key, input_type: InputType):
+        self.set_n_in(input_type)
+        pd = dtypes.policy().param_dtype
+        return {"gamma": jnp.ones((self.n_in,), pd),
+                "beta": jnp.zeros((self.n_in,), pd)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None,
+              mask=None):
+        return layer_norm(x, params["gamma"], params["beta"],
+                          self.eps), state

@@ -22,8 +22,11 @@ Two interchangeable local-chunk engines drive the ring:
   TPU (fwd and all three grads).
 
 Also exports ``blockwise_attention`` (single-device chunked attention,
-the memory-efficient fallback) and a ``MultiHeadAttention`` layer
-config usable in networks.
+the memory-efficient fallback). The layer-config entry points are
+``SelfAttentionLayer`` / ``TransformerEncoderLayer``
+(nn/conf/layers/attention.py), which route through
+``ring_self_attention`` here whenever the wrapper activates a seq
+axis (parallel/seq_context).
 """
 
 from __future__ import annotations

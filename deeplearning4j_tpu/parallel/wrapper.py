@@ -235,23 +235,13 @@ class ParallelWrapper:
                 "seq with tensor/pipeline parallelism via the "
                 "functional APIs for now")
         if isinstance(self.model, ComputationGraph):
-            from deeplearning4j_tpu.nn.conf.graph import (
-                ElementWiseVertex, MergeVertex, ScaleVertex,
-                ShiftVertex, SubsetVertex)
-            from deeplearning4j_tpu.nn.conf.layers.base import Layer
-            # time-pointwise vertex whitelist (L2Normalize norms over
-            # TIME, Stack rides the batch axis, LastTimeStep /
-            # DuplicateToTimeSeries / Reshape / Preprocessor reshape
-            # time — all excluded)
-            ok = (ElementWiseVertex, MergeVertex, ScaleVertex,
-                  ShiftVertex, SubsetVertex)
+            # layers AND vertices self-declare time-pointwiseness via
+            # the seq_parallelizable class attribute (Layer base +
+            # GraphVertex base; see nn/conf/graph.py for which
+            # vertices opt in and why the rest cannot)
             bad = []
             for name, (obj, _) in self.model.conf.vertices.items():
-                if isinstance(obj, Layer):
-                    if not getattr(obj, "seq_parallelizable", False):
-                        bad.append(f"vertex '{name}' "
-                                   f"({type(obj).__name__})")
-                elif not isinstance(obj, ok):
+                if not getattr(obj, "seq_parallelizable", False):
                     bad.append(f"vertex '{name}' "
                                f"({type(obj).__name__})")
             if bad:
@@ -263,8 +253,13 @@ class ParallelWrapper:
             # every input must be TEMPORAL: the batch shards axis 1
             # over 'seq', which is only time for recurrent inputs —
             # a (B, F) static input would silently shard features
-            in_types = getattr(self.model.conf, "input_types",
-                               None) or []
+            in_types = getattr(self.model.conf, "input_types", None)
+            if not in_types:
+                raise ValueError(
+                    "sequence-parallel graphs need set_input_types("
+                    "InputType.recurrent(...)) so the wrapper can "
+                    "prove every input is temporal before sharding "
+                    "axis 1 over 'seq'")
             non_rnn = [f"input {i} ({t.kind})"
                        for i, t in enumerate(in_types)
                        if t.kind != "rnn"]

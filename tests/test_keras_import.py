@@ -95,6 +95,17 @@ class TestSequentialImport:
         x = rng.normal(2, 3, (5, 6)).astype(np.float32)
         _compare(tmp_path, m, x)
 
+    def test_layernorm(self, tmp_path, rng):
+        from keras import layers
+        m = keras.Sequential([
+            keras.Input((7, 6)),
+            layers.Dense(8),
+            layers.LayerNormalization(),
+            layers.Dense(3, activation="softmax"),
+        ])
+        x = rng.normal(1, 2, (4, 7, 6)).astype(np.float32)
+        _compare(tmp_path, m, x)
+
     def test_lstm_return_sequences(self, tmp_path, rng):
         from keras import layers
         m = keras.Sequential([
