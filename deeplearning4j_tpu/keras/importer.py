@@ -244,6 +244,41 @@ def _map_layernorm(cfg):
                               eps=float(cfg.get("epsilon", 1e-3)))
 
 
+def _map_mha(cfg):
+    """Keras MultiHeadAttention → SelfAttentionLayer. Exact for the
+    standard transformer configuration: SELF-attention (the functional
+    importer verifies query/key/value come from one tensor) with
+    num_heads * key_dim == model dim (our internal dim and output dim
+    coincide; Keras's defaults give exactly that in encoder blocks).
+    Cross-attention, value_dim != key_dim, output_shape overrides, and
+    non-time attention_axes are rejected loudly."""
+    from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+    H = int(cfg["num_heads"])
+    key_dim = int(cfg["key_dim"])
+    value_dim = cfg.get("value_dim")
+    if value_dim is not None and int(value_dim) != key_dim:
+        raise KerasImportError(
+            f"MultiHeadAttention value_dim={value_dim} != key_dim="
+            f"{key_dim} unsupported")
+    if cfg.get("output_shape") is not None:
+        raise KerasImportError(
+            "MultiHeadAttention output_shape overrides unsupported")
+    ax = cfg.get("attention_axes")
+    if ax not in (None, [1], (1,), 1):
+        raise KerasImportError(
+            f"MultiHeadAttention attention_axes={ax} unsupported "
+            "(time-axis attention only)")
+    if cfg.get("dropout"):
+        logger.warning(
+            "MultiHeadAttention '%s': attention-probability dropout "
+            "%.3g is not modeled (inference identical; training "
+            "differs)", cfg.get("name"), cfg.get("dropout"))
+    return SelfAttentionLayer(
+        n_out=H * key_dim, n_heads=H,
+        qkv_bias=bool(cfg.get("use_bias", True)),
+        name=cfg.get("name"))
+
+
 def map_keras_layer(class_name: str, cfg: dict, *, is_output=False,
                     sequence_input=False):
     """Returns a layer config, or None for structural layers."""
@@ -280,6 +315,8 @@ def map_keras_layer(class_name: str, cfg: dict, *, is_output=False,
         return _map_batchnorm(cfg)
     if class_name == "LayerNormalization":
         return _map_layernorm(cfg)
+    if class_name == "MultiHeadAttention":
+        return _map_mha(cfg)
     if class_name == "Activation":
         return _map_activation(cfg)
     if class_name in ("Dropout", "SpatialDropout2D", "SpatialDropout1D"):
@@ -428,6 +465,35 @@ def _assign_weights(layer, params: dict, state: dict,
         put(params, "b", _lstm_gate_permute(arrays[2], units))
     elif class_name == "Embedding":
         put(params, "W", arrays[0])
+    elif class_name == "MultiHeadAttention":
+        # weight_names order: q/k/v kernel[,bias] each, then
+        # attention_output kernel[,bias]. Kernels are (d_in, H, kd) /
+        # (H, kd, d_out); head-major reshape matches our column-block
+        # head split exactly.
+        use_bias = bool((kcfg or {}).get("use_bias", True))
+        arrs = list(arrays)
+        d = params["Wo"].shape[0]
+        din = arrs[0].shape[0]
+        if arrs[0].shape[1] * arrs[0].shape[2] != d or din != d:
+            raise KerasImportError(
+                f"MultiHeadAttention: num_heads*key_dim="
+                f"{arrs[0].shape[1] * arrs[0].shape[2]} must equal "
+                f"the model dim {din} (Keras's internal dim != "
+                f"output dim is unsupported)")
+
+        def take():
+            k = arrs.pop(0).reshape(din, d)
+            b = arrs.pop(0).reshape(d) if use_bias else None
+            return k, b
+
+        for wname, bname in (("Wq", "bq"), ("Wk", "bk"), ("Wv", "bv")):
+            kmat, bvec = take()
+            put(params, wname, kmat)
+            if bvec is not None:
+                put(params, bname, bvec)
+        put(params, "Wo", arrs.pop(0).reshape(d, d))
+        if use_bias:
+            put(params, "bo", arrs.pop(0))
     elif class_name == "LayerNormalization":
         # keras order: [gamma if scale][beta if center]
         arrs = list(arrays)
@@ -472,6 +538,34 @@ def _parse_inbound(nodes) -> List[str]:
         for node in nodes:
             for ref in node:
                 out.append(ref[0])
+    return out
+
+
+def _call_kwargs(nodes) -> dict:
+    """Non-tensor CALL-time kwargs of a layer's (single) inbound node —
+    e.g. MultiHeadAttention's use_causal_mask. Tensor-valued kwargs
+    stay in _parse_inbound's tensor list; this collects the flags."""
+    out: dict = {}
+    if not nodes:
+        return out
+
+    def is_tensor(v):
+        return isinstance(v, dict) and "keras_history" in v.get(
+            "config", {})
+
+    first = nodes[0]
+    if isinstance(first, dict):            # keras 3
+        for node in nodes:
+            for k, v in node.get("kwargs", {}).items():
+                if not is_tensor(v):
+                    out[k] = v
+    else:                                  # keras 2
+        for node in nodes:
+            for ref in node:
+                if len(ref) > 3 and isinstance(ref[3], dict):
+                    for k, v in ref[3].items():
+                        if not is_tensor(v):
+                            out[k] = v
     return out
 
 
@@ -615,12 +709,36 @@ def _import_functional(model_cfg, f):
                     if vkind == "ElementWiseVertex" else MergeVertex())
             plan.append((name, vert, inbound, True))
             continue
+        if cname == "MultiHeadAttention":
+            # self-attention only: query/value(/key) must PROVABLY be
+            # one tensor — the call serializes >= 2 tensor args, so a
+            # single surfaced tensor means the rest hid somewhere we
+            # did not parse (reject rather than guess)
+            if len(inbound) < 2 or len(set(inbound)) != 1:
+                raise KerasImportError(
+                    f"MultiHeadAttention '{name}' attends across "
+                    f"different tensors ({inbound}) — cross-attention "
+                    "import is unsupported (self-attention only)")
+            ckw = _call_kwargs(lc.get("inbound_nodes"))
+            unsupported = {k: v for k, v in ckw.items()
+                           if k not in ("use_causal_mask",) and v}
+            if unsupported:
+                raise KerasImportError(
+                    f"MultiHeadAttention '{name}' call kwargs "
+                    f"{sorted(unsupported)} unsupported (an "
+                    "attention_mask tensor has no import analog)")
+            inbound = inbound[:1]
+            mha_causal = bool(ckw.get("use_causal_mask", False))
+        else:
+            mha_causal = False
         layer = map_keras_layer(
             cname, lcfg,
             is_output=(name in output_refs and cname == "Dense"))
         if layer is None:
             alias[name] = inbound[0]
             continue
+        if mha_causal:
+            layer.causal = True        # call-time use_causal_mask
         plan.append((name, layer, inbound, False))
         weight_map[name] = (cname, lcfg)
 

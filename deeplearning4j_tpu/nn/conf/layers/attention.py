@@ -38,6 +38,9 @@ class SelfAttentionLayer(BaseLayer):
     n_out: Optional[int] = None        # model dim (defaults to n_in)
     n_heads: int = 4
     causal: bool = False
+    # biases on the q/k/v projections (Keras MultiHeadAttention
+    # default; our native transformer blocks keep them off)
+    qkv_bias: bool = False
 
     seq_parallelizable = True          # attention rides the ring
 
@@ -66,6 +69,10 @@ class SelfAttentionLayer(BaseLayer):
             "Wo": self._sample_w(ko, (d, d), d, d),
             "bo": jnp.zeros((d,), pd),
         }
+        if self.qkv_bias:
+            p["bq"] = jnp.zeros((d,), pd)
+            p["bk"] = jnp.zeros((d,), pd)
+            p["bv"] = jnp.zeros((d,), pd)
         return p, {}
 
     def apply(self, params, state, x, *, training=False, rng=None,
@@ -79,9 +86,14 @@ class SelfAttentionLayer(BaseLayer):
         def split_heads(y):
             return y.reshape(B, T, H, Dh)
 
-        q = split_heads(x @ params["Wq"])
-        k = split_heads(x @ params["Wk"])
-        v = split_heads(x @ params["Wv"])
+        q = x @ params["Wq"]
+        k = x @ params["Wk"]
+        v = x @ params["Wv"]
+        if self.qkv_bias:
+            q = q + params["bq"]
+            k = k + params["bk"]
+            v = v + params["bv"]
+        q, k, v = split_heads(q), split_heads(k), split_heads(v)
         from deeplearning4j_tpu.parallel.seq_context import (
             current_seq_axis)
         seq_axis = current_seq_axis()

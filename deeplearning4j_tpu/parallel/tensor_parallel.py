@@ -110,7 +110,9 @@ def _spec_for(param_name: str, ndim: int, rule: str, axis: str) -> P:
             return P(None, axis)
         if param_name in _ATTN_ROW:
             return P(axis, None)
-        if param_name == "b1":           # follows W1's output split
+        if param_name in ("b1", "bq", "bk", "bv"):
+            # follow their matmul's column (output) split — qkv
+            # biases exist on Keras-imported attention (qkv_bias)
             return P(axis)
         return P()
     if param_name in ("b", "beta", "gamma"):

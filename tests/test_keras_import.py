@@ -177,6 +177,55 @@ class TestFunctionalImport:
         x = rng.normal(0, 1, (3, 8, 8, 3)).astype(np.float32)
         _compare(tmp_path, m, x)
 
+    def test_transformer_encoder_block(self, tmp_path, rng):
+        """A real Keras transformer encoder block — LayerNormalization,
+        MultiHeadAttention (self-attention), residual Adds, GELU MLP —
+        imports exactly (the modern-era analog of the reference's
+        KerasModelEndToEndTest discipline)."""
+        from keras import layers
+        d, T, H = 16, 12, 4
+        inp = keras.Input((T, d))
+        h = layers.LayerNormalization()(inp)
+        att = layers.MultiHeadAttention(num_heads=H, key_dim=d // H)(
+            h, h)
+        x1 = layers.Add()([inp, att])
+        h2 = layers.LayerNormalization()(x1)
+        m1 = layers.Dense(4 * d, activation="gelu")(h2)
+        m2 = layers.Dense(d)(m1)
+        x2 = layers.Add()([x1, m2])
+        out = layers.Dense(3, activation="softmax")(
+            layers.GlobalAveragePooling1D()(x2))
+        m = keras.Model(inp, out)
+        x = rng.normal(0, 1, (3, T, d)).astype(np.float32)
+        _compare(tmp_path, m, x)
+
+    def test_causal_mha_import(self, tmp_path, rng):
+        """use_causal_mask=True lives in the CALL kwargs, not the
+        layer config — it must import as causal attention."""
+        from keras import layers
+        inp = keras.Input((10, 8))
+        att = layers.MultiHeadAttention(num_heads=2, key_dim=4)(
+            inp, inp, use_causal_mask=True)
+        out = layers.Dense(2, activation="softmax")(
+            layers.GlobalAveragePooling1D()(att))
+        m = keras.Model(inp, out)
+        x = rng.normal(0, 1, (3, 10, 8)).astype(np.float32)
+        ours = _compare(tmp_path, m, x)
+        assert any(getattr(v[0], "causal", False)
+                   for v in ours.conf.vertices.values())
+
+    def test_cross_attention_rejected(self, tmp_path, rng):
+        from keras import layers
+        a = keras.Input((6, 8))
+        b = keras.Input((6, 8))
+        att = layers.MultiHeadAttention(num_heads=2, key_dim=4)(a, b)
+        out = layers.Dense(2, activation="softmax")(
+            layers.GlobalAveragePooling1D()(att))
+        m = keras.Model([a, b], out)
+        path = _save(tmp_path, m)
+        with pytest.raises(KerasImportError, match="cross-attention"):
+            import_keras_model_and_weights(path)
+
     def test_imported_model_trainable(self, tmp_path, rng):
         from keras import layers
         m = keras.Sequential([
