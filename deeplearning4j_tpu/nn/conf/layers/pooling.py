@@ -127,6 +127,12 @@ class GlobalPoolingLayer(Layer):
     pnorm: int = 2
     collapse_dimensions: bool = True
 
+    # under sequence parallelism this layer COLLAPSES the sharded time
+    # axis with a collective (pmax/psum/pmean over the seq axis), so
+    # downstream layers see replicated activations — the wrapper's
+    # validation lets any layer follow it (Layer base declares False)
+    seq_collapses_time = True
+
     def output_type(self, input_type: InputType) -> InputType:
         if input_type.kind == "rnn":
             return InputType.feed_forward(input_type.size)
@@ -134,34 +140,74 @@ class GlobalPoolingLayer(Layer):
             return InputType.feed_forward(input_type.channels)
         return input_type
 
+    @staticmethod
+    def _combine(val, seq_ax, op):
+        """Combine local pools across the seq axis, then re-mark the
+        (now identical-everywhere) result as device-varying: the seq
+        step's loss pmean and the /nshards gradient normalization
+        count one term per shard, so the collective's output must
+        keep the varying type (each shard's identical copy IS its
+        term)."""
+        from jax import lax
+        if not seq_ax:
+            return val
+        if op is lax.pmax:
+            # pmax has no differentiation rule: gather + max instead
+            # (gradient flows to the argmax shard's local pool); the
+            # gathered result already carries the varying type
+            return jnp.max(lax.all_gather(val, seq_ax), axis=0)
+        # psum/pmean outputs are seq-INVARIANT: re-mark varying
+        return lax.pcast(op(val, seq_ax), seq_ax, to="varying")
+
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        from jax import lax
+
+        from deeplearning4j_tpu.parallel.seq_context import (
+            current_seq_axis)
         if x.ndim == 4:          # NHWC → pool over H,W
             axes = (1, 2)
+            seq_ax = None
         elif x.ndim == 3:        # NTC → pool over T
             axes = (1,)
+            # sequence-parallel: x is the LOCAL time chunk — pool
+            # locally, then combine across the seq axis so every
+            # shard holds the GLOBAL pool (replicated downstream)
+            seq_ax = current_seq_axis()
         else:
             return x, state
         if mask is not None and x.ndim == 3:
             m = mask[..., None]          # (B,T,1)
             if self.pooling == PoolingType.MAX:
                 big_neg = jnp.finfo(x.dtype).min
-                return jnp.max(jnp.where(m > 0, x, big_neg), axis=1), state
+                out = jnp.max(jnp.where(m > 0, x, big_neg), axis=1)
+                return self._combine(out, seq_ax, lax.pmax), state
             if self.pooling == PoolingType.SUM:
-                return jnp.sum(x * m, axis=1), state
+                out = jnp.sum(x * m, axis=1)
+                return self._combine(out, seq_ax, lax.psum), state
             if self.pooling == PoolingType.AVG:
-                denom = jnp.maximum(jnp.sum(m, axis=1), 1.0)
-                return jnp.sum(x * m, axis=1) / denom, state
+                # global masked mean: combine numerator AND count
+                num = self._combine(jnp.sum(x * m, axis=1), seq_ax,
+                                    lax.psum)
+                den = self._combine(jnp.sum(m, axis=1), seq_ax,
+                                    lax.psum)
+                return num / jnp.maximum(den, 1.0), state
             if self.pooling == PoolingType.PNORM:
                 p = float(self.pnorm)
                 s = jnp.sum((jnp.abs(x) * m) ** p, axis=1)
+                s = self._combine(s, seq_ax, lax.psum)
                 return s ** (1.0 / p), state
         if self.pooling == PoolingType.MAX:
-            return jnp.max(x, axis=axes), state
+            out = jnp.max(x, axis=axes)
+            return self._combine(out, seq_ax, lax.pmax), state
         if self.pooling == PoolingType.AVG:
-            return jnp.mean(x, axis=axes), state
+            out = jnp.mean(x, axis=axes)     # equal chunks: pmean exact
+            return self._combine(out, seq_ax, lax.pmean), state
         if self.pooling == PoolingType.SUM:
-            return jnp.sum(x, axis=axes), state
+            out = jnp.sum(x, axis=axes)
+            return self._combine(out, seq_ax, lax.psum), state
         if self.pooling == PoolingType.PNORM:
             p = float(self.pnorm)
-            return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p), state
+            s = jnp.sum(jnp.abs(x) ** p, axis=axes)
+            s = self._combine(s, seq_ax, lax.psum)
+            return s ** (1.0 / p), state
         raise ValueError(self.pooling)

@@ -234,6 +234,7 @@ class ParallelWrapper:
                 f"meshes only; mesh also carries {extra} — combine "
                 "seq with tensor/pipeline parallelism via the "
                 "functional APIs for now")
+        self._seq_collapses = False
         if isinstance(self.model, ComputationGraph):
             # layers AND vertices self-declare time-pointwiseness via
             # the seq_parallelizable class attribute (Layer base +
@@ -273,15 +274,44 @@ class ParallelWrapper:
                 "sequence-parallel training supports "
                 "MultiLayerNetwork and ComputationGraph; got "
                 f"{type(self.model).__name__}")
-        bad = [f"layer {i} ({type(l).__name__})"
-               for i, l in enumerate(self.model.layers)
-               if not getattr(l, "seq_parallelizable", False)]
+        # the batch shards axis 1 over 'seq' — that must be TIME, so
+        # the network input has to be recurrent (mirrors the graph
+        # branch; a CNN input would silently shard image height)
+        in_t = getattr(self.model.conf, "input_type", None)
+        if in_t is None or in_t.kind != "rnn":
+            raise ValueError(
+                "sequence-parallel training needs set_input_type("
+                "InputType.recurrent(...)) — got "
+                f"{getattr(in_t, 'kind', None)!r}; the wrapper shards "
+                "axis 1 over 'seq', which is only time for recurrent "
+                "inputs")
+        bad = []
+        collapsed = False
+        for i, l in enumerate(self.model.layers):
+            if collapsed:
+                # time axis already pooled away with a collective:
+                # downstream activations are REPLICATED over seq, so
+                # any deterministic layer is exact — but stochastic
+                # layers draw per-shard rng (the step decorrelates
+                # dropout by seq index) and would break replication
+                if getattr(l, "dropout", 0.0):
+                    bad.append(f"layer {i} ({type(l).__name__}: "
+                               "dropout after the time collapse)")
+                continue
+            if getattr(l, "seq_collapses_time", False):
+                collapsed = True
+            elif not getattr(l, "seq_parallelizable", False):
+                bad.append(f"layer {i} ({type(l).__name__})")
         if bad:
             raise ValueError(
                 "these layers cannot train over a 'seq' mesh axis (not "
                 "pointwise in time): " + ", ".join(bad)
-                + " — use attention/dense/time-distributed layers, or "
+                + " — use attention/dense/time-distributed layers "
+                  "(optionally a GlobalPoolingLayer collapse), or "
                   "drop the seq axis from the mesh")
+        # time-collapsed nets have NON-temporal labels: (B, K) shards
+        # over 'data' only (the batch sharder consults this)
+        self._seq_collapses = collapsed
         # input preprocessors reshape with GLOBAL timestep counts
         # (e.g. FeedForwardToRnn) — wrong on a local time chunk
         pps = getattr(self.model.conf, "preprocessors", None) or {}
@@ -344,9 +374,15 @@ class ParallelWrapper:
                                      new_state, loss, opt_state, params,
                                      axes)
 
-        bspec = P("data" if "data" in mesh.axis_names else None, "seq")
+        daxis = "data" if "data" in mesh.axis_names else None
+        bspec_t = P(daxis, "seq")              # temporal leaves
+        # labels of a time-collapsing net are (B, K): batch-axis only
+        bspec_l = (P(daxis) if getattr(self, "_seq_collapses", False)
+                   else bspec_t)
         smapped = shard_map(per_device, mesh=mesh,
-                            in_specs=(P(), P(), P(), bspec, P(), P()),
+                            in_specs=(P(), P(), P(),
+                                      (bspec_t, bspec_l, bspec_t,
+                                       bspec_l), P(), P()),
                             out_specs=(P(), P(), P(), P()))
         return jax.jit(smapped, donate_argnums=(0, 1, 2))
 
@@ -359,11 +395,11 @@ class ParallelWrapper:
         per-output lists (ComputationGraph MultiDataSet)."""
         nseq = self._seq_axis_size()
         ndata = self.mesh.shape.get("data", 1)
-        spec = P("data" if "data" in self.mesh.axis_names else None,
-                 "seq")
-        sharding = NamedSharding(self.mesh, spec)
+        daxis = "data" if "data" in self.mesh.axis_names else None
+        temporal = NamedSharding(self.mesh, P(daxis, "seq"))
+        batch_only = NamedSharding(self.mesh, P(daxis))
 
-        def put(a):
+        def put_temporal(a):
             if a.ndim < 2:
                 raise ValueError(f"seq-parallel batch arrays must be "
                                  f"(B, T, ...); got shape {a.shape}")
@@ -371,9 +407,25 @@ class ParallelWrapper:
                 raise ValueError(
                     f"seq-parallel batch shape {a.shape} not divisible "
                     f"by mesh (data={ndata}, seq={nseq})")
-            return jax.device_put(a, sharding)
+            return jax.device_put(a, temporal)
 
-        return jax.tree_util.tree_map(put, batch)
+        def put_batch_only(a):
+            if a.shape[0] % ndata:
+                raise ValueError(
+                    f"seq-parallel batch shape {a.shape} not divisible "
+                    f"by mesh (data={ndata})")
+            return jax.device_put(a, batch_only)
+
+        f, l, fm, lm = batch
+        # features/feature-masks are always temporal; labels are
+        # temporal only for seq-to-seq nets — a time-collapsing net
+        # (GlobalPooling) has (B, K) labels sharded over 'data' alone
+        put_label = (put_batch_only if getattr(self, "_seq_collapses",
+                                               False)
+                     else put_temporal)
+        t = jax.tree_util.tree_map
+        return (t(put_temporal, f), t(put_label, l),
+                t(put_temporal, fm), t(put_label, lm))
 
     def _init_residual(self):
         ndev = self.mesh.shape["data"]

@@ -1038,3 +1038,53 @@ class TestSequenceParallelGraph:
         with pytest.raises(ValueError, match="recurrent"):
             GraphParallelWrapper(cg, mesh, prefetch_buffer=0).fit(
                 ListDataSetIterator([DataSet(x, y)]), epochs=1)
+
+
+class TestSequenceParallelClassifier:
+    """Time-COLLAPSING networks under sequence parallelism: a
+    GlobalPoolingLayer pools its local chunk then combines across the
+    seq axis with a collective (pmax/psum/pmean; masked avg psums
+    numerator AND count), so attention classifiers — not just
+    seq-to-seq LMs — train over a seq mesh."""
+
+    B, T, C, K = 4, 32, 16, 3
+
+    def _net(self, pooling="avg"):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GlobalPoolingLayer, OutputLayer, TransformerEncoderLayer)
+        conf = (NeuralNetConfiguration.builder().set_seed(9)
+                .updater(updaters.adam(1e-2)).list()
+                .layer(TransformerEncoderLayer(n_heads=4))
+                .layer(GlobalPoolingLayer(pooling=pooling))
+                .layer(OutputLayer(n_out=self.K))
+                .set_input_type(InputType.recurrent(self.C, self.T))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def _batch(self, masked=False):
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype("float32")
+        y = np.eye(self.K, dtype="float32")[
+            rng.integers(0, self.K, self.B)]
+        fm = None
+        if masked:
+            fm = np.ones((self.B, self.T), "float32")
+            fm[0, 20:] = 0.0
+            fm[1, 9:] = 0.0
+        return DataSet(x, y, fm, None)
+
+    @pytest.mark.parametrize("pooling,masked", [
+        ("avg", False), ("max", False), ("avg", True), ("max", True),
+        ("sum", False), ("pnorm", False)])
+    def test_matches_single_device(self, pooling, masked):
+        ds = self._batch(masked)
+        single = self._net(pooling)
+        single.fit(ds)
+        single.fit(ds)
+        sp = self._net(pooling)
+        mesh = build_mesh(MeshSpec(data=2, seq=4), jax.devices()[:8])
+        ParallelWrapper(sp, mesh, prefetch_buffer=0).fit(
+            ListDataSetIterator([ds]), epochs=2)
+        np.testing.assert_allclose(
+            np.asarray(sp.params_flat()),
+            np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
