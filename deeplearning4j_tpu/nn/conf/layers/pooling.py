@@ -148,7 +148,6 @@ class GlobalPoolingLayer(Layer):
         count one term per shard, so the collective's output must
         keep the varying type (each shard's identical copy IS its
         term)."""
-        from jax import lax
         if not seq_ax:
             return val
         if op is lax.pmax:
@@ -160,8 +159,6 @@ class GlobalPoolingLayer(Layer):
         return lax.pcast(op(val, seq_ax), seq_ax, to="varying")
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
-        from jax import lax
-
         from deeplearning4j_tpu.parallel.seq_context import (
             current_seq_axis)
         if x.ndim == 4:          # NHWC → pool over H,W

@@ -97,6 +97,7 @@ class ParallelWrapper:
         self.dcn_compression = dcn_compression
         self._compressed_step = None
         self._seq_step = None
+        self._seq_collapses = False   # set by _validate_seq_model
         self._residual = None
 
     # ---- builder parity ----
@@ -234,7 +235,7 @@ class ParallelWrapper:
                 f"meshes only; mesh also carries {extra} — combine "
                 "seq with tensor/pipeline parallelism via the "
                 "functional APIs for now")
-        self._seq_collapses = False
+        self._seq_collapses = False      # recomputed per validation
         if isinstance(self.model, ComputationGraph):
             # layers AND vertices self-declare time-pointwiseness via
             # the seq_parallelizable class attribute (Layer base +
@@ -377,8 +378,7 @@ class ParallelWrapper:
         daxis = "data" if "data" in mesh.axis_names else None
         bspec_t = P(daxis, "seq")              # temporal leaves
         # labels of a time-collapsing net are (B, K): batch-axis only
-        bspec_l = (P(daxis) if getattr(self, "_seq_collapses", False)
-                   else bspec_t)
+        bspec_l = P(daxis) if self._seq_collapses else bspec_t
         smapped = shard_map(per_device, mesh=mesh,
                             in_specs=(P(), P(), P(),
                                       (bspec_t, bspec_l, bspec_t,
@@ -420,8 +420,7 @@ class ParallelWrapper:
         # features/feature-masks are always temporal; labels are
         # temporal only for seq-to-seq nets — a time-collapsing net
         # (GlobalPooling) has (B, K) labels sharded over 'data' alone
-        put_label = (put_batch_only if getattr(self, "_seq_collapses",
-                                               False)
+        put_label = (put_batch_only if self._seq_collapses
                      else put_temporal)
         t = jax.tree_util.tree_map
         return (t(put_temporal, f), t(put_label, l),

