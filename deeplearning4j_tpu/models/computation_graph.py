@@ -520,6 +520,12 @@ class ComputationGraph:
                                          training=False)
                 self._rnn_state[name] = carry
                 acts[name] = y
+            elif hasattr(obj, "apply_stream"):
+                # attention vertices: the streaming carry is the KV
+                # cache (rnnTimeStep contract extended to transformers)
+                acts[name], self._rnn_state[name] = obj.apply_stream(
+                    self.params[name], self._rnn_state.get(name),
+                    xin[0])
             elif isinstance(obj, Layer):
                 acts[name], _ = obj.apply(self.params[name],
                                           self.state[name], xin[0],

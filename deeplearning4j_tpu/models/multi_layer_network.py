@@ -477,6 +477,12 @@ class MultiLayerNetwork:
                 h, carry = layer.apply_rnn(self.params[i], h, carry,
                                            training=False)
                 self._rnn_state[i] = carry
+            elif hasattr(layer, "apply_stream"):
+                # attention layers: the streaming carry is the KV
+                # cache (rnnTimeStep contract extended to
+                # transformers)
+                h, self._rnn_state[i] = layer.apply_stream(
+                    self.params[i], self._rnn_state[i], h)
             else:
                 h, _ = layer.apply(self.params[i], self.state[i], h,
                                    training=False)

@@ -80,20 +80,7 @@ class SelfAttentionLayer(BaseLayer):
         from deeplearning4j_tpu.ops.attention import flash_attention
         x = self.apply_input_dropout(x, training=training, rng=rng)
         B, T, _ = x.shape
-        H = self.n_heads
-        Dh = self.n_out // H
-
-        def split_heads(y):
-            return y.reshape(B, T, H, Dh)
-
-        q = x @ params["Wq"]
-        k = x @ params["Wk"]
-        v = x @ params["Wv"]
-        if self.qkv_bias:
-            q = q + params["bq"]
-            k = k + params["bk"]
-            v = v + params["bv"]
-        q, k, v = split_heads(q), split_heads(k), split_heads(v)
+        q, k, v = self._project_qkv(params, x)
         from deeplearning4j_tpu.parallel.seq_context import (
             current_seq_axis)
         seq_axis = current_seq_axis()
@@ -125,6 +112,53 @@ class SelfAttentionLayer(BaseLayer):
             out = flash_attention(q, k, v, causal=self.causal)
         out = out.reshape(B, T, self.n_out)
         return out @ params["Wo"] + params["bo"], state
+
+    def _project_qkv(self, params, x):
+        """The shared q/k/v projection (+optional biases) and head
+        split — ONE implementation for apply and apply_stream, so
+        full-sequence and streaming outputs cannot drift."""
+        B, T, _ = x.shape
+        H = self.n_heads
+        Dh = self.n_out // H
+        q = x @ params["Wq"]
+        k = x @ params["Wk"]
+        v = x @ params["Wv"]
+        if self.qkv_bias:
+            q = q + params["bq"]
+            k = k + params["bk"]
+            v = v + params["bv"]
+        split = lambda y: y.reshape(B, T, H, Dh)
+        return split(q), split(k), split(v)
+
+    # ---- stateful streaming inference (rnnTimeStep contract,
+    #      MultiLayerNetwork.java:2656): the attention analog of a
+    #      recurrent carry is the KV CACHE ----
+    def apply_stream(self, params, cache, x):
+        """Incremental decode: ``x`` is the NEW (B, t, C) chunk;
+        ``cache`` holds the k/v history (None at sequence start).
+        Returns (out, new_cache); feeding chunks sequentially equals
+        one full-sequence causal forward (tested). Eager-mode path
+        (rnn_time_step is not jitted), so the cache grows by concat —
+        no static max length needed. Requires causal=True: streaming
+        non-causal attention would need future tokens."""
+        if not self.causal:
+            raise ValueError(
+                "apply_stream requires causal=True: non-causal "
+                "attention needs future timesteps — use output() on "
+                "the full sequence instead")
+        B, t, _ = x.shape
+        q, k, v = self._project_qkv(params, x)
+        if cache is None:
+            n_cached = 0
+            k_full, v_full = k, v
+        else:
+            n_cached = cache["k"].shape[1]
+            k_full = jnp.concatenate([cache["k"], k], axis=1)
+            v_full = jnp.concatenate([cache["v"], v], axis=1)
+        out = _stream_attention(q, k_full, v_full, n_cached)
+        out = out.reshape(B, t, self.n_out)
+        return (out @ params["Wo"] + params["bo"],
+                {"k": k_full, "v": v_full})
 
 
 @register_layer
@@ -186,8 +220,40 @@ class TransformerEncoderLayer(BaseLayer):
         a, _ = self._attn.apply(params["attn"], {}, h,
                                 training=training, rng=rng, mask=mask)
         x = x + a
+        return x + self._mlp_half(params, x), state
+
+    def _mlp_half(self, params, x):
+        """Pre-LN MLP residual branch — shared by apply and
+        apply_stream (per-token, so streaming needs no carry)."""
         h = _layer_norm(x, params["ln2_g"], params["ln2_b"])
         act = self.activation_fn()
-        h = act(h @ params["W1"] + params["b1"]) @ params["W2"] \
+        return act(h @ params["W1"] + params["b1"]) @ params["W2"] \
             + params["b2"]
-        return x + h, state
+
+    def apply_stream(self, params, cache, x):
+        """Incremental decode through the full pre-LN block: the
+        inner attention carries the KV cache, the LN/MLP halves are
+        per-token (see SelfAttentionLayer.apply_stream)."""
+        if not hasattr(self, "_attn"):
+            self._attn = SelfAttentionLayer(
+                n_in=self.n_in, n_out=self.n_out, n_heads=self.n_heads,
+                causal=self.causal)
+        h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        a, cache = self._attn.apply_stream(params["attn"], cache, h)
+        x = x + a
+        return x + self._mlp_half(params, x), cache
+
+
+def _stream_attention(q, k_full, v_full, n_cached: int):
+    """Exact attention of the NEW chunk's queries over the full
+    cached+new history, causal within the chunk: new position i
+    (global n_cached + i) sees keys [0, n_cached + i]."""
+    from deeplearning4j_tpu.ops.attention import _NEG_INF
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full) * scale
+    t_new = q.shape[1]
+    k_pos = jnp.arange(k_full.shape[1])[None, :]
+    q_pos = n_cached + jnp.arange(t_new)[:, None]
+    logits = jnp.where((k_pos <= q_pos)[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_full)

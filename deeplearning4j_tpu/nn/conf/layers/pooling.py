@@ -158,6 +158,38 @@ class GlobalPoolingLayer(Layer):
         # psum/pmean outputs are seq-INVARIANT: re-mark varying
         return lax.pcast(op(val, seq_ax), seq_ax, to="varying")
 
+    def apply_stream(self, params, cache, x):
+        """Stateful streaming inference (the rnnTimeStep contract
+        extended through the time collapse): the carry is the running
+        pool statistic — sum+count (avg), max, sum, or Σ|x|^p
+        (pnorm). Each step returns the pool over the stream SO FAR,
+        so the final step equals the full-sequence ``apply`` and a
+        prefix step is the prediction on that prefix."""
+        if x.ndim != 3:
+            raise ValueError("apply_stream pools over TIME: input "
+                             f"must be (B, t, C), got {x.shape}")
+        if self.pooling == PoolingType.MAX:
+            cur = jnp.max(x, axis=1)
+            m = cur if cache is None else jnp.maximum(cache, cur)
+            return m, m
+        if self.pooling in (PoolingType.AVG, PoolingType.SUM):
+            s_new = jnp.sum(x, axis=1)
+            n_new = x.shape[1]
+            if cache is not None:
+                s_new = s_new + cache["sum"]
+                n_new = n_new + cache["count"]
+            cache = {"sum": s_new, "count": n_new}
+            if self.pooling == PoolingType.SUM:
+                return s_new, cache
+            return s_new / n_new, cache
+        if self.pooling == PoolingType.PNORM:
+            p = float(self.pnorm)
+            s_new = jnp.sum(jnp.abs(x) ** p, axis=1)
+            if cache is not None:
+                s_new = s_new + cache
+            return s_new ** (1.0 / p), s_new
+        raise ValueError(self.pooling)
+
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         from deeplearning4j_tpu.parallel.seq_context import (
             current_seq_axis)

@@ -481,3 +481,123 @@ class TestMaskedFlashKernels:
             np.asarray(dk)[0, 11:], np.zeros_like(np.asarray(dk)[0, 11:]))
         np.testing.assert_array_equal(
             np.asarray(dv)[1, 7:], np.zeros_like(np.asarray(dv)[1, 7:]))
+
+
+class TestTransformerStreaming:
+    """Stateful streaming inference for transformers: the attention
+    analog of the rnnTimeStep carry is the KV cache
+    (MultiLayerNetwork.java:2656 contract, extended to attention) —
+    feeding timesteps or chunks incrementally must equal the full
+    causal forward."""
+
+    B, T, C, V = 2, 12, 16, 7
+
+    def _net(self):
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, TransformerEncoderLayer)
+        conf = (NeuralNetConfiguration.builder().set_seed(1)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(TransformerEncoderLayer(n_heads=4, causal=True))
+                .layer(TransformerEncoderLayer(n_heads=4, causal=True))
+                .layer(RnnOutputLayer(n_out=self.V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.C, self.T))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_per_step_equals_full_sequence(self, rng):
+        net = self._net()
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype(
+            np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        stepped = np.stack(
+            [np.asarray(net.rnn_time_step(x[:, t]))
+             for t in range(self.T)], axis=1)
+        np.testing.assert_allclose(stepped, full, atol=1e-4)
+
+    def test_chunked_equals_full_sequence(self, rng):
+        """Prefill + decode: a 8-step chunk then single steps."""
+        net = self._net()
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype(
+            np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        pre = np.asarray(net.rnn_time_step(x[:, :8]))
+        rest = [np.asarray(net.rnn_time_step(x[:, t]))
+                for t in range(8, self.T)]
+        got = np.concatenate([pre, np.stack(rest, axis=1)], axis=1)
+        np.testing.assert_allclose(got, full, atol=1e-4)
+
+    def test_graph_attention_streaming(self, rng):
+        from deeplearning4j_tpu import (ComputationGraph,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, SelfAttentionLayer)
+        conf = (NeuralNetConfiguration.builder().set_seed(2)
+                .updater(updaters.adam(1e-3))
+                .graph_builder().add_inputs("in")
+                .add_layer("attn", SelfAttentionLayer(
+                    n_out=self.C, n_heads=4, causal=True), "in")
+                .add_layer("out", RnnOutputLayer(n_out=self.V,
+                                                 loss="mcxent"),
+                           "attn")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(self.C, self.T))
+                .build())
+        cg = ComputationGraph(conf).init()
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype(
+            np.float32)
+        out = cg.output(x)
+        full = np.asarray(out[0] if isinstance(out, (list, tuple))
+                          else out)
+        cg.rnn_clear_previous_state()
+        stepped = np.stack(
+            [np.asarray(cg.rnn_time_step(x[:, t]))
+             for t in range(self.T)], axis=1)
+        np.testing.assert_allclose(stepped, full, atol=1e-4)
+
+    def test_non_causal_rejected(self):
+        import jax
+
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        lay = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2,
+                                 causal=False)
+        p, _ = lay.initialize(jax.random.PRNGKey(0),
+                              InputType.recurrent(8, 4))
+        x = np.zeros((1, 1, 8), np.float32)
+        with pytest.raises(ValueError, match="causal"):
+            lay.apply_stream(p, None, x)
+
+    @pytest.mark.parametrize("pooling", ["avg", "max", "sum", "pnorm"])
+    def test_streamed_classifier_final_step(self, rng, pooling):
+        """A pooled transformer CLASSIFIER streams too: the pooling
+        carry is the running statistic, and the final streamed step
+        equals the full-sequence forward."""
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GlobalPoolingLayer, OutputLayer, TransformerEncoderLayer)
+        conf = (NeuralNetConfiguration.builder().set_seed(4)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(TransformerEncoderLayer(n_heads=4, causal=True))
+                .layer(GlobalPoolingLayer(pooling=pooling))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.recurrent(self.C, self.T))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype(
+            np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        for t in range(self.T):
+            last = np.asarray(net.rnn_time_step(x[:, t]))
+        np.testing.assert_allclose(last, full, atol=1e-4)
