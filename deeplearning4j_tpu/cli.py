@@ -18,17 +18,9 @@ import argparse
 import os
 import sys
 
-# honor JAX_PLATFORMS=cpu BEFORE any backend use: a hardware plugin
-# (e.g. the axon TPU tunnel) re-pins the platform at import, and a
-# dead tunnel would otherwise hang every CLI invocation that asked
-# for CPU (the env var alone is not enough — same idiom as
-# tests/conftest.py and the examples)
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    import jax
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except RuntimeError:
-        pass    # backends already initialized
+from deeplearning4j_tpu.util.platform import pin_cpu_platform
+
+pin_cpu_platform()     # a dead TPU tunnel must not hang CPU-pinned CLIs
 
 
 def _cmd_train(args):

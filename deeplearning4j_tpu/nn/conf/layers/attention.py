@@ -195,10 +195,8 @@ class TransformerEncoderLayer(BaseLayer):
         pd = dtypes.policy().param_dtype
         d = self.n_out
         dff = d * self.ffn_multiplier
-        self._attn = SelfAttentionLayer(
-            n_in=d, n_out=d, n_heads=self.n_heads, causal=self.causal,
-            weight_init=self.weight_init)
-        attn_p, _ = self._attn.initialize(ka, InputType.recurrent(d))
+        attn_p, _ = self._ensure_attn().initialize(
+            ka, InputType.recurrent(d))
         p = {
             "attn": attn_p,
             "ln1_g": jnp.ones((d,), pd), "ln1_b": jnp.zeros((d,), pd),
@@ -210,12 +208,16 @@ class TransformerEncoderLayer(BaseLayer):
         }
         return p, {}
 
-    def apply(self, params, state, x, *, training=False, rng=None,
-              mask=None):
+    def _ensure_attn(self):
         if not hasattr(self, "_attn"):
             self._attn = SelfAttentionLayer(
                 n_in=self.n_in, n_out=self.n_out, n_heads=self.n_heads,
-                causal=self.causal)
+                causal=self.causal, weight_init=self.weight_init)
+        return self._attn
+
+    def apply(self, params, state, x, *, training=False, rng=None,
+              mask=None):
+        self._ensure_attn()
         h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
         a, _ = self._attn.apply(params["attn"], {}, h,
                                 training=training, rng=rng, mask=mask)
@@ -234,10 +236,7 @@ class TransformerEncoderLayer(BaseLayer):
         """Incremental decode through the full pre-LN block: the
         inner attention carries the KV cache, the LN/MLP halves are
         per-token (see SelfAttentionLayer.apply_stream)."""
-        if not hasattr(self, "_attn"):
-            self._attn = SelfAttentionLayer(
-                n_in=self.n_in, n_out=self.n_out, n_heads=self.n_heads,
-                causal=self.causal)
+        self._ensure_attn()
         h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
         a, cache = self._attn.apply_stream(params["attn"], cache, h)
         x = x + a

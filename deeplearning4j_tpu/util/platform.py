@@ -1,0 +1,24 @@
+"""Platform pinning helper.
+
+A hardware plugin (e.g. the axon TPU tunnel) re-pins jax's platform at
+import time, overriding the JAX_PLATFORMS env var — and a dead tunnel
+then HANGS the first backend use. Calling this before any backend use
+honors an explicit CPU request reliably (the tests/conftest.py idiom,
+shared so the CLI and every example stay in sync)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["pin_cpu_platform"]
+
+
+def pin_cpu_platform() -> None:
+    """If JAX_PLATFORMS=cpu is requested, enforce it via jax.config
+    (no-op otherwise; safe after backend init)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass    # backends already initialized; use what we have

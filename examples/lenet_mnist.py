@@ -15,17 +15,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-# honor JAX_PLATFORMS=cpu BEFORE any backend use: a hardware plugin
-# (e.g. the axon TPU tunnel) re-pins the platform at import, and a
-# dead tunnel would otherwise hang the run (env var alone is not
-# enough)
-import os as _os
-if _os.environ.get("JAX_PLATFORMS") == "cpu":
-    import jax as _jax
-    try:
-        _jax.config.update("jax_platforms", "cpu")
-    except RuntimeError:
-        pass
+from deeplearning4j_tpu.util.platform import pin_cpu_platform
+
+pin_cpu_platform()   # dead TPU tunnel must not hang CPU-pinned runs
 
 import argparse
 
