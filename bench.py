@@ -16,11 +16,21 @@ the detected chip).
 
 Skip the non-headline configs with ``--headline-only`` (or env
 BENCH_HEADLINE_ONLY=1) when iterating.
+
+Delivery contract (round-5): a watchdog guarantees ONE stdout JSON
+line and exit code 0 before a hard internal deadline under
+BENCH_BUDGET_SECONDS, whatever the tunnel does — freshly measured if
+the headline leg finished, else the last committed BENCH_DETAIL
+headline tagged ``"stale": true``. Rehearse the degraded-tunnel paths
+with BENCH_REHEARSE_HANG=1 (legs hang) or BENCH_REHEARSE_ORCH_HANG=1
+(orchestrator wedges); see tests/test_bench_harness.py.
 """
 
 import json
 import os
+import signal
 import sys
+import threading
 import time
 
 import numpy as np
@@ -1115,6 +1125,173 @@ def _leg_flash_attention(peak):
                  "fwd+bwd kernels, auto 1024^2 tiles; " + prod_note)}
 
 
+DECODE_STEPS = 128
+DECODE_CAP = 256
+MASKED_ATTN_SHAPE = (4, 4096, 8, 64)     # B, T, H, D
+MASKED_ATTN_BURST = 100                  # chained steps per burst
+
+
+def _leg_transformer_decode(peak):
+    """Streaming decode for the transformer-LM config: the jitted
+    fixed-capacity KV-cache session (models/streaming.py) vs the
+    eager concat-cache rnn_time_step path — same contract (parity
+    tested in tests/), one XLA dispatch per token vs a Python op
+    stream, O(t) vs O(pos) cache traffic per step (round-4 verdict
+    weak #7)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration, dtypes)
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        EmbeddingSequenceLayer, RnnOutputLayer, TransformerEncoderLayer)
+
+    b = (NeuralNetConfiguration.builder().set_seed(0)
+         .updater(updaters.adam(1e-3)).list()
+         .layer(EmbeddingSequenceLayer(n_in=LM_V, n_out=LM_D)))
+    for _ in range(LM_L):
+        b = b.layer(TransformerEncoderLayer(n_heads=LM_H, causal=True))
+    conf = (b.layer(RnnOutputLayer(n_out=LM_V, loss="mcxent"))
+            .set_input_type(InputType.recurrent(LM_V, DECODE_CAP))
+            .build())
+    with dtypes.policy_scope(dtypes.tpu_bf16()):
+        net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    # fixed id stream (not sampled from the model): keeps every step
+    # device-side with no per-token host sync; the cache carry is the
+    # cross-step data dependency, so the tunnel cannot dedupe steps
+    ids = rng.integers(0, LM_V, (DECODE_STEPS, LM_B, 1)).astype(
+        "float32")
+
+    sess = net.streaming_session(capacity=DECODE_CAP, batch=LM_B,
+                                 dtype=jnp.bfloat16)
+    h = sess.step(ids[0])               # compile the t=1 executable
+    float(jnp.sum(h))
+
+    def m_bounded():
+        sess.reset()
+        t0 = time.perf_counter()
+        for s in range(DECODE_STEPS):
+            h = sess.step(ids[s])
+        float(jnp.sum(h))               # host fetch = end-of-burst sync
+        return time.perf_counter() - t0
+
+    eager_steps = 16
+    net.rnn_clear_previous_state()
+    h = net.rnn_time_step(ids[0])       # warm the eager op caches
+    float(jnp.sum(h))
+
+    def m_eager():
+        net.rnn_clear_previous_state()
+        t0 = time.perf_counter()
+        for s in range(eager_steps):
+            h = net.rnn_time_step(ids[s])
+        float(jnp.sum(h))
+        return time.perf_counter() - t0
+
+    dt_b, dt_e = _interleave(m_bounded, m_eager, repeats=3)
+    rate_b = DECODE_STEPS * LM_B / dt_b
+    rate_e = eager_steps * LM_B / dt_e
+    print(f"transformer decode: bounded-cache {rate_b:.0f} tok/s, "
+          f"eager rnn_time_step {rate_e:.0f} tok/s "
+          f"({rate_b / rate_e:.1f}x)", file=sys.stderr)
+    return {
+        "metric": (f"Transformer-LM streaming decode (B={LM_B}, "
+                   f"d={LM_D}, L={LM_L}, heads={LM_H}, vocab {LM_V}, "
+                   f"cap {DECODE_CAP}, bf16 cache)"),
+        "value": round(rate_b, 0), "unit": "tokens/sec/chip",
+        "baseline": round(rate_e, 0),
+        "vs_baseline": round(rate_b / rate_e, 3),
+        "mfu": None,
+        "note": (f"value: jitted fixed-capacity KV-cache session, "
+                 f"{DECODE_STEPS} single-token steps; baseline: "
+                 f"eager concat-cache rnn_time_step over its FIRST "
+                 f"{eager_steps} tokens (short history flatters it — "
+                 f"its per-step cost grows with position); parity of "
+                 f"the two paths is asserted in "
+                 f"tests/test_native_and_kernels.py")}
+
+
+def _leg_flash_attention_masked(peak):
+    """Variable-length batch at T=4096 through the kv-mask-aware
+    Pallas kernels (fwd+bwd) vs (a) exact masked attention — the
+    fallback a maskless kernel forces — and (b) the unmasked kernel —
+    the masking overhead. Records the COMPONENTS.md claim as an
+    artifact (round-4 verdict weak #6)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.attention import (_exact_masked,
+                                                  flash_attention)
+    B, T, H, D = MASKED_ATTN_SHAPE
+    rngk = jax.random.PRNGKey(0)
+    q = jax.random.normal(rngk, (B, T, H, D), jnp.float32)
+    # ragged real lengths (1/4 .. full): the shapes stay static, the
+    # mask carries the raggedness — the TPU-native variable-length
+    # contract
+    lens = tuple(T * (i + 1) // B for i in range(B))
+    mask = jnp.asarray(
+        np.arange(T)[None, :] < np.asarray(lens)[:, None],
+        jnp.float32)
+
+    def mk(fn):
+        # chain grad(q) into the next input: identical in-flight calls
+        # dedupe through the tunnel and time as ~0 (see
+        # _leg_flash_attention)
+        g = jax.jit(jax.grad(
+            lambda x: jnp.sum((fn(x, x, x)
+                               * mask[:, :, None, None]) ** 2)))
+        float(jnp.sum(g(q)))
+        burst = MASKED_ATTN_BURST
+
+        def measure():
+            a = q
+            t0 = time.perf_counter()
+            for _ in range(burst):
+                a = g(a)
+            float(jnp.sum(a))
+            return (time.perf_counter() - t0) / burst
+        return measure
+
+    m_masked = mk(lambda a, b, c: flash_attention(a, b, c,
+                                                  kv_mask=mask))
+    m_exact = mk(lambda a, b, c: _exact_masked(a, b, c, mask, False))
+    m_unmasked = mk(lambda a, b, c: flash_attention(a, b, c))
+    # two interleave windows, both anchored on the masked kernel so
+    # each ratio comes from alternating bursts within one window
+    dt_m, dt_e = _interleave(m_masked, m_exact, repeats=3)
+    dt_m2, dt_u = _interleave(m_masked, m_unmasked, repeats=3)
+    toks = float(sum(lens))            # real (unpadded) tokens
+    attn_flops = 14 * T * T * D * B * H
+    if peak:
+        _check_plausible(attn_flops / min(dt_m, dt_e) / peak,
+                         "masked flash attention")
+        _check_plausible(attn_flops / min(dt_m2, dt_u) / peak,
+                         "masked flash (unmasked window)")
+    print(f"masked flash T={T} ragged fwd+bwd: "
+          f"{toks/dt_m:.0f} real tok/s; vs exact masked "
+          f"{dt_e/dt_m:.2f}x; vs unmasked kernel "
+          f"{dt_u/dt_m2:.3f}x", file=sys.stderr)
+    return {
+        "metric": ("masked flash attention fwd+bwd, ragged batch "
+                   f"(B={B}, T={T}, lens={list(lens)}, H={H}, D={D}, "
+                   "f32)"),
+        "value": round(toks / dt_m, 0), "unit": "real tokens/sec",
+        "baseline": round(toks / dt_e, 0),
+        "vs_baseline": round(dt_e / dt_m, 3),
+        "vs_exact_masked": round(dt_e / dt_m, 3),
+        "vs_unmasked_kernel": round(dt_u / dt_m2, 3),
+        "mfu": None,
+        "note": ("baseline = exact masked attention (materializes "
+                 "TxT with -inf bias) — what variable-length batches "
+                 "fall back to without kv-mask-aware kernels; "
+                 "vs_unmasked_kernel isolates the mask operand's "
+                 "overhead (1.0 = free). Throughput counts REAL "
+                 "(unpadded) tokens only")}
+
+
 # (name, fn, warm-cache wall estimate sec). Order = priority: the five
 # BASELINE.md configs first (VGG before the informational flash leg —
 # round-2 lost config 4 to the wall clock with the legs the other way).
@@ -1129,6 +1306,8 @@ _LEGS = [
     ("char_rnn", _leg_char_rnn, 240),
     ("transformer_lm", _leg_transformer_lm, 300),
     ("flash_attention", _leg_flash_attention, 300),
+    ("flash_attention_masked", _leg_flash_attention_masked, 300),
+    ("transformer_decode", _leg_transformer_decode, 300),
     # 480s: its ResNet executable (n_classes=10) is NOT covered by
     # the other ResNet legs' compile cache — cold tunnel compile ~5min
     ("resnet_native_etl", _leg_resnet_native_etl, 480),
@@ -1150,7 +1329,22 @@ def _setup_xla_cache():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 
+def _pin_cpu_if_requested():
+    """JAX_PLATFORMS=cpu must hold even though the axon plugin re-pins
+    the platform at import time — a wedged tunnel would otherwise hang
+    CPU-pinned rehearsals/smokes at first backend use (the
+    tests/conftest.py + examples idiom)."""
+    from deeplearning4j_tpu.util.platform import pin_cpu_platform
+    pin_cpu_platform()
+
+
 def _run_leg_inprocess(name):
+    _pin_cpu_if_requested()
+    if os.environ.get("BENCH_REHEARSE_HANG") == "1":
+        # degraded-tunnel rehearsal: the leg subprocess hangs forever,
+        # exactly like a wedged axon terminal. The orchestrator's
+        # watchdog must still produce the stdout artifact + rc 0.
+        time.sleep(1e9)
     _setup_xla_cache()
     peak, _ = _peak_flops()
     fn = dict((n, f) for n, f, _ in _LEGS)[name]
@@ -1165,6 +1359,104 @@ def _run_leg_inprocess(name):
     print(json.dumps(cfg), flush=True)
 
 
+# ---------------------------------------------------------------------------
+# orchestrator hardening — two of four driver runs ended rc=124 with no
+# stdout line (round 2, round 4: tunnel degraded, leg timeouts +
+# cooldowns ate the budget, the driver wall-killed the process while a
+# fallback was still compiling). The contract is inverted now: a
+# watchdog GUARANTEES one stdout JSON line and exit 0 before a hard
+# internal deadline set under the driver budget, whatever the tunnel
+# does. Freshly measured if the headline leg finished; else the last
+# committed BENCH_DETAIL headline tagged "stale": true.
+# ---------------------------------------------------------------------------
+
+_ACTIVE_CHILD = {"proc": None}
+_HEADLINE_PRINTED = threading.Event()
+_EMIT_LOCK = threading.Lock()
+
+_PLACEHOLDER_HEADLINE = {
+    "metric": "ResNet50 train throughput (batch 128, 224x224, f32)",
+    "value": 0.0, "unit": "images/sec/chip", "vs_baseline": None}
+
+
+def _emit_headline(cfg, stale=False):
+    """The ONE stdout line the driver parses. Idempotent under the
+    main-path/watchdog race: the lock makes test-and-set atomic, so
+    exactly one caller emits."""
+    with _EMIT_LOCK:
+        if _HEADLINE_PRINTED.is_set():
+            return
+        _HEADLINE_PRINTED.set()
+    out = {"metric": cfg["metric"], "value": cfg["value"],
+           "unit": cfg["unit"], "vs_baseline": cfg.get("vs_baseline")}
+    if cfg.get("mfu") is not None:
+        out["mfu"] = cfg["mfu"]
+    if stale:
+        out["stale"] = True
+        out["stale_note"] = ("tunnel degraded this run; value is the "
+                             "last committed BENCH_DETAIL.json "
+                             "headline, not freshly measured")
+    print(json.dumps(out), flush=True)
+
+
+def _emit_best_fallback(fallback_cfg):
+    """No freshly-measured headline is coming: emit the committed
+    stale one, or the explicit zero-value placeholder on a first-ever
+    run. One helper so the watchdog and main paths cannot drift."""
+    _emit_headline(fallback_cfg if fallback_cfg is not None
+                   else _PLACEHOLDER_HEADLINE, stale=True)
+
+
+def _kill_child():
+    p = _ACTIVE_CHILD.get("proc")
+    if p is not None and p.poll() is None:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+
+def _hard_deadline(budget):
+    """Seconds of runway before the watchdog must fire. Leaves the
+    larger of 60s / 20% of budget as headroom under the driver's wall
+    clock (the driver's true budget is >= BENCH_BUDGET_SECONDS; the
+    env default is deliberately conservative). Floor of 5s keeps
+    tiny-budget rehearsals meaningful."""
+    return max(5.0, budget - max(60.0, 0.2 * budget))
+
+
+def _start_watchdog(t_start, budget, fallback_cfg, flush):
+    """Daemon thread: at the hard deadline, emit the best headline we
+    have (fresh if the main path already printed, else the committed
+    stale one), kill any in-flight leg subprocess (an orphan holding
+    the driver's stderr pipe would block its read past our exit), and
+    _exit(0). os._exit skips atexit/interpreter teardown — that is the
+    point: a wedged tunnel client cannot veto process death."""
+    deadline = t_start + _hard_deadline(budget)
+
+    def run():
+        while True:
+            left = deadline - time.perf_counter()
+            if left <= 0:
+                break
+            time.sleep(min(left, 1.0))
+        if not _HEADLINE_PRINTED.is_set():
+            _emit_best_fallback(fallback_cfg)
+        _kill_child()
+        try:
+            flush()
+        except Exception:
+            pass
+        sys.stderr.write("watchdog: hard deadline reached — exiting "
+                         "0 with the emitted headline\n")
+        sys.stderr.flush()
+        os._exit(0)
+
+    t = threading.Thread(target=run, name="bench-watchdog", daemon=True)
+    t.start()
+    return deadline
+
+
 def main():
     if "--leg" in sys.argv:
         _run_leg_inprocess(sys.argv[sys.argv.index("--leg") + 1])
@@ -1176,14 +1468,54 @@ def main():
     t_start = time.perf_counter()
     import subprocess
     here = os.path.abspath(__file__)
+    detail_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    # snapshot the COMMITTED detail headline NOW, before any flush()
+    # overwrites the file — the watchdog's stale fallback
+    fallback_cfg = None
+    try:
+        with open(detail_path) as f:
+            prev = json.load(f)
+        if prev.get("configs"):
+            fallback_cfg = prev["configs"][0]
+    except Exception:
+        pass
+
+    def noop_flush():
+        pass
+
+    # watchdog is armed BEFORE the first backend/tunnel touch: even
+    # the device-kind probe can hang on a wedged terminal
+    flush_holder = {"fn": noop_flush}
+    deadline = _start_watchdog(t_start, budget, fallback_cfg,
+                               lambda: flush_holder["fn"]())
+
+    if os.environ.get("BENCH_REHEARSE_ORCH_HANG") == "1":
+        # rehearsal: the orchestrator itself wedges right after arming
+        # the watchdog (worst case: even the device probe hangs). The
+        # watchdog must still deliver the artifact + rc 0.
+        time.sleep(1e9)
+
+    def left_to_deadline():
+        return deadline - time.perf_counter()
+
     # device kind via a SUBPROCESS: the orchestrator must not hold a
     # TPU client itself — on exclusively-locked TPUs (plain TPU VMs,
     # no tunnel) that would lock every --leg subprocess out
     try:
         kind = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].device_kind)"],
-            capture_output=True, timeout=300, check=True,
+             "import os, jax\n"
+             "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
+             "    jax.config.update('jax_platforms', 'cpu')\n"
+             "print(jax.devices()[0].device_kind)"],
+            capture_output=True,
+            # tight cap: the probe only feeds the MFU side-metric, and
+            # on a wedged tunnel every probe second is headline runway
+            # (observed: a 135s probe timeout ate a quarter of the
+            # rehearsal budget)
+            timeout=max(15, min(90, left_to_deadline() * 0.2)),
+            check=True,
         ).stdout.decode().strip().splitlines()[-1]
     except Exception:
         kind = "unknown"
@@ -1220,79 +1552,130 @@ def main():
                   "this file (flash kernels, bf16, ~0.42 MFU) and "
                   "VGG16's dense 4096-wide layers."),
               "configs": []}
-    detail_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+
+    flush_lock = threading.Lock()
 
     def flush():
         # write incrementally after EVERY leg — a driver wall-kill
-        # mid-leg must not lose captured configs
-        tmp = detail_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(detail, f, indent=2)
-        os.replace(tmp, detail_path)
+        # mid-leg must not lose captured configs. Never clobber the
+        # committed file with an EMPTY run: the watchdog's next-round
+        # stale fallback lives there. Locked: the watchdog thread
+        # also flushes at the deadline, and two writers interleaving
+        # on the same tmp file would commit corrupt JSON.
+        if not detail["configs"]:
+            return
+        with flush_lock:
+            tmp = detail_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(detail, f, indent=2)
+            os.replace(tmp, detail_path)
 
-    def _run_leg_once(name, estimate):
-        left = budget - (time.perf_counter() - t_start)
-        if left < min(estimate, 120):
-            print(f"{name} skipped: {left:.0f}s left < leg estimate "
-                  f"{estimate}s", file=sys.stderr)
+    flush_holder["fn"] = flush
+
+    def _run_leg_once(name, estimate, timeout):
+        if timeout < 60:
+            print(f"{name} skipped: {timeout:.0f}s timeout too small",
+                  file=sys.stderr)
             return "skip"
+        p = None
         try:
-            # never let one leg eat more than half the remaining budget
-            r = subprocess.run(
+            # own process GROUP: on timeout or watchdog fire the whole
+            # leg tree dies — an orphan holding our inherited stderr
+            # pipe would block the driver's read past our exit
+            p = subprocess.Popen(
                 [sys.executable, here, "--leg", name],
-                capture_output=True,
-                timeout=max(120, min(left * 0.5, estimate * 2)))
-            sys.stderr.write(r.stderr.decode(errors="replace"))
-            if r.returncode == 3:       # clean dependency skip
-                return "skip"
-            if r.returncode != 0:
-                print(f"{name} leg failed rc={r.returncode}",
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                start_new_session=True)
+            _ACTIVE_CHILD["proc"] = p
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                _kill_child()
+                try:
+                    out, err = p.communicate(timeout=10)
+                except Exception:
+                    out, err = b"", b""
+                sys.stderr.write(err.decode(errors="replace"))
+                print(f"{name} leg timed out ({timeout:.0f}s)",
                       file=sys.stderr)
                 return None
-            line = r.stdout.decode().strip().splitlines()[-1]
+            sys.stderr.write(err.decode(errors="replace"))
+            if p.returncode == 3:       # clean dependency skip
+                return "skip"
+            if p.returncode != 0:
+                print(f"{name} leg failed rc={p.returncode}",
+                      file=sys.stderr)
+                return None
+            line = out.decode().strip().splitlines()[-1]
             return json.loads(line)
-        except subprocess.TimeoutExpired:
-            print(f"{name} leg timed out", file=sys.stderr)
-            return None
         except Exception as e:
             print(f"{name} leg error: {e}", file=sys.stderr)
             return None
+        finally:
+            if p is not None and p.poll() is None:
+                _kill_child()
+            _ACTIVE_CHILD["proc"] = None
 
-    def run_leg(name, estimate):
-        cfg = _run_leg_once(name, estimate)
+    def run_leg(name, estimate, headline=False):
+        left = left_to_deadline()
+        if left < min(estimate, 120):
+            print(f"{name} skipped: {left:.0f}s to deadline < leg "
+                  f"estimate {estimate}s", file=sys.stderr)
+            return None
+        # budget-aware from leg one (round-4 failure: two 450s headline
+        # attempts + cooldown overran the driver's wall clock). The
+        # first attempt may use at most 60% of the runway to the HARD
+        # deadline (70% for the headline: the watchdog guarantees the
+        # artifact either way, and a cold tunnel compile needs the
+        # extra runway more than the retry does), so a failure always
+        # leaves room to act on.
+        cfg = _run_leg_once(name, estimate,
+                            min(left * (0.7 if headline else 0.6),
+                                estimate * 2))
         if cfg is None:
-            left = budget - (time.perf_counter() - t_start)
-            if left < 60 + min(estimate, 120):
-                # no room for cooldown + retry: don't burn the budget
-                # a later cheap leg could still use
-                print(f"{name}: failed and {left:.0f}s left — "
+            left = left_to_deadline()
+            need = 30 + min(estimate, 120)
+            if left < need + (30 if headline else 60):
+                print(f"{name}: failed and {left:.0f}s to deadline — "
                       "skipping retry", file=sys.stderr)
                 return None
             # the tunnel recovers from transient transport failures /
-            # degraded-sync episodes within a minute; one retry
-            print(f"{name}: cooling down 60s then retrying",
+            # degraded-sync episodes within a minute; one retry with a
+            # shorter cooldown for the headline (runway is precious)
+            cool = 30 if headline else 60
+            print(f"{name}: cooling down {cool}s then retrying",
                   file=sys.stderr)
-            time.sleep(60)
-            cfg = _run_leg_once(name, estimate)
+            time.sleep(cool)
+            cfg = _run_leg_once(name, estimate,
+                                min(left_to_deadline() * 0.8,
+                                    estimate * 2))
         return None if cfg == "skip" else cfg
 
     # headline first; fall back to in-process if the subprocess dies
-    head = run_leg("resnet_f32", 420)
-    if head is None:
+    head = run_leg("resnet_f32", 420, headline=True)
+    if head is None and left_to_deadline() > 120:
         # last resort: in-process (initializes the backend here — the
-        # subprocess legs already failed, so holding the client is moot)
-        _setup_xla_cache()
-        head = _leg_resnet_f32(peak)
-    detail["configs"].append(head)
-    flush()
-    # the driver consumes stdout's single JSON line — emit it NOW so a
-    # timeout in the (informational) extras can't lose the headline
-    out = {"metric": head["metric"], "value": head["value"],
-           "unit": head["unit"], "vs_baseline": head["vs_baseline"]}
-    if head.get("mfu") is not None:
-        out["mfu"] = head["mfu"]
-    print(json.dumps(out), flush=True)
+        # subprocess legs already failed, so holding the client is
+        # moot). The watchdog still guards this: if the compile wedges,
+        # the stale headline goes out at the deadline regardless.
+        try:
+            _pin_cpu_if_requested()
+            _setup_xla_cache()
+            head = _leg_resnet_f32(peak)
+        except Exception as e:
+            print(f"in-process headline fallback failed: {e}",
+                  file=sys.stderr)
+            head = None
+    if head is not None:
+        detail["configs"].append(head)
+        flush()
+        # the driver consumes stdout's single JSON line — emit it NOW
+        # so a timeout in the (informational) extras can't lose it
+        _emit_headline(head)
+    else:
+        # measured-this-run is not happening; emit the stale line
+        # immediately rather than waiting for the watchdog
+        _emit_best_fallback(fallback_cfg)
 
     if not headline_only:
         for name, _fn, estimate in _LEGS[1:]:
@@ -1301,6 +1684,8 @@ def main():
                 detail["configs"].append(cfg)
                 flush()
     flush()
+    if not _HEADLINE_PRINTED.is_set():
+        _emit_best_fallback(fallback_cfg)
 
 
 if __name__ == "__main__":
