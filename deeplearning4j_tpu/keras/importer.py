@@ -273,9 +273,10 @@ def _map_mha(cfg):
             "MultiHeadAttention '%s': attention-probability dropout "
             "%.3g is not modeled (inference identical; training "
             "differs)", cfg.get("name"), cfg.get("dropout"))
+    use_bias = bool(cfg.get("use_bias", True))
     return SelfAttentionLayer(
         n_out=H * key_dim, n_heads=H,
-        qkv_bias=bool(cfg.get("use_bias", True)),
+        qkv_bias=use_bias, out_bias=use_bias,
         name=cfg.get("name"))
 
 

@@ -493,6 +493,20 @@ class MultiLayerNetwork:
     def rnn_clear_previous_state(self):
         self._rnn_state = None
 
+    def streaming_session(self, capacity: int, batch: int,
+                          dtype=None):
+        """Jitted bounded-cache streaming inference: the TPU-first
+        counterpart to the eager ``rnn_time_step`` (same contract,
+        one compiled XLA executable per chunk length, fixed-capacity
+        KV caches updated in place — see models/streaming.py).
+        ``capacity`` is the max total sequence length the session can
+        stream before ``reset()``."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.streaming import StreamingSession
+        return StreamingSession(self, capacity, batch,
+                                dtype or jnp.float32)
+
     # ------------------------------------------------------------------
     # params plumbing (reference flat params view :542-554)
     # ------------------------------------------------------------------

@@ -41,6 +41,12 @@ class SelfAttentionLayer(BaseLayer):
     # biases on the q/k/v projections (Keras MultiHeadAttention
     # default; our native transformer blocks keep them off)
     qkv_bias: bool = False
+    # bias on the output projection. Kept separate from qkv_bias so a
+    # Keras MultiHeadAttention(use_bias=False) import has the SAME
+    # trainable surface as the source model — a zero-initialized bo
+    # matches at inference but would train a parameter Keras doesn't
+    # have (ADVICE r4)
+    out_bias: bool = True
 
     seq_parallelizable = True          # attention rides the ring
 
@@ -67,8 +73,9 @@ class SelfAttentionLayer(BaseLayer):
             "Wk": self._sample_w(kk, (self.n_in, d), self.n_in, d),
             "Wv": self._sample_w(kv, (self.n_in, d), self.n_in, d),
             "Wo": self._sample_w(ko, (d, d), d, d),
-            "bo": jnp.zeros((d,), pd),
         }
+        if self.out_bias:
+            p["bo"] = jnp.zeros((d,), pd)
         if self.qkv_bias:
             p["bq"] = jnp.zeros((d,), pd)
             p["bk"] = jnp.zeros((d,), pd)
@@ -111,7 +118,10 @@ class SelfAttentionLayer(BaseLayer):
         else:
             out = flash_attention(q, k, v, causal=self.causal)
         out = out.reshape(B, T, self.n_out)
-        return out @ params["Wo"] + params["bo"], state
+        proj = out @ params["Wo"]
+        if self.out_bias:
+            proj = proj + params["bo"]
+        return proj, state
 
     def _project_qkv(self, params, x):
         """The shared q/k/v projection (+optional biases) and head
@@ -157,8 +167,64 @@ class SelfAttentionLayer(BaseLayer):
             v_full = jnp.concatenate([cache["v"], v], axis=1)
         out = _stream_attention(q, k_full, v_full, n_cached)
         out = out.reshape(B, t, self.n_out)
-        return (out @ params["Wo"] + params["bo"],
-                {"k": k_full, "v": v_full})
+        proj = out @ params["Wo"]
+        if self.out_bias:
+            proj = proj + params["bo"]
+        return proj, {"k": k_full, "v": v_full}
+
+    # ---- jitted bounded-cache streaming (round-4 verdict weak #7:
+    #      the eager concat cache is O(T^2) copy traffic with a
+    #      dispatch per token; this variant carries a FIXED-capacity
+    #      cache with static shapes so the whole token step jits) ----
+    def zero_stream_cache(self, batch: int, capacity: int, dtype):
+        H = self.n_heads
+        Dh = self.n_out // H
+        z = jnp.zeros((batch, capacity, H, Dh), dtype)
+        return {"k": z, "v": z}
+
+    def apply_stream_bounded(self, params, cache, x, pos):
+        """One jittable decode step: ``x`` is the new (B, t, C) chunk,
+        ``cache`` a fixed-capacity {'k','v'} of shape (B, CAP, H, Dh),
+        ``pos`` the traced count of valid cached tokens. Writes the
+        chunk at [pos, pos+t) in place (dynamic_update_slice — O(t)
+        traffic, vs the eager path's O(pos) concat) and attends the
+        new queries over the full capacity with a single positional
+        mask: query i (global pos+i) sees keys k_pos <= pos+i, which
+        simultaneously hides unwritten tail slots, stale slots past
+        pos+t, and in-chunk future tokens. Returns (out, cache) —
+        the caller advances pos. Capacity bounds are the CALLER's to
+        enforce (they are static host decisions; see
+        models/streaming.py)."""
+        if not self.causal:
+            raise ValueError(
+                "apply_stream_bounded requires causal=True: streaming "
+                "non-causal attention would need future timesteps")
+        B, t, _ = x.shape
+        q, k, v = self._project_qkv(params, x)
+        zero = jnp.zeros((), jnp.int32)
+        k_cache = jax.lax.dynamic_update_slice(
+            cache["k"], k.astype(cache["k"].dtype),
+            (zero, pos, zero, zero))
+        v_cache = jax.lax.dynamic_update_slice(
+            cache["v"], v.astype(cache["v"].dtype),
+            (zero, pos, zero, zero))
+        cap = k_cache.shape[1]
+        scale = q.shape[-1] ** -0.5
+        from deeplearning4j_tpu.ops.attention import _NEG_INF
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q,
+                            k_cache.astype(q.dtype)) * scale
+        k_pos = jnp.arange(cap)[None, :]
+        q_pos = pos + jnp.arange(t)[:, None]
+        logits = jnp.where((k_pos <= q_pos)[None, None], logits,
+                           _NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         v_cache.astype(q.dtype))
+        out = out.reshape(B, t, self.n_out)
+        proj = out @ params["Wo"]
+        if self.out_bias:
+            proj = proj + params["bo"]
+        return proj, {"k": k_cache, "v": v_cache}
 
 
 @register_layer
@@ -239,6 +305,20 @@ class TransformerEncoderLayer(BaseLayer):
         self._ensure_attn()
         h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
         a, cache = self._attn.apply_stream(params["attn"], cache, h)
+        x = x + a
+        return x + self._mlp_half(params, x), cache
+
+    def zero_stream_cache(self, batch: int, capacity: int, dtype):
+        return self._ensure_attn().zero_stream_cache(batch, capacity,
+                                                     dtype)
+
+    def apply_stream_bounded(self, params, cache, x, pos):
+        """Jittable bounded-cache decode step through the pre-LN
+        block (see SelfAttentionLayer.apply_stream_bounded)."""
+        self._ensure_attn()
+        h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        a, cache = self._attn.apply_stream_bounded(params["attn"],
+                                                   cache, h, pos)
         x = x + a
         return x + self._mlp_half(params, x), cache
 

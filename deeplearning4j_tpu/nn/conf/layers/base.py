@@ -178,6 +178,11 @@ class FeedForwardLayer(BaseLayer):
     def set_n_in(self, input_type: InputType) -> None:
         if self.n_in is None:
             self.n_in = input_type.flat_size()
+        if self.n_out is None:
+            # direct initialize() must fail as loudly as the builder
+            # path (which hits the same check via output_type) — not
+            # with a TypeError from the weight sampler
+            raise ValueError(f"{type(self).__name__} requires n_out")
 
     def output_type(self, input_type: InputType) -> InputType:
         if self.n_out is None:

@@ -594,6 +594,19 @@ def _flash_masked_bwd(causal, block_q, block_k, precision, res, g):
 _flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
 
 
+def float_kv_mask(kv_mask):
+    """Cast an int/bool kv_mask to float at the public dispatch
+    boundary (flash_attention here, ring_self_attention in
+    parallel/ring_attention.py): the masked custom VJPs return a
+    zeros cotangent for the mask, and JAX requires float0 — not
+    zeros — for integer primals, so without the cast jax.grad dies
+    with a confusing custom_vjp dtype error."""
+    kv_mask = jnp.asarray(kv_mask)
+    if not jnp.issubdtype(kv_mask.dtype, jnp.floating):
+        kv_mask = kv_mask.astype(jnp.float32)
+    return kv_mask
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = 0, block_k: int = 0,
                     precision: str = "default", kv_mask=None):
@@ -612,6 +625,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if block_k <= 0:
         block_k = _auto_block(q.shape[1], q.shape[3])
     if kv_mask is not None:
+        kv_mask = float_kv_mask(kv_mask)
         return _flash_masked(q, k, v, kv_mask, causal, block_q,
                              block_k, precision)
     return _flash(q, k, v, causal, block_q, block_k, precision)

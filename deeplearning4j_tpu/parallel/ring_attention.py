@@ -479,6 +479,8 @@ def ring_self_attention(q, k, v, *, axis_name: str,
     impl = ("pallas" if jax.default_backend() == "tpu" and blk > 0
             else "jnp")
     if kv_mask is not None:
+        from deeplearning4j_tpu.ops.attention import float_kv_mask
+        kv_mask = float_kv_mask(kv_mask)
         # the mask kernel tile puts block_k on lanes: Mosaic needs it
         # 128-divisible or equal to the (local) array dim
         if impl == "pallas" and not (blk % 128 == 0

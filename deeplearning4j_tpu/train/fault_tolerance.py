@@ -63,26 +63,44 @@ _CKPT_RE = re.compile(r"ckpt_(\d+)\.zip$")
 _POS_ENTRY = "data_position.json"
 
 
-def _fingerprint(ds) -> str:
-    """Cheap content fingerprint of a batch: shape + dtype + three
-    sampled 1KB windows (head / middle / tail) of the flattened
-    feature array. Sampling windows (not just the head) catches
-    shared-BOS/padding layouts whose leading bytes are identical
-    across batches; slicing views before ``tobytes`` keeps the copy
-    at ~3KB regardless of batch size."""
-    feats = ds.features
-    if isinstance(feats, (list, tuple)):        # MultiDataSet
-        feats = feats[0]
-    a = np.asarray(feats)
+def _hash_array(h, a) -> None:
+    a = np.asarray(a)
     flat = a.reshape(-1) if a.flags.c_contiguous else a.ravel()
     k = 256
     n = flat.size
-    h = hashlib.sha1()
     h.update(str(a.shape).encode())
     h.update(str(a.dtype).encode())
     for window in (flat[:k], flat[n // 2:n // 2 + k],
                    flat[max(0, n - k):]):
         h.update(np.ascontiguousarray(window).tobytes())
+
+
+def _fingerprint(ds) -> str:
+    """Cheap content fingerprint of a batch: shape + dtype + three
+    sampled 1KB windows (head / middle / tail) of EVERY feature AND
+    label array (all of them for a MultiDataSet). Labels are folded
+    in deliberately: a replayed iterator that kept features but
+    substituted or reordered labels would otherwise pass the
+    determinism check and silently train on wrong targets. Sampling
+    windows (not just the head) catches shared-BOS/padding layouts
+    whose leading bytes are identical across batches; slicing views
+    before ``tobytes`` keeps the copy small regardless of batch
+    size."""
+    h = hashlib.sha1()
+    for group in (ds.features, getattr(ds, "labels", None)):
+        if group is None:
+            continue
+        if not isinstance(group, (list, tuple)):
+            group = (group,)
+        h.update(b"|g%d" % len(group))
+        for slot, a in enumerate(group):
+            # per-slot marker even for None: [x, None, y] must not
+            # fingerprint equal to [x, y, None]
+            h.update(b"|s%d" % slot)
+            if a is None:
+                h.update(b"<none>")
+            else:
+                _hash_array(h, a)
     return h.hexdigest()
 
 

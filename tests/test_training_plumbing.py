@@ -635,3 +635,25 @@ class TestRollbackPersistence:
         reordered = [batches[1], batches[0], batches[2]]
         with pytest.raises(RuntimeError, match="not deterministic"):
             t2.fit(reordered, until_epoch=1)
+
+    def test_fingerprint_covers_labels_and_all_arrays(self):
+        """A replay that keeps features but substitutes labels (or a
+        later MultiDataSet array) must change the fingerprint —
+        otherwise resume silently trains on wrong targets (ADVICE
+        r4)."""
+        from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+        from deeplearning4j_tpu.train.fault_tolerance import _fingerprint
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        base = _fingerprint(DataSet(x, y))
+        assert base == _fingerprint(DataSet(x.copy(), y.copy()))
+        y2 = np.roll(y, 1, axis=0)
+        assert base != _fingerprint(DataSet(x, y2))
+
+        x2 = rng.normal(0, 1, (8, 4)).astype(np.float32)
+        mbase = _fingerprint(MultiDataSet([x, x2], [y]))
+        x2b = x2.copy()
+        x2b[3] += 1.0            # second FEATURE array changes
+        assert mbase != _fingerprint(MultiDataSet([x, x2b], [y]))
+        assert mbase != _fingerprint(MultiDataSet([x, x2], [y2]))
