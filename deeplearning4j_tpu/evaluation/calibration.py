@@ -53,16 +53,17 @@ class EvaluationCalibration:
         m = np.asarray(mask)
         if timesteps is not None:
             # time series: (B, T) timestep mask, rows flattened the
-            # same way labels/predictions were
+            # same way labels/predictions were; a pre-flattened (B*T,)
+            # vector is also accepted. Anything else raises — a
+            # transposed (T, B) mask has the right SIZE but would
+            # land on the wrong (batch, time) cells.
             if m.shape == (n // timesteps, timesteps):
                 m = m.reshape(-1)
-            elif m.size == n:
-                m = m.reshape(-1)
-            else:
+            elif m.shape != (n,):
                 raise ValueError(
-                    f"time-series mask shape {mask.shape} does not "
+                    f"time-series mask shape {m.shape} does not "
                     f"match (batch, timesteps)=("
-                    f"{n // timesteps}, {timesteps})")
+                    f"{n // timesteps}, {timesteps}) or ({n},)")
             return np.broadcast_to((m > 0)[:, None], (n, c))
         if m.ndim == 1 and m.shape[0] == n:
             return np.broadcast_to((m > 0)[:, None], (n, c))

@@ -42,7 +42,7 @@ from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
 __all__ = ["LatticeDictionary", "ViterbiSegmenter",
            "LatticeCJKTokenizerFactory", "small_cjk_dictionary",
            "chinese_dictionary", "japanese_dictionary",
-           "compile_dictionary"]
+           "korean_dictionary", "compile_dictionary"]
 
 # ---------------------------------------------------------------------------
 # Dictionary file format (the Kuromoji TSV → binary pipeline analog;
@@ -195,6 +195,13 @@ class LatticeDictionary:
         return self
 
 
+# character classes whose unknown-word candidates are generated even
+# where dictionary words start (Kuromoji unknown invoke=1) — scripts
+# where unseen stems fuse with known attachments
+_ALWAYS_INVOKE = frozenset({"hangul", "katakana"})
+_UNK_MAX_LEN = 12          # bound on invoke-always candidate length
+
+
 def _char_class(ch: str) -> str:
     cp = ord(ch)
     if 0x3040 <= cp <= 0x309F:
@@ -263,6 +270,37 @@ class ViterbiSegmenter:
             # the run and every prefix (prefixes keep the DP connected
             # when a dictionary word begins mid-run)
             for end in range(i + 1, j + 1):
+                ending[end].append(_Node(
+                    i, end, text[i:end],
+                    self.unknown_cost * (1.0 + 0.3 * (end - i - 1)),
+                    "unk"))
+        # invoke-always classes (Kuromoji's unknown-word policy
+        # invoke=1 for KATAKANA; hangul added here): from every CLASS
+        #-RUN start, emit the run and its prefixes even THROUGH
+        # positions where dictionary words also start. Agglutinative
+        # scripts need this: an unseen Korean stem like 블록체인 must
+        # stay a candidate although the dictionary ending 인 starts
+        # inside it — without these nodes the only path is 블록체|인.
+        for i in range(n):
+            cls = _char_class(text[i])
+            if cls not in _ALWAYS_INVOKE:
+                continue
+            if i > 0 and _char_class(text[i - 1]) == cls:
+                continue                  # only class-run starts
+            j = i + 1
+            while j < n and _char_class(text[j]) == cls:
+                j += 1
+            # for an uncovered start the loop above already emitted
+            # prefixes up to the first covered position — only the
+            # spans BEYOND that truncation point are new
+            first = i + 1
+            if not starts_covered[i]:
+                j1 = i + 1
+                while j1 < n and not starts_covered[j1] \
+                        and _char_class(text[j1]) == cls:
+                    j1 += 1
+                first = j1 + 1
+            for end in range(first, min(j, i + _UNK_MAX_LEN) + 1):
                 ending[end].append(_Node(
                     i, end, text[i:end],
                     self.unknown_cost * (1.0 + 0.3 * (end - i - 1)),
@@ -337,6 +375,18 @@ def japanese_dictionary() -> LatticeDictionary:
     return _bundled("ja_core")
 
 
+def korean_dictionary() -> LatticeDictionary:
+    """The bundled Korean core dictionary (~900 curated morphemes:
+    josa particles + verb/adjective endings + common content words +
+    a tag-pair connection matrix — tools/build_ko_dictionary.py).
+    Korean eojeol split stem|josa / stem|ending; an out-of-dictionary
+    stem groups as one hangul unknown run that ends where a known
+    attachment begins (the reference wraps an external analyzer for
+    this, deeplearning4j-nlp-korean/.../KoreanTokenizer.java:24-40 —
+    here it is the same lattice that serves zh/ja)."""
+    return _bundled("ko_core")
+
+
 def small_cjk_dictionary() -> LatticeDictionary:
     """A small bundled dictionary (counts → costs) exercising the
     classic segmentation ambiguities. A real deployment loads a corpus
@@ -365,16 +415,18 @@ class LatticeCJKTokenizerFactory:
 
     ``dictionary``: a LatticeDictionary, a path to a ``.tsv``/
     ``.tsv.gz``/compiled ``.npz`` dictionary file, or a bundled
-    language pack name (``"zh"`` — default — / ``"ja"``). Out of the
-    box this segments real Chinese with the 65k-entry bundled
-    dictionary (reference parity: the ansj/Kuromoji packs ship inside
-    the language-pack jars)."""
+    language pack name (``"zh"`` — default — / ``"ja"`` / ``"ko"``).
+    Out of the box this segments real Chinese with the 65k-entry
+    bundled dictionary (reference parity: the ansj/Kuromoji packs
+    ship inside the language-pack jars)."""
 
     def __init__(self, dictionary=None, *, unknown_cost: float = 12.0):
         if dictionary is None or dictionary == "zh":
             dictionary = chinese_dictionary()
         elif dictionary == "ja":
             dictionary = japanese_dictionary()
+        elif dictionary == "ko":
+            dictionary = korean_dictionary()
         elif isinstance(dictionary, (str, os.PathLike)):
             dictionary = LatticeDictionary.load(dictionary)
         self.segmenter = ViterbiSegmenter(dictionary,
