@@ -156,6 +156,14 @@ class LossLayer(OutputLayer):
     """Loss without weights (nn/conf/layers/LossLayer.java): input passes
     through activation straight to the loss."""
 
+    def set_n_in(self, input_type: InputType) -> None:
+        # weightless: n_out is the input width, never user-required
+        # (the base class refuses a missing n_out)
+        if self.n_in is None:
+            self.n_in = input_type.flat_size()
+        if self.n_out is None:
+            self.n_out = self.n_in
+
     def initialize(self, key, input_type: InputType):
         self.set_n_in(input_type)
         self.n_out = self.n_in

@@ -61,22 +61,48 @@ class SpmdPipeline:
     mesh: jax Mesh with a ``pipe`` axis of size S (= #stages).
     stage_apply: ``(stage_params, h) -> h`` — one stage's compute;
         params for ALL stages are stacked on a leading S axis and
-        sharded over ``pipe``.
+        sharded over ``pipe``. With ``stateful=True`` the signature is
+        ``(stage_params, stage_state, h, key, m) -> (h, new_state)``
+        where ``key`` is the step's base rng and ``m`` the (traced)
+        microbatch index — layers fold dropout noise and thread aux
+        state (BatchNorm running stats) through it.
     embed_apply: ``(embed_params, x) -> h`` input projection, run
         replicated (heterogeneous head/tail stay out of the rotation).
-    head_loss: ``(head_params, h, y) -> scalar mean loss``.
+        Stateful: ``(embed_params, embed_state, x, key, m) ->
+        (h, new_state)``.
+    head_loss: ``(head_params, h, y) -> scalar mean loss``. Stateful:
+        ``(head_params, head_state, h, y, key, m) ->
+        (loss, new_state)``.
+
+    Stateful mode threads aux state SEQUENTIALLY in microbatch order
+    everywhere (embed and head run their microbatches under lax.scan
+    instead of vmap; each rotating stage sees its microbatches in
+    order by construction and guards updates to valid ticks), so the
+    semantics are exactly "microbatches applied one after another" —
+    the invariant the pp=1 parity tests pin down.
     """
 
     def __init__(self, mesh, stage_apply: Callable, embed_apply: Callable,
                  head_loss: Callable, *, axis: str = "pipe",
-                 n_microbatches: int = 8):
+                 n_microbatches: int = 8, stateful: bool = False):
         self.mesh = mesh
         self.axis = axis
         self.S = mesh.shape[axis]
         self.M = n_microbatches
-        self.stage_apply = stage_apply
-        self.embed_apply = embed_apply
-        self.head_loss = head_loss
+        self.stateful = stateful
+        if stateful:
+            self.stage_apply = stage_apply
+            self.embed_apply = embed_apply
+            self.head_loss = head_loss
+        else:
+            # lift the plain callables onto the stateful contract so
+            # one per_device implementation serves both modes
+            self.stage_apply = \
+                lambda p, s, h, key, m: (stage_apply(p, h), s)
+            self.embed_apply = \
+                lambda p, s, x, key, m: (embed_apply(p, x), s)
+            self.head_loss = \
+                lambda p, s, h, y, key, m: (head_loss(p, h, y), s)
 
     # -- placement helpers -------------------------------------------------
     def shard_stage_params(self, stacked):
@@ -90,55 +116,126 @@ class SpmdPipeline:
 
     # -- the train step ----------------------------------------------------
     def make_train_step(self, optimizer):
+        """Stateless mode: ``step(stage, embed, head, opt_s, opt_e,
+        opt_h, xs, ys) -> (stage, embed, head, opt_s, opt_e, opt_h,
+        loss)`` (the original signature). Stateful mode adds aux
+        state and rng:
+        ``step(stage, stage_state, embed, embed_state, head,
+        head_state, opt_s, opt_e, opt_h, xs, ys, key) ->
+        (..., states..., loss)``."""
         S, M, axis = self.S, self.M, self.axis
         stage_apply = self.stage_apply
         embed_apply = self.embed_apply
         head_loss = self.head_loss
+        stateful = self.stateful
         perm = [(i, (i + 1) % S) for i in range(S)]
 
-        def per_device(stage_params, embed_params, head_params,
-                       opt_s, opt_e, opt_h, xs, ys):
+        def per_device(stage_params, stage_state, embed_params,
+                       embed_state, head_params, head_state,
+                       opt_s, opt_e, opt_h, xs, ys, key):
             # local stage params arrive as a (1, ...) shard — drop the
             # stage axis for the stage body
             local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+            local_state = jax.tree_util.tree_map(lambda a: a[0],
+                                                 stage_state)
             dev = lax.axis_index(axis)
 
             def loss_fn(local, embed_params, head_params):
-                hs = jax.vmap(lambda x: embed_apply(embed_params, x))(xs)
+                # ---- embed: STATEFUL mode scans microbatches in
+                # order so aux state updates sequentially; stateless
+                # mode keeps the batched vmap (no serialization cost
+                # for nets with no aux state)
+                if stateful:
+                    def em(s, xm):
+                        m, x = xm
+                        h, s = embed_apply(embed_params, s, x, key, m)
+                        return s, h
+
+                    new_embed_state, hs = lax.scan(
+                        em, embed_state, (jnp.arange(M), xs))
+                else:
+                    hs = jax.vmap(
+                        lambda m, x: embed_apply(
+                            embed_params, embed_state, x, key, m)[0]
+                    )(jnp.arange(M), xs)
+                    new_embed_state = embed_state
                 # the scan carry is device-varying (each device holds a
                 # different in-flight activation) — mark it so the
                 # carry types line up under jax's varying-axes checking
                 h0 = lax.pcast(jnp.zeros_like(hs[0]), axis, to="varying")
+                st0 = jax.tree_util.tree_map(
+                    lambda a: lax.pcast(a, axis, to="varying"),
+                    local_state)
 
-                def tick(state, t):
+                def tick(carry, t):
+                    state, aux = carry
                     inject = hs[jnp.clip(t, 0, M - 1)]
                     state = jnp.where(
                         jnp.logical_and(dev == 0, t < M)[..., None],
                         inject, state)
-                    y = stage_apply(local, state)
+                    # device d sees microbatch m = t - d at tick t
+                    m = jnp.clip(t - dev, 0, M - 1)
+                    valid = jnp.logical_and(t - dev >= 0, t - dev < M)
+                    y, aux2 = stage_apply(local, aux, state, key, m)
+                    # aux (BN running stats) advances ONLY on real
+                    # microbatch ticks — bubble ticks carry garbage
+                    aux = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(valid, n, o), aux2, aux)
                     out = y                       # pre-rotation emission
                     y = lax.ppermute(y, axis, perm)
-                    return y, out
+                    return (y, aux), out
 
                 # T = M + S - 1 ticks drain the pipeline
-                _, outs = lax.scan(tick, h0, jnp.arange(M + S - 1))
+                (_, new_local_state), outs = lax.scan(
+                    tick, (h0, st0), jnp.arange(M + S - 1))
                 # the final stage's emissions for microbatch m happen at
                 # tick m + S - 1
                 final = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
-                losses = jax.vmap(
-                    lambda h, y: head_loss(head_params, h, y))(final, ys)
+
+                if stateful:
+                    def hd(s, hy):
+                        m, h, y = hy
+                        l, s = head_loss(head_params, s, h, y, key, m)
+                        return s, l
+
+                    # the head consumes device-varying activations, so
+                    # its state carry must start varying too (psum
+                    # below restores invariance from the last device's
+                    # copy)
+                    hs0 = jax.tree_util.tree_map(
+                        lambda a: lax.pcast(a, axis, to="varying"),
+                        head_state)
+                    new_head_state, losses = lax.scan(
+                        hd, hs0, (jnp.arange(M), final, ys))
+                else:
+                    losses = jax.vmap(
+                        lambda m, h, y: head_loss(
+                            head_params, head_state, h, y, key, m)[0]
+                    )(jnp.arange(M), final, ys)
+                    new_head_state = head_state
                 # only the LAST device's activations are the real model
-                # outputs; psum broadcasts its loss to everyone
+                # outputs; psum broadcasts its loss (and head state) to
+                # everyone
                 mine = jnp.where(dev == S - 1, jnp.mean(losses), 0.0)
-                return lax.psum(mine, axis)
+                if stateful:
+                    new_head_state = jax.tree_util.tree_map(
+                        lambda a: lax.psum(
+                            jnp.where(dev == S - 1, a,
+                                      jnp.zeros_like(a)),
+                            axis),
+                        new_head_state)
+                return lax.psum(mine, axis), (new_local_state,
+                                              new_embed_state,
+                                              new_head_state)
 
             # stage params are device-varying (sharded): grads stay
             # local; embed/head are replicated: jax's varying-axes AD
             # auto-psums their cotangents across devices — exactly the
             # sum of per-device contributions we need
-            loss, grads = jax.value_and_grad(
-                loss_fn, argnums=(0, 1, 2))(local, embed_params,
-                                            head_params)
+            (loss, aux_states), grads = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2), has_aux=True)(
+                local, embed_params, head_params)
+            new_local_state, new_embed_state, new_head_state = aux_states
             g_stage, g_embed, g_head = grads
             # opt state for the stage carries the same (1, ...) local
             # stage axis as the params — strip it for the update, put
@@ -149,22 +246,39 @@ class SpmdPipeline:
             new_local = optax.apply_updates(local, up_s)
             new_stage = jax.tree_util.tree_map(lambda a: a[None],
                                                new_local)
+            new_stage_state = jax.tree_util.tree_map(
+                lambda a: a[None], new_local_state)
             opt_s2 = jax.tree_util.tree_map(lambda a: a[None],
                                             opt_s2_local)
             up_e, opt_e2 = optimizer.update(g_embed, opt_e, embed_params)
             new_embed = optax.apply_updates(embed_params, up_e)
             up_h, opt_h2 = optimizer.update(g_head, opt_h, head_params)
             new_head = optax.apply_updates(head_params, up_h)
-            return (new_stage, new_embed, new_head, opt_s2, opt_e2,
-                    opt_h2, loss)
+            return (new_stage, new_stage_state, new_embed,
+                    new_embed_state, new_head, new_head_state,
+                    opt_s2, opt_e2, opt_h2, loss)
 
         smapped = shard_map(
             per_device, mesh=self.mesh,
-            in_specs=(P(self.axis), P(), P(), P(self.axis), P(), P(),
-                      P(), P()),
-            out_specs=(P(self.axis), P(), P(), P(self.axis), P(), P(),
-                       P()))
-        return jax.jit(smapped, donate_argnums=(0, 1, 2, 3, 4, 5))
+            in_specs=(P(self.axis), P(self.axis), P(), P(), P(), P(),
+                      P(self.axis), P(), P(), P(), P(), P()),
+            out_specs=(P(self.axis), P(self.axis), P(), P(), P(), P(),
+                       P(self.axis), P(), P(), P()))
+        full = jax.jit(smapped,
+                       donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+        if self.stateful:
+            return full
+
+        # stateless compatibility wrapper: the original signature
+        dummy_key = jax.random.PRNGKey(0)
+
+        def step(stage, embed, head, opt_s, opt_e, opt_h, xs, ys):
+            (stage, _, embed, _, head, _, opt_s, opt_e, opt_h,
+             loss) = full(stage, {}, embed, {}, head, {},
+                          opt_s, opt_e, opt_h, xs, ys, dummy_key)
+            return stage, embed, head, opt_s, opt_e, opt_h, loss
+
+        return step
 
     def init_opt_states(self, optimizer, stage_params, embed_params,
                         head_params):
@@ -227,10 +341,23 @@ class NetworkSpmdPipeline:
     mean for uniform microbatches, so training MATCHES the
     single-device step (asserted by dryrun regime 9 / tests).
 
+    Stateful layers (BatchNorm running stats) and dropout are
+    first-class (round-4 verdict next #3): aux state is threaded
+    sequentially in microbatch order (stage-local on each device,
+    scan-carried in the replicated prefix/suffix), and dropout noise
+    folds a per-step base key with the ABSOLUTE layer index and the
+    microbatch index — both partition-independent, so pp=S training
+    is bit-comparable to pp=1 on the same microbatch schedule (the
+    parity the tests/dryrun assert). Note the semantics are
+    "microbatches applied sequentially": BN normalizes each
+    microbatch by its own batch statistics, exactly like a
+    single-device loop over the M microbatches — NOT like one
+    full-batch step (the standard pipeline-parallel BN contract).
+
     Limits (fail loudly): the net must end in a loss layer, carry no
-    input preprocessors, masks, stateful layers (BN), dropout (the
-    bridge runs rng-free), or gradient normalization; the identical
-    run must cover at least S layers.
+    input preprocessors, masks, or gradient normalization /
+    clipping / constraints / per-layer updaters; the identical run
+    must cover at least S layers.
     """
 
     def __init__(self, model, mesh, *, axis: str = "pipe",
@@ -243,22 +370,11 @@ class NetworkSpmdPipeline:
                 f"got {type(model).__name__}")
         if model.params is None:
             model.init()
-        if getattr(model.conf, "preprocessors", None):
-            raise ValueError("input preprocessors are not supported on "
-                             "the device-resident pipeline")
         layers = model.layers
         if not layers[-1].has_loss():
             raise ValueError("last layer has no loss — the pipeline "
                              "head needs one")
         for i, (l, s) in enumerate(zip(layers, model.state)):
-            if jax.tree_util.tree_leaves(s):
-                raise ValueError(
-                    f"layer {i} ({type(l).__name__}) carries state "
-                    "(e.g. BatchNorm) — not supported device-resident")
-            if getattr(l, "dropout", 0.0):
-                raise ValueError(
-                    f"layer {i} ({type(l).__name__}) uses dropout — "
-                    "the device-resident bridge runs rng-free")
             if getattr(l, "gradient_normalization", None):
                 raise ValueError(
                     f"layer {i} ({type(l).__name__}) configures "
@@ -306,6 +422,18 @@ class NetworkSpmdPipeline:
                 "use the GPipe scheduler (parallel/pipeline.py) for "
                 "heterogeneous stacks")
         end = start + n_run
+        preprocessors = dict(getattr(model.conf, "preprocessors",
+                                     None) or {})
+        # preprocessors are pure functions: they fold into the
+        # replicated prefix/suffix applies. STRICTLY inside the
+        # rotating run they would break the stages' homogeneity.
+        for p in preprocessors:
+            if start < p < end:
+                raise ValueError(
+                    f"input preprocessor at layer {p} sits inside the "
+                    f"rotating stage run [{start}, {end}) — not "
+                    "supported device-resident; use the GPipe "
+                    "scheduler")
         self.model = model
         self.mesh = mesh
         self._start, self._end = start, end
@@ -315,70 +443,163 @@ class NetworkSpmdPipeline:
         prefix = layers[:start]
         suffix = layers[end:-1]
         out_layer = layers[-1]
+        out_idx = len(layers) - 1
         n_per = self._n_per
 
-        def stage_apply(p, h):
-            # p leaves: (n_per, ...) — apply the folded layers in order
+        def fold(key, layer_idx, m):
+            # dropout noise keyed by ABSOLUTE layer index + microbatch
+            # index: both are partition-independent, so pp=S matches
+            # pp=1 exactly (the parity contract)
+            return jax.random.fold_in(jax.random.fold_in(
+                key, layer_idx), m)
+
+        def stage_apply(p, s, h, key, m):
+            # p/s leaves: (n_per, ...) — apply the folded layers in
+            # order, threading each one's aux state
+            dev = lax.axis_index(axis)
+            new_s = s
             for i in range(n_per):
                 pi = jax.tree_util.tree_map(lambda a: a[i], p)
-                h, _ = block_layer.apply(pi, {}, h, training=True,
-                                         rng=None)
-            return h
+                si = jax.tree_util.tree_map(lambda a: a[i], new_s)
+                gidx = start + dev * n_per + i
+                h, si2 = block_layer.apply(
+                    pi, si, h, training=True, rng=fold(key, gidx, m))
+                new_s = jax.tree_util.tree_map(
+                    lambda full, upd, ii=i: full.at[ii].set(upd),
+                    new_s, si2)
+            return h, new_s
 
-        def embed_apply(ep, x):
+        def embed_apply(ep, es, x, key, m):
             h = x
-            for l, p in zip(prefix, ep):
-                h, _ = l.apply(p, {}, h, training=True, rng=None)
-            return h
+            out_states = []
+            for idx, (l, p, s) in enumerate(zip(prefix, ep, es)):
+                if idx in preprocessors:
+                    h = preprocessors[idx](h)
+                h, s2 = l.apply(p, s, h, training=True,
+                                rng=fold(key, idx, m))
+                out_states.append(s2)
+            if start in preprocessors:   # feeds the run's first layer
+                h = preprocessors[start](h)
+            return h, tuple(out_states)
 
-        def head_loss(hp, h, y):
-            for l, p in zip(suffix, hp[:-1]):
-                h, _ = l.apply(p, {}, h, training=True, rng=None)
-            return out_layer.loss_from_input(hp[-1], h, y,
-                                             training=True, rng=None)
+        def head_loss(hp, hs, h, y, key, m):
+            out_states = []
+            for j, (l, p, s) in enumerate(zip(suffix, hp[:-1], hs)):
+                if end + j in preprocessors:
+                    h = preprocessors[end + j](h)
+                h, s2 = l.apply(p, s, h, training=True,
+                                rng=fold(key, end + j, m))
+                out_states.append(s2)
+            if out_idx in preprocessors:
+                h = preprocessors[out_idx](h)
+            loss = out_layer.loss_from_input(
+                hp[-1], h, y, training=True,
+                rng=fold(key, out_idx, m))
+            return loss, tuple(out_states)
 
-        self.pipe = SpmdPipeline(mesh, stage_apply, embed_apply,
-                                 head_loss, axis=axis,
-                                 n_microbatches=n_microbatches)
-        # stack the run's params: leaves (N, ...) → (S, n_per, ...)
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *model.params[start:end])
-        stacked = jax.tree_util.tree_map(
-            lambda a: a.reshape((S, n_per) + a.shape[1:]), stacked)
+        # stateful machinery (sequential state scans, rng plumbing)
+        # only when the net needs it: a state-free dropout-free net
+        # keeps the batched vmap embed/head and the cheaper step
+        needs_state = any(jax.tree_util.tree_leaves(s)
+                          for s in model.state)
+        needs_rng = any(getattr(l, "dropout", 0.0) for l in layers)
+        self._stateful = needs_state or needs_rng
+        if self._stateful:
+            self.pipe = SpmdPipeline(mesh, stage_apply, embed_apply,
+                                     head_loss, axis=axis,
+                                     n_microbatches=n_microbatches,
+                                     stateful=True)
+        else:
+            dummy = jax.random.PRNGKey(0)
+            empty_run = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *model.state[start:end])
+            empty_run = jax.tree_util.tree_map(
+                lambda a: a.reshape((1,) + a.shape), empty_run)
+            zero = jnp.int32(0)
+            self.pipe = SpmdPipeline(
+                mesh,
+                lambda p, h: stage_apply(p, empty_run, h, dummy,
+                                         zero)[0],
+                lambda p, x: embed_apply(
+                    p, tuple(model.state[:start]), x, dummy, zero)[0],
+                lambda p, h, y: head_loss(
+                    p, tuple(model.state[end:-1]), h, y, dummy,
+                    zero)[0],
+                axis=axis, n_microbatches=n_microbatches,
+                stateful=False)
+        # stack the run's params AND states: leaves (N, ...) →
+        # (S, n_per, ...)
+        def stack_run(trees):
+            t = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                       *trees)
+            return jax.tree_util.tree_map(
+                lambda a: a.reshape((S, n_per) + a.shape[1:]), t)
+
+        stacked = stack_run(model.params[start:end])
         self._stage = self.pipe.shard_stage_params(stacked)
+        self._stage_state = self.pipe.shard_stage_params(
+            stack_run(model.state[start:end]))
         self._embed = self.pipe.replicate(
             tuple(model.params[:start]))
+        self._embed_state = self.pipe.replicate(
+            tuple(model.state[:start]))
         self._head = self.pipe.replicate(
             tuple(model.params[end:]))
+        # head state excludes the out layer (loss_from_input is
+        # stateless); keep the slice aligned with the suffix layers
+        self._head_state = self.pipe.replicate(
+            tuple(model.state[end:-1]))
         opt = model._optimizer
         self._opt_s, self._opt_e, self._opt_h = \
             self.pipe.init_opt_states(opt, stacked,
                                       tuple(model.params[:start]),
                                       tuple(model.params[end:]))
         self._step = self.pipe.make_train_step(opt)
+        self._base_key = model._rng_key if getattr(
+            model, "_rng_key", None) is not None \
+            else jax.random.PRNGKey(0)
 
     def train_batch(self, x, y) -> float:
         """One optimizer step over (B, ...) arrays; B must divide by
         n_microbatches. Returns the batch mean loss."""
         xs, ys = self.pipe.microbatch(x, y)
-        (self._stage, self._embed, self._head, self._opt_s,
-         self._opt_e, self._opt_h, loss) = self._step(
-            self._stage, self._embed, self._head, self._opt_s,
-            self._opt_e, self._opt_h, xs, ys)
+        if self._stateful:
+            key = jax.random.fold_in(self._base_key,
+                                     self.model.iteration_count)
+            (self._stage, self._stage_state, self._embed,
+             self._embed_state, self._head, self._head_state,
+             self._opt_s, self._opt_e, self._opt_h, loss) = self._step(
+                self._stage, self._stage_state, self._embed,
+                self._embed_state, self._head, self._head_state,
+                self._opt_s, self._opt_e, self._opt_h, xs, ys, key)
+        else:
+            (self._stage, self._embed, self._head, self._opt_s,
+             self._opt_e, self._opt_h, loss) = self._step(
+                self._stage, self._embed, self._head, self._opt_s,
+                self._opt_e, self._opt_h, xs, ys)
         self.model.iteration_count += 1
         self.model.score_value = loss
         return float(loss)
 
     def collect_params(self):
-        """Write the trained params back into ``model.params`` in
-        layer order (the PipelineParallel.collect_params analog)."""
-        stage = jax.device_get(self._stage)
-        flatwise = jax.tree_util.tree_map(
-            lambda a: a.reshape((self._S * self._n_per,) + a.shape[2:]),
-            stage)
-        run = [jax.tree_util.tree_map(lambda a: a[i], flatwise)
-               for i in range(self._S * self._n_per)]
-        embed = list(jax.device_get(self._embed))
-        head = list(jax.device_get(self._head))
-        self.model.params = embed + run + head
+        """Write the trained params AND aux states back into the
+        model in layer order (the PipelineParallel.collect_params
+        analog)."""
+        def unstack_run(tree):
+            flat = jax.tree_util.tree_map(
+                lambda a: a.reshape((self._S * self._n_per,)
+                                    + a.shape[2:]), tree)
+            return [jax.tree_util.tree_map(lambda a: a[i], flat)
+                    for i in range(self._S * self._n_per)]
+
+        start, end = self._start, self._end
+        self.model.params = (
+            list(jax.device_get(self._embed))
+            + unstack_run(jax.device_get(self._stage))
+            + list(jax.device_get(self._head)))
+        self.model.state = (
+            list(jax.device_get(self._embed_state))
+            + unstack_run(jax.device_get(self._stage_state))
+            + list(jax.device_get(self._head_state))
+            + [self.model.state[-1]])      # out layer: stateless
         return self.model

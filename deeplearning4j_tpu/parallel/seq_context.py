@@ -27,7 +27,8 @@ import contextlib
 import threading
 from typing import Optional
 
-__all__ = ["sequence_parallel", "current_seq_axis",
+__all__ = ["sequence_parallel", "sequence_parallel_gspmd",
+           "current_seq_axis", "current_seq_mesh",
            "current_loss_axes"]
 
 _tls = threading.local()
@@ -38,25 +39,71 @@ def current_seq_axis() -> Optional[str]:
     return getattr(_tls, "axis", None)
 
 
+def current_seq_mesh():
+    """The mesh of a GSPMD-mode sequence-parallel trace, or None.
+
+    Two execution modes share the seq seam:
+
+    - **manual** (``sequence_parallel``): the WRAPPER traces the whole
+      step inside one shard_map; layer code sees local chunks and the
+      attention layer calls ``ring_self_attention`` directly (it is
+      already inside the manual region). ``current_seq_mesh()`` is
+      None.
+    - **GSPMD** (``sequence_parallel_gspmd``): the step is a plain jit
+      with GSPMD partitioning every axis (data/model/seq), and ONLY
+      the ring needs manual collectives — the attention layer opens
+      its own shard_map island over just the seq axis (jax
+      ``axis_names={seq}``; other axes stay automatic). This is what
+      makes seq COMPOSABLE with tensor parallelism: Megatron-sharded
+      projections stay GSPMD while the ring rides its island.
+    """
+    return getattr(_tls, "mesh", None)
+
+
 def current_loss_axes():
     """Mesh axes the BATCH is sharded over (e.g. ('data', 'seq')), or
     None outside a sequence-parallel trace. Masked time-distributed
     losses consult this: the masked mean's denominator is a GLOBAL
     count (shards hold different numbers of unmasked steps), so the
     loss layer psums the count over these axes and scales so that the
-    wrapper's mean-of-local-losses equals the global masked mean."""
+    wrapper's mean-of-local-losses equals the global masked mean.
+    (GSPMD mode leaves this None on purpose: the loss computes on
+    global logical arrays and XLA already yields the global mean.)"""
     return getattr(_tls, "loss_axes", None)
 
 
 @contextlib.contextmanager
 def sequence_parallel(axis_name: str, loss_axes=None):
-    """Activate sequence-parallel routing while tracing a step."""
+    """Activate MANUAL sequence-parallel routing while tracing a step
+    (inside the wrapper's shard_map)."""
     prev = getattr(_tls, "axis", None)
     prev_axes = getattr(_tls, "loss_axes", None)
+    prev_mesh = getattr(_tls, "mesh", None)
     _tls.axis = axis_name
     _tls.loss_axes = loss_axes
+    _tls.mesh = None
     try:
         yield
     finally:
         _tls.axis = prev
         _tls.loss_axes = prev_axes
+        _tls.mesh = prev_mesh
+
+
+@contextlib.contextmanager
+def sequence_parallel_gspmd(mesh, axis_name: str = "seq"):
+    """Activate GSPMD-mode sequence-parallel routing: the attention
+    layers open shard_map islands over ``axis_name`` on ``mesh``;
+    everything else partitions automatically (composes with dp/tp)."""
+    prev = getattr(_tls, "axis", None)
+    prev_axes = getattr(_tls, "loss_axes", None)
+    prev_mesh = getattr(_tls, "mesh", None)
+    _tls.axis = axis_name
+    _tls.loss_axes = None
+    _tls.mesh = mesh
+    try:
+        yield
+    finally:
+        _tls.axis = prev
+        _tls.loss_axes = prev_axes
+        _tls.mesh = prev_mesh

@@ -42,13 +42,11 @@ logger = logging.getLogger("deeplearning4j_tpu")
 __all__ = ["ParallelWrapper", "GraphParallelWrapper"]
 
 
-def _spmd_update_tail(model, is_graph, optimizer, grads, new_state,
-                      loss, opt_state, params, axes):
-    """Shared per-device tail of the explicit shard_map train steps
-    (compressed-DCN and sequence-parallel): gradient normalization →
-    optimizer → per-layer constraints, then merge the per-device aux
-    state (BN stats, centers — average floats / max ints) and pmean
-    the loss so the replicated out-specs hold."""
+def _grad_update(model, is_graph, optimizer, grads, opt_state, params):
+    """Gradient normalization → optimizer → per-layer constraints:
+    the single update path every wrapper step variant (plain GSPMD
+    seq, manual seq, compressed) routes through so a fix here applies
+    to all of them."""
     import optax
 
     from deeplearning4j_tpu.train.constraints import (
@@ -71,6 +69,18 @@ def _spmd_update_tail(model, is_graph, optimizer, grads, new_state,
     else:
         new_params = [apply_layer_constraints(l, p)
                       for l, p in zip(model.layers, new_params)]
+    return new_params, new_opt
+
+
+def _spmd_update_tail(model, is_graph, optimizer, grads, new_state,
+                      loss, opt_state, params, axes):
+    """Shared per-device tail of the explicit shard_map train steps
+    (compressed-DCN and sequence-parallel): the common update path,
+    then merge the per-device aux state (BN stats, centers — average
+    floats / max ints) and pmean the loss so the replicated
+    out-specs hold."""
+    new_params, new_opt = _grad_update(model, is_graph, optimizer,
+                                       grads, opt_state, params)
     new_state = jax.tree_util.tree_map(
         lambda s: (jax.lax.pmean(s, axes)
                    if jnp.issubdtype(s.dtype, jnp.floating)
@@ -98,6 +108,7 @@ class ParallelWrapper:
         self._compressed_step = None
         self._seq_step = None
         self._seq_collapses = False   # set by _validate_seq_model
+        self._seq_gspmd = False       # set by _validate_seq_model
         self._residual = None
 
     # ---- builder parity ----
@@ -222,19 +233,19 @@ class ParallelWrapper:
             ComputationGraph)
         from deeplearning4j_tpu.models.multi_layer_network import (
             MultiLayerNetwork)
-        if self.dcn_compression is not None:
-            raise NotImplementedError("dcn_compression + seq axis not "
-                                      "supported yet")
         extra = [a for a in self.mesh.axis_names
                  if a not in ("data", "seq") and self.mesh.shape[a] > 1]
-        if extra:
-            # param cotangents psum over EVERY mesh axis; axes the seq
-            # step doesn't normalize for would silently scale gradients
+        if self.dcn_compression is not None and extra:
             raise NotImplementedError(
-                "sequence-parallel training supports 'data' x 'seq' "
-                f"meshes only; mesh also carries {extra} — combine "
-                "seq with tensor/pipeline parallelism via the "
-                "functional APIs for now")
+                "dcn_compression composes with 'data' x 'seq' meshes "
+                f"(manual step); mesh also carries {extra}")
+        # dp x seq runs the manual all-shard_map step; any further
+        # axis (tensor-parallel 'model') switches to the GSPMD step:
+        # plain jit partitions data/model automatically and the
+        # attention layers open ring islands over just 'seq'
+        # (seq_context.sequence_parallel_gspmd) — that is how
+        # dp x tp x sp composes on one mesh (round-4 verdict next #4)
+        self._seq_gspmd = bool(extra)
         self._seq_collapses = False      # recomputed per validation
         if isinstance(self.model, ComputationGraph):
             # layers AND vertices self-declare time-pointwiseness via
@@ -334,7 +345,14 @@ class ParallelWrapper:
         stay replicated; AD psums their cotangents over every mesh
         axis, so dividing by the shard count yields the exact global
         mean gradient — sp training matches the single-device step to
-        float tolerance (dryrun regime 8 asserts it)."""
+        float tolerance (dryrun regime 8 asserts it).
+
+        With ``dcn_compression`` the data-axis reduction is
+        intercepted: params are marked device-varying over 'data'
+        ONLY, so AD auto-psums the seq cotangent in full precision
+        (intra-slice ICI) while the int8 + threshold + residual-error-
+        feedback reduce runs over 'data' — the DCN-spanning axis the
+        compression exists for."""
         from deeplearning4j_tpu.models.computation_graph import (
             ComputationGraph)
         from deeplearning4j_tpu.parallel.seq_context import (
@@ -352,39 +370,118 @@ class ParallelWrapper:
         nshards = 1
         for a in axes:
             nshards *= mesh.shape[a]
+        compressed = self.dcn_compression is not None
+        if compressed:
+            from deeplearning4j_tpu.parallel.compression import (
+                make_compressed_psum_ef)
+            psum_ef = make_compressed_psum_ef(
+                float(self.dcn_compression.get("threshold", 0.0)))
 
-        def per_device(params, state, opt_state, batch, base_rng, step):
+        def per_device(params, state, opt_state, residual, batch,
+                       base_rng, step):
             rng = jax.random.fold_in(base_rng, step)
             # decorrelate dropout across every shard (data AND seq —
             # two time-chunks of one example are distinct positions)
             for ax in axes:
                 rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+            if compressed:
+                residual = jax.tree_util.tree_map(lambda r: r[0],
+                                                  residual)
+                # varying over 'data' only: the seq cotangent still
+                # auto-psums (full precision, ICI); the data-axis
+                # reduction is ours to compress
+                params_in = jax.tree_util.tree_map(
+                    lambda p: jax.lax.pcast(p, "data", to="varying"),
+                    params)
+            else:
+                params_in = params
             with sequence_parallel("seq", loss_axes=axes):
                 def loss_fn(p):
                     return model._loss(p, state, batch, rng,
                                        training=True)
 
                 (loss, new_state), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params)
-            # params are mesh-invariant, so AD already psummed the
-            # cotangent over every axis: grads == Σ_shards ∂(local
+                    loss_fn, has_aux=True)(params_in)
+            # grads on each data shard: Σ over seq shards of ∂(local
             # mean loss); the global loss is the MEAN of the uniform
-            # local means — normalize
+            # local means — normalize by the full shard count
             grads = jax.tree_util.tree_map(lambda g: g / nshards, grads)
-            return _spmd_update_tail(model, is_graph, optimizer, grads,
-                                     new_state, loss, opt_state, params,
-                                     axes)
+            if compressed:
+                grads, new_residual = psum_ef(grads, residual, "data")
+            new_params, new_state, new_opt, loss = _spmd_update_tail(
+                model, is_graph, optimizer, grads, new_state, loss,
+                opt_state, params, axes)
+            if compressed:
+                new_residual = jax.tree_util.tree_map(
+                    lambda r: r[None], new_residual)
+                return new_params, new_state, new_opt, new_residual, \
+                    loss
+            return new_params, new_state, new_opt, loss
 
         daxis = "data" if "data" in mesh.axis_names else None
         bspec_t = P(daxis, "seq")              # temporal leaves
         # labels of a time-collapsing net are (B, K): batch-axis only
         bspec_l = P(daxis) if self._seq_collapses else bspec_t
-        smapped = shard_map(per_device, mesh=mesh,
-                            in_specs=(P(), P(), P(),
-                                      (bspec_t, bspec_l, bspec_t,
-                                       bspec_l), P(), P()),
+        bspec = (bspec_t, bspec_l, bspec_t, bspec_l)
+        if compressed:
+            smapped = shard_map(
+                per_device, mesh=mesh,
+                in_specs=(P(), P(), P(), P("data"), bspec, P(), P()),
+                out_specs=(P(), P(), P(), P("data"), P()))
+            return jax.jit(smapped, donate_argnums=(0, 1, 2, 3))
+
+        def no_residual(params, state, opt_state, batch, base_rng,
+                        step):
+            return per_device(params, state, opt_state, None, batch,
+                              base_rng, step)
+
+        smapped = shard_map(no_residual, mesh=mesh,
+                            in_specs=(P(), P(), P(), bspec, P(), P()),
                             out_specs=(P(), P(), P(), P()))
         return jax.jit(smapped, donate_argnums=(0, 1, 2))
+
+    def _make_seq_gspmd_step(self):
+        """Sequence-parallel step for meshes that ALSO carry other
+        sharded axes (tensor-parallel 'model'): a plain jit — GSPMD
+        partitions params (tp shardings preserved), batch (B→'data',
+        T→'seq') and every pointwise op automatically, computing
+        global-mean losses and auto-psumming replicated-param
+        cotangents — traced under ``sequence_parallel_gspmd`` so the
+        attention layers open manual ring islands over just 'seq'.
+        No manual normalization is needed: the loss IS the global
+        mean, so gradients match the single-device step to float
+        tolerance (dryrun regime 11 asserts dp=2 x tp=2 x sp=2)."""
+        import functools
+
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
+        from deeplearning4j_tpu.parallel.seq_context import (
+            sequence_parallel_gspmd)
+
+        model = self.model
+        mesh = self.mesh
+        is_graph = isinstance(model, ComputationGraph)
+        optimizer = model._optimizer
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step(params, state, opt_state, batch, base_rng, step):
+            # the context is entered INSIDE the jitted body so every
+            # (re)trace sees the routing, not just the first call
+            with sequence_parallel_gspmd(mesh, "seq"):
+                rng = jax.random.fold_in(base_rng, step)
+
+                def loss_fn(p):
+                    return model._loss(p, state, batch, rng,
+                                       training=True)
+
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                new_params, new_opt = _grad_update(
+                    model, is_graph, optimizer, grads, opt_state,
+                    params)
+            return new_params, new_state, new_opt, loss
+
+        return train_step
 
     def _shard_seq_batch(self, batch):
         """Every batch leaf (B, T, ...) → B over 'data', T over 'seq'
@@ -475,7 +572,9 @@ class ParallelWrapper:
         if seq_parallel:
             self._validate_seq_model()
             if self._seq_step is None:
-                self._seq_step = self._make_seq_step()
+                self._seq_step = (self._make_seq_gspmd_step()
+                                  if self._seq_gspmd
+                                  else self._make_seq_step())
             step = self._seq_step
         elif compressed:
             if self._compressed_step is None:

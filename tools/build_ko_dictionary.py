@@ -205,11 +205,20 @@ HEADER = """\
 def main():
     buf = io.StringIO()
     buf.write(HEADER)
-    seen = set()
+    # The lattice dictionary holds ONE (cost, tag) per surface form,
+    # so ambiguous morphemes (은/는/을 are both josa and verb endings;
+    # 시간/년/월/일 both nouns and counters) keep their FIRST listing —
+    # the add() calls above are ordered most-common-role-first on
+    # purpose. Dropped duplicates are printed so a curation change
+    # that silently loses a tag is visible.
+    seen = {}
+    dropped = []
     for w, c, t in entries:
         if w in seen:
+            if seen[w] != t:
+                dropped.append(f"{w} ({t}; kept {seen[w]})")
             continue
-        seen.add(w)
+        seen[w] = t
         buf.write(f"{w}\t{c}\t{t}\n")
     for l, r, c in CONNS:
         buf.write(f"@conn\t{l}\t{r}\t{c}\n")
@@ -222,6 +231,9 @@ def main():
                            mtime=0) as f:
             f.write(buf.getvalue().encode("utf-8"))
     print(f"{out}: {len(seen)} entries")
+    if dropped:
+        print(f"dropped {len(dropped)} ambiguous-role duplicates "
+              f"(first-listed role wins): {', '.join(dropped)}")
 
 
 if __name__ == "__main__":
