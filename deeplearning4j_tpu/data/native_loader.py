@@ -190,13 +190,15 @@ class NativeCSVDataSetIterator(DataSetIterator):
         self._open()
         lab_width = (0 if self.label_index < 0
                      else (self.num_classes or 1))
-        feat = np.empty((self._bs, self.n_features), np.float32)
-        lab = np.empty((self._bs, lab_width), np.float32) \
-            if lab_width else None
         try:
             while True:
                 if self._handle is None:
                     return      # reset() mid-iteration: stop cleanly
+                # fresh arrays per batch (hand-off, no second copy —
+                # see the image iterator's note)
+                feat = np.empty((self._bs, self.n_features), np.float32)
+                lab = np.empty((self._bs, lab_width), np.float32) \
+                    if lab_width else None
                 n = self._lib.dl4j_loader_next(
                     self._handle,
                     feat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
@@ -204,8 +206,12 @@ class NativeCSVDataSetIterator(DataSetIterator):
                     if lab is not None else None)
                 if n <= 0:
                     return
-                yield DataSet(feat[:n].copy(),
-                              lab[:n].copy() if lab is not None else None)
+                if n == self._bs:
+                    yield DataSet(feat, lab)
+                else:
+                    yield DataSet(feat[:n].copy(),
+                                  lab[:n].copy() if lab is not None
+                                  else None)
         finally:
             self._close()
 
@@ -309,20 +315,30 @@ class NativeImageDataSetIterator(DataSetIterator):
         self._close()
         self._open()
         n_classes = len(self._classes)
-        feat = np.empty((self._bs, self.height, self.width,
-                         self.channels), np.float32)
-        lab = np.empty((self._bs, n_classes), np.float32)
         try:
             while True:
                 if self._handle is None:
                     return      # reset() mid-iteration: stop cleanly
+                # FRESH arrays per batch: the native side memcpys
+                # once (GIL released during the ctypes call) and the
+                # arrays are handed off as-is — the old reusable
+                # buffer forced a second 60MB Python-side .copy()
+                # per batch, which was the dominant EXPOSED cost
+                # under decode-ahead overlap (bench leg
+                # overlap_exposed)
+                feat = np.empty((self._bs, self.height, self.width,
+                                 self.channels), np.float32)
+                lab = np.empty((self._bs, n_classes), np.float32)
                 n = self._lib.dl4j_image_loader_next(
                     self._handle,
                     feat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                     lab.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
                 if n <= 0:
                     return
-                yield DataSet(feat[:n].copy(), lab[:n].copy())
+                if n == self._bs:
+                    yield DataSet(feat, lab)
+                else:           # trailing partial batch
+                    yield DataSet(feat[:n].copy(), lab[:n].copy())
         finally:
             self._close()
 
