@@ -540,6 +540,18 @@ class ComputationGraph:
     def rnn_clear_previous_state(self):
         self._rnn_state = None
 
+    def streaming_session(self, capacity: int, batch: int,
+                          dtype=None):
+        """Jitted bounded-cache streaming inference over the graph
+        topology — the TPU-first counterpart to the eager
+        ``rnn_time_step`` (see models/streaming.py)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.streaming import (
+            GraphStreamingSession)
+        return GraphStreamingSession(self, capacity, batch,
+                                     dtype or jnp.float32)
+
     # ------------------------------------------------------------------
     # layerwise pretraining (reference ComputationGraph.pretrain
     # :652,664: each pretrainable layer vertex is trained on its own

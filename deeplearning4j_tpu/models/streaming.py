@@ -1,19 +1,20 @@
 """Jitted bounded-cache streaming inference (rnnTimeStep, compiled).
 
-``MultiLayerNetwork.rnn_time_step`` (reference
-MultiLayerNetwork.java:2656) is deliberately eager: it matches the
-reference contract, grows attention KV caches by concat, and pays a
-Python dispatch per token-step — fine for debugging, wrong as a TPU
-inference path (round-4 verdict weak #7: O(T^2) total copy traffic).
+``rnn_time_step`` on both executors (reference
+MultiLayerNetwork.java:2656, ComputationGraph.java:2358) is
+deliberately eager: it matches the reference contract, grows attention
+KV caches by concat, and pays a Python dispatch per token-step — fine
+for debugging, wrong as a TPU inference path (round-4 verdict weak #7:
+O(T^2) total copy traffic).
 
-``StreamingSession`` is the TPU-first variant: every stream carry has
-a STATIC shape — attention layers get a fixed-capacity KV cache
-written in place with ``lax.dynamic_update_slice`` (O(t) traffic per
-step), recurrent layers carry their usual state — so one XLA
-executable per chunk length covers the whole decode, with a single
-device dispatch per step and no retrace as the sequence grows.
+The sessions here are the TPU-first variant: every stream carry has a
+STATIC shape — attention layers get a fixed-capacity KV cache written
+in place with ``lax.dynamic_update_slice`` (O(t) traffic per step),
+recurrent layers carry their usual state — so one XLA executable per
+chunk length covers the whole decode, with a single device dispatch
+per step and no retrace as the sequence grows.
 
-Chunk lengths are compile-time buckets: the session caches one
+Chunk lengths are compile-time buckets: a session caches one
 executable per distinct chunk length it sees (a decode loop uses
 exactly one, t=1; a prompt prefill adds one more). Keep chunk sizes
 consistent — every new length is a new compile.
@@ -21,16 +22,81 @@ consistent — every new length is a new compile.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-__all__ = ["StreamingSession"]
+__all__ = ["StreamingSession", "GraphStreamingSession"]
 
 
-class StreamingSession:
+class _BoundedSession:
+    """Shared machinery of both executors' sessions: the
+    chunk-length-keyed executable cache, position/capacity/batch
+    bookkeeping, and device-side autoregressive generation."""
+
+    def __init__(self, capacity: int, batch: int):
+        self.capacity = int(capacity)
+        self.batch = int(batch)
+        self.pos = 0
+        self._step_cache = {}
+
+    def _fn_for(self, t: int):
+        fn = self._step_cache.get(t)
+        if fn is None:
+            fn = self._step_cache[t] = self._make_step(t)
+        return fn
+
+    def _check(self, B: int, t: int) -> None:
+        if B != self.batch:
+            raise ValueError(f"batch {B} != session batch "
+                             f"{self.batch}")
+        if self.pos + t > self.capacity:
+            raise ValueError(
+                f"stream overflow: pos {self.pos} + chunk {t} exceeds "
+                f"capacity {self.capacity} — create the session with "
+                f"a larger capacity or reset()")
+
+    def _make_step(self, t: int):
+        raise NotImplementedError
+
+    def generate(self, prompt, n_tokens: int, *,
+                 temperature: float = 0.0, rng_key=None):
+        """Autoregressive generation for id-input (embedding-first)
+        language models — single-input graphs and layer stacks alike:
+        prefill the (B, T0) integer prompt as one chunk, then decode
+        ``n_tokens`` greedily (temperature=0) or by temperature
+        sampling. The sampling runs on DEVICE arrays — no per-token
+        host sync; the only fetch is the caller's. Returns
+        (B, n_tokens) generated ids. Needs
+        ``capacity >= T0 + n_tokens - 1`` (step() checks)."""
+        prompt = jnp.asarray(prompt)
+        if prompt.ndim != 2:
+            raise ValueError(
+                f"prompt must be (B, T0) token ids; got shape "
+                f"{prompt.shape}")
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+        # EmbeddingSequenceLayer reads (B, t, 1) id channels
+        probs = self.step(prompt[:, :, None].astype(jnp.float32))
+        last = probs[:, -1]
+        out = []
+        for i in range(n_tokens):
+            if temperature > 0:
+                rng_key, sub = jax.random.split(rng_key)
+                # output layers emit probabilities (softmax applied):
+                # sample in log space
+                nxt = jax.random.categorical(
+                    sub, jnp.log(last + 1e-9) / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            out.append(nxt)
+            if i + 1 < n_tokens:
+                probs = self.step(
+                    nxt[:, None, None].astype(jnp.float32))
+                last = probs[:, 0]
+        return jnp.stack(out, axis=1)
+
+
+class StreamingSession(_BoundedSession):
     """Stateful token-streaming over a ``MultiLayerNetwork``.
 
     Built via ``net.streaming_session(capacity=...)``. ``step(x)``
@@ -42,11 +108,8 @@ class StreamingSession:
 
     def __init__(self, net, capacity: int, batch: int,
                  dtype=jnp.float32):
+        super().__init__(capacity, batch)
         self.net = net
-        self.capacity = int(capacity)
-        self.batch = int(batch)
-        self.pos = 0                      # host mirror of the carry
-        self._step_cache = {}             # chunk length -> jitted fn
         self._states = []
         for layer in net.layers:
             if hasattr(layer, "apply_stream_bounded"):
@@ -56,8 +119,6 @@ class StreamingSession:
                 self._states.append(layer.zero_state(batch))
             else:
                 self._states.append(None)
-
-    # ------------------------------------------------------------------
 
     def _make_step(self, t: int):
         net = self.net
@@ -94,19 +155,10 @@ class StreamingSession:
         if squeeze:
             x = x[:, None, :]
         B, t, _ = x.shape
-        if B != self.batch:
-            raise ValueError(f"batch {B} != session batch "
-                             f"{self.batch}")
-        if self.pos + t > self.capacity:
-            raise ValueError(
-                f"stream overflow: pos {self.pos} + chunk {t} exceeds "
-                f"capacity {self.capacity} — create the session with "
-                f"a larger capacity or reset()")
-        fn = self._step_cache.get(t)
-        if fn is None:
-            fn = self._step_cache[t] = self._make_step(t)
-        h, self._states = fn(self.net.params, self.net.state,
-                             self._states, jnp.int32(self.pos), x)
+        self._check(B, t)
+        h, self._states = self._fn_for(t)(
+            self.net.params, self.net.state, self._states,
+            jnp.int32(self.pos), x)
         self.pos += t
         if squeeze and h.ndim == 3:
             h = h[:, -1, :]
@@ -121,3 +173,90 @@ class StreamingSession:
             if hasattr(layer, "zero_state") and not hasattr(
                     layer, "apply_stream_bounded"):
                 self._states[i] = layer.zero_state(self.batch)
+
+
+class GraphStreamingSession(_BoundedSession):
+    """The ComputationGraph counterpart of :class:`StreamingSession`
+    (reference rnnTimeStep, ComputationGraph.java:2358): one compiled
+    token-step over the vertex topology, fixed-capacity KV caches for
+    attention vertices, recurrent carries for RNN vertices. Built via
+    ``graph.streaming_session(capacity=..., batch=...)``; ``step``
+    takes one array per network input and returns the network
+    output(s) for the new steps. ``generate`` works for single-input
+    graphs."""
+
+    def __init__(self, graph, capacity: int, batch: int,
+                 dtype=jnp.float32):
+        super().__init__(capacity, batch)
+        self.graph = graph
+        self._states = {}
+        for name, (obj, _ins) in graph.conf.vertices.items():
+            if hasattr(obj, "apply_stream_bounded"):
+                self._states[name] = obj.zero_stream_cache(
+                    batch, self.capacity, dtype)
+            elif hasattr(obj, "zero_state") and hasattr(obj,
+                                                        "apply_rnn"):
+                self._states[name] = obj.zero_state(batch)
+
+    def _make_step(self, t: int):
+        graph = self.graph
+        conf = graph.conf
+        order = list(conf.topological_order())
+        vertices = dict(conf.vertices)
+        # dispatch mirrors the eager rnn_time_step
+        # (computation_graph.py): Layer — not BaseLayer — is the
+        # layer-vertex base class (DropoutLayer, GlobalPooling,
+        # LayerNormalization, ... subclass Layer directly)
+        from deeplearning4j_tpu.nn.conf.layers.base import Layer
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+            BaseRecurrentLayer)
+
+        def step(params, layer_states, stream_states, pos, xs):
+            acts = dict(zip(conf.network_inputs, xs))
+            new_streams = dict(stream_states)
+            for name in order:
+                obj, ins = vertices[name]
+                xin = [acts[i] for i in ins]
+                if hasattr(obj, "apply_stream_bounded"):
+                    acts[name], new_streams[name] = \
+                        obj.apply_stream_bounded(
+                            params[name], stream_states[name],
+                            xin[0], pos)
+                elif isinstance(obj, BaseRecurrentLayer):
+                    acts[name], new_streams[name] = obj.apply_rnn(
+                        params[name], xin[0], stream_states[name],
+                        training=False)
+                elif isinstance(obj, Layer):
+                    acts[name], _ = obj.apply(
+                        params[name], layer_states[name], xin[0],
+                        training=False)
+                else:
+                    acts[name] = obj.apply(xin)
+            return tuple(acts[o] for o in conf.network_outputs), \
+                new_streams
+
+        return jax.jit(step)
+
+    def step(self, *inputs):
+        xs = [jnp.asarray(x) for x in inputs]
+        squeeze = xs[0].ndim == 2
+        if squeeze:
+            xs = [x[:, None, :] for x in xs]
+        B, t = xs[0].shape[0], xs[0].shape[1]
+        self._check(B, t)
+        outs, self._states = self._fn_for(t)(
+            self.graph.params, self.graph.state, self._states,
+            jnp.int32(self.pos), tuple(xs))
+        self.pos += t
+        if squeeze:
+            outs = tuple(o[:, -1, :] if o.ndim == 3 else o
+                         for o in outs)
+        return outs if len(outs) > 1 else outs[0]
+
+    def reset(self):
+        self.pos = 0
+        for name, (obj, _ins) in self.graph.conf.vertices.items():
+            if hasattr(obj, "zero_state") and not hasattr(
+                    obj, "apply_stream_bounded") and name in \
+                    self._states:
+                self._states[name] = obj.zero_state(self.batch)

@@ -706,6 +706,53 @@ class TestTransformerStreaming:
             np.concatenate([pre, np.stack(rest, axis=1)], axis=1),
             eager, atol=1e-4)
 
+    def test_generate_matches_eager_greedy_loop(self, rng):
+        """session.generate (device-side sampling over the bounded
+        cache) equals a hand-rolled greedy loop over the eager
+        rnn_time_step path."""
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            EmbeddingSequenceLayer, RnnOutputLayer,
+            TransformerEncoderLayer)
+        B, T0, N, V, C = 2, 4, 6, 13, 16
+        conf = (NeuralNetConfiguration.builder().set_seed(9)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(EmbeddingSequenceLayer(n_in=V, n_out=C))
+                .layer(TransformerEncoderLayer(n_heads=4, causal=True))
+                .layer(RnnOutputLayer(n_out=V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(V, T0 + N))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        prompt = rng.integers(0, V, (B, T0))
+
+        sess = net.streaming_session(capacity=T0 + N, batch=B)
+        ids = np.asarray(sess.generate(prompt, N))
+        assert ids.shape == (B, N)
+
+        # eager reference: rnn_time_step + host argmax per token
+        net.rnn_clear_previous_state()
+        probs = np.asarray(net.rnn_time_step(
+            prompt[:, :, None].astype(np.float32)))
+        last = probs[:, -1]
+        want = []
+        for _ in range(N):
+            nxt = last.argmax(axis=-1)
+            want.append(nxt)
+            out = np.asarray(net.rnn_time_step(
+                nxt[:, None, None].astype(np.float32)))
+            last = out[:, 0]
+        np.testing.assert_array_equal(ids, np.stack(want, axis=1))
+
+        # temperature path runs and respects shapes/capacity
+        sess.reset()
+        ids_t = np.asarray(sess.generate(prompt, N, temperature=0.8))
+        assert ids_t.shape == (B, N) and (ids_t < V).all()
+        with pytest.raises(ValueError, match="prompt"):
+            sess.generate(prompt[0], 2)
+
     def test_bounded_session_overflow_and_batch_checked(self, rng):
         net = self._net()
         sess = net.streaming_session(capacity=4, batch=self.B)
@@ -718,6 +765,55 @@ class TestTransformerStreaming:
         sess.step(x)                      # usable again
         with pytest.raises(ValueError, match="batch"):
             sess.step(x[:1])
+
+    def test_graph_bounded_session_equals_full(self, rng):
+        """GraphStreamingSession: the ComputationGraph counterpart —
+        per-step jitted decode over the vertex topology equals the
+        full forward, across a reset."""
+        from deeplearning4j_tpu import (ComputationGraph,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            LayerNormalization, RnnOutputLayer, SelfAttentionLayer)
+        # the LayerNormalization vertex matters: it subclasses Layer
+        # DIRECTLY (not BaseLayer), pinning the session's vertex
+        # dispatch to the same class the eager rnn_time_step uses
+        conf = (NeuralNetConfiguration.builder().set_seed(2)
+                .updater(updaters.adam(1e-3))
+                .graph_builder().add_inputs("in")
+                .add_layer("attn", SelfAttentionLayer(
+                    n_out=self.C, n_heads=4, causal=True), "in")
+                .add_layer("ln", LayerNormalization(), "attn")
+                .add_layer("out", RnnOutputLayer(n_out=self.V,
+                                                 loss="mcxent"),
+                           "ln")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(self.C, self.T))
+                .build())
+        cg = ComputationGraph(conf).init()
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype(
+            np.float32)
+        out = cg.output(x)
+        full = np.asarray(out[0] if isinstance(out, (list, tuple))
+                          else out)
+        sess = cg.streaming_session(capacity=self.T, batch=self.B)
+        stepped = np.stack(
+            [np.asarray(sess.step(x[:, t])) for t in range(self.T)],
+            axis=1)
+        np.testing.assert_allclose(stepped, full, atol=1e-4)
+        assert list(sess._step_cache) == [1]
+        # reset + fresh sequence: no stale-cache leakage
+        x2 = rng.normal(0, 1, (self.B, self.T, self.C)).astype(
+            np.float32)
+        out2 = cg.output(x2)
+        full2 = np.asarray(out2[0] if isinstance(out2, (list, tuple))
+                           else out2)
+        sess.reset()
+        s2 = np.stack(
+            [np.asarray(sess.step(x2[:, t])) for t in range(self.T)],
+            axis=1)
+        np.testing.assert_allclose(s2, full2, atol=1e-4)
 
     def test_bounded_session_mixed_lstm_transformer(self, rng):
         """A mixed LSTM + transformer stack streams through the same
