@@ -77,6 +77,10 @@ class _BoundedSession:
             rng_key = jax.random.PRNGKey(0)
         # EmbeddingSequenceLayer reads (B, t, 1) id channels
         probs = self.step(prompt[:, :, None].astype(jnp.float32))
+        if isinstance(probs, tuple):
+            raise ValueError(
+                "generate() needs a single-output network; this "
+                "graph has multiple network_outputs")
         last = probs[:, -1]
         out = []
         for i in range(n_tokens):
@@ -139,12 +143,22 @@ class StreamingSession(_BoundedSession):
                     h, new_streams[i] = layer.apply_rnn(
                         params[i], h, stream_states[i],
                         training=False)
+                elif hasattr(layer, "apply_stream"):
+                    # running-statistic carries (GlobalPooling's
+                    # sum/count/max) — static shapes, jittable; a
+                    # per-chunk apply() here would silently pool only
+                    # the newest chunk
+                    h, new_streams[i] = layer.apply_stream(
+                        params[i], stream_states[i], h)
                 else:
                     h, _ = layer.apply(params[i], layer_states[i], h,
                                        training=False)
             return h, new_streams
 
-        return jax.jit(step)
+        # donated stream states: the KV caches genuinely update in
+        # place (undonated inputs cannot alias outputs, which would
+        # re-copy the full capacity each token-step)
+        return jax.jit(step, donate_argnums=(2,))
 
     def step(self, x):
         """Feed the next chunk; returns outputs for the new steps.
@@ -167,12 +181,16 @@ class StreamingSession(_BoundedSession):
     def reset(self):
         """Start a new sequence: rewind the position. Attention
         caches need no zeroing (slots beyond ``pos`` are masked and
-        overwritten), recurrent carries do."""
+        overwritten); recurrent carries and running-pool statistics
+        do."""
         self.pos = 0
         for i, layer in enumerate(self.net.layers):
-            if hasattr(layer, "zero_state") and not hasattr(
-                    layer, "apply_stream_bounded"):
+            if hasattr(layer, "apply_stream_bounded"):
+                continue
+            if hasattr(layer, "zero_state"):
                 self._states[i] = layer.zero_state(self.batch)
+            elif hasattr(layer, "apply_stream"):
+                self._states[i] = None     # running pool restarts
 
 
 class GraphStreamingSession(_BoundedSession):
@@ -226,6 +244,13 @@ class GraphStreamingSession(_BoundedSession):
                     acts[name], new_streams[name] = obj.apply_rnn(
                         params[name], xin[0], stream_states[name],
                         training=False)
+                elif hasattr(obj, "apply_stream"):
+                    # running-statistic carries (GlobalPooling):
+                    # per-chunk apply() would pool only the newest
+                    # chunk (the eager rnn_time_step dispatches the
+                    # same way)
+                    acts[name], new_streams[name] = obj.apply_stream(
+                        params[name], stream_states.get(name), xin[0])
                 elif isinstance(obj, Layer):
                     acts[name], _ = obj.apply(
                         params[name], layer_states[name], xin[0],
@@ -235,7 +260,7 @@ class GraphStreamingSession(_BoundedSession):
             return tuple(acts[o] for o in conf.network_outputs), \
                 new_streams
 
-        return jax.jit(step)
+        return jax.jit(step, donate_argnums=(2,))
 
     def step(self, *inputs):
         xs = [jnp.asarray(x) for x in inputs]
@@ -243,6 +268,13 @@ class GraphStreamingSession(_BoundedSession):
         if squeeze:
             xs = [x[:, None, :] for x in xs]
         B, t = xs[0].shape[0], xs[0].shape[1]
+        for i, x in enumerate(xs[1:], start=1):
+            if x.shape[0] != B or x.shape[1] != t:
+                raise ValueError(
+                    f"input {i} has (batch, t)="
+                    f"{tuple(x.shape[:2])}; every input must match "
+                    f"input 0's ({B}, {t}) — pos advances once per "
+                    "step")
         self._check(B, t)
         outs, self._states = self._fn_for(t)(
             self.graph.params, self.graph.state, self._states,
@@ -255,8 +287,14 @@ class GraphStreamingSession(_BoundedSession):
 
     def reset(self):
         self.pos = 0
+        kept = {}
         for name, (obj, _ins) in self.graph.conf.vertices.items():
-            if hasattr(obj, "zero_state") and not hasattr(
-                    obj, "apply_stream_bounded") and name in \
-                    self._states:
-                self._states[name] = obj.zero_state(self.batch)
+            if hasattr(obj, "apply_stream_bounded"):
+                if name in self._states:    # pos-masked; keep as-is
+                    kept[name] = self._states[name]
+            elif hasattr(obj, "zero_state") and hasattr(obj,
+                                                        "apply_rnn"):
+                kept[name] = obj.zero_state(self.batch)
+            # apply_stream running carries (GlobalPooling) drop:
+            # they restart from None
+        self._states = kept

@@ -225,8 +225,11 @@ class SelfAttentionLayer(BaseLayer):
     def zero_stream_cache(self, batch: int, capacity: int, dtype):
         H = self.n_heads
         Dh = self.n_out // H
-        z = jnp.zeros((batch, capacity, H, Dh), dtype)
-        return {"k": z, "v": z}
+        # two DISTINCT buffers: the session donates the cache to the
+        # jitted step, and donating one aliased array twice is a
+        # runtime error
+        return {"k": jnp.zeros((batch, capacity, H, Dh), dtype),
+                "v": jnp.zeros((batch, capacity, H, Dh), dtype)}
 
     def apply_stream_bounded(self, params, cache, x, pos):
         """One jittable decode step: ``x`` is the new (B, t, C) chunk,

@@ -54,9 +54,12 @@ class BaseRecurrentLayer(FeedForwardLayer):
 
     def zero_state(self, batch: int, dtype=None):
         from deeplearning4j_tpu import dtypes as dtypes_mod
-        z = jnp.zeros((batch, self.n_out),
-                      dtype or dtypes_mod.policy().param_dtype)
-        return (z, z)
+        dt = dtype or dtypes_mod.policy().param_dtype
+        # distinct h/c buffers: streaming sessions donate the carry
+        # to the jitted step, and donating one aliased array twice
+        # is a runtime error
+        return (jnp.zeros((batch, self.n_out), dt),
+                jnp.zeros((batch, self.n_out), dt))
 
     def apply_rnn(self, params, x, carry, *, training=False, rng=None,
                   mask=None):

@@ -51,6 +51,11 @@ class TestExamples:
         assert "max |keras - ours|" in out
         assert "fine-tuned accuracy" in out
 
+    def test_streaming_generation(self):
+        out = _run("streaming_generation.py", "--epochs", "1",
+                   "--gen-tokens", "8")
+        assert "bounded session matches eager decode OK" in out
+
     def test_long_context_lm(self):
         out = _run("long_context_lm.py", "--epochs", "8")
         assert "data=2 x seq=2" in out

@@ -815,6 +815,43 @@ class TestTransformerStreaming:
             axis=1)
         np.testing.assert_allclose(s2, full2, atol=1e-4)
 
+    @pytest.mark.parametrize("pooling", ["avg", "max"])
+    def test_bounded_session_pooled_classifier(self, rng, pooling):
+        """GlobalPooling streams through the bounded session via its
+        running-statistic carry (a per-chunk apply would silently
+        pool only the newest token); final step equals the full
+        forward, and reset() restarts the statistic."""
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GlobalPoolingLayer, OutputLayer, TransformerEncoderLayer)
+        conf = (NeuralNetConfiguration.builder().set_seed(4)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(TransformerEncoderLayer(n_heads=4, causal=True))
+                .layer(GlobalPoolingLayer(pooling=pooling))
+                .layer(OutputLayer(n_out=self.V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.C, self.T))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype(
+            np.float32)
+        full = np.asarray(net.output(x))
+        sess = net.streaming_session(capacity=self.T, batch=self.B)
+        for t in range(self.T):
+            last = sess.step(x[:, t])
+        np.testing.assert_allclose(np.asarray(last), full, atol=1e-4)
+        # reset: a fresh sequence must not inherit the pool
+        x2 = rng.normal(0, 1, (self.B, self.T, self.C)).astype(
+            np.float32)
+        full2 = np.asarray(net.output(x2))
+        sess.reset()
+        for t in range(self.T):
+            last2 = sess.step(x2[:, t])
+        np.testing.assert_allclose(np.asarray(last2), full2,
+                                   atol=1e-4)
+
     def test_bounded_session_mixed_lstm_transformer(self, rng):
         """A mixed LSTM + transformer stack streams through the same
         session: recurrent carries and KV caches coexist."""

@@ -1044,6 +1044,49 @@ class TestThreeAxisComposition:
             np.asarray(comp.params_flat()),
             np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
 
+    def test_dp_tp_sp_computation_graph(self):
+        """The GSPMD step serves BOTH executors: a ComputationGraph
+        with a head-split attention vertex trains dp=2 x tp=2 x sp=2
+        and matches single-device."""
+        from deeplearning4j_tpu import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, SelfAttentionLayer)
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            shard_graph_params)
+
+        def build():
+            conf = (NeuralNetConfiguration.builder().set_seed(12)
+                    .updater(updaters.adam(1e-2))
+                    .graph_builder().add_inputs("in")
+                    .add_layer("attn", SelfAttentionLayer(
+                        n_out=self.C, n_heads=4, causal=True), "in")
+                    .add_layer("out", RnnOutputLayer(
+                        n_out=self.V, loss="mcxent"), "attn")
+                    .set_outputs("out")
+                    .set_input_types(
+                        InputType.recurrent(self.C, self.T))
+                    .build())
+            return ComputationGraph(conf).init()
+
+        rng = np.random.default_rng(13)
+        x = rng.normal(0, 1, (self.B, self.T, self.C)).astype(
+            np.float32)
+        y = np.eye(self.V, dtype="float32")[
+            rng.integers(0, self.V, (self.B, self.T))]
+        single = build()
+        single.fit(DataSet(x, y))
+        comp = build()
+        mesh = build_mesh(MeshSpec(data=2, model=2, seq=2),
+                          jax.devices()[:8])
+        comp.params = shard_graph_params(comp.params, comp, mesh)
+        comp.opt_state = comp._optimizer.init(comp.params)
+        pw = ParallelWrapper(comp, mesh, prefetch_buffer=0)
+        pw.fit(ListDataSetIterator([DataSet(x, y)]), epochs=1)
+        assert pw._seq_gspmd
+        np.testing.assert_allclose(
+            np.asarray(comp.params_flat()),
+            np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
+
     def test_dp_tp_sp_masked_variable_length(self):
         """Variable-length batches compose too: the kv-mask chunk
         rides the ring island while dp/tp stay GSPMD."""
