@@ -16,8 +16,10 @@ per step and no retrace as the sequence grows.
 
 Chunk lengths are compile-time buckets: a session caches one
 executable per distinct chunk length it sees (a decode loop uses
-exactly one, t=1; a prompt prefill adds one more). Keep chunk sizes
-consistent — every new length is a new compile.
+exactly one, t=1; a prompt prefill adds one more), plus one extra
+trace when a running-statistic carry (GlobalPooling) materializes on
+its first step (its feature width is unknown before data flows).
+Keep chunk sizes consistent — every new length is a new compile.
 """
 
 from __future__ import annotations
@@ -38,12 +40,19 @@ class _BoundedSession:
         self.batch = int(batch)
         self.pos = 0
         self._step_cache = {}
+        self._gen_cache = {}      # (n_tokens, temperature) -> program
 
     def _fn_for(self, t: int):
         fn = self._step_cache.get(t)
         if fn is None:
             fn = self._step_cache[t] = self._make_step(t)
         return fn
+
+    def _raw_step(self, t: int):
+        """The un-jitted step body for chunk length ``t`` — pure, so
+        it can sit inside a larger jitted program (fused generate's
+        lax.scan)."""
+        raise NotImplementedError
 
     def _check(self, B: int, t: int) -> None:
         if B != self.batch:
@@ -58,46 +67,111 @@ class _BoundedSession:
     def _make_step(self, t: int):
         raise NotImplementedError
 
+    def _fused_ctx(self):
+        """(params, layer_states, feed) for the fused program; feed
+        is ``(params, layer_states, states, pos, x) -> (h, states)``
+        with x (B, 1, 1). Subclass hook."""
+        raise NotImplementedError
+
+    def _n_outputs(self) -> int:
+        return 1
+
+    @staticmethod
+    def _sample(last, temp, key):
+        """(next_ids, new_key). ONE implementation for the unfused
+        loop and the fused scan body — their id-parity contract
+        (tested) depends on bitwise-identical sampling."""
+        if temp > 0:
+            key, sub = jax.random.split(key)
+            # output layers emit probabilities (softmax applied):
+            # sample in log space
+            nxt = jax.random.categorical(
+                sub, jnp.log(last + 1e-9) / temp, axis=-1)
+        else:
+            nxt = jnp.argmax(last, axis=-1)
+        return nxt, key
+
     def generate(self, prompt, n_tokens: int, *,
-                 temperature: float = 0.0, rng_key=None):
+                 temperature: float = 0.0, rng_key=None,
+                 fused: bool = False):
         """Autoregressive generation for id-input (embedding-first)
         language models — single-input graphs and layer stacks alike:
         prefill the (B, T0) integer prompt as one chunk, then decode
         ``n_tokens`` greedily (temperature=0) or by temperature
         sampling. The sampling runs on DEVICE arrays — no per-token
         host sync; the only fetch is the caller's. Returns
-        (B, n_tokens) generated ids. Needs
-        ``capacity >= T0 + n_tokens - 1`` (step() checks)."""
+        (B, n_tokens) generated ids.
+
+        ``fused=True`` compiles the ENTIRE decode loop into one XLA
+        program (lax.scan over the sampled tokens with the bounded
+        caches as carries): a single device dispatch replaces
+        n_tokens of them — the difference dominates when dispatch
+        latency is high (e.g. a tunnel'd chip). One compile per
+        (n_tokens, temperature); identical ids to the unfused path
+        for the same rng_key (tested). Needs
+        ``capacity >= T0 + n_tokens`` fused (the last sampled token
+        is written to cache) vs ``T0 + n_tokens - 1`` unfused."""
         prompt = jnp.asarray(prompt)
         if prompt.ndim != 2:
             raise ValueError(
                 f"prompt must be (B, T0) token ids; got shape "
                 f"{prompt.shape}")
-        if rng_key is None:
-            rng_key = jax.random.PRNGKey(0)
-        # EmbeddingSequenceLayer reads (B, t, 1) id channels
-        probs = self.step(prompt[:, :, None].astype(jnp.float32))
-        if isinstance(probs, tuple):
+        if self._n_outputs() != 1:
+            # checked BEFORE the prefill: failing after it would
+            # leave the session's caches/pos silently advanced
             raise ValueError(
                 "generate() needs a single-output network; this "
                 "graph has multiple network_outputs")
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+        if fused and self.pos + prompt.shape[1] + n_tokens > \
+                self.capacity:
+            raise ValueError(
+                f"fused generate writes every sampled token: pos "
+                f"{self.pos} + prompt {prompt.shape[1]} + n_tokens "
+                f"{n_tokens} exceeds capacity {self.capacity}")
+        # EmbeddingSequenceLayer reads (B, t, 1) id channels
+        probs = self.step(prompt[:, :, None].astype(jnp.float32))
         last = probs[:, -1]
+        temp = float(temperature)
+        if fused:
+            return self._generate_fused(last, n_tokens, temp,
+                                        rng_key)
         out = []
         for i in range(n_tokens):
-            if temperature > 0:
-                rng_key, sub = jax.random.split(rng_key)
-                # output layers emit probabilities (softmax applied):
-                # sample in log space
-                nxt = jax.random.categorical(
-                    sub, jnp.log(last + 1e-9) / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(last, axis=-1)
+            nxt, rng_key = self._sample(last, temp, rng_key)
             out.append(nxt)
             if i + 1 < n_tokens:
                 probs = self.step(
                     nxt[:, None, None].astype(jnp.float32))
                 last = probs[:, 0]
         return jnp.stack(out, axis=1)
+
+    def _generate_fused(self, last, n_tokens, temp, rng_key):
+        params, lstates, feed = self._fused_ctx()
+        prog = self._gen_cache.get((n_tokens, temp))
+        if prog is None:
+            def program(params, lstates, states, pos, last, key):
+                sample = self._sample
+
+                def body(carry, _):
+                    states, pos, last, key = carry
+                    nxt, key = sample(last, temp, key)
+                    x = nxt[:, None, None].astype(jnp.float32)
+                    h, states = feed(params, lstates, states, pos, x)
+                    return (states, pos + 1, h[:, 0], key), nxt
+
+                (states, pos, _, _), ids = jax.lax.scan(
+                    body, (states, pos, last, key), None,
+                    length=n_tokens)
+                return jnp.swapaxes(ids, 0, 1), states
+
+            prog = self._gen_cache[(n_tokens, temp)] = jax.jit(
+                program, donate_argnums=(2,))
+        ids, self._states = prog(params, lstates, self._states,
+                                 jnp.int32(self.pos), last, rng_key)
+        self.pos += n_tokens
+        return ids
 
 
 class StreamingSession(_BoundedSession):
@@ -124,7 +198,7 @@ class StreamingSession(_BoundedSession):
             else:
                 self._states.append(None)
 
-    def _make_step(self, t: int):
+    def _raw_step(self, t: int):
         net = self.net
         layers = list(net.layers)
         preprocessors = dict(net.conf.preprocessors)
@@ -155,10 +229,17 @@ class StreamingSession(_BoundedSession):
                                        training=False)
             return h, new_streams
 
+        return step
+
+    def _make_step(self, t: int):
         # donated stream states: the KV caches genuinely update in
         # place (undonated inputs cannot alias outputs, which would
         # re-copy the full capacity each token-step)
-        return jax.jit(step, donate_argnums=(2,))
+        return jax.jit(self._raw_step(t), donate_argnums=(2,))
+
+    def _fused_ctx(self):
+        raw = self._raw_step(1)
+        return self.net.params, self.net.state, raw
 
     def step(self, x):
         """Feed the next chunk; returns outputs for the new steps.
@@ -216,7 +297,7 @@ class GraphStreamingSession(_BoundedSession):
                                                         "apply_rnn"):
                 self._states[name] = obj.zero_state(batch)
 
-    def _make_step(self, t: int):
+    def _raw_step(self, t: int):
         graph = self.graph
         conf = graph.conf
         order = list(conf.topological_order())
@@ -260,7 +341,22 @@ class GraphStreamingSession(_BoundedSession):
             return tuple(acts[o] for o in conf.network_outputs), \
                 new_streams
 
-        return jax.jit(step, donate_argnums=(2,))
+        return step
+
+    def _make_step(self, t: int):
+        return jax.jit(self._raw_step(t), donate_argnums=(2,))
+
+    def _n_outputs(self) -> int:
+        return len(self.graph.conf.network_outputs)
+
+    def _fused_ctx(self):
+        raw = self._raw_step(1)
+
+        def feed(params, lstates, states, pos, x):
+            outs, states = raw(params, lstates, states, pos, (x,))
+            return outs[0], states
+
+        return self.graph.params, self.graph.state, feed
 
     def step(self, *inputs):
         xs = [jnp.asarray(x) for x in inputs]

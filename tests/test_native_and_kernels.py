@@ -753,6 +753,81 @@ class TestTransformerStreaming:
         with pytest.raises(ValueError, match="prompt"):
             sess.generate(prompt[0], 2)
 
+        # FUSED decode (one XLA program for the whole loop) must
+        # produce identical ids to the unfused path — greedy AND
+        # temperature (same rng_key => same sampling sequence)
+        import jax as _jax
+        sess.reset()
+        ids_f = np.asarray(sess.generate(prompt, N, fused=True))
+        np.testing.assert_array_equal(ids_f, ids)
+        sess.reset()
+        ids_tf = np.asarray(sess.generate(
+            prompt, N, temperature=0.8, fused=True,
+            rng_key=_jax.random.PRNGKey(0)))
+        np.testing.assert_array_equal(ids_tf, ids_t)
+        with pytest.raises(ValueError, match="capacity"):
+            sess2 = net.streaming_session(capacity=T0 + N - 1,
+                                          batch=B)
+            sess2.generate(prompt, N, fused=True)
+
+    def test_graph_generate_fused_and_multi_output_guard(self, rng):
+        """generate on a ComputationGraph: fused equals unfused; a
+        multi-output graph is rejected BEFORE the prefill touches
+        the session state."""
+        import jax
+        from deeplearning4j_tpu import (ComputationGraph,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            EmbeddingSequenceLayer, RnnOutputLayer,
+            SelfAttentionLayer)
+        B, T0, N, V, C = 2, 3, 5, 11, 16
+
+        def build(two_outputs=False):
+            gb = (NeuralNetConfiguration.builder().set_seed(6)
+                  .updater(updaters.adam(1e-3))
+                  .graph_builder().add_inputs("in")
+                  .add_layer("emb", EmbeddingSequenceLayer(
+                      n_in=V, n_out=C), "in")
+                  .add_layer("attn", SelfAttentionLayer(
+                      n_out=C, n_heads=4, causal=True), "emb")
+                  .add_layer("out", RnnOutputLayer(
+                      n_out=V, loss="mcxent"), "attn"))
+            if two_outputs:
+                gb = gb.add_layer("out2", RnnOutputLayer(
+                    n_out=V, loss="mcxent"), "attn")
+                gb = gb.set_outputs("out", "out2")
+            else:
+                gb = gb.set_outputs("out")
+            conf = (gb.set_input_types(
+                InputType.recurrent(V, T0 + N)).build())
+            return ComputationGraph(conf).init()
+
+        cg = build()
+        prompt = rng.integers(0, V, (B, T0))
+        sess = cg.streaming_session(capacity=T0 + N, batch=B)
+        ids = np.asarray(sess.generate(prompt, N))
+        sess.reset()
+        ids_f = np.asarray(sess.generate(prompt, N, fused=True))
+        np.testing.assert_array_equal(ids_f, ids)
+        sess.reset()
+        ids_t = np.asarray(sess.generate(
+            prompt, N, temperature=0.7,
+            rng_key=jax.random.PRNGKey(3)))
+        sess.reset()
+        ids_tf = np.asarray(sess.generate(
+            prompt, N, temperature=0.7, fused=True,
+            rng_key=jax.random.PRNGKey(3)))
+        np.testing.assert_array_equal(ids_tf, ids_t)
+
+        cg2 = build(two_outputs=True)
+        sess2 = cg2.streaming_session(capacity=T0 + N, batch=B)
+        with pytest.raises(ValueError, match="single-output"):
+            sess2.generate(prompt, N)
+        # the failed call must not have touched the session
+        assert sess2.pos == 0
+
     def test_bounded_session_overflow_and_batch_checked(self, rng):
         net = self._net()
         sess = net.streaming_session(capacity=4, batch=self.B)
