@@ -1260,25 +1260,34 @@ def _leg_transformer_decode(peak):
     # FUSED decode: the whole generation is ONE lax.scan program —
     # a single dispatch replaces DECODE_STEPS of them (greedy
     # sampling included), which is where the dispatch-bound decode
-    # regime actually wants to live on a tunnel'd chip
-    prompt = np.zeros((LM_B, 1), np.float32)
+    # regime actually wants to live on a tunnel'd chip. Tunnel
+    # discipline: the prompt CONTENT changes per burst (the runtime
+    # memoizes by (executable, input content) — a constant prompt
+    # with deterministic greedy decode would repeat byte-identical
+    # calls that time as ~0), and the fused/bounded ratio comes from
+    # alternating bursts within ONE window.
+    fused_ctr = [0]
     sess.reset()
-    gen_ids = sess.generate(prompt, DECODE_STEPS, fused=True)  # compile
+    gen_ids = sess.generate(np.zeros((LM_B, 1), np.float32),
+                            DECODE_STEPS, fused=True)   # compile
     float(jnp.sum(gen_ids))
 
     def m_fused():
+        fused_ctr[0] += 1
+        prompt = np.full((LM_B, 1), fused_ctr[0] % LM_V, np.float32)
         sess.reset()
         t0 = time.perf_counter()
         out = sess.generate(prompt, DECODE_STEPS, fused=True)
         float(jnp.sum(out))
         return time.perf_counter() - t0
 
-    dt_f = min(m_fused() for _ in range(3))
+    dt_b2, dt_f = _interleave(m_bounded, m_fused, repeats=3)
     rate_f = DECODE_STEPS * LM_B / dt_f
+    fused_vs_bounded = dt_b2 / dt_f
     print(f"transformer decode: bounded-cache {rate_b:.0f} tok/s, "
           f"eager rnn_time_step {rate_e:.0f} tok/s "
           f"({rate_b / rate_e:.1f}x); FUSED scan generate "
-          f"{rate_f:.0f} tok/s ({rate_f / rate_b:.1f}x bounded)",
+          f"{rate_f:.0f} tok/s ({fused_vs_bounded:.1f}x bounded)",
           file=sys.stderr)
     return {
         "metric": (f"Transformer-LM streaming decode (B={LM_B}, "
@@ -1288,7 +1297,7 @@ def _leg_transformer_decode(peak):
         "baseline": round(rate_e, 0),
         "vs_baseline": round(rate_b / rate_e, 3),
         "fused_scan_tokens_per_sec": round(rate_f, 0),
-        "fused_vs_bounded": round(rate_f / rate_b, 3),
+        "fused_vs_bounded": round(fused_vs_bounded, 3),
         "mfu": None,
         "note": (f"value: jitted fixed-capacity KV-cache session, "
                  f"{DECODE_STEPS} single-token steps; baseline: "

@@ -68,9 +68,15 @@ class _BoundedSession:
         raise NotImplementedError
 
     def _fused_ctx(self):
-        """(params, layer_states, feed) for the fused program; feed
-        is ``(params, layer_states, states, pos, x) -> (h, states)``
-        with x (B, 1, 1). Subclass hook."""
+        """The fused program's ``feed``:
+        ``(params, layer_states, states, pos, x) -> (h, states)``
+        with x (B, 1, 1). Subclass hook, called only on a program
+        CACHE MISS (building the raw step closure is not free)."""
+        raise NotImplementedError
+
+    def _model_params(self):
+        """(params, layer_states) fetched fresh per call. Subclass
+        hook."""
         raise NotImplementedError
 
     def _n_outputs(self) -> int:
@@ -148,9 +154,10 @@ class _BoundedSession:
         return jnp.stack(out, axis=1)
 
     def _generate_fused(self, last, n_tokens, temp, rng_key):
-        params, lstates, feed = self._fused_ctx()
+        params, lstates = self._model_params()
         prog = self._gen_cache.get((n_tokens, temp))
         if prog is None:
+            feed = self._fused_ctx()
             def program(params, lstates, states, pos, last, key):
                 sample = self._sample
 
@@ -238,8 +245,10 @@ class StreamingSession(_BoundedSession):
         return jax.jit(self._raw_step(t), donate_argnums=(2,))
 
     def _fused_ctx(self):
-        raw = self._raw_step(1)
-        return self.net.params, self.net.state, raw
+        return self._raw_step(1)
+
+    def _model_params(self):
+        return self.net.params, self.net.state
 
     def step(self, x):
         """Feed the next chunk; returns outputs for the new steps.
@@ -356,7 +365,10 @@ class GraphStreamingSession(_BoundedSession):
             outs, states = raw(params, lstates, states, pos, (x,))
             return outs[0], states
 
-        return self.graph.params, self.graph.state, feed
+        return feed
+
+    def _model_params(self):
+        return self.graph.params, self.graph.state
 
     def step(self, *inputs):
         xs = [jnp.asarray(x) for x in inputs]

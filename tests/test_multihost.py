@@ -286,6 +286,54 @@ if is_coordinator():
     np.save(os.path.join(out_dir, "pp_losses.npy"),
             np.array(losses))
 print("PP_OK", pid)
+
+# --- scenario F: THREE parallelism axes across processes ---
+# dp=2 x tp=2 x sp=2 over 2 procs x 4 devices: the GSPMD seq step
+# (plain jit + ring islands over 'seq') with Megatron-sharded
+# params, batch sharded B->data and T->seq, spanning the process
+# boundary. The replicated loss trajectory is the comparable
+# artifact (params are model-sharded, not coordinator-gatherable).
+from deeplearning4j_tpu.parallel.tensor_parallel import shard_params
+
+def _lm3():
+    b = (NeuralNetConfiguration.builder().set_seed(31)
+         .updater(updaters.adam(1e-2)).list()
+         .layer(EmbeddingSequenceLayer(n_in=11, n_out=16))
+         .layer(TransformerEncoderLayer(n_heads=4, causal=True))
+         .layer(RnnOutputLayer(n_out=11, loss="mcxent"))
+         .set_input_type(InputType.recurrent(11, 8)))
+    return MultiLayerNetwork(b.build()).init()
+
+rngf = np.random.default_rng(33)
+xf = rngf.integers(0, 11, (8, 8)).astype("float32")
+yf = np.eye(11, dtype="float32")[rngf.integers(0, 11, (8, 8))]
+fmesh = build_mesh(MeshSpec(data=2, model=2, seq=2), jax.devices())
+netf = _lm3()
+netf.params = shard_params(netf.params, netf, fmesh)
+netf.opt_state = netf._optimizer.init(netf.params)
+pwf = ParallelWrapper(netf, fmesh, prefetch_buffer=0)
+pwf._validate_seq_model()
+assert pwf._seq_gspmd
+fstep = pwf._make_seq_gspmd_step()
+fshard = NamedSharding(fmesh, P("data", "seq"))
+blo, bhi = pid * 4, (pid + 1) * 4
+
+def make_f(local, g_shape):
+    return jax.make_array_from_process_local_data(
+        fshard, np.ascontiguousarray(local), g_shape)
+
+bf = (make_f(xf[blo:bhi], (8, 8)),
+      make_f(yf[blo:bhi], (8, 8, 11)), None, None)
+pf, sf, of_, lossf = (netf.params, netf.state, netf.opt_state, None)
+flosses = []
+for i in range(2):
+    pf, sf, of_, lossf = fstep(pf, sf, of_, bf, netf._rng_key,
+                               np.int32(i))
+    flosses.append(float(lossf))
+if is_coordinator():
+    np.save(os.path.join(out_dir, "dptpsp_losses.npy"),
+            np.array(flosses))
+print("DTS_OK", pid)
 """
 
 
@@ -390,7 +438,7 @@ class TestMultiProcessDistributed:
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {i} failed:\n{out}"
             for tag in ("CG_OK", "COMP_OK", "CKPT_OK", "SEQ_OK",
-                        "PP_OK"):
+                        "PP_OK", "DTS_OK"):
                 assert f"{tag} {i}" in out, out
 
         import jax
@@ -520,3 +568,28 @@ class TestMultiProcessDistributed:
             np.testing.assert_allclose(
                 np.load(os.path.join(tmp_path, "pp_losses.npy")),
                 np.array(ref_losses), rtol=1e-5, atol=1e-6)
+
+        # F: single-device transformer == 2-process dp x tp x sp loss
+        # trajectory
+        from deeplearning4j_tpu.nn.conf.layers import (
+            EmbeddingSequenceLayer)
+        rngf = np.random.default_rng(33)
+        xf = rngf.integers(0, 11, (8, 8)).astype("float32")
+        yf = np.eye(11, dtype="float32")[
+            rngf.integers(0, 11, (8, 8))]
+        netf = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder().set_seed(31)
+             .updater(updaters.adam(1e-2)).list()
+             .layer(EmbeddingSequenceLayer(n_in=11, n_out=16))
+             .layer(TransformerEncoderLayer(n_heads=4, causal=True))
+             .layer(RnnOutputLayer(n_out=11, loss="mcxent"))
+             .set_input_type(InputType.recurrent(11, 8))
+             .build())).init()
+        dsf = DataSet(xf, yf)
+        ref_f = []
+        for _ in range(2):
+            netf.fit(dsf)
+            ref_f.append(float(netf.score_value))
+        np.testing.assert_allclose(
+            np.load(os.path.join(tmp_path, "dptpsp_losses.npy")),
+            np.array(ref_f), rtol=2e-4)
