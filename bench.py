@@ -1232,11 +1232,19 @@ def _leg_transformer_decode(peak):
     h = sess.step(ids[0])               # compile the t=1 executable
     float(jnp.sum(h))
 
+    bounded_ctr = [0]
+
     def m_bounded():
+        # drift the id stream per burst: a repeated burst would replay
+        # byte-identical (executable, content) calls, which the tunnel
+        # runtime can serve memoized (~0s) — same discipline as the
+        # fused window below
+        bounded_ctr[0] += 1
+        ids_b = (ids + bounded_ctr[0]) % LM_V
         sess.reset()
         t0 = time.perf_counter()
         for s in range(DECODE_STEPS):
-            h = sess.step(ids[s])
+            h = sess.step(ids_b[s])
         float(jnp.sum(h))               # host fetch = end-of-burst sync
         return time.perf_counter() - t0
 

@@ -8,7 +8,8 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["export_evaluation_html", "export_roc_html"]
+__all__ = ["export_evaluation_html", "export_roc_html",
+           "export_calibration_html"]
 
 
 def _svg_polyline(xs, ys, w=420, h=300, color="#36c"):
@@ -51,6 +52,54 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head>
 <h2>Confusion matrix (rows = actual)</h2>
 <table><tr><th></th>{''.join(f'<th>{j}</th>' for j in range(n))}</tr>
 {''.join(conf_rows)}</table>
+</body></html>"""
+    with open(path, "w") as f:
+        f.write(html)
+
+
+def _svg_bars(counts, w=420, h=220, color="#593"):
+    total = max(1, int(max(counts))) if len(counts) else 1
+    n = max(1, len(counts))
+    bw = (w - 50) / n
+    bars = "".join(
+        f'<rect x="{30 + i * bw:.1f}" '
+        f'y="{h - 25 - (c / total) * (h - 50):.1f}" '
+        f'width="{max(1.0, bw - 1):.1f}" '
+        f'height="{(c / total) * (h - 50):.1f}" fill="{color}"/>'
+        for i, c in enumerate(counts))
+    return (f'<svg width="{w}" height="{h}">'
+            f'<rect x="30" y="25" width="{w-50}" height="{h-50}" '
+            f'fill="none" stroke="#ccc"/>{bars}</svg>')
+
+
+def export_calibration_html(calibration, path: str,
+                            title: str = "Calibration") -> None:
+    """Reliability diagrams + ECE per class, the overall residual
+    plot and probability histogram (the calibration charts the
+    reference's UI renders from EvaluationCalibration)."""
+    ec = calibration
+    n = ec.num_classes()
+    if n < 0:
+        raise ValueError(
+            "EvaluationCalibration has no data — call eval() before "
+            "exporting")
+    sections = []
+    for i in range(max(0, n)):
+        mean_pred, observed = ec.reliability_diagram(i)
+        sections.append(
+            f"<h2>Class {i} reliability "
+            f"(ECE {ec.expected_calibration_error(i):.4f})</h2>"
+            + _svg_polyline(list(mean_pred), list(observed)))
+    _, resid = ec.residual_plot()
+    _, hist = ec.probability_histogram()
+    html = f"""<!DOCTYPE html><html><head><title>{title}</title>
+<style>body{{font-family:sans-serif;margin:2em}}</style></head>
+<body><h1>{title}</h1>
+{''.join(sections)}
+<h2>Residual plot |label &minus; p| (all classes)</h2>
+{_svg_bars(list(resid))}
+<h2>Probability histogram (all classes)</h2>
+{_svg_bars(list(hist), color="#36c")}
 </body></html>"""
     with open(path, "w") as f:
         f.write(html)

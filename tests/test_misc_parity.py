@@ -180,6 +180,17 @@ class TestEvaluationTools:
         export_roc_html(roc, p2)
         assert "AUC" in open(p2).read()
 
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+        from deeplearning4j_tpu.evaluation.tools import (
+            export_calibration_html)
+        ec = EvaluationCalibration()
+        ec.eval(labels, preds / preds.sum(1, keepdims=True))
+        p3 = os.path.join(tmp_path, "cal.html")
+        export_calibration_html(ec, p3)
+        html3 = open(p3).read()
+        assert "ECE" in html3 and "Residual plot" in html3
+        assert html3.count("<svg") == 5     # 3 reliability + 2 hists
+
 
 class TestEvaluationCalibration:
     """Residual plots + mask contract (round-4 verdict weak #5): every
