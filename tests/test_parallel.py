@@ -1044,6 +1044,43 @@ class TestThreeAxisComposition:
             np.asarray(comp.params_flat()),
             np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
 
+    def test_dp_tp_sp_with_dropout_matches_exactly(self):
+        """Under GSPMD the dropout mask is computed over the LOGICAL
+        global array with the same rng fold as the single-device
+        step, so even stochastic training matches — no per-shard
+        noise decorrelation needed (unlike the manual seq step)."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            EmbeddingSequenceLayer, RnnOutputLayer,
+            TransformerEncoderLayer)
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            shard_params)
+
+        def build():
+            b = (NeuralNetConfiguration.builder().set_seed(6)
+                 .updater(updaters.adam(1e-2)).list()
+                 .layer(EmbeddingSequenceLayer(n_in=self.V,
+                                               n_out=self.C))
+                 .layer(TransformerEncoderLayer(n_heads=4,
+                                                causal=True,
+                                                dropout=0.3))
+                 .layer(RnnOutputLayer(n_out=self.V, loss="mcxent"))
+                 .set_input_type(InputType.recurrent(self.V, self.T)))
+            return MultiLayerNetwork(b.build()).init()
+
+        x, y = self._batch()
+        single = build()
+        single.fit(DataSet(x, y))
+        comp = build()
+        mesh = build_mesh(MeshSpec(data=2, model=2, seq=2),
+                          jax.devices()[:8])
+        comp.params = shard_params(comp.params, comp, mesh)
+        comp.opt_state = comp._optimizer.init(comp.params)
+        ParallelWrapper(comp, mesh, prefetch_buffer=0).fit(
+            ListDataSetIterator([DataSet(x, y)]), epochs=1)
+        np.testing.assert_allclose(
+            np.asarray(comp.params_flat()),
+            np.asarray(single.params_flat()), rtol=2e-4, atol=2e-5)
+
     def test_dp_tp_sp_computation_graph(self):
         """The GSPMD step serves BOTH executors: a ComputationGraph
         with a head-split attention vertex trains dp=2 x tp=2 x sp=2
