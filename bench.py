@@ -1248,7 +1248,11 @@ def _leg_transformer_decode(peak):
         float(jnp.sum(h))               # host fetch = end-of-burst sync
         return time.perf_counter() - t0
 
-    eager_steps = 16
+    # few eager steps: each token-step is DOZENS of un-jitted op
+    # dispatches through the tunnel (~10-130 ms each) — the baseline
+    # only needs enough steps for a stable per-token rate, and the
+    # short history already flatters it
+    eager_steps = 6
     net.rnn_clear_previous_state()
     h = net.rnn_time_step(ids[0])       # warm the eager op caches
     float(jnp.sum(h))
