@@ -354,10 +354,11 @@ class NetworkSpmdPipeline:
     single-device loop over the M microbatches — NOT like one
     full-batch step (the standard pipeline-parallel BN contract).
 
-    Limits (fail loudly): the net must end in a loss layer, carry no
-    input preprocessors, masks, or gradient normalization /
-    clipping / constraints / per-layer updaters; the identical run
-    must cover at least S layers.
+    Limits (fail loudly): the net must end in a loss layer and carry
+    no masks, gradient normalization / clipping / constraints /
+    per-layer updaters; input preprocessors are supported in the
+    replicated prefix/suffix but not STRICTLY inside the rotating
+    run; the identical run must cover at least S layers.
     """
 
     def __init__(self, model, mesh, *, axis: str = "pipe",
