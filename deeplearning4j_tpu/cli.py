@@ -8,6 +8,7 @@ subcommands:
     python -m deeplearning4j_tpu train --model m.zip --data d.csv \
         --features 4 --label-index 4 --classes 3 --workers 8
     python -m deeplearning4j_tpu ui --port 9000
+    python -m deeplearning4j_tpu serve --model m.zip --port 8080
     python -m deeplearning4j_tpu serve-knn --points p.npy --port 9200
     python -m deeplearning4j_tpu summary --model m.zip
 """
@@ -81,6 +82,38 @@ def _cmd_serve_knn(args):
         server.stop()
 
 
+def _cmd_serve(args):
+    import time
+    from deeplearning4j_tpu.serving.http import ModelServer
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    from deeplearning4j_tpu.util.model_serializer import restore_model
+    registry = ModelRegistry()
+    for spec in args.model:
+        # [NAME=]PATH: an existing file wins outright — a bare path
+        # may itself contain '=' (run=3/m.zip); otherwise split on
+        # the first '=' only when the prefix looks like a name
+        name, sep, path = spec.partition("=")
+        if os.path.exists(spec) or not sep or os.sep in name \
+                or "/" in name:
+            name, path = "default", spec
+        version = registry.register(name, restore_model(path))
+        print(f"registered {name} v{version} from {path}")
+    server = ModelServer(
+        registry, port=args.port, host=args.host,
+        max_batch_size=args.max_batch_size,
+        queue_limit=args.queue_limit, wait_ms=args.wait_ms,
+        slots=args.slots, capacity=args.capacity).start()
+    print(f"serving on http://{args.host}:{server.port}/ "
+          f"(/v1/predict /v1/generate /v1/models /healthz /metrics; "
+          "ctrl-c drains and stops)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("draining...")
+        server.stop(drain=True)
+
+
 def _cmd_summary(args):
     from deeplearning4j_tpu.util.model_guesser import (guess_format,
                                                        load_model_guess)
@@ -120,6 +153,28 @@ def main(argv=None):
     k.add_argument("--distance", default="euclidean",
                    choices=["euclidean", "cosine"])
     k.set_defaults(fn=_cmd_serve_knn)
+
+    v = sub.add_parser(
+        "serve",
+        help="model-serving HTTP server (dynamic + continuous "
+             "batching, admission control, /metrics)")
+    v.add_argument("--model", action="append", required=True,
+                   metavar="[NAME=]PATH",
+                   help="model zip to host; repeatable; NAME defaults "
+                        "to 'default'")
+    v.add_argument("--host", default="127.0.0.1")
+    v.add_argument("--port", type=int, default=8080)
+    v.add_argument("--max-batch-size", type=int, default=32,
+                   help="rows per coalesced predict call")
+    v.add_argument("--queue-limit", type=int, default=256,
+                   help="pending requests before load-shed (429)")
+    v.add_argument("--wait-ms", type=float, default=2.0,
+                   help="batch collection window")
+    v.add_argument("--slots", type=int, default=4,
+                   help="continuous-batching KV-cache slots")
+    v.add_argument("--capacity", type=int, default=256,
+                   help="max prompt+generated tokens per request")
+    v.set_defaults(fn=_cmd_serve)
 
     s = sub.add_parser("summary", help="inspect a model file")
     s.add_argument("--model", required=True)

@@ -507,6 +507,20 @@ class MultiLayerNetwork:
         return StreamingSession(self, capacity, batch,
                                 dtype or jnp.float32)
 
+    def slot_streaming_session(self, capacity: int, slots: int,
+                               dtype=None):
+        """Per-slot-position streaming session for continuous
+        batching: each of the ``slots`` batch rows is an independent
+        decode stream that can be reset and re-admitted while its
+        neighbours keep generating (see
+        ``serving.ContinuousBatcher``)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.streaming import (
+            SlotStreamingSession)
+        return SlotStreamingSession(self, capacity, slots,
+                                    dtype or jnp.float32)
+
     # ------------------------------------------------------------------
     # params plumbing (reference flat params view :542-554)
     # ------------------------------------------------------------------

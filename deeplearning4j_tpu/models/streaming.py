@@ -26,8 +26,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["StreamingSession", "GraphStreamingSession"]
+__all__ = ["StreamingSession", "GraphStreamingSession",
+           "SlotStreamingSession"]
 
 
 class _BoundedSession:
@@ -195,15 +197,20 @@ class StreamingSession(_BoundedSession):
                  dtype=jnp.float32):
         super().__init__(capacity, batch)
         self.net = net
-        self._states = []
-        for layer in net.layers:
+        self._dtype = dtype
+        self._states = self._fresh_states()
+
+    def _fresh_states(self):
+        states = []
+        for layer in self.net.layers:
             if hasattr(layer, "apply_stream_bounded"):
-                self._states.append(layer.zero_stream_cache(
-                    batch, self.capacity, dtype))
+                states.append(layer.zero_stream_cache(
+                    self.batch, self.capacity, self._dtype))
             elif hasattr(layer, "zero_state"):
-                self._states.append(layer.zero_state(batch))
+                states.append(layer.zero_state(self.batch))
             else:
-                self._states.append(None)
+                states.append(None)
+        return states
 
     def _raw_step(self, t: int):
         net = self.net
@@ -281,6 +288,114 @@ class StreamingSession(_BoundedSession):
                 self._states[i] = layer.zero_state(self.batch)
             elif hasattr(layer, "apply_stream"):
                 self._states[i] = None     # running pool restarts
+
+
+class SlotStreamingSession(StreamingSession):
+    """Continuous-batching substrate: a StreamingSession whose ``pos``
+    is PER SLOT (a (B,) vector), so each batch row is an independent
+    decode stream that can be reset and re-admitted while its
+    neighbours keep generating — the iteration-level scheduling the
+    serving layer needs (admit new requests into free KV-cache slots
+    between steps instead of draining the whole batch).
+
+    Built on the scalar machinery by vmapping the t=1 raw step over
+    the batch axis: every slot runs the exact B=1 computation with its
+    own position, so a request's logits are bitwise independent of
+    which other slots are occupied (slot-parity is tested). The KV
+    mask (k_pos <= q_pos) makes slot reuse free for attention caches —
+    a re-admitted slot starts at pos 0 and never sees the previous
+    occupant's stale keys; recurrent carries DO need zeroing, which
+    ``reset_slot`` does row-wise.
+
+    Restriction: running-statistic carries (``apply_stream`` layers,
+    e.g. GlobalPooling) lazily materialize state with restart-at-None
+    semantics that has no per-row reset — such layers are rejected at
+    construction (use the one-shot predict path for those models).
+    """
+
+    def __init__(self, net, capacity: int, slots: int,
+                 dtype=jnp.float32):
+        for i, layer in enumerate(net.layers):
+            if (not hasattr(layer, "apply_stream_bounded")
+                    and not hasattr(layer, "zero_state")
+                    and hasattr(layer, "apply_stream")):
+                raise ValueError(
+                    f"layer {i} ({type(layer).__name__}) carries a "
+                    "running statistic (apply_stream) with no per-"
+                    "slot reset; SlotStreamingSession cannot host it")
+        super().__init__(net, capacity, slots, dtype)
+        self.slots = slots
+        self.slot_pos = np.zeros((slots,), np.int32)
+        self._slot_step = None
+
+    def _make_slot_step(self):
+        raw = self._raw_step(1)
+
+        def per_slot(params, lstates, states, pos, x):
+            # re-grow the batch axis the vmap stripped: the raw step
+            # (and every layer under it) is written for (B, t, C)
+            states1 = jax.tree_util.tree_map(lambda s: s[None], states)
+            h, new_states = raw(params, lstates, states1, pos,
+                                x[None])
+            return h[0], jax.tree_util.tree_map(lambda s: s[0],
+                                                new_states)
+
+        vm = jax.vmap(per_slot, in_axes=(None, None, 0, 0, 0))
+        return jax.jit(vm, donate_argnums=(2,))
+
+    def step_slots(self, x, active):
+        """One decode step for every slot at once. ``x`` is
+        (slots, 1, C) — occupied slots carry their next token, free
+        slots a dummy (their output is ignored and their ``pos`` does
+        not advance, so the dummy write is overwritten on admission).
+        ``active`` is a (slots,) bool mask. Returns the (slots, 1, V)
+        network output for the new step."""
+        x = jnp.asarray(x)
+        active = np.asarray(active, bool)
+        if x.shape[0] != self.slots:
+            raise ValueError(f"x has {x.shape[0]} rows; session has "
+                             f"{self.slots} slots")
+        if active.any() and int(self.slot_pos[active].max()) >= \
+                self.capacity:
+            raise ValueError(
+                f"slot overflow: an active slot is at pos "
+                f"{int(self.slot_pos[active].max())} with capacity "
+                f"{self.capacity} — admit shorter requests or build "
+                "the session with a larger capacity")
+        if self._slot_step is None:
+            self._slot_step = self._make_slot_step()
+        h, self._states = self._slot_step(
+            self.net.params, self.net.state, self._states,
+            jnp.asarray(self.slot_pos), x)
+        self.slot_pos = self.slot_pos + active.astype(self.slot_pos.dtype)
+        return h
+
+    def reset_slot(self, slot: int):
+        """Recycle one slot for a new request: rewind its position and
+        zero its recurrent carries row-wise. Attention caches need no
+        zeroing (positions beyond the slot's pos are masked and get
+        overwritten as the new stream advances)."""
+        self.slot_pos[slot] = 0
+        for i, layer in enumerate(self.net.layers):
+            if hasattr(layer, "apply_stream_bounded"):
+                continue
+            if hasattr(layer, "zero_state"):
+                zero = layer.zero_state(1)
+                self._states[i] = jax.tree_util.tree_map(
+                    lambda s, z: s.at[slot].set(z[0]),
+                    self._states[i], zero)
+
+    def reset(self):
+        super().reset()
+        self.slot_pos = np.zeros((self.slots,), np.int32)
+
+    def reinit_states(self):
+        """Rebuild EVERY carry from scratch. The jitted slot step
+        donates the state buffers, so after a step that failed
+        mid-call the old carries may be deleted device arrays —
+        recycling the session means fresh ones, not a reset."""
+        self.slot_pos = np.zeros((self.slots,), np.int32)
+        self._states = self._fresh_states()
 
 
 class GraphStreamingSession(_BoundedSession):

@@ -18,7 +18,23 @@ from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["InferenceMode", "ParallelInference"]
+from deeplearning4j_tpu.serving.errors import QueueFullError
+
+__all__ = ["InferenceMode", "ParallelInference", "QueueFullError",
+           "pow2_pad_rows"]
+
+
+def pow2_pad_rows(x: np.ndarray) -> np.ndarray:
+    """Pad axis 0 up to the next power of two (shape bucketing: a
+    batch of 1..max rows compiles to ~log2(max) executables, not max).
+    Shared by this collector and the serving scheduler built on it."""
+    target = 1
+    while target < x.shape[0]:
+        target *= 2
+    if target == x.shape[0]:
+        return x
+    pad = np.zeros((target - x.shape[0],) + x.shape[1:], x.dtype)
+    return np.concatenate([x, pad], axis=0)
 
 
 class InferenceMode:
@@ -80,14 +96,27 @@ class ParallelInference:
 
     # ---- serving ----
     def output(self, x) -> np.ndarray:
-        """Blocking inference call, safe from many threads."""
+        """Blocking inference call, safe from many threads.
+
+        Backpressure is EXPLICIT: when ``queue_limit`` pending requests
+        are already waiting, this raises :class:`QueueFullError`
+        immediately instead of blocking the caller indefinitely — the
+        reference's ObservablesProvider drops to the caller the same
+        way, and the serving scheduler reuses this fail-fast path.
+        """
         x = np.asarray(x)
         if self.mode == InferenceMode.SEQUENTIAL:
             return np.asarray(self.model.output(x))
         if self._stop.is_set():
             raise RuntimeError("ParallelInference is shut down")
         p = _Pending(x)
-        self._queue.put(p)
+        try:
+            self._queue.put_nowait(p)
+        except queue.Full:
+            raise QueueFullError(
+                f"inference queue is at its limit "
+                f"({self._queue.maxsize} pending requests); shed the "
+                "request and retry with backoff") from None
         if self._stop.is_set() and not p.event.is_set():
             # raced with shutdown's drain: serve directly rather than
             # waiting on a collector that already exited
@@ -134,23 +163,36 @@ class ParallelInference:
         try:
             x = np.concatenate([p.x for p in batch], axis=0)
             # pad to next power of two -> few distinct compiled shapes
-            target = 1
-            while target < x.shape[0]:
-                target *= 2
-            if target != x.shape[0]:
-                pad = np.zeros((target - x.shape[0],) + x.shape[1:],
-                               x.dtype)
-                x = np.concatenate([x, pad], axis=0)
-            out = np.asarray(self.model.output(x))
+            out = np.asarray(self.model.output(pow2_pad_rows(x)))
             off = 0
             for p in batch:
                 n = p.x.shape[0]
                 p.result = out[off:off + n]
                 off += n
                 p.event.set()
-        except BaseException as e:   # deliver the error to every waiter
+        except BaseException as batch_err:
+            # the coalesced call failed — retry each item ALONE so a
+            # poison request fails only its own caller, and every
+            # waiter gets either a result or its OWN error (never a
+            # neighbour's). Two CONSECUTIVE per-item failures mean
+            # the device, not an input, is broken: stop hammering it
+            # once per waiter and fail the remainder immediately
+            consecutive = 0
             for p in batch:
-                p.error = e
+                if consecutive >= 2:
+                    p.error = batch_err
+                    p.event.set()
+                    continue
+                try:
+                    # padded retry — the raw row count may be a shape
+                    # the pow2 bucketing never compiled
+                    out = np.asarray(self.model.output(
+                        pow2_pad_rows(p.x)))
+                    p.result = out[:p.x.shape[0]]
+                    consecutive = 0
+                except BaseException as e:
+                    consecutive += 1
+                    p.error = e
                 p.event.set()
 
     def shutdown(self):

@@ -1,0 +1,41 @@
+"""deeplearning4j_tpu.serving — production model serving.
+
+Unifies the repo's serving fragments into one stack (see ISSUE /
+COMPONENTS.md "Serving"): ModelRegistry (versioned hosting),
+BatchScheduler (dynamic batching + admission control),
+ContinuousBatcher (iteration-level scheduling over KV-cache slots),
+ModelServer (stdlib HTTP front end) and ServingMetrics (latency
+histograms / queue depth / batch occupancy / shed counts).
+
+Submodules import lazily: ``serving.errors`` stays a dependency leaf
+(``parallel/inference`` imports it), and importing the package does
+not pull jax/numpy until a component is actually used.
+"""
+
+_EXPORTS = {
+    "ServingError": "errors",
+    "QueueFullError": "errors",
+    "DeadlineExceededError": "errors",
+    "ModelNotFoundError": "errors",
+    "ServerClosedError": "errors",
+    "LatencyHistogram": "metrics",
+    "EndpointMetrics": "metrics",
+    "BatchOccupancy": "metrics",
+    "ServingMetrics": "metrics",
+    "ModelRegistry": "registry",
+    "BatchScheduler": "scheduler",
+    "pow2_pad_rows": "scheduler",
+    "ContinuousBatcher": "continuous",
+    "ModelServer": "http",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)

@@ -1,0 +1,244 @@
+"""Continuous batching over bounded-KV-cache decode sessions.
+
+One-shot dynamic batching (scheduler.py) is wrong for autoregressive
+generation: requests finish at different lengths, and draining the
+whole batch before admitting new work leaves device slots idle exactly
+when traffic is heaviest. This module does iteration-level scheduling
+(the Orca/vLLM idea, here over ``models/streaming.py``'s
+SlotStreamingSession): a fixed pool of KV-cache slots steps together
+— every step is the SAME (slots, 1, 1) compiled executable — and
+between steps finished slots are recycled to queued requests. Prompt
+prefill rides the decode steps token-by-token (teacher-forced), so
+admission never changes the compiled shape.
+
+Admission control mirrors the scheduler (the shared
+``serving/lifecycle.py`` plumbing): bounded queue with
+``QueueFullError`` shed, per-request deadline checked while queued,
+graceful drain. Sampling happens host-side per step (greedy or
+temperature with a per-request seeded RNG), which keeps per-request
+sampling parameters out of the compiled program; each slot's logits
+are bitwise independent of its neighbours (vmapped B=1 math —
+slot-reuse parity against a sequential decode is tested).
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.errors import DeadlineExceededError
+from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
+                                                  ServingBackend)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+__all__ = ["ContinuousBatcher"]
+
+
+class _GenRequest(BaseRequest):
+    __slots__ = ("prompt", "n_tokens", "temperature", "seed")
+
+    def __init__(self, prompt, n_tokens, temperature, seed, deadline):
+        super().__init__(deadline)
+        self.prompt = prompt
+        self.n_tokens = n_tokens
+        self.temperature = temperature
+        self.seed = seed
+
+
+class _Slot:
+    __slots__ = ("req", "feed", "prompt_left", "out", "rng")
+
+    def __init__(self, req: _GenRequest):
+        self.req = req
+        self.feed = int(req.prompt[0])
+        self.prompt_left = list(int(t) for t in req.prompt[1:])
+        self.out: List[int] = []
+        self.rng = (np.random.default_rng(req.seed)
+                    if req.temperature > 0 else None)
+
+
+class ContinuousBatcher(ServingBackend):
+    """Slot-recycling decode scheduler for one id-input
+    (embedding-first) language model.
+
+    ``slots`` is the device batch (the max continuous-batch
+    occupancy); ``capacity`` bounds prompt+generation length per
+    request.
+    """
+
+    def __init__(self, net, slots: int = 4, capacity: int = 256,
+                 queue_limit: int = 64,
+                 metrics: Optional[ServingMetrics] = None,
+                 name: str = "generate", dtype=None):
+        super().__init__("contbatch", name, queue_limit, slots,
+                         metrics)
+        self.session = net.slot_streaming_session(capacity=capacity,
+                                                  slots=slots,
+                                                  dtype=dtype)
+        self.slots = slots
+        self.capacity = capacity
+        self._slots: List[Optional[_Slot]] = [None] * slots
+        # admitted-but-unslotted requests live HERE, not in the queue:
+        # deadlines must be enforceable while every slot is busy, and
+        # a queue.Queue cannot be inspected without draining it
+        self._pending: List[_GenRequest] = []
+        self._start_worker()
+
+    # ---- admission ----
+    def submit(self, prompt, n_tokens: int, temperature: float = 0.0,
+               seed: int = 0,
+               timeout: Optional[float] = None) -> _GenRequest:
+        """Enqueue one generate request. ``prompt`` is a 1-d (or
+        (1, T0)) sequence of token ids; returns a waitable handle."""
+        self._admit_guard()
+        prompt = np.asarray(prompt).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must contain at least one token")
+        if int(n_tokens) < 1:
+            raise ValueError(
+                f"n_tokens must be >= 1, got {n_tokens}")
+        if prompt.size + n_tokens > self.capacity:
+            raise ValueError(
+                f"prompt ({prompt.size}) + n_tokens ({n_tokens}) "
+                f"exceeds slot capacity {self.capacity}")
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        return self._enqueue(_GenRequest(
+            prompt, int(n_tokens), float(temperature), int(seed),
+            deadline))
+
+    def generate(self, prompt, n_tokens: int, temperature: float = 0.0,
+                 seed: int = 0,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        return self.wait(self.submit(prompt, n_tokens, temperature,
+                                     seed, timeout=timeout))
+
+    def active_slots(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def _extra_depth(self) -> int:
+        return len(self._pending)
+
+    # ---- iteration-level scheduling ----
+    def _pump(self, block: bool) -> None:
+        """Move everything queued into the pending list (blocking
+        briefly only when the batcher is otherwise idle)."""
+        try:
+            self._pending.append(
+                self._queue.get(timeout=0.05 if block else 0.0))
+        except queue.Empty:
+            return
+        while True:
+            try:
+                self._pending.append(self._queue.get_nowait())
+            except queue.Empty:
+                return
+
+    def _expire_pending(self) -> None:
+        """Deadline enforcement runs EVERY step, including while all
+        slots are busy — a waiter must fail at its deadline, not when
+        a slot finally frees."""
+        now = time.monotonic()
+        keep = []
+        for r in self._pending:
+            if r.deadline is not None and now > r.deadline:
+                self._endpoint.count_expired()
+                r.error = DeadlineExceededError(
+                    "generate request deadline expired while queued "
+                    "(decoding never started)")
+                r.event.set()
+            else:
+                keep.append(r)
+        self._pending = keep
+
+    def _admit(self) -> None:
+        while self._pending:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if not free:
+                return
+            r = self._pending.pop(0)
+            self.session.reset_slot(free[0])
+            self._slots[free[0]] = _Slot(r)
+
+    @staticmethod
+    def _sample(probs: np.ndarray, slot: _Slot) -> int:
+        if slot.req.temperature <= 0:
+            return int(np.argmax(probs))
+        logits = np.log(probs + 1e-9) / slot.req.temperature
+        p = np.exp(logits - logits.max())
+        p = p / p.sum()
+        return int(slot.rng.choice(p.size, p=p))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            have_active = any(s is not None for s in self._slots)
+            self._pump(block=not have_active and not self._pending)
+            self._expire_pending()
+            self._admit()
+            active = np.asarray([s is not None for s in self._slots])
+            if not active.any():
+                if (self._draining.is_set() and self._queue.empty()
+                        and not self._pending):
+                    self._drained.set()
+                continue
+            x = np.zeros((self.slots, 1, 1), np.float32)
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    x[i, 0, 0] = s.feed
+            try:
+                h = np.asarray(self.session.step_slots(x, active))
+            except BaseException as e:
+                # a failed device step poisons every active stream —
+                # deliver the error, recycle the slots, and REBUILD
+                # the session carries: the jitted step donates them,
+                # so after a mid-call failure the old buffers may
+                # already be deleted and every later step would die
+                # with them
+                for i, s in enumerate(self._slots):
+                    if s is not None:
+                        self._endpoint.count_error()
+                        s.req.error = e
+                        s.req.event.set()
+                        self._slots[i] = None
+                try:
+                    self.session.reinit_states()
+                except BaseException:
+                    pass      # next step surfaces any persistent fault
+                continue
+            self._occupancy.record(int(active.sum()))
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    continue
+                if s.prompt_left:
+                    # still prefilling: teacher-force the next prompt
+                    # token; this step's output is discarded
+                    s.feed = s.prompt_left.pop(0)
+                    continue
+                try:
+                    nxt = self._sample(h[i, 0], s)
+                except BaseException as e:
+                    # per-slot host-side failure (e.g. NaN output
+                    # probabilities under temperature sampling) fails
+                    # only this request — never the worker
+                    self._endpoint.count_error()
+                    s.req.error = e
+                    s.req.event.set()
+                    self._slots[i] = None
+                    continue
+                s.out.append(nxt)
+                if len(s.out) >= s.req.n_tokens:
+                    s.req.result = np.asarray(s.out, np.int64)
+                    s.req.event.set()
+                    self._slots[i] = None    # slot recycled next admit
+                else:
+                    s.feed = nxt
+
+    def _abort_inflight(self):
+        leftovers = [s.req for s in self._slots if s is not None]
+        leftovers.extend(self._pending)
+        self._slots = [None] * self.slots
+        self._pending = []
+        return leftovers

@@ -1,0 +1,45 @@
+"""Typed serving errors.
+
+A serving front must fail FAST and fail TYPED: callers (and the HTTP
+layer mapping errors to status codes) distinguish "the system is
+saturated, back off" (QueueFullError → 429), "your request waited past
+its deadline" (DeadlineExceededError → 504), "no such model"
+(ModelNotFoundError → 404) and "the server is draining for shutdown"
+(ServerClosedError → 503). Blocking forever — the failure mode the
+round-5 ADVICE flags for naive bounded queues — is never an option.
+
+This module is a dependency LEAF (stdlib only): ``parallel/inference``
+imports ``QueueFullError`` from here without pulling the rest of the
+serving stack, and ``serving/__init__`` re-exports lazily.
+"""
+
+__all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
+           "ModelNotFoundError", "ServerClosedError"]
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-layer failures."""
+
+
+class QueueFullError(ServingError):
+    """Admission control rejected the request: the bounded queue is at
+    its limit. Load-shedding semantics — the caller should back off
+    and retry, not block (HTTP maps this to 429)."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired while it waited in the queue (or
+    before its batch was served). The work was never started — safe to
+    retry (HTTP maps this to 504)."""
+
+
+class ModelNotFoundError(ServingError, KeyError):
+    """No model registered under the requested name/version (404)."""
+
+    def __str__(self):   # KeyError quotes its message; keep it plain
+        return ServingError.__str__(self)
+
+
+class ServerClosedError(ServingError):
+    """The scheduler/server is draining or shut down: no new requests
+    are admitted; in-flight requests still complete (503)."""

@@ -1,0 +1,307 @@
+"""Stdlib HTTP front end for the serving stack.
+
+The same ``ThreadingHTTPServer`` idiom as ``ui/server.py`` (the
+reference's Play-based servers become stdlib http.server + JSON), in
+front of the registry + schedulers:
+
+- ``POST /v1/predict``  {"model", "version"?, "inputs", "timeout_ms"?}
+  → {"outputs", "model_version"}
+- ``POST /v1/generate`` {"model", "version"?, "prompt", "n_tokens",
+  "temperature"?, "seed"?, "timeout_ms"?} → {"ids", "model_version"}
+- ``GET  /v1/models``   → registry listing
+- ``GET  /healthz``     → {"status": "ok" | "draining"}
+- ``GET  /metrics``     → ServingMetrics snapshot
+
+Error mapping is the typed-error contract from ``serving/errors.py``:
+QueueFullError → 429, DeadlineExceededError → 504, ModelNotFoundError
+→ 404, ServerClosedError (draining) → 503, bad request → 400.
+``stop(drain=True)`` is the graceful path: /healthz flips to
+"draining", new work is refused, queued + in-flight work completes,
+then the listener stops.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.continuous import ContinuousBatcher
+from deeplearning4j_tpu.serving.errors import (DeadlineExceededError,
+                                               ModelNotFoundError,
+                                               QueueFullError,
+                                               ServerClosedError,
+                                               ServingError)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.scheduler import BatchScheduler
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["ModelServer"]
+
+
+class ModelServer:
+    """Registry + per-model schedulers behind one HTTP listener.
+
+    Schedulers are created lazily per (model name, version) on first
+    use, so registering a new version swaps serving onto a fresh
+    scheduler while the old version's in-flight batches complete.
+    """
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 port: int = 0, host: str = "127.0.0.1",
+                 max_batch_size: int = 32, queue_limit: int = 256,
+                 wait_ms: float = 2.0, slots: int = 4,
+                 capacity: int = 256,
+                 metrics: Optional[ServingMetrics] = None):
+        self.registry = registry or ModelRegistry()
+        self.metrics = metrics or ServingMetrics()
+        self.host = host
+        self.port = port
+        self.max_batch_size = max_batch_size
+        self.queue_limit = queue_limit
+        self.wait_ms = wait_ms
+        self.slots = slots
+        self.capacity = capacity
+        self._schedulers: Dict[Tuple[str, int], BatchScheduler] = {}
+        self._batchers: Dict[Tuple[str, int], ContinuousBatcher] = {}
+        self._lock = threading.Lock()
+        self._create_locks: Dict[tuple, threading.Lock] = {}
+        self._draining = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- backend resolution ----
+    def _get_or_create(self, cache: dict, key: tuple, factory):
+        """Resolve-or-build a backend WITHOUT holding the global lock
+        through construction (building allocates device buffers and
+        must not stall unrelated models), serialized per key so a
+        thundering first-request herd builds exactly one backend.
+        Draining is re-checked after the build: a backend created
+        behind stop()'s back would leak its worker thread + gauge."""
+        with self._lock:
+            b = cache.get(key)
+            if b is not None:
+                return b
+            if self._draining.is_set():
+                raise ServerClosedError(
+                    "server is draining; not creating new backends")
+            create_lock = self._create_locks.setdefault(
+                ("sched",) + key if cache is self._schedulers
+                else ("batch",) + key, threading.Lock())
+        with create_lock:
+            with self._lock:
+                b = cache.get(key)
+                if b is not None:
+                    return b
+            b = factory()
+            with self._lock:
+                if not self._draining.is_set():
+                    cache[key] = b
+                    return b
+        b.shutdown(drain=False)
+        raise ServerClosedError(
+            "server is draining; not creating new backends")
+
+    def scheduler_for(
+            self, name: str, version: Optional[int] = None
+    ) -> Tuple[BatchScheduler, int]:
+        """(scheduler, served version) — the single resolution point
+        for a predict request."""
+        model, version = self.registry.resolve(name, version)
+        s = self._get_or_create(
+            self._schedulers, (name, version),
+            lambda: BatchScheduler(
+                model, max_batch_size=self.max_batch_size,
+                queue_limit=self.queue_limit, wait_ms=self.wait_ms,
+                metrics=self.metrics,
+                name=f"predict/{name}/v{version}"))
+        return s, version
+
+    def batcher_for(
+            self, name: str, version: Optional[int] = None
+    ) -> Tuple[ContinuousBatcher, int]:
+        """(batcher, served version)."""
+        model, version = self.registry.resolve(name, version)
+        if not hasattr(model, "slot_streaming_session"):
+            raise ServingError(
+                f"model {name!r} does not support streaming "
+                "generation (no slot_streaming_session)")
+        b = self._get_or_create(
+            self._batchers, (name, version),
+            lambda: ContinuousBatcher(
+                model, slots=self.slots, capacity=self.capacity,
+                queue_limit=self.queue_limit, metrics=self.metrics,
+                name=f"generate/{name}/v{version}"))
+        return b, version
+
+    # ---- HTTP plumbing ----
+    def start(self) -> "ModelServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, obj):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n).decode() or "{}")
+
+            def do_GET(self):
+                path = urlparse(self.path).path
+                if path == "/healthz":
+                    self._send(200, {
+                        "status": ("draining"
+                                   if server._draining.is_set()
+                                   else "ok")})
+                elif path == "/metrics":
+                    self._send(200, server.metrics.snapshot())
+                elif path == "/v1/models":
+                    self._send(200, {"models":
+                                     server.registry.models()})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                if path == "/v1/predict":
+                    self._serve_request(server._handle_predict)
+                elif path == "/v1/generate":
+                    self._serve_request(server._handle_generate)
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def _serve_request(self, handler):
+                if server._draining.is_set():
+                    self._send(503, {"error": "server is draining"})
+                    return
+                try:
+                    body = self._body()
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"bad JSON: {e}"})
+                    return
+                try:
+                    self._send(200, handler(body))
+                except QueueFullError as e:
+                    self._send(429, {"error": str(e)})
+                except DeadlineExceededError as e:
+                    self._send(504, {"error": str(e)})
+                except ModelNotFoundError as e:
+                    self._send(404, {"error": str(e)})
+                except ServerClosedError as e:
+                    self._send(503, {"error": str(e)})
+                except ServingError as e:
+                    # remaining typed serving errors (e.g. generate
+                    # against a model with no streaming session) are
+                    # client mistakes, not server faults
+                    self._send(400, {"error": str(e)})
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:    # keep the listener alive
+                    logger.exception("serving error")
+                    self._send(500, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="model-server")
+        self._thread.start()
+        logger.info("model server on http://%s:%d/", self.host,
+                    self.port)
+        return self
+
+    # ---- endpoint handlers (also the in-process API) ----
+    @staticmethod
+    def _timeout_s(body) -> Optional[float]:
+        t = body.get("timeout_ms")
+        return None if t is None else float(t) / 1e3
+
+    def _handle_predict(self, body: dict) -> dict:
+        if "model" not in body or "inputs" not in body:
+            raise ValueError('predict body needs "model" and "inputs"')
+        sched, version = self.scheduler_for(body["model"],
+                                            body.get("version"))
+        x = np.asarray(body["inputs"], np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        out = sched.predict(x, timeout=self._timeout_s(body))
+        return {"outputs": np.asarray(out).tolist(),
+                "model_version": version}
+
+    def _handle_generate(self, body: dict) -> dict:
+        if "model" not in body or "prompt" not in body:
+            raise ValueError('generate body needs "model" and '
+                             '"prompt"')
+        batcher, version = self.batcher_for(body["model"],
+                                            body.get("version"))
+        ids = batcher.generate(
+            body["prompt"], int(body.get("n_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)),
+            seed=int(body.get("seed", 0)),
+            timeout=self._timeout_s(body))
+        return {"ids": np.asarray(ids).tolist(),
+                "model_version": version}
+
+    # ---- lifecycle ----
+    def evict_model(self, name: str, version: Optional[int] = None,
+                    drain: bool = True, timeout: float = 30.0) -> bool:
+        """Release the scheduler/batcher backing a swapped-out model
+        version (every version of ``name`` when ``version`` is None):
+        their collector threads and compiled executables live until
+        evicted, so pair this with ``registry.unregister`` on
+        long-running servers."""
+        ok = True
+        with self._lock:
+            keys = [k for k in set(self._schedulers) |
+                    set(self._batchers)
+                    if k[0] == name and (version is None
+                                         or k[1] == version)]
+            backends = ([self._schedulers.pop(k) for k in keys
+                         if k in self._schedulers]
+                        + [self._batchers.pop(k) for k in keys
+                           if k in self._batchers])
+        for b in backends:
+            ok = b.shutdown(drain=drain, timeout=timeout) and ok
+        return ok
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:
+        """Graceful by default: refuse new work, complete queued and
+        in-flight requests, then stop the listener. Backends drain
+        CONCURRENTLY, so the wall-clock bound is one ``timeout``, not
+        one per hosted model version."""
+        self._draining.set()
+        with self._lock:
+            backends = (list(self._schedulers.values())
+                        + list(self._batchers.values()))
+            self._schedulers.clear()
+            self._batchers.clear()
+        oks = {}
+        threads = [threading.Thread(
+            target=lambda b=b: oks.__setitem__(
+                b, b.shutdown(drain=drain, timeout=timeout)),
+            daemon=True) for b in backends]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout + 10.0)
+        ok = all(oks.get(b, False) for b in backends)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        return ok

@@ -1,0 +1,162 @@
+"""Shared backend lifecycle: bounded admission, waitable requests,
+graceful drain.
+
+BatchScheduler (one-shot predict) and ContinuousBatcher (generate)
+differ only in their serving loops; the request plumbing around those
+loops — fail-fast enqueue with shed accounting, the post-enqueue
+shutdown race guard, waiter completion, the leftover sweep that keeps
+shutdown from stranding blocked callers, drain/shutdown ordering, and
+gauge registration/cleanup — is identical and lives here so a fix to
+one backend cannot silently miss the other.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+from deeplearning4j_tpu.serving.errors import (QueueFullError,
+                                               ServerClosedError)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+__all__ = ["BaseRequest", "ServingBackend"]
+
+
+class BaseRequest:
+    """A waitable unit of admitted work."""
+
+    __slots__ = ("event", "result", "error", "deadline", "t_submit")
+
+    def __init__(self, deadline: Optional[float]):
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.deadline = deadline
+        self.t_submit = time.monotonic()
+
+
+class ServingBackend:
+    """Queue + worker-thread lifecycle shared by the serving
+    backends. Subclasses implement ``_loop`` (which must call
+    ``_sweep_leftovers`` on exit) and call ``_start_worker`` once
+    constructed."""
+
+    def __init__(self, kind: str, name: str, queue_limit: int,
+                 occupancy_max: int,
+                 metrics: Optional[ServingMetrics] = None):
+        self.name = name
+        self.metrics = metrics or ServingMetrics()
+        self._endpoint = self.metrics.endpoint(name)
+        self._occupancy = self.metrics.occupancy(name, occupancy_max)
+        self.metrics.register_gauge(f"{name}_queue_depth",
+                                    self.queue_depth)
+        self._queue: "queue.Queue[BaseRequest]" = queue.Queue(queue_limit)
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run,
+                                        name=f"{kind}-{name}",
+                                        daemon=True)
+
+    def _start_worker(self) -> None:
+        self._worker.start()
+
+    def _run(self) -> None:
+        # the worker must NEVER die without releasing waiters: a loop
+        # crash (bad request data, device fault outside the guarded
+        # step) would otherwise strand every blocked event.wait()
+        # caller forever
+        try:
+            self._loop()
+        finally:
+            self._stop.set()
+            self._sweep_leftovers(self._abort_inflight())
+
+    def _loop(self) -> None:
+        raise NotImplementedError
+
+    def _abort_inflight(self) -> List["BaseRequest"]:
+        """Uncompleted requests the subclass holds outside the queue
+        (open buckets, occupied slots); called once at worker exit."""
+        return []
+
+    # ---- admission ----
+    def _admit_guard(self) -> None:
+        if self._draining.is_set() or self._stop.is_set():
+            raise ServerClosedError(
+                f"{self.name!r} is draining; not admitting new "
+                "requests")
+
+    def _enqueue(self, r: BaseRequest) -> BaseRequest:
+        """Fail-fast put: shed at the limit, and guard the race where
+        shutdown's final sweep already ran — nothing would ever
+        complete a request admitted after it."""
+        try:
+            self._queue.put_nowait(r)
+        except queue.Full:
+            self._endpoint.count_shed()
+            raise QueueFullError(
+                f"{self.name!r} queue is at its limit "
+                f"({self._queue.maxsize}); request shed — retry with "
+                "backoff") from None
+        if self._stop.is_set() and not r.event.is_set():
+            r.error = ServerClosedError(
+                f"{self.name!r} shut down while the request was "
+                "being admitted")
+            r.event.set()
+        return r
+
+    def wait(self, r: BaseRequest):
+        r.event.wait()
+        if r.error is not None:
+            raise r.error
+        self._endpoint.observe(time.monotonic() - r.t_submit)
+        return r.result
+
+    # ---- observability ----
+    def _extra_depth(self) -> int:
+        """Work the subclass holds outside the queue (e.g. open
+        batching buckets)."""
+        return 0
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize() + self._extra_depth()
+
+    # ---- shutdown ----
+    def _sweep_leftovers(self,
+                         extra: Optional[List[BaseRequest]] = None):
+        """Fail whatever never started so no caller stays blocked on
+        ``event.wait()`` after the worker exits."""
+        err = ServerClosedError(
+            f"{self.name!r} shut down before the request was served")
+        leftovers = list(extra or [])
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for r in leftovers:
+            r.error = err
+            r.event.set()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting; let queued and in-flight work complete,
+        then stop the worker. True when fully drained in time."""
+        self._draining.set()
+        ok = self._drained.wait(timeout)
+        self._stop.set()
+        self._worker.join(timeout=5.0)
+        self.metrics.unregister_gauge(f"{self.name}_queue_depth")
+        return ok
+
+    def shutdown(self, drain: bool = True,
+                 timeout: float = 30.0) -> bool:
+        if drain:
+            return self.drain(timeout)
+        self._draining.set()
+        self._stop.set()
+        self._worker.join(timeout=5.0)
+        self.metrics.unregister_gauge(f"{self.name}_queue_depth")
+        return True

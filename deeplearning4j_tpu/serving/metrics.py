@@ -1,0 +1,248 @@
+"""Serving metrics: latency histograms, queue depth, batch occupancy.
+
+The observable surface of the serving stack (ISSUE: per-endpoint
+p50/p95/p99 latency, queue depth, batch occupancy actual/max, shed
+count), exported as one JSON snapshot on ``/metrics`` and feedable into
+the existing ``ui/stats.py`` storage so the training dashboard's
+plumbing (InMemoryStatsStorage / FileStatsStorage, the remote-POST
+route) carries serving telemetry too.
+
+Histograms are fixed log-spaced buckets (Prometheus style): recording
+is O(1) with a lock-free-enough increment under the GIL plus a lock
+for the multi-field update; quantiles interpolate within the bucket.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["LatencyHistogram", "EndpointMetrics", "BatchOccupancy",
+           "ServingMetrics"]
+
+
+def _log_buckets(lo: float = 1e-4, hi: float = 60.0,
+                 factor: float = 1.45) -> List[float]:
+    edges = [lo]
+    while edges[-1] < hi:
+        edges.append(edges[-1] * factor)
+    return edges
+
+
+_EDGES = _log_buckets()        # seconds; +1 overflow bucket at the end
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram with interpolated quantiles."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = [0] * (len(_EDGES) + 1)
+        self.count = 0
+        self.sum = 0.0
+
+    def record(self, seconds: float) -> None:
+        i = 0
+        while i < len(_EDGES) and seconds > _EDGES[i]:
+            i += 1
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += seconds
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile: linear interpolation inside the
+        bucket holding the q-th sample (0 if empty)."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        seen = 0
+        for i, c in enumerate(counts):
+            if seen + c >= rank:
+                lo = 0.0 if i == 0 else _EDGES[i - 1]
+                hi = _EDGES[min(i, len(_EDGES) - 1)]
+                frac = (rank - seen) / c if c else 0.0
+                return lo + (hi - lo) * min(1.0, frac)
+            seen += c
+        return _EDGES[-1]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self.count, self.sum
+        return {"count": count,
+                "mean_ms": round(total / count * 1e3, 3) if count else 0.0,
+                "p50_ms": round(self.quantile(0.50) * 1e3, 3),
+                "p95_ms": round(self.quantile(0.95) * 1e3, 3),
+                "p99_ms": round(self.quantile(0.99) * 1e3, 3)}
+
+
+class EndpointMetrics:
+    """Counters + latency histogram for one endpoint."""
+
+    _RATE_WINDOW = 30.0           # seconds of completions behind the
+    _RATE_EVENTS = 4096           # current-rate estimate
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.errors = 0
+        self.shed = 0             # load-shed (QueueFullError)
+        self.expired = 0          # deadline expiry
+        self.latency = LatencyHistogram()
+        self._recent = collections.deque(maxlen=self._RATE_EVENTS)
+        self._t0 = time.monotonic()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self._recent.append(time.monotonic())
+        self.latency.record(seconds)
+
+    def count_error(self) -> None:
+        # an errored response is still a completed request: folding it
+        # into ``requests`` keeps requests_per_sec honest during an
+        # outage (error rate can never exceed 100%)
+        with self._lock:
+            self.errors += 1
+            self.requests += 1
+            self._recent.append(time.monotonic())
+
+    def count_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def count_expired(self) -> None:
+        with self._lock:
+            self.expired += 1
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            out = {"requests": self.requests, "errors": self.errors,
+                   "shed": self.shed, "deadline_expired": self.expired}
+            recent = list(self._recent)
+        # CURRENT rate over a sliding window, not a lifetime average
+        # (a lifetime mean can never show a traffic drop). If the
+        # event ring overflowed inside the window, the true rate is
+        # higher — use the ring's own span as the denominator then.
+        n = sum(1 for t in recent if t >= now - self._RATE_WINDOW)
+        if n >= self._RATE_EVENTS:
+            span = max(now - recent[0], 1e-9)
+        else:
+            span = min(self._RATE_WINDOW, max(now - self._t0, 1e-9))
+        out["requests_per_sec"] = round(n / span, 2)
+        out["latency"] = self.latency.snapshot()
+        return out
+
+
+class BatchOccupancy:
+    """How full the coalesced device calls actually are — THE number
+    that says whether dynamic/continuous batching is working (avg 1.0
+    under load means the batcher degraded to sequential serving)."""
+
+    def __init__(self, max_batch_size: int):
+        self._lock = threading.Lock()
+        self.max_batch_size = max_batch_size
+        self.batches = 0
+        self.items = 0
+        self.max_seen = 0
+
+    def record(self, n_items: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.items += n_items
+            self.max_seen = max(self.max_seen, n_items)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            b, i, m = self.batches, self.items, self.max_seen
+        return {"batches": b, "items": i,
+                "avg_batch_size": round(i / b, 3) if b else 0.0,
+                "max_batch_size_seen": m,
+                "max_batch_size": self.max_batch_size}
+
+
+class ServingMetrics:
+    """Aggregated registry of endpoint metrics, occupancy trackers and
+    queue-depth gauges; one ``snapshot()`` is the /metrics payload."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._endpoints: Dict[str, EndpointMetrics] = {}
+        self._occupancy: Dict[str, BatchOccupancy] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._iteration = 0
+
+    def endpoint(self, name: str) -> EndpointMetrics:
+        with self._lock:
+            if name not in self._endpoints:
+                self._endpoints[name] = EndpointMetrics()
+            return self._endpoints[name]
+
+    def occupancy(self, name: str,
+                  max_batch_size: int = 0) -> BatchOccupancy:
+        with self._lock:
+            if name not in self._occupancy:
+                self._occupancy[name] = BatchOccupancy(max_batch_size)
+            return self._occupancy[name]
+
+    def register_gauge(self, name: str,
+                       fn: Callable[[], float]) -> None:
+        """A pull gauge (e.g. current queue depth) sampled at
+        snapshot time."""
+        with self._lock:
+            self._gauges[name] = fn
+
+    def unregister_gauge(self, name: str) -> None:
+        """Drop a gauge (a shut-down scheduler must unhook its
+        queue-depth callback, or the bound method pins the backend —
+        and its model — in memory forever)."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            endpoints = dict(self._endpoints)
+            occupancy = dict(self._occupancy)
+            gauges = dict(self._gauges)
+        out = {"endpoints": {n: e.snapshot()
+                             for n, e in endpoints.items()},
+               "batching": {n: o.snapshot()
+                            for n, o in occupancy.items()},
+               "gauges": {}}
+        for name, fn in gauges.items():
+            try:
+                out["gauges"][name] = fn()
+            except Exception:
+                out["gauges"][name] = None
+        return out
+
+    # ---- bridge into the training-UI stats pipeline ----
+    def publish_to(self, storage, session_id: str = "serving",
+                   endpoint: Optional[str] = None) -> None:
+        """Append one StatsReport snapshot to a ``ui/stats.py``
+        storage (InMemory or File): serving throughput rides the
+        ``samples_per_sec`` series and p50 latency the
+        ``duration_ms`` series, so the existing dashboard and its
+        remote-POST route chart serving load with zero new wiring."""
+        from deeplearning4j_tpu.ui.stats import StatsReport
+        snap = self.snapshot()
+        eps = snap["endpoints"]
+        if endpoint is not None:
+            eps = {endpoint: eps[endpoint]} if endpoint in eps else {}
+        requests = sum(e["requests"] for e in eps.values())
+        rps = sum(e["requests_per_sec"] for e in eps.values())
+        p50 = max((e["latency"]["p50_ms"] for e in eps.values()),
+                  default=0.0)
+        with self._lock:
+            self._iteration += 1
+            it = self._iteration
+        storage.put_update(StatsReport(
+            session_id=session_id, worker_id="serving_0", iteration=it,
+            timestamp=time.time(), score=float(requests),
+            samples_per_sec=float(rps), duration_ms=float(p50)))

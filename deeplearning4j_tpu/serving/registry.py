@@ -1,0 +1,117 @@
+"""Model registry: named, versioned, hot-swappable model hosting.
+
+The TF-Serving ServableManager idea (Abadi et al., arXiv:1605.08695)
+on this repo's executors: a server hosts several named
+``MultiLayerNetwork``/``ComputationGraph`` models; registering a new
+version under an existing name atomically swaps the serving default
+(new requests see the new version, in-flight requests finish on the
+model object they already resolved — Python refcounting keeps the old
+version alive until its last request completes); old versions stay
+addressable until ``unregister``\\ ed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.serving.errors import ModelNotFoundError
+
+__all__ = ["ModelRegistry"]
+
+
+class ModelRegistry:
+    """Thread-safe name → {version → model} map with a serving
+    default (the highest registered version)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: Dict[str, Dict[int, object]] = {}
+        self._registered_at: Dict[str, Dict[int, float]] = {}
+
+    def register(self, name: str, model,
+                 version: Optional[int] = None) -> int:
+        """Host ``model`` under ``name``. ``version`` defaults to
+        (highest existing version)+1 — registering again under the
+        same name IS the swap-in. Returns the version."""
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version is None:
+                version = max(versions, default=0) + 1
+            versions[version] = model
+            self._registered_at.setdefault(name, {})[version] = \
+                time.time()
+            return version
+
+    def get(self, name: str, version: Optional[int] = None):
+        """Resolve a model (the highest version when ``version`` is
+        None). Raises :class:`ModelNotFoundError` otherwise."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFoundError(f"no model named {name!r}")
+            if version is None:
+                version = max(versions)
+            model = versions.get(version)
+            if model is None:
+                raise ModelNotFoundError(
+                    f"model {name!r} has no version {version} "
+                    f"(available: {sorted(versions)})")
+            return model
+
+    def resolve(self, name: str, version: Optional[int] = None):
+        """(model, version) — the version actually served."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFoundError(f"no model named {name!r}")
+            if version is None:
+                version = max(versions)
+            if version not in versions:
+                raise ModelNotFoundError(
+                    f"model {name!r} has no version {version} "
+                    f"(available: {sorted(versions)})")
+            return versions[version], version
+
+    def unregister(self, name: str,
+                   version: Optional[int] = None) -> None:
+        """Swap a version out (all versions when ``version`` is None).
+        In-flight requests holding the model object complete
+        normally."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFoundError(f"no model named {name!r}")
+            if version is None:
+                del self._models[name]
+                self._registered_at.pop(name, None)
+                return
+            if version not in versions:
+                raise ModelNotFoundError(
+                    f"model {name!r} has no version {version}")
+            del versions[version]
+            self._registered_at.get(name, {}).pop(version, None)
+            if not versions:
+                del self._models[name]
+                self._registered_at.pop(name, None)
+
+    def models(self) -> List[dict]:
+        """The /v1/models payload."""
+        with self._lock:
+            out = []
+            for name in sorted(self._models):
+                versions = self._models[name]
+                out.append({
+                    "name": name,
+                    "versions": sorted(versions),
+                    "serving_default": max(versions),
+                    "registered_at": {
+                        str(v): t for v, t in sorted(
+                            self._registered_at.get(name, {}).items())},
+                })
+            return out
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._models
