@@ -43,7 +43,10 @@ LENET_BATCH = 128
 LENET_STEPS = 600
 
 # bf16 peak FLOP/s per chip by device kind (prefix match). Used only
-# for the MFU side-metric; throughput vs flax is the headline.
+# for the MFU side-metric; throughput vs flax is the headline. Kept
+# as a local mirror of observability/step_profile.py's table: the
+# orchestrator must stay import-free of the package (and of jax)
+# until its watchdog is armed.
 _PEAK_BF16 = {
     "TPU v5 lite": 197e12,    # v5e
     "TPU v5": 459e12,         # v5p
@@ -616,6 +619,44 @@ def _check_plausible(mfu_like, what):
         raise RuntimeError(
             f"implausible timing for {what}: implied MFU "
             f"{mfu_like:.2f} — tunnel degraded (non-blocking sync?)")
+
+
+BURST_STEPS = 10
+
+
+def _leg_resnet_burst(peak):
+    """Degraded-tunnel FRESH path (round-5 verdict next #1a): a
+    <=10-timed-step burst of the headline config, run FIRST and
+    committed before the full legs start. Once the persistent XLA
+    cache holds the two executables this is seconds of device time —
+    an honest freshly-measured headline even when the 420s full leg
+    cannot finish through a degraded tunnel. The full leg, when it
+    completes, supersedes this number on stdout; the burst stays in
+    BENCH_DETAIL tagged ``"burst": true``."""
+    m_ours = bench_ours(steps=BURST_STEPS, prep=True)
+    m_ref = bench_flax_resnet50(steps=BURST_STEPS, prep=True)
+    dt_o, dt_r = _interleave(m_ours, m_ref, repeats=2)
+    ours = BURST_STEPS * BATCH / dt_o
+    ref = BURST_STEPS * BATCH / dt_r
+    print(f"resnet50 BURST ours: {ours:.1f} img/s, flax ref: "
+          f"{ref:.1f}", file=sys.stderr)
+    if peak:
+        _check_plausible(_mfu(RESNET50_FWD_FLOPS, max(ours, ref), True,
+                              peak), "resnet50 f32 burst")
+    return {
+        "metric": ("ResNet50 train throughput (batch 128, 224x224, "
+                   f"f32, {BURST_STEPS}-step burst)"),
+        "value": round(ours, 1), "unit": "images/sec/chip",
+        "baseline": round(ref, 1), "vs_baseline": round(ours / ref, 3),
+        "burst": True,
+        "mfu": round(_mfu(RESNET50_FWD_FLOPS, ours, True, peak), 4)
+        if peak else None,
+        "note": ("short-burst fresh headline: committed before the "
+                 "full legs so a degraded tunnel still yields a "
+                 "freshly measured number; burst timing carries more "
+                 "per-burst sync overhead than the full 40-step leg, "
+                 "so the full leg's value supersedes it when both "
+                 "land")}
 
 
 def _leg_resnet_f32(peak):
@@ -1525,6 +1566,12 @@ _LEGS = [
     ("resnet_native_etl", _leg_resnet_native_etl, 480),
 ]
 
+# every runnable --leg (the burst headline rides outside the ordered
+# full-leg list: the orchestrator schedules it explicitly, first)
+_LEG_FNS = {**{n: f for n, f, _ in _LEGS},
+            "resnet_burst": _leg_resnet_burst}
+BURST_ESTIMATE = 300        # warm-cache: seconds; cold: one compile
+
 
 def _setup_xla_cache():
     """Persistent XLA compilation cache — the tunnel'd AOT compile of
@@ -1558,8 +1605,19 @@ def _run_leg_inprocess(name):
         # watchdog must still produce the stdout artifact + rc 0.
         time.sleep(1e9)
     _setup_xla_cache()
+    # hook jax.monitoring BEFORE first backend use so every compile
+    # in the leg is counted: compile_cache_hit answers the round-5
+    # question 'did the 441s timeout hide a cold compile?' with data
+    compile_stats = None
+    try:
+        from deeplearning4j_tpu.observability.compile_watch import (
+            install_global_watch)
+        compile_stats = install_global_watch()
+    except Exception as e:
+        print(f"{name}: compile accounting unavailable: {e}",
+              file=sys.stderr)
     peak, _ = _peak_flops()
-    fn = dict((n, f) for n, f, _ in _LEGS)[name]
+    fn = _LEG_FNS[name]
     try:
         cfg = fn(peak)
     except ImportError as e:
@@ -1568,6 +1626,18 @@ def _run_leg_inprocess(name):
         # burn a cooldown + retry on it
         print(f"{name}: dependency unavailable: {e}", file=sys.stderr)
         raise SystemExit(3)
+    if compile_stats is not None:
+        s = compile_stats.summary()
+        cfg["compile_cache_hit"] = s["cache_hit"]
+        cfg["compile_stats"] = {
+            k: s[k] for k in ("backend_compiles", "compile_secs",
+                              "cache_requests",
+                              "persistent_cache_hits")}
+        print(f"{name}: compile_cache_hit={s['cache_hit']} "
+              f"(backend_compiles={s['backend_compiles']}, "
+              f"{s['compile_secs']:.1f}s compiling, persistent hits "
+              f"{s['persistent_cache_hits']}/{s['cache_requests']} "
+              "requests)", file=sys.stderr)
     print(json.dumps(cfg), flush=True)
 
 
@@ -1590,6 +1660,11 @@ _PLACEHOLDER_HEADLINE = {
     "metric": "ResNet50 train throughput (batch 128, 224x224, f32)",
     "value": 0.0, "unit": "images/sec/chip", "vs_baseline": None}
 
+# best headline available if the full leg never lands, upgraded as
+# the run progresses: committed-stale -> fresh burst. One holder so
+# the watchdog and the main path cannot emit different fallbacks.
+_FALLBACK = {"cfg": None, "stale": True}
+
 
 def _emit_headline(cfg, stale=False):
     """The ONE stdout line the driver parses. Idempotent under the
@@ -1603,6 +1678,10 @@ def _emit_headline(cfg, stale=False):
            "unit": cfg["unit"], "vs_baseline": cfg.get("vs_baseline")}
     if cfg.get("mfu") is not None:
         out["mfu"] = cfg["mfu"]
+    if cfg.get("burst"):
+        out["burst"] = True
+    if cfg.get("compile_cache_hit") is not None:
+        out["compile_cache_hit"] = cfg["compile_cache_hit"]
     if stale:
         out["stale"] = True
         out["stale_note"] = ("tunnel degraded this run; value is the "
@@ -1611,12 +1690,22 @@ def _emit_headline(cfg, stale=False):
     print(json.dumps(out), flush=True)
 
 
-def _emit_best_fallback(fallback_cfg):
-    """No freshly-measured headline is coming: emit the committed
-    stale one, or the explicit zero-value placeholder on a first-ever
-    run. One helper so the watchdog and main paths cannot drift."""
-    _emit_headline(fallback_cfg if fallback_cfg is not None
-                   else _PLACEHOLDER_HEADLINE, stale=True)
+def _emit_best_fallback():
+    """No full freshly-measured headline is coming: emit the best we
+    hold — the fresh short-burst number if the burst leg landed
+    (stale=False: it WAS measured this run), else the committed stale
+    headline, else the explicit zero-value placeholder."""
+    cfg = _FALLBACK["cfg"]
+    _emit_headline(cfg if cfg is not None else _PLACEHOLDER_HEADLINE,
+                   stale=_FALLBACK["stale"] or cfg is None)
+
+
+def _cheapest_first(legs):
+    """Degraded-tunnel ordering (round-5 verdict next #1c): after the
+    first headline timeout, run the remaining legs cheapest-first so
+    *something* fresh survives the budget instead of the two most
+    expensive legs eating it."""
+    return sorted(legs, key=lambda t: t[2])
 
 
 def _kill_child():
@@ -1637,13 +1726,14 @@ def _hard_deadline(budget):
     return max(5.0, budget - max(60.0, 0.2 * budget))
 
 
-def _start_watchdog(t_start, budget, fallback_cfg, flush):
+def _start_watchdog(t_start, budget, flush):
     """Daemon thread: at the hard deadline, emit the best headline we
-    have (fresh if the main path already printed, else the committed
-    stale one), kill any in-flight leg subprocess (an orphan holding
-    the driver's stderr pipe would block its read past our exit), and
-    _exit(0). os._exit skips atexit/interpreter teardown — that is the
-    point: a wedged tunnel client cannot veto process death."""
+    have (fresh if the main path already printed, else the freshest
+    _FALLBACK — burst-or-stale), kill any in-flight leg subprocess
+    (an orphan holding the driver's stderr pipe would block its read
+    past our exit), and _exit(0). os._exit skips atexit/interpreter
+    teardown — that is the point: a wedged tunnel client cannot veto
+    process death."""
     deadline = t_start + _hard_deadline(budget)
 
     def run():
@@ -1653,7 +1743,7 @@ def _start_watchdog(t_start, budget, fallback_cfg, flush):
                 break
             time.sleep(min(left, 1.0))
         if not _HEADLINE_PRINTED.is_set():
-            _emit_best_fallback(fallback_cfg)
+            _emit_best_fallback()
         _kill_child()
         try:
             flush()
@@ -1683,13 +1773,23 @@ def main():
     detail_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
     # snapshot the COMMITTED detail headline NOW, before any flush()
-    # overwrites the file — the watchdog's stale fallback
-    fallback_cfg = None
+    # overwrites the file — the watchdog's stale fallback (the burst
+    # leg upgrades _FALLBACK to a fresh number once it lands)
     try:
         with open(detail_path) as f:
             prev = json.load(f)
-        if prev.get("configs"):
-            fallback_cfg = prev["configs"][0]
+        configs = prev.get("configs") or []
+        # ONLY headline-config entries qualify (a degraded prior run
+        # may have committed cheapest-first legs ahead of configs[-1];
+        # promoting e.g. the serving leg to the driver-parsed
+        # headline line would corrupt the artifact). Prefer the
+        # committed FULL headline over a committed burst.
+        heads = [c for c in configs if str(c.get("metric", ""))
+                 .startswith("ResNet50 train throughput (batch 128, "
+                             "224x224, f32")]
+        full = [c for c in heads if not c.get("burst")]
+        if full or heads:
+            _FALLBACK["cfg"] = (full or heads)[0]
     except Exception:
         pass
 
@@ -1699,7 +1799,7 @@ def main():
     # watchdog is armed BEFORE the first backend/tunnel touch: even
     # the device-kind probe can hang on a wedged terminal
     flush_holder = {"fn": noop_flush}
-    deadline = _start_watchdog(t_start, budget, fallback_cfg,
+    deadline = _start_watchdog(t_start, budget,
                                lambda: flush_holder["fn"]())
 
     if os.environ.get("BENCH_REHEARSE_ORCH_HANG") == "1":
@@ -1863,41 +1963,82 @@ def main():
                                     estimate * 2))
         return None if cfg == "skip" else cfg
 
-    # headline first; fall back to in-process if the subprocess dies
+    # BURST first (round-5 verdict next #1a): a <=10-timed-step fresh
+    # headline committed before the full legs start, so a degraded
+    # tunnel that kills the 420s leg still yields a number measured
+    # THIS run. It also warms the persistent XLA cache for the full
+    # headline's two executables.
+    burst = run_leg("resnet_burst", BURST_ESTIMATE, headline=True)
+    if burst is not None:
+        detail["configs"].append(burst)
+        flush()
+        _FALLBACK["cfg"] = burst
+        _FALLBACK["stale"] = False      # fresh, just short-burst
+
+    # full headline; fall back to in-process if the subprocess dies
     head = run_leg("resnet_f32", 420, headline=True)
-    if head is None and left_to_deadline() > 120:
+    if head is None and burst is None and left_to_deadline() > 120:
         # last resort: in-process (initializes the backend here — the
         # subprocess legs already failed, so holding the client is
-        # moot). The watchdog still guards this: if the compile wedges,
-        # the stale headline goes out at the deadline regardless.
+        # moot). Only reached when even the burst failed: with a
+        # fresh burst in hand, runway is better spent on cheap legs.
+        # The watchdog still guards this: if the compile wedges, the
+        # fallback headline goes out at the deadline regardless.
         try:
             _pin_cpu_if_requested()
             _setup_xla_cache()
+            # same compile accounting as the subprocess legs: THIS
+            # path runs precisely when the tunnel is degraded, where
+            # 'did a cold compile eat the budget?' matters most
+            cstats = cmark = None
+            try:
+                from deeplearning4j_tpu.observability.compile_watch \
+                    import install_global_watch
+                cstats = install_global_watch()
+                cmark = cstats.mark()
+            except Exception:
+                pass
             head = _leg_resnet_f32(peak)
+            if cstats is not None:
+                s = cstats.summary(since=cmark)
+                head["compile_cache_hit"] = s["cache_hit"]
+                head["compile_stats"] = {
+                    k: s[k] for k in ("backend_compiles",
+                                      "compile_secs", "cache_requests",
+                                      "persistent_cache_hits")}
         except Exception as e:
             print(f"in-process headline fallback failed: {e}",
                   file=sys.stderr)
             head = None
     if head is not None:
-        detail["configs"].append(head)
+        detail["configs"].insert(0, head)
         flush()
         # the driver consumes stdout's single JSON line — emit it NOW
         # so a timeout in the (informational) extras can't lose it
         _emit_headline(head)
     else:
-        # measured-this-run is not happening; emit the stale line
-        # immediately rather than waiting for the watchdog
-        _emit_best_fallback(fallback_cfg)
+        # the full headline is not happening; emit the freshest line
+        # we hold (burst if it landed, else committed-stale) NOW
+        # rather than waiting for the watchdog
+        _emit_best_fallback()
 
     if not headline_only:
-        for name, _fn, estimate in _LEGS[1:]:
+        rest = list(_LEGS[1:])
+        if head is None:
+            # first headline timeout => degraded tunnel: cheapest
+            # first so the remaining runway yields the most fresh legs
+            rest = _cheapest_first(rest)
+            print("headline leg failed - reordering remaining legs "
+                  "cheapest-first: "
+                  + ", ".join(n for n, _, _ in rest), file=sys.stderr)
+        for name, _fn, estimate in rest:
             cfg = run_leg(name, estimate)
             if cfg is not None:
                 detail["configs"].append(cfg)
                 flush()
     flush()
     if not _HEADLINE_PRINTED.is_set():
-        _emit_best_fallback(fallback_cfg)
+        _emit_best_fallback()
 
 
 if __name__ == "__main__":

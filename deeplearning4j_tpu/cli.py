@@ -126,6 +126,10 @@ def _cmd_summary(args):
 
 def main(argv=None):
     p = argparse.ArgumentParser(prog="deeplearning4j_tpu")
+    p.add_argument("--trace", metavar="PATH", default=None,
+                   help="record structured spans for this run and "
+                        "write a Chrome trace-event file (open in "
+                        "Perfetto / chrome://tracing) to PATH on exit")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     t = sub.add_parser("train", help="train a saved model on CSV data")
@@ -181,6 +185,17 @@ def main(argv=None):
     s.set_defaults(fn=_cmd_summary)
 
     args = p.parse_args(argv)
+    if args.trace:
+        import atexit
+
+        from deeplearning4j_tpu.observability.tracing import trace
+        trace.enable()
+
+        def _dump(path=args.trace):
+            n = trace.export_chrome_trace(path)
+            print(f"trace written: {path} ({n} events)")
+
+        atexit.register(_dump)
     args.fn(args)
 
 

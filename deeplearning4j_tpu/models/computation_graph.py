@@ -52,6 +52,9 @@ class ComputationGraph:
         self._jit_tbptt_step = None
         self._jit_output = {}
         self._rnn_state: Optional[Dict[str, object]] = None
+        # (data_wait_s, dispatch_s) of the latest fit iteration —
+        # read by observability.step_profile.ProfilerListener
+        self._step_timing = None
 
     # ------------------------------------------------------------------
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
@@ -324,35 +327,60 @@ class ComputationGraph:
             self._jit_train_step = self._make_train_step()
         step_fn = self._jit_train_step
         tbptt = self.conf.conf.tbptt
+        import time
+
+        from deeplearning4j_tpu.observability.tracing import trace
         for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self)
-            for ds in data:
-                mds = self._as_multi(ds)
-                if tbptt is not None and any(
-                        np.ndim(f) == 3 for f in mds.features):
-                    self._fit_tbptt(mds, tbptt)
-                    continue
-                batch = self._batch_tuple(mds)
-                self.params, self.state, self.opt_state, loss = step_fn(
-                    self.params, self.state, self.opt_state, batch,
-                    self._rng_key, np.int32(self.iteration_count))
-                self.score_value = loss
+            with trace.span("epoch"):
                 for lst in self.listeners:
-                    lst.iteration_done(self, self.iteration_count, loss,
-                                       mds.num_examples())
-                self.iteration_count += 1
-            for lst in self.listeners:
-                lst.on_epoch_end(self)
+                    lst.on_epoch_start(self)
+                data_iter = iter(data)
+                while True:
+                    t0 = time.perf_counter()
+                    with trace.span("data_wait"):
+                        ds = next(data_iter, None)
+                    if ds is None:
+                        break
+                    t1 = time.perf_counter()
+                    mds = self._as_multi(ds)
+                    if tbptt is not None and any(
+                            np.ndim(f) == 3 for f in mds.features):
+                        with trace.span("train_step_tbptt"):
+                            self._fit_tbptt(mds, tbptt,
+                                            data_wait_s=t1 - t0)
+                        continue
+                    with trace.span("train_step"):
+                        batch = self._batch_tuple(mds)
+                        (self.params, self.state, self.opt_state,
+                         loss) = step_fn(
+                            self.params, self.state, self.opt_state,
+                            batch, self._rng_key,
+                            np.int32(self.iteration_count))
+                    self.score_value = loss
+                    # (data_wait_s, dispatch_s) for ProfilerListener
+                    self._step_timing = (t1 - t0,
+                                         time.perf_counter() - t1)
+                    with trace.span("listeners"):
+                        for lst in self.listeners:
+                            lst.iteration_done(self,
+                                               self.iteration_count,
+                                               loss,
+                                               mds.num_examples())
+                    self.iteration_count += 1
+                for lst in self.listeners:
+                    lst.on_epoch_end(self)
             self.epoch_count += 1
         return self
 
-    def _fit_tbptt(self, mds: MultiDataSet, tbptt):
+    def _fit_tbptt(self, mds: MultiDataSet, tbptt,
+                   data_wait_s: float = 0.0):
         """Truncated BPTT over a MultiDataSet (reference
         ComputationGraph.doTruncatedBPTT :2532): every time-series
         array (features, labels, masks) is split into fwd_length
         chunks; recurrent vertex hidden state carries across chunks
-        with the gradient stopped at the boundary."""
+        with the gradient stopped at the boundary. ``data_wait_s`` is
+        billed to the first chunk's ``_step_timing``."""
+        import time
         fwd = tbptt["fwd_length"]
         ts = [f for f in mds.features if np.ndim(f) == 3]
         T = ts[0].shape[1]
@@ -385,12 +413,15 @@ class ComputationGraph:
             sub = MultiDataSet(list(feats), list(labels),
                                None if fm is None else list(fm),
                                None if lm is None else list(lm))
+            t_chunk = time.perf_counter()
             batch = self._batch_tuple(sub)
             (self.params, self.state, self.opt_state, loss,
              carries) = step_fn(self.params, self.state, self.opt_state,
                                 batch, carries, self._rng_key,
                                 np.int32(self.iteration_count))
             self.score_value = loss
+            self._step_timing = (data_wait_s if start == 0 else 0.0,
+                                 time.perf_counter() - t_chunk)
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration_count, loss,
                                    sub.num_examples())

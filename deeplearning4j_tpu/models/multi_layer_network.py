@@ -79,6 +79,9 @@ class MultiLayerNetwork:
         self._jit_tbptt_step = None
         self._jit_output = {}
         self._optimizer = None
+        # (data_wait_s, dispatch_s) of the latest fit iteration —
+        # read by observability.step_profile.ProfilerListener
+        self._step_timing = None
 
     # ------------------------------------------------------------------
     # init (reference MultiLayerNetwork.init :396-554)
@@ -289,6 +292,9 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: Optional[int] = None):
+        import time
+
+        from deeplearning4j_tpu.observability.tracing import trace
         if self.params is None:
             self.init()
         it = _as_iterator(data, labels, batch_size)
@@ -297,31 +303,57 @@ class MultiLayerNetwork:
         step_fn = self._jit_train_step
         tbptt = self.conf.conf.tbptt
         for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self)
-            for ds in it:
-                if tbptt is not None and ds.features.ndim == 3:
-                    self._fit_tbptt(ds, step_fn, tbptt)
-                    continue
-                batch = self._batch_tuple(ds)
-                self.params, self.state, self.opt_state, loss = step_fn(
-                    self.params, self.state, self.opt_state, batch,
-                    self._rng_key, np.int32(self.iteration_count))
-                self.score_value = loss
+            with trace.span("epoch"):
                 for lst in self.listeners:
-                    lst.iteration_done(self, self.iteration_count, loss,
-                                       ds.num_examples())
-                self.iteration_count += 1
-            for lst in self.listeners:
-                lst.on_epoch_end(self)
+                    lst.on_epoch_start(self)
+                data_iter = iter(it)
+                while True:
+                    # data wait timed apart from the step so the
+                    # profiler/tracer can tell an input-starved chip
+                    # from a dispatch-bound host
+                    t0 = time.perf_counter()
+                    with trace.span("data_wait"):
+                        ds = next(data_iter, None)
+                    if ds is None:
+                        break
+                    t1 = time.perf_counter()
+                    if tbptt is not None and ds.features.ndim == 3:
+                        with trace.span("train_step_tbptt"):
+                            self._fit_tbptt(ds, step_fn, tbptt,
+                                            data_wait_s=t1 - t0)
+                        continue
+                    with trace.span("train_step"):
+                        batch = self._batch_tuple(ds)
+                        (self.params, self.state, self.opt_state,
+                         loss) = step_fn(
+                            self.params, self.state, self.opt_state,
+                            batch, self._rng_key,
+                            np.int32(self.iteration_count))
+                    self.score_value = loss
+                    # (data_wait_s, dispatch_s) — ProfilerListener input
+                    self._step_timing = (t1 - t0,
+                                         time.perf_counter() - t1)
+                    with trace.span("listeners"):
+                        for lst in self.listeners:
+                            lst.iteration_done(self,
+                                               self.iteration_count,
+                                               loss, ds.num_examples())
+                    self.iteration_count += 1
+                for lst in self.listeners:
+                    lst.on_epoch_end(self)
             self.epoch_count += 1
         return self
 
-    def _fit_tbptt(self, ds: DataSet, step_fn_unused, tbptt):
+    def _fit_tbptt(self, ds: DataSet, step_fn_unused, tbptt,
+                   data_wait_s: float = 0.0):
         """Truncated BPTT (reference doTruncatedBPTT :1404): split the
         sequence into fwd_length chunks; recurrent hidden state carries
         across chunks (stop_gradient at the boundary), exactly the
-        reference's carried-state/truncated-gradient semantics."""
+        reference's carried-state/truncated-gradient semantics.
+        ``data_wait_s`` is the batch's input wait, billed to the FIRST
+        chunk's ``_step_timing`` (each chunk is one listener
+        iteration; later chunks waited on no data)."""
+        import time
         fwd = tbptt["fwd_length"]
         T = ds.features.shape[1]
         B = ds.features.shape[0]
@@ -340,12 +372,15 @@ class MultiLayerNetwork:
                 else ds.features_mask[:, start:end],
                 None if ds.labels_mask is None
                 else ds.labels_mask[:, start:end])
+            t_chunk = time.perf_counter()
             batch = self._batch_tuple(sub)
             (self.params, self.state, self.opt_state, loss,
              carries) = step_fn(self.params, self.state, self.opt_state,
                                 batch, carries, self._rng_key,
                                 np.int32(self.iteration_count))
             self.score_value = loss
+            self._step_timing = (data_wait_s if start == 0 else 0.0,
+                                 time.perf_counter() - t_chunk)
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration_count, loss,
                                    sub.num_examples())

@@ -1,0 +1,38 @@
+"""Observability subsystem: tracing, recompile watchdog, unified
+metrics registry, training-step profiler.
+
+The measurement substrate under every perf claim in this repo (the
+reference's PerformanceListener/StatsStorage pipeline, grown into the
+tracing + compile/runtime-attribution subsystem TensorFlow
+(arXiv:1605.08695) treats as first-class):
+
+- ``tracing``        nested spans -> JSONL / Chrome trace (Perfetto)
+- ``compile_watch``  every XLA compile logged with shapes; cache
+                     hit/miss accounting; recompile-storm trip-wire
+- ``registry``       process-wide counters/gauges/histograms with
+                     Prometheus text exposition
+- ``step_profile``   data-wait / dispatch / device decomposition +
+                     MFU, riding the standard listener chain
+"""
+
+from deeplearning4j_tpu.observability.compile_watch import (
+    CompileWatcher, RecompileStormError, install_global_watch, watch,
+)
+from deeplearning4j_tpu.observability.registry import (
+    REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
+)
+from deeplearning4j_tpu.observability.step_profile import (
+    ProfilerListener, detect_peak_flops, model_flops_utilization,
+    peak_flops_for_kind,
+)
+from deeplearning4j_tpu.observability.tracing import (
+    Tracer, get_tracer, trace,
+)
+
+__all__ = [
+    "CompileWatcher", "RecompileStormError", "install_global_watch",
+    "watch", "REGISTRY", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "ProfilerListener", "detect_peak_flops",
+    "model_flops_utilization", "peak_flops_for_kind", "Tracer",
+    "get_tracer", "trace",
+]

@@ -12,8 +12,10 @@ XLA sees few distinct shapes (no retrace storms).
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
+import weakref
 from typing import List, Optional
 
 import numpy as np
@@ -21,7 +23,25 @@ import numpy as np
 from deeplearning4j_tpu.serving.errors import QueueFullError
 
 __all__ = ["InferenceMode", "ParallelInference", "QueueFullError",
-           "pow2_pad_rows"]
+           "pow2_pad_rows", "serve_batch_with_retry"]
+
+_INSTANCE_IDS = itertools.count()
+_SHARED_METRICS = None
+_SHARED_LOCK = threading.Lock()
+
+
+def _shared_metrics():
+    """Default ServingMetrics bound to the process-wide registry, so
+    ParallelInference's shed counts and queue-depth gauges report
+    through the same pipe as training and serving (lazy: importing
+    this module must stay cheap)."""
+    global _SHARED_METRICS
+    with _SHARED_LOCK:
+        if _SHARED_METRICS is None:
+            from deeplearning4j_tpu.observability.registry import REGISTRY
+            from deeplearning4j_tpu.serving.metrics import ServingMetrics
+            _SHARED_METRICS = ServingMetrics(registry=REGISTRY)
+        return _SHARED_METRICS
 
 
 def pow2_pad_rows(x: np.ndarray) -> np.ndarray:
@@ -35,6 +55,49 @@ def pow2_pad_rows(x: np.ndarray) -> np.ndarray:
         return x
     pad = np.zeros((target - x.shape[0],) + x.shape[1:], x.dtype)
     return np.concatenate([x, pad], axis=0)
+
+
+def serve_batch_with_retry(output_fn, batch, count_error=None) -> None:
+    """Serve one coalesced batch of waitable requests (items with
+    ``.x``/``.result``/``.error``/``.event``), with the poison-request
+    recovery policy shared by this collector and the serving
+    scheduler (one copy, so a fix to the policy cannot miss a
+    backend): if the coalesced call fails, retry each item ALONE so a
+    poison request fails only its own caller — but cap the cascade:
+    two CONSECUTIVE per-item failures mean the device, not an input,
+    is broken (the tunnel can be down for hours), and serially
+    hammering it once per waiter would wedge the collector for the
+    whole outage. Retries are pow2-padded: the raw row count may be a
+    shape the bucketing never compiled, and a cold compile
+    mid-recovery would wedge the collector."""
+    try:
+        x = np.concatenate([r.x for r in batch], axis=0)
+        out = np.asarray(output_fn(pow2_pad_rows(x)))
+        off = 0
+        for r in batch:
+            n = r.x.shape[0]
+            r.result = out[off:off + n]
+            off += n
+            r.event.set()
+    except BaseException as batch_err:
+        consecutive = 0
+        for r in batch:
+            if consecutive >= 2:
+                r.error = batch_err
+                if count_error is not None:
+                    count_error()
+                r.event.set()
+                continue
+            try:
+                out = np.asarray(output_fn(pow2_pad_rows(r.x)))
+                r.result = out[:r.x.shape[0]]
+                consecutive = 0
+            except BaseException as e:
+                consecutive += 1
+                r.error = e
+                if count_error is not None:
+                    count_error()
+            r.event.set()
 
 
 class InferenceMode:
@@ -53,7 +116,7 @@ class _Pending:
 class ParallelInference:
     def __init__(self, model, mode: str = InferenceMode.BATCHED,
                  max_batch_size: int = 32, queue_limit: int = 64,
-                 wait_ms: float = 2.0):
+                 wait_ms: float = 2.0, metrics=None):
         self.model = model
         self.mode = mode
         self.max_batch_size = max_batch_size
@@ -61,6 +124,25 @@ class ParallelInference:
         self._queue: "queue.Queue[_Pending]" = queue.Queue(queue_limit)
         self._stop = threading.Event()
         self._worker = None
+        # shed/request/error accounting through the unified registry
+        # (metrics: a ServingMetrics; default = the process-wide one,
+        # where counters aggregate safely across instances). The
+        # per-instance queue-depth gauge holds only a WEAKREF to the
+        # queue: instances dropped without shutdown() (ad-hoc
+        # SEQUENTIAL-mode uses) stay GC-able, and a dead gauge
+        # callback returns None, which exposition skips.
+        self.metrics = metrics if metrics is not None \
+            else _shared_metrics()
+        self._endpoint = self.metrics.endpoint("parallel_inference")
+        self._gauge_name = (
+            f"parallel_inference_{next(_INSTANCE_IDS)}_queue_depth")
+        qref = weakref.ref(self._queue)
+
+        def _depth():
+            q = qref()
+            return None if q is None else q.qsize()
+
+        self.metrics.register_gauge(self._gauge_name, _depth)
         if mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(target=self._collector,
                                             daemon=True)
@@ -73,6 +155,7 @@ class ParallelInference:
             self._mode = InferenceMode.BATCHED
             self._bs = 32
             self._ql = 64
+            self._metrics = None
 
         def inference_mode(self, m):
             self._mode = m
@@ -86,9 +169,13 @@ class ParallelInference:
             self._ql = n
             return self
 
+        def metrics(self, m):
+            self._metrics = m
+            return self
+
         def build(self):
             return ParallelInference(self._model, self._mode, self._bs,
-                                     self._ql)
+                                     self._ql, metrics=self._metrics)
 
     @staticmethod
     def builder(model):
@@ -106,13 +193,18 @@ class ParallelInference:
         """
         x = np.asarray(x)
         if self.mode == InferenceMode.SEQUENTIAL:
-            return np.asarray(self.model.output(x))
+            t0 = _now()
+            out = np.asarray(self.model.output(x))
+            self._endpoint.observe(_now() - t0)
+            return out
         if self._stop.is_set():
             raise RuntimeError("ParallelInference is shut down")
+        t0 = _now()
         p = _Pending(x)
         try:
             self._queue.put_nowait(p)
         except queue.Full:
+            self._endpoint.count_shed()
             raise QueueFullError(
                 f"inference queue is at its limit "
                 f"({self._queue.maxsize} pending requests); shed the "
@@ -128,6 +220,9 @@ class ParallelInference:
         p.event.wait()
         if p.error is not None:
             raise p.error
+        # successes must be observed, or the endpoint's requests
+        # counter equals its errors and reads as a 100% error rate
+        self._endpoint.observe(_now() - t0)
         return p.result
 
     def _collector(self):
@@ -160,43 +255,12 @@ class ParallelInference:
             self._serve(batch, total)
 
     def _serve(self, batch: List[_Pending], total: int):
-        try:
-            x = np.concatenate([p.x for p in batch], axis=0)
-            # pad to next power of two -> few distinct compiled shapes
-            out = np.asarray(self.model.output(pow2_pad_rows(x)))
-            off = 0
-            for p in batch:
-                n = p.x.shape[0]
-                p.result = out[off:off + n]
-                off += n
-                p.event.set()
-        except BaseException as batch_err:
-            # the coalesced call failed — retry each item ALONE so a
-            # poison request fails only its own caller, and every
-            # waiter gets either a result or its OWN error (never a
-            # neighbour's). Two CONSECUTIVE per-item failures mean
-            # the device, not an input, is broken: stop hammering it
-            # once per waiter and fail the remainder immediately
-            consecutive = 0
-            for p in batch:
-                if consecutive >= 2:
-                    p.error = batch_err
-                    p.event.set()
-                    continue
-                try:
-                    # padded retry — the raw row count may be a shape
-                    # the pow2 bucketing never compiled
-                    out = np.asarray(self.model.output(
-                        pow2_pad_rows(p.x)))
-                    p.result = out[:p.x.shape[0]]
-                    consecutive = 0
-                except BaseException as e:
-                    consecutive += 1
-                    p.error = e
-                p.event.set()
+        serve_batch_with_retry(self.model.output, batch,
+                               count_error=self._endpoint.count_error)
 
     def shutdown(self):
         self._stop.set()
+        self.metrics.unregister_gauge(self._gauge_name)
         if self._worker is not None:
             self._worker.join(timeout=1.0)
         # fail any requests still queued so their callers don't block

@@ -75,9 +75,16 @@ class ContinuousBatcher(ServingBackend):
                  name: str = "generate", dtype=None):
         super().__init__("contbatch", name, queue_limit, slots,
                          metrics)
-        self.session = net.slot_streaming_session(capacity=capacity,
-                                                  slots=slots,
-                                                  dtype=dtype)
+        try:
+            self.session = net.slot_streaming_session(
+                capacity=capacity, slots=slots, dtype=dtype)
+        except BaseException:
+            # super().__init__ already registered the queue-depth
+            # gauge; a failed construction must not leak it (a leaked
+            # gauge pins the half-built backend AND the model via the
+            # bound method — the unregister_gauge docstring's warning)
+            self.metrics.unregister_gauge(f"{self.name}_queue_depth")
+            raise
         self.slots = slots
         self.capacity = capacity
         self._slots: List[Optional[_Slot]] = [None] * slots
@@ -94,7 +101,16 @@ class ContinuousBatcher(ServingBackend):
         """Enqueue one generate request. ``prompt`` is a 1-d (or
         (1, T0)) sequence of token ids; returns a waitable handle."""
         self._admit_guard()
-        prompt = np.asarray(prompt).reshape(-1)
+        prompt = np.asarray(prompt)
+        if prompt.ndim > 1 and prompt.shape[0] != 1:
+            # a (B, T) batch of prompts is NOT one request: silently
+            # flattening would concatenate unrelated prompts and
+            # generate over the junction
+            raise ValueError(
+                f"prompt must be one sequence (1-d or (1, T)); got "
+                f"shape {prompt.shape} — submit one request per "
+                "prompt")
+        prompt = prompt.reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must contain at least one token")
         if int(n_tokens) < 1:

@@ -10,7 +10,11 @@ front of the registry + schedulers:
   "temperature"?, "seed"?, "timeout_ms"?} → {"ids", "model_version"}
 - ``GET  /v1/models``   → registry listing
 - ``GET  /healthz``     → {"status": "ok" | "draining"}
-- ``GET  /metrics``     → ServingMetrics snapshot
+- ``GET  /metrics``     → ServingMetrics snapshot (JSON), or
+  Prometheus text exposition when the client asks for it —
+  ``?format=prometheus``, or an ``Accept`` header naming
+  ``text/plain`` / ``openmetrics`` (what Prometheus scrapers send).
+  The JSON default preserves the pre-observability contract.
 
 Error mapping is the typed-error contract from ``serving/errors.py``:
 QueueFullError → 429, DeadlineExceededError → 504, ModelNotFoundError
@@ -27,7 +31,7 @@ import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -157,6 +161,25 @@ class ModelServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _send_text(self, code, text, content_type):
+                data = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _wants_prometheus(self) -> bool:
+                q = parse_qs(urlparse(self.path).query)
+                fmt = (q.get("format") or [None])[0]
+                if fmt == "prometheus":
+                    return True
+                if fmt == "json":
+                    return False
+                accept = self.headers.get("Accept", "")
+                return ("text/plain" in accept
+                        or "openmetrics" in accept)
+
             def _body(self):
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n).decode() or "{}")
@@ -169,7 +192,13 @@ class ModelServer:
                                    if server._draining.is_set()
                                    else "ok")})
                 elif path == "/metrics":
-                    self._send(200, server.metrics.snapshot())
+                    if self._wants_prometheus():
+                        self._send_text(
+                            200, server.metrics.prometheus_text(),
+                            "text/plain; version=0.0.4; "
+                            "charset=utf-8")
+                    else:
+                        self._send(200, server.metrics.snapshot())
                 elif path == "/v1/models":
                     self._send(200, {"models":
                                      server.registry.models()})

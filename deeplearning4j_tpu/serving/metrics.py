@@ -1,15 +1,22 @@
 """Serving metrics: latency histograms, queue depth, batch occupancy.
 
-The observable surface of the serving stack (ISSUE: per-endpoint
-p50/p95/p99 latency, queue depth, batch occupancy actual/max, shed
-count), exported as one JSON snapshot on ``/metrics`` and feedable into
-the existing ``ui/stats.py`` storage so the training dashboard's
-plumbing (InMemoryStatsStorage / FileStatsStorage, the remote-POST
-route) carries serving telemetry too.
+The observable surface of the serving stack (per-endpoint p50/p95/p99
+latency, queue depth, batch occupancy actual/max, shed count),
+exported as one JSON snapshot on ``/metrics`` and feedable into the
+existing ``ui/stats.py`` storage so the training dashboard's plumbing
+(InMemoryStatsStorage / FileStatsStorage, the remote-POST route)
+carries serving telemetry too.
 
-Histograms are fixed log-spaced buckets (Prometheus style): recording
-is O(1) with a lock-free-enough increment under the GIL plus a lock
-for the multi-field update; quantiles interpolate within the bucket.
+Since the observability subsystem landed, every instrument here is
+backed by the unified registry
+(``deeplearning4j_tpu/observability/registry.py``): the histogram /
+quantile code that used to live in this file moved there, counters
+and queue-depth gauges register as labeled Prometheus families, and
+``prometheus_text()`` renders the standard exposition the
+``/metrics`` endpoint now serves to scrapers. Each ``ServingMetrics``
+owns its registry by default (parallel test servers must not share
+counters); pass ``registry=observability.REGISTRY`` to join the
+process-wide pipe with training metrics.
 """
 
 from __future__ import annotations
@@ -19,57 +26,25 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from deeplearning4j_tpu.observability.registry import (
+    Histogram, MetricsRegistry, default_latency_buckets,
+)
+
 __all__ = ["LatencyHistogram", "EndpointMetrics", "BatchOccupancy",
            "ServingMetrics"]
 
 
-def _log_buckets(lo: float = 1e-4, hi: float = 60.0,
-                 factor: float = 1.45) -> List[float]:
-    edges = [lo]
-    while edges[-1] < hi:
-        edges.append(edges[-1] * factor)
-    return edges
+_EDGES = default_latency_buckets()    # seconds; +1 overflow at the end
 
 
-_EDGES = _log_buckets()        # seconds; +1 overflow bucket at the end
+class LatencyHistogram(Histogram):
+    """Log-bucketed latency histogram (seconds in, ms out) — the
+    registry Histogram with the serving snapshot shape preserved."""
 
-
-class LatencyHistogram:
-    """Log-bucketed latency histogram with interpolated quantiles."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.counts = [0] * (len(_EDGES) + 1)
-        self.count = 0
-        self.sum = 0.0
-
-    def record(self, seconds: float) -> None:
-        i = 0
-        while i < len(_EDGES) and seconds > _EDGES[i]:
-            i += 1
-        with self._lock:
-            self.counts[i] += 1
-            self.count += 1
-            self.sum += seconds
-
-    def quantile(self, q: float) -> float:
-        """Approximate quantile: linear interpolation inside the
-        bucket holding the q-th sample (0 if empty)."""
-        with self._lock:
-            total = self.count
-            counts = list(self.counts)
-        if total == 0:
-            return 0.0
-        rank = q * total
-        seen = 0
-        for i, c in enumerate(counts):
-            if seen + c >= rank:
-                lo = 0.0 if i == 0 else _EDGES[i - 1]
-                hi = _EDGES[min(i, len(_EDGES) - 1)]
-                frac = (rank - seen) / c if c else 0.0
-                return lo + (hi - lo) * min(1.0, frac)
-            seen += c
-        return _EDGES[-1]
+    def __init__(self, name: str = "serving_latency_seconds",
+                 labels: Optional[Dict[str, str]] = None):
+        super().__init__(name, help="request latency (seconds)",
+                         labels=labels, buckets=_EDGES)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -82,49 +57,84 @@ class LatencyHistogram:
 
 
 class EndpointMetrics:
-    """Counters + latency histogram for one endpoint."""
+    """Counters + latency histogram for one endpoint, registered as
+    ``serving_*`` Prometheus families labeled by endpoint."""
 
     _RATE_WINDOW = 30.0           # seconds of completions behind the
     _RATE_EVENTS = 4096           # current-rate estimate
 
-    def __init__(self):
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 name: str = "endpoint"):
+        reg = registry or MetricsRegistry()
+        lbl = {"endpoint": name}
         self._lock = threading.Lock()
-        self.requests = 0
-        self.errors = 0
-        self.shed = 0             # load-shed (QueueFullError)
-        self.expired = 0          # deadline expiry
-        self.latency = LatencyHistogram()
+        self._requests = reg.counter(
+            "serving_requests_total", help="completed requests",
+            labels=lbl)
+        self._errors = reg.counter(
+            "serving_errors_total", help="errored responses",
+            labels=lbl)
+        self._shed = reg.counter(
+            "serving_shed_total", help="load-shed (QueueFullError)",
+            labels=lbl)
+        self._expired = reg.counter(
+            "serving_deadline_expired_total", help="deadline expiry",
+            labels=lbl)
+        # atomic get-or-adopt, matching the counters' get-or-create:
+        # two EndpointMetrics for one endpoint on a SHARED registry
+        # (the process-wide pipe) must merge, not raise
+        self.latency = reg.adopt(LatencyHistogram(labels=lbl))
         self._recent = collections.deque(maxlen=self._RATE_EVENTS)
         self._t0 = time.monotonic()
 
+    # int views preserving the pre-registry attribute API
+    @property
+    def requests(self) -> int:
+        return int(self._requests.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value)
+
+    @property
+    def shed(self) -> int:
+        return int(self._shed.value)
+
+    @property
+    def expired(self) -> int:
+        return int(self._expired.value)
+
     def observe(self, seconds: float) -> None:
+        self._requests.inc()
         with self._lock:
-            self.requests += 1
             self._recent.append(time.monotonic())
         self.latency.record(seconds)
 
     def count_error(self) -> None:
         # an errored response is still a completed request: folding it
         # into ``requests`` keeps requests_per_sec honest during an
-        # outage (error rate can never exceed 100%)
+        # outage (error rate can never exceed 100%) — requests FIRST,
+        # so a concurrent scrape never reads errors > requests
+        self._requests.inc()
+        self._errors.inc()
         with self._lock:
-            self.errors += 1
-            self.requests += 1
             self._recent.append(time.monotonic())
 
     def count_shed(self) -> None:
-        with self._lock:
-            self.shed += 1
+        self._shed.inc()
 
     def count_expired(self) -> None:
-        with self._lock:
-            self.expired += 1
+        self._expired.inc()
 
     def snapshot(self) -> dict:
         now = time.monotonic()
+        # errors read BEFORE requests: count_error increments requests
+        # first, so any error this read observes already has its
+        # request counted — a scrape can never see errors > requests
+        errors = self.errors
+        out = {"requests": self.requests, "errors": errors,
+               "shed": self.shed, "deadline_expired": self.expired}
         with self._lock:
-            out = {"requests": self.requests, "errors": self.errors,
-                   "shed": self.shed, "deadline_expired": self.expired}
             recent = list(self._recent)
         # CURRENT rate over a sliding window, not a lifetime average
         # (a lifetime mean can never show a traffic drop). If the
@@ -145,22 +155,39 @@ class BatchOccupancy:
     that says whether dynamic/continuous batching is working (avg 1.0
     under load means the batcher degraded to sequential serving)."""
 
-    def __init__(self, max_batch_size: int):
+    def __init__(self, max_batch_size: int,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: str = "batch"):
+        reg = registry or MetricsRegistry()
+        lbl = {"endpoint": name}
         self._lock = threading.Lock()
         self.max_batch_size = max_batch_size
-        self.batches = 0
-        self.items = 0
+        self._batches = reg.counter(
+            "serving_batches_total", help="coalesced device calls",
+            labels=lbl)
+        self._items = reg.counter(
+            "serving_batch_items_total",
+            help="items across coalesced calls", labels=lbl)
         self.max_seen = 0
 
+    @property
+    def batches(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def items(self) -> int:
+        return int(self._items.value)
+
     def record(self, n_items: int) -> None:
+        self._batches.inc()
+        self._items.inc(n_items)
         with self._lock:
-            self.batches += 1
-            self.items += n_items
             self.max_seen = max(self.max_seen, n_items)
 
     def snapshot(self) -> dict:
+        b, i = self.batches, self.items
         with self._lock:
-            b, i, m = self.batches, self.items, self.max_seen
+            m = self.max_seen
         return {"batches": b, "items": i,
                 "avg_batch_size": round(i / b, 3) if b else 0.0,
                 "max_batch_size_seen": m,
@@ -169,10 +196,13 @@ class BatchOccupancy:
 
 class ServingMetrics:
     """Aggregated registry of endpoint metrics, occupancy trackers and
-    queue-depth gauges; one ``snapshot()`` is the /metrics payload."""
+    queue-depth gauges; one ``snapshot()`` is the /metrics JSON
+    payload, ``prometheus_text()`` the scraper exposition."""
 
-    def __init__(self):
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
         self._endpoints: Dict[str, EndpointMetrics] = {}
         self._occupancy: Dict[str, BatchOccupancy] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
@@ -181,22 +211,27 @@ class ServingMetrics:
     def endpoint(self, name: str) -> EndpointMetrics:
         with self._lock:
             if name not in self._endpoints:
-                self._endpoints[name] = EndpointMetrics()
+                self._endpoints[name] = EndpointMetrics(
+                    registry=self.registry, name=name)
             return self._endpoints[name]
 
     def occupancy(self, name: str,
                   max_batch_size: int = 0) -> BatchOccupancy:
         with self._lock:
             if name not in self._occupancy:
-                self._occupancy[name] = BatchOccupancy(max_batch_size)
+                self._occupancy[name] = BatchOccupancy(
+                    max_batch_size, registry=self.registry, name=name)
             return self._occupancy[name]
 
     def register_gauge(self, name: str,
                        fn: Callable[[], float]) -> None:
         """A pull gauge (e.g. current queue depth) sampled at
-        snapshot time."""
+        snapshot/exposition time."""
         with self._lock:
             self._gauges[name] = fn
+        self.registry.gauge("serving_gauge",
+                            help="registered serving gauges",
+                            labels={"name": name}, fn=fn)
 
     def unregister_gauge(self, name: str) -> None:
         """Drop a gauge (a shut-down scheduler must unhook its
@@ -204,6 +239,8 @@ class ServingMetrics:
         and its model — in memory forever)."""
         with self._lock:
             self._gauges.pop(name, None)
+        self.registry.unregister("serving_gauge",
+                                 labels={"name": name})
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -221,6 +258,9 @@ class ServingMetrics:
             except Exception:
                 out["gauges"][name] = None
         return out
+
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()
 
     # ---- bridge into the training-UI stats pipeline ----
     def publish_to(self, storage, session_id: str = "serving",

@@ -23,7 +23,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.parallel.inference import pow2_pad_rows
+from deeplearning4j_tpu.parallel.inference import (
+    pow2_pad_rows, serve_batch_with_retry)
 from deeplearning4j_tpu.serving.errors import DeadlineExceededError
 from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
                                                   ServingBackend)
@@ -168,39 +169,8 @@ class BatchScheduler(ServingBackend):
             return
         rows = sum(r.x.shape[0] for r in live)
         self._occupancy.record(rows)
-        try:
-            x = np.concatenate([r.x for r in live], axis=0)
-            out = np.asarray(self.model.output(pow2_pad_rows(x)))
-            off = 0
-            for r in live:
-                n = r.x.shape[0]
-                r.result = out[off:off + n]
-                off += n
-                r.event.set()
-        except BaseException as batch_err:
-            # coalesced call failed: retry each item ALONE so a poison
-            # request fails only its own caller — but cap the cascade:
-            # two CONSECUTIVE per-item failures mean the device, not
-            # an input, is broken (the tunnel can be down for hours),
-            # and serially hammering it once per waiter would wedge
-            # the collector for the whole outage
-            consecutive = 0
-            for r in live:
-                if consecutive >= 2:
-                    r.error = batch_err
-                    self._endpoint.count_error()
-                    r.event.set()
-                    continue
-                try:
-                    # padded retry: the raw row count may be a shape
-                    # the pow2 bucketing never compiled, and a cold
-                    # compile mid-recovery would wedge the collector
-                    out = np.asarray(self.model.output(
-                        pow2_pad_rows(r.x)))
-                    r.result = out[:r.x.shape[0]]
-                    consecutive = 0
-                except BaseException as e:
-                    consecutive += 1
-                    r.error = e
-                    self._endpoint.count_error()
-                r.event.set()
+        # coalesced call + poison-request recovery: ONE shared
+        # implementation with ParallelInference (the policy's home —
+        # a fix there cannot silently miss this backend)
+        serve_batch_with_retry(self.model.output, live,
+                               count_error=self._endpoint.count_error)

@@ -54,6 +54,11 @@ class StatsReport:
     duration_ms: float = 0.0
     samples_per_sec: float = 0.0
     memory_bytes: Optional[int] = None
+    # step decomposition from observability.step_profile
+    # (data_wait_ms / dispatch_ms / device_fence_ms / mfu ...): the
+    # dashboard and remote-POST route carry the profiler's reports
+    # through the same storage pipe as training stats
+    profile: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

@@ -60,3 +60,21 @@ class TestExamples:
         out = _run("long_context_lm.py", "--epochs", "8")
         assert "data=2 x seq=2" in out
         assert "matches single-device params: True" in out
+
+    def test_tpu_transformer_generate_cpu_fallback(self, tmp_path):
+        # ENV pins JAX_PLATFORMS=cpu, so the guarded example must
+        # print its reasoned fallback and still run end to end with
+        # profiler + compile watch + trace export
+        trace_path = str(tmp_path / "t.json")
+        out = _run("tpu_transformer_generate.py", "--epochs", "1",
+                   "--gen-tokens", "8", "--trace", trace_path)
+        assert "falling back to CPU" in out
+        assert "JAX_PLATFORMS=cpu" in out          # the reason
+        assert "generated:" in out
+        assert "step profile:" in out
+        assert "compile watch:" in out
+        import json as _json
+        with open(trace_path) as f:
+            doc = _json.load(f)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert {"train", "generate", "train_step"} <= names
