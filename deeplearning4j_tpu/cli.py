@@ -32,6 +32,13 @@ def _cmd_train(args):
                                                     ScoreIterationListener)
     from deeplearning4j_tpu.util.model_serializer import (restore_model,
                                                           write_model)
+    if args.health == "rollback" and args.workers and args.workers > 1:
+        # nothing in the ParallelWrapper path catches the rollback
+        # flag — failing loudly beats silently losing the policy
+        sys.exit("train: --health rollback is not supported with "
+                 "--workers >1 (rollback needs the single-worker "
+                 "ElasticTrainer loop); use --health warn/raise or "
+                 "drop --workers")
     model = restore_model(args.model)
     rr = CSVRecordReader().initialize(args.data)
     it = RecordReaderDataSetIterator(
@@ -39,10 +46,24 @@ def _cmd_train(args):
         num_classes=args.classes, regression=args.classes == 0)
     model.set_listeners(ScoreIterationListener(10),
                         PerformanceListener(frequency=10))
+    if args.health:
+        from deeplearning4j_tpu.observability.flight_recorder import (
+            get_recorder)
+        from deeplearning4j_tpu.observability.health import (
+            HealthMonitor)
+        model.add_listeners(HealthMonitor(policy=args.health,
+                                          recorder=get_recorder()))
     if args.workers and args.workers > 1:
         pw = (ParallelWrapper.builder(model).workers(args.workers)
               .prefetch_buffer(args.prefetch).build())
         pw.fit(it, epochs=args.epochs)
+    elif args.health == "rollback":
+        # the rollback policy needs a checkpoint loop to roll back TO
+        from deeplearning4j_tpu.train.fault_tolerance import (
+            ElasticTrainer)
+        ckpt_dir = (args.output or args.model) + ".ckpts"
+        ElasticTrainer(model, ckpt_dir, save_every=10).fit(
+            it, epochs=args.epochs)
     else:
         model.fit(it, epochs=args.epochs)
     out = args.output or args.model
@@ -130,6 +151,12 @@ def main(argv=None):
                    help="record structured spans for this run and "
                         "write a Chrome trace-event file (open in "
                         "Perfetto / chrome://tracing) to PATH on exit")
+    p.add_argument("--flight-record", metavar="DIR", default=None,
+                   help="install a flight recorder: spans/stats/"
+                        "anomalies ride a bounded ring and a "
+                        "self-contained post-mortem bundle (JSONL + "
+                        "Chrome trace + env snapshot) is written "
+                        "under DIR on crash or exit")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     t = sub.add_parser("train", help="train a saved model on CSV data")
@@ -144,6 +171,14 @@ def main(argv=None):
                    help=">1 = data-parallel over that many devices")
     t.add_argument("--prefetch", type=int, default=2)
     t.add_argument("--output", default=None)
+    t.add_argument("--health", nargs="?", const="warn", default=None,
+                   choices=["warn", "raise", "rollback"],
+                   metavar="POLICY",
+                   help="attach the training-health monitor (fused "
+                        "NaN/Inf check in the train step + "
+                        "divergence/plateau/gradient detectors); "
+                        "POLICY = warn | raise | rollback "
+                        "(default warn)")
     t.set_defaults(fn=_cmd_train)
 
     u = sub.add_parser("ui", help="training dashboard server")
@@ -185,6 +220,13 @@ def main(argv=None):
     s.set_defaults(fn=_cmd_summary)
 
     args = p.parse_args(argv)
+    recorder = None
+    if args.flight_record:
+        from deeplearning4j_tpu.observability.flight_recorder import (
+            FlightRecorder, install)
+        from deeplearning4j_tpu.observability.tracing import trace
+        trace.enable()     # spans must flow for trace.json to matter
+        recorder = install(FlightRecorder(out_dir=args.flight_record))
     if args.trace:
         import atexit
 
@@ -196,7 +238,20 @@ def main(argv=None):
             print(f"trace written: {path} ({n} events)")
 
         atexit.register(_dump)
-    args.fn(args)
+    try:
+        args.fn(args)
+    except Exception:
+        if recorder is not None:
+            # the fit-loop hook usually dumped already (forced);
+            # debounce here so a CLI-level crash still leaves a
+            # bundle without duplicating the fit-loop one
+            recorder.dump("cli_exception", force=False)
+        raise
+    else:
+        if recorder is not None:
+            bundle = recorder.dump("exit", force=True)
+            if bundle:
+                print(f"flight-recorder bundle: {bundle}")
 
 
 if __name__ == "__main__":

@@ -55,6 +55,11 @@ class ComputationGraph:
         # (data_wait_s, dispatch_s) of the latest fit iteration —
         # read by observability.step_profile.ProfilerListener
         self._step_timing = None
+        # observability.health wiring (see MultiLayerNetwork): fused
+        # finite-check vector stashed unfetched + latest batch refs
+        self._health_enabled = False
+        self._last_health = None
+        self._last_batch = None
 
     # ------------------------------------------------------------------
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
@@ -230,6 +235,7 @@ class ComputationGraph:
 
     def _make_train_step(self):
         optimizer = self._optimizer
+        health_enabled = self._health_enabled
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, state, opt_state, batch, base_rng, step):
@@ -251,9 +257,28 @@ class ComputationGraph:
             for name, p in new_params.items():
                 obj, _ = self.conf.vertices[name]
                 constrained[name] = apply_layer_constraints(obj, p)
+            if health_enabled:
+                # fused finite check + global norms, computed inside
+                # this same XLA program (observability/health.py)
+                from deeplearning4j_tpu.observability.health import (
+                    fused_health)
+                health = fused_health(loss, grads, updates, constrained)
+                return constrained, new_state, new_opt, loss, health
             return constrained, new_state, new_opt, loss
 
         return train_step
+
+    def _sync_health_mode(self) -> None:
+        """Compile the fused health check into the train step iff a
+        health-monitoring listener is attached."""
+        want = any(getattr(l, "wants_device_health", False)
+                   for l in self.listeners)
+        if want != self._health_enabled:
+            self._health_enabled = want
+            self._jit_train_step = None
+            self._jit_tbptt_step = None
+            if not want:
+                self._last_health = None
 
     def _make_tbptt_step(self):
         """Graph tBPTT step (reference ComputationGraph.doTruncatedBPTT
@@ -323,6 +348,7 @@ class ComputationGraph:
             # one-shot generators would be exhausted after epoch 1;
             # materialize so every epoch actually trains
             data = list(data)
+        self._sync_health_mode()
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
         step_fn = self._jit_train_step
@@ -330,46 +356,59 @@ class ComputationGraph:
         import time
 
         from deeplearning4j_tpu.observability.tracing import trace
-        for _ in range(epochs):
-            with trace.span("epoch"):
-                for lst in self.listeners:
-                    lst.on_epoch_start(self)
-                data_iter = iter(data)
-                while True:
-                    t0 = time.perf_counter()
-                    with trace.span("data_wait"):
-                        ds = next(data_iter, None)
-                    if ds is None:
-                        break
-                    t1 = time.perf_counter()
-                    mds = self._as_multi(ds)
-                    if tbptt is not None and any(
-                            np.ndim(f) == 3 for f in mds.features):
-                        with trace.span("train_step_tbptt"):
-                            self._fit_tbptt(mds, tbptt,
-                                            data_wait_s=t1 - t0)
-                        continue
-                    with trace.span("train_step"):
-                        batch = self._batch_tuple(mds)
-                        (self.params, self.state, self.opt_state,
-                         loss) = step_fn(
-                            self.params, self.state, self.opt_state,
-                            batch, self._rng_key,
-                            np.int32(self.iteration_count))
-                    self.score_value = loss
-                    # (data_wait_s, dispatch_s) for ProfilerListener
-                    self._step_timing = (t1 - t0,
-                                         time.perf_counter() - t1)
-                    with trace.span("listeners"):
-                        for lst in self.listeners:
-                            lst.iteration_done(self,
-                                               self.iteration_count,
-                                               loss,
-                                               mds.num_examples())
-                    self.iteration_count += 1
-                for lst in self.listeners:
-                    lst.on_epoch_end(self)
-            self.epoch_count += 1
+        try:
+            for _ in range(epochs):
+                with trace.span("epoch"):
+                    for lst in self.listeners:
+                        lst.on_epoch_start(self)
+                    data_iter = iter(data)
+                    while True:
+                        t0 = time.perf_counter()
+                        with trace.span("data_wait"):
+                            ds = next(data_iter, None)
+                        if ds is None:
+                            break
+                        t1 = time.perf_counter()
+                        mds = self._as_multi(ds)
+                        if tbptt is not None and any(
+                                np.ndim(f) == 3 for f in mds.features):
+                            with trace.span("train_step_tbptt"):
+                                self._fit_tbptt(mds, tbptt,
+                                                data_wait_s=t1 - t0)
+                            continue
+                        with trace.span("train_step"):
+                            batch = self._batch_tuple(mds)
+                            out = step_fn(
+                                self.params, self.state, self.opt_state,
+                                batch, self._rng_key,
+                                np.int32(self.iteration_count))
+                        if self._health_enabled:
+                            (self.params, self.state, self.opt_state,
+                             loss, self._last_health) = out
+                        else:
+                            (self.params, self.state, self.opt_state,
+                             loss) = out
+                        self._last_batch = batch
+                        self.score_value = loss
+                        # (data_wait_s, dispatch_s) for ProfilerListener
+                        self._step_timing = (t1 - t0,
+                                             time.perf_counter() - t1)
+                        with trace.span("listeners"):
+                            for lst in self.listeners:
+                                lst.iteration_done(
+                                    self, self.iteration_count, loss,
+                                    mds.num_examples())
+                        self.iteration_count += 1
+                    for lst in self.listeners:
+                        lst.on_epoch_end(self)
+                self.epoch_count += 1
+        except Exception as e:
+            # black box: leave a post-mortem bundle when a flight
+            # recorder is installed, then propagate unchanged
+            from deeplearning4j_tpu.observability.flight_recorder \
+                import on_fit_exception
+            on_fit_exception(self, e)
+            raise
         return self
 
     def _fit_tbptt(self, mds: MultiDataSet, tbptt,
@@ -385,6 +424,9 @@ class ComputationGraph:
         ts = [f for f in mds.features if np.ndim(f) == 3]
         T = ts[0].shape[1]
         B = ts[0].shape[0]
+        # the tBPTT step has no fused health vector: a stale one from
+        # the standard path must not masquerade as this chunk's
+        self._last_health = None
         if self._jit_tbptt_step is None:
             self._jit_tbptt_step = self._make_tbptt_step()
         step_fn = self._jit_tbptt_step
@@ -680,6 +722,10 @@ class ComputationGraph:
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
         return self
 
     def summary(self) -> str:

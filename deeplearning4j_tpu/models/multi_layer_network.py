@@ -82,6 +82,16 @@ class MultiLayerNetwork:
         # (data_wait_s, dispatch_s) of the latest fit iteration —
         # read by observability.step_profile.ProfilerListener
         self._step_timing = None
+        # observability.health wiring: when a listener sets
+        # wants_device_health, the train step also returns the fused
+        # [finite_bits, loss, |grads|, |updates|, |params|] vector,
+        # stashed here UNFETCHED (the monitor does the one transfer)
+        self._health_enabled = False
+        self._last_health = None
+        # device refs of the latest batch tuple (for the monitor's
+        # optional dead-activation forward pass) — a reference, not a
+        # copy or sync
+        self._last_batch = None
 
     # ------------------------------------------------------------------
     # init (reference MultiLayerNetwork.init :396-554)
@@ -219,6 +229,7 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def _make_train_step(self):
         optimizer = self._optimizer
+        health_enabled = self._health_enabled
         from deeplearning4j_tpu.train.gradnorm import (
             apply_gradient_normalization)
 
@@ -243,9 +254,30 @@ class MultiLayerNetwork:
                 apply_layer_constraints(l, p)
                 for l, p in zip(self.layers, new_params)
             ]
+            if health_enabled:
+                # fused finite check + global norms, computed inside
+                # this same XLA program (observability/health.py)
+                from deeplearning4j_tpu.observability.health import (
+                    fused_health)
+                health = fused_health(loss, grads, updates, new_params)
+                return new_params, new_states, new_opt_state, loss, \
+                    health
             return new_params, new_states, new_opt_state, loss
 
         return train_step
+
+    def _sync_health_mode(self) -> None:
+        """Compile the fused health check into the train step iff a
+        health-monitoring listener is attached (one jit invalidation
+        per toggle, not per fit)."""
+        want = any(getattr(l, "wants_device_health", False)
+                   for l in self.listeners)
+        if want != self._health_enabled:
+            self._health_enabled = want
+            self._jit_train_step = None
+            self._jit_tbptt_step = None
+            if not want:
+                self._last_health = None
 
     def _make_tbptt_step(self):
         """Train step that also threads recurrent carries across chunks
@@ -298,50 +330,66 @@ class MultiLayerNetwork:
         if self.params is None:
             self.init()
         it = _as_iterator(data, labels, batch_size)
+        self._sync_health_mode()
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
         step_fn = self._jit_train_step
         tbptt = self.conf.conf.tbptt
-        for _ in range(epochs):
-            with trace.span("epoch"):
-                for lst in self.listeners:
-                    lst.on_epoch_start(self)
-                data_iter = iter(it)
-                while True:
-                    # data wait timed apart from the step so the
-                    # profiler/tracer can tell an input-starved chip
-                    # from a dispatch-bound host
-                    t0 = time.perf_counter()
-                    with trace.span("data_wait"):
-                        ds = next(data_iter, None)
-                    if ds is None:
-                        break
-                    t1 = time.perf_counter()
-                    if tbptt is not None and ds.features.ndim == 3:
-                        with trace.span("train_step_tbptt"):
-                            self._fit_tbptt(ds, step_fn, tbptt,
-                                            data_wait_s=t1 - t0)
-                        continue
-                    with trace.span("train_step"):
-                        batch = self._batch_tuple(ds)
-                        (self.params, self.state, self.opt_state,
-                         loss) = step_fn(
-                            self.params, self.state, self.opt_state,
-                            batch, self._rng_key,
-                            np.int32(self.iteration_count))
-                    self.score_value = loss
-                    # (data_wait_s, dispatch_s) — ProfilerListener input
-                    self._step_timing = (t1 - t0,
-                                         time.perf_counter() - t1)
-                    with trace.span("listeners"):
-                        for lst in self.listeners:
-                            lst.iteration_done(self,
-                                               self.iteration_count,
-                                               loss, ds.num_examples())
-                    self.iteration_count += 1
-                for lst in self.listeners:
-                    lst.on_epoch_end(self)
-            self.epoch_count += 1
+        try:
+            for _ in range(epochs):
+                with trace.span("epoch"):
+                    for lst in self.listeners:
+                        lst.on_epoch_start(self)
+                    data_iter = iter(it)
+                    while True:
+                        # data wait timed apart from the step so the
+                        # profiler/tracer can tell an input-starved chip
+                        # from a dispatch-bound host
+                        t0 = time.perf_counter()
+                        with trace.span("data_wait"):
+                            ds = next(data_iter, None)
+                        if ds is None:
+                            break
+                        t1 = time.perf_counter()
+                        if tbptt is not None and ds.features.ndim == 3:
+                            with trace.span("train_step_tbptt"):
+                                self._fit_tbptt(ds, step_fn, tbptt,
+                                                data_wait_s=t1 - t0)
+                            continue
+                        with trace.span("train_step"):
+                            batch = self._batch_tuple(ds)
+                            out = step_fn(
+                                self.params, self.state, self.opt_state,
+                                batch, self._rng_key,
+                                np.int32(self.iteration_count))
+                        if self._health_enabled:
+                            (self.params, self.state, self.opt_state,
+                             loss, self._last_health) = out
+                        else:
+                            (self.params, self.state, self.opt_state,
+                             loss) = out
+                        self._last_batch = batch
+                        self.score_value = loss
+                        # (data_wait_s, dispatch_s) — ProfilerListener
+                        self._step_timing = (t1 - t0,
+                                             time.perf_counter() - t1)
+                        with trace.span("listeners"):
+                            for lst in self.listeners:
+                                lst.iteration_done(
+                                    self, self.iteration_count, loss,
+                                    ds.num_examples())
+                        self.iteration_count += 1
+                    for lst in self.listeners:
+                        lst.on_epoch_end(self)
+                self.epoch_count += 1
+        except Exception as e:
+            # black box: an escaping exception leaves a post-mortem
+            # bundle when a flight recorder is installed (no-op
+            # otherwise), then propagates unchanged
+            from deeplearning4j_tpu.observability.flight_recorder \
+                import on_fit_exception
+            on_fit_exception(self, e)
+            raise
         return self
 
     def _fit_tbptt(self, ds: DataSet, step_fn_unused, tbptt,
@@ -357,6 +405,9 @@ class MultiLayerNetwork:
         fwd = tbptt["fwd_length"]
         T = ds.features.shape[1]
         B = ds.features.shape[0]
+        # the tBPTT step has no fused health vector: a stale one from
+        # the standard path must not masquerade as this chunk's
+        self._last_health = None
         if self._jit_tbptt_step is None:
             self._jit_tbptt_step = self._make_tbptt_step()
         step_fn = self._jit_tbptt_step

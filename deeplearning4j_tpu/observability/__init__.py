@@ -13,10 +13,30 @@ tracing + compile/runtime-attribution subsystem TensorFlow
                      Prometheus text exposition
 - ``step_profile``   data-wait / dispatch / device decomposition +
                      MFU, riding the standard listener chain
+
+and (ISSUE 3) the layer that WATCHES the measurements and acts:
+
+- ``health``           HealthMonitor: fused in-step finite check +
+                       host sliding-window detectors, with
+                       warn/raise/rollback policies
+- ``flight_recorder``  bounded event ring -> self-contained
+                       post-mortem bundle on anomaly/crash/dump()
+- ``alerts``           declarative threshold rules over any registry
+                       metric (for-duration + debounce), feeding
+                       /healthz and the UI health panel
 """
 
+from deeplearning4j_tpu.observability.alerts import (
+    AlertManager, AlertRule,
+)
 from deeplearning4j_tpu.observability.compile_watch import (
     CompileWatcher, RecompileStormError, install_global_watch, watch,
+)
+from deeplearning4j_tpu.observability.flight_recorder import (
+    FlightRecorder,
+)
+from deeplearning4j_tpu.observability.health import (
+    HealthMonitor, TrainingDivergedError, fused_health,
 )
 from deeplearning4j_tpu.observability.registry import (
     REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
@@ -30,7 +50,9 @@ from deeplearning4j_tpu.observability.tracing import (
 )
 
 __all__ = [
-    "CompileWatcher", "RecompileStormError", "install_global_watch",
+    "AlertManager", "AlertRule", "CompileWatcher",
+    "FlightRecorder", "HealthMonitor", "RecompileStormError",
+    "TrainingDivergedError", "fused_health", "install_global_watch",
     "watch", "REGISTRY", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "ProfilerListener", "detect_peak_flops",
     "model_flops_utilization", "peak_flops_for_kind", "Tracer",

@@ -1,0 +1,332 @@
+"""Flight recorder: a bounded black box that survives the crash.
+
+A dead training run is normally debugged from whatever happened to be
+on stdout. The flight recorder keeps the last N observability events
+— tracer spans, StatsReports, metric snapshots, health anomalies — in
+a ring buffer, and on anomaly, unhandled fit-loop exception, or an
+explicit ``dump()`` writes a **self-contained post-mortem bundle**:
+
+    <out_dir>/postmortem-<stamp>-<reason>/
+        MANIFEST.json   reason, timestamps, file list, drop counts
+        events.jsonl    the ring, one JSON event per line
+        trace.json      Chrome trace-event JSON (Perfetto-loadable)
+        env.json        device/platform/env/compile-stats snapshot
+        metrics.json    MetricsRegistry snapshot
+
+Everything in the bundle loads standalone — no repo, no model, no
+live process needed. Wiring:
+
+- ``FlightRecorder(...)`` subscribes itself to the process tracer
+  (``Tracer.add_sink``) so spans stream in while tracing is enabled;
+- it speaks the stats-storage protocol (``put_update``), so it can be
+  chained anywhere a storage goes;
+- ``install()`` makes it the process recorder: the executors' fit
+  loops call :func:`on_fit_exception` on ANY escaping exception, and
+  serving backends call :func:`on_backend_crash` from their worker
+  sweep, so an aborted run leaves a bundle without any per-callsite
+  wiring.
+
+Dumps triggered by anomalies are debounced (``min_dump_interval_s``)
+— a rollback storm must not fill the disk with bundles; unhandled
+exceptions and explicit ``dump()`` always write.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import logging
+import os
+import platform
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["FlightRecorder", "install", "uninstall", "get_recorder",
+           "on_fit_exception", "on_backend_crash"]
+
+
+def _jsonable(obj):
+    """Best-effort JSON coercion for ring payloads (numpy scalars,
+    dataclasses, exceptions)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    if isinstance(obj, BaseException):
+        return repr(obj)
+    if hasattr(obj, "item"):
+        try:
+            return obj.item()
+        except Exception:
+            pass
+    return str(obj)
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 20_000,
+                 out_dir: Optional[str] = None,
+                 registry=None, tracer=None,
+                 capture_spans: bool = True,
+                 min_dump_interval_s: float = 60.0):
+        self.capacity = capacity
+        self.out_dir = out_dir
+        if registry is None:
+            from deeplearning4j_tpu.observability.registry import REGISTRY
+            registry = REGISTRY
+        self.registry = registry
+        if tracer is None:
+            from deeplearning4j_tpu.observability.tracing import trace
+            tracer = trace
+        self.tracer = tracer
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(
+            maxlen=capacity)
+        self.total_events = 0       # including ones the ring dropped
+        self.dumps: List[str] = []
+        self._last_dump = -float("inf")
+        self.min_dump_interval_s = min_dump_interval_s
+        self._sink_installed = False
+        if capture_spans:
+            try:
+                self.tracer.add_sink(self._on_span)
+                self._sink_installed = True
+            except Exception:
+                logger.exception("could not subscribe to tracer")
+
+    def close(self) -> None:
+        if self._sink_installed:
+            try:
+                self.tracer.remove_sink(self._on_span)
+            except Exception:
+                pass
+            self._sink_installed = False
+
+    # ------------------------------------------------------------------
+    # feeds
+    # ------------------------------------------------------------------
+    def record(self, kind: str, /, **payload) -> None:
+        # ``kind`` is positional-only so a payload carrying its own
+        # "kind" key can't collide with the event kind
+        ev = {"t": time.time()}
+        ev.update(payload)
+        ev["kind"] = kind
+        with self._lock:
+            self._events.append(ev)
+            self.total_events += 1
+
+    def _on_span(self, span_event: dict) -> None:
+        # tracer sink: called for every completed span while tracing
+        # is enabled; the ring bounds memory, never the tracer
+        ev = {"t": time.time(), "kind": "span"}
+        ev.update(span_event)
+        with self._lock:
+            self._events.append(ev)
+            self.total_events += 1
+
+    def put_update(self, report) -> None:
+        """Stats-storage protocol: record the report into the ring
+        (chain the recorder wherever a storage goes)."""
+        try:
+            payload = dataclasses.asdict(report)
+        except TypeError:
+            payload = {"repr": repr(report)}
+        self.record("stats_report", report=payload)
+
+    def record_registry_snapshot(self) -> None:
+        try:
+            self.record("metrics", snapshot=self.registry.snapshot())
+        except Exception:
+            logger.exception("registry snapshot failed")
+
+    def on_anomaly(self, anomaly: dict) -> None:
+        """Health-monitor hook: record, then dump (debounced)."""
+        payload = dict(anomaly)
+        payload["detector"] = payload.pop("kind", "unknown")
+        self.record("anomaly", **payload)
+        self.dump(reason=f"anomaly_{payload['detector']}",
+                  force=False)
+
+    def on_exception(self, where: str, exc: BaseException,
+                     force: bool = True, **context) -> None:
+        self.record("exception", where=where, error=repr(exc),
+                    traceback="".join(traceback.format_exception(
+                        type(exc), exc, exc.__traceback__))[-8000:],
+                    **context)
+        self.dump(reason=f"exception_{where}", force=force)
+
+    # ------------------------------------------------------------------
+    # snapshotting
+    # ------------------------------------------------------------------
+    def env_snapshot(self) -> dict:
+        snap = {
+            "time": time.time(),
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "python": sys.version,
+            "platform": platform.platform(),
+            "hostname": platform.node(),
+            "env": {k: v for k, v in os.environ.items()
+                    if k.startswith(("JAX_", "XLA_", "TPU_",
+                                     "LIBTPU_"))},
+        }
+        try:
+            import jax
+            snap["jax_version"] = jax.__version__
+            snap["devices"] = [
+                {"id": d.id, "kind": d.device_kind,
+                 "platform": d.platform,
+                 "process_index": d.process_index}
+                for d in jax.devices()]
+        except Exception as e:
+            snap["devices_error"] = repr(e)
+        try:
+            from deeplearning4j_tpu.observability import compile_watch
+            stats = compile_watch._GLOBAL_STATS
+            if stats is not None:
+                snap["compile_stats"] = stats.summary()
+        except Exception:
+            pass
+        return snap
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    # ------------------------------------------------------------------
+    # the bundle
+    # ------------------------------------------------------------------
+    def dump(self, reason: str = "manual",
+             out_dir: Optional[str] = None,
+             force: bool = True) -> Optional[str]:
+        """Write a post-mortem bundle; returns its directory (or None
+        when a non-forced dump was debounced or no out_dir is known).
+        """
+        base = out_dir or self.out_dir
+        if base is None:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if not force and (now - self._last_dump
+                              < self.min_dump_interval_s):
+                return None
+            self._last_dump = now
+        safe_reason = "".join(c if c.isalnum() or c in "-_" else "_"
+                              for c in reason)[:60]
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        bundle = os.path.join(base, f"postmortem-{stamp}-{safe_reason}")
+        n = 1
+        while os.path.exists(bundle):
+            bundle = os.path.join(
+                base, f"postmortem-{stamp}-{safe_reason}.{n}")
+            n += 1
+        os.makedirs(bundle, exist_ok=True)
+        files = []
+
+        evs = self.events()
+        with open(os.path.join(bundle, "events.jsonl"), "w") as f:
+            for ev in evs:
+                f.write(json.dumps(ev, default=_jsonable) + "\n")
+        files.append("events.jsonl")
+
+        try:
+            self.tracer.export_chrome_trace(
+                os.path.join(bundle, "trace.json"))
+            files.append("trace.json")
+        except Exception:
+            logger.exception("chrome trace export failed")
+
+        with open(os.path.join(bundle, "env.json"), "w") as f:
+            json.dump(self.env_snapshot(), f, indent=2,
+                      default=_jsonable)
+        files.append("env.json")
+
+        try:
+            with open(os.path.join(bundle, "metrics.json"), "w") as f:
+                json.dump(self.registry.snapshot(), f, indent=2,
+                          default=_jsonable)
+            files.append("metrics.json")
+        except Exception:
+            logger.exception("metrics snapshot failed")
+
+        with self._lock:
+            dropped = self.total_events - len(evs)
+        with open(os.path.join(bundle, "MANIFEST.json"), "w") as f:
+            json.dump({"reason": reason, "created": time.time(),
+                       "files": sorted(files + ["MANIFEST.json"]),
+                       "events": len(evs),
+                       "events_total": self.total_events,
+                       "events_dropped_from_ring": dropped}, f,
+                      indent=2)
+        self.dumps.append(bundle)
+        logger.warning("flight-recorder bundle (%s): %s", reason,
+                       bundle)
+        return bundle
+
+
+# ---------------------------------------------------------------------------
+# process-wide recorder (the executors' crash hook target)
+# ---------------------------------------------------------------------------
+
+_GLOBAL: Optional[FlightRecorder] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def install(recorder: FlightRecorder) -> FlightRecorder:
+    """Make ``recorder`` the process recorder: fit-loop exceptions and
+    serving worker crashes land in it automatically."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is not None and _GLOBAL is not recorder:
+            _GLOBAL.close()
+        _GLOBAL = recorder
+    return recorder
+
+
+def uninstall() -> None:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is not None:
+            _GLOBAL.close()
+        _GLOBAL = None
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _GLOBAL
+
+
+def on_fit_exception(model, exc: BaseException) -> None:
+    """Called by the executors when ANY exception escapes the fit
+    loop; no-op without an installed recorder, never raises."""
+    rec = _GLOBAL
+    if rec is None:
+        return
+    try:
+        rec.record_registry_snapshot()
+        # rollback-flagged divergences are (probably) about to be
+        # HANDLED by ElasticTrainer — debounce those dumps; anything
+        # else escaping the fit loop is a real crash and always dumps
+        handled = bool(getattr(exc, "rollback", False))
+        rec.on_exception(
+            "fit_loop", exc, force=not handled,
+            model=type(model).__name__,
+            iteration=getattr(model, "iteration_count", None),
+            epoch=getattr(model, "epoch_count", None))
+    except Exception:
+        logger.exception("flight recorder failed during fit crash")
+
+
+def on_backend_crash(name: str, exc: BaseException) -> None:
+    """Called from a serving backend's worker sweep when its loop
+    dies; no-op without an installed recorder, never raises."""
+    rec = _GLOBAL
+    if rec is None:
+        return
+    try:
+        rec.record("backend_crash", backend=name, error=repr(exc))
+        rec.dump(reason=f"backend_crash_{name}", force=False)
+    except Exception:
+        logger.exception("flight recorder failed during backend crash")

@@ -107,6 +107,9 @@ class Tracer:
         self.dropped = 0
         self._tls = threading.local()
         self._jsonl: Optional[io.TextIOBase] = None
+        # subscribers fed every completed span (the flight recorder's
+        # ring); called outside the buffer lock
+        self._sinks: List = []
         # one origin for the whole trace so ts values are comparable
         self._origin_ns = time.perf_counter_ns()
 
@@ -189,6 +192,25 @@ class Tracer:
             if self._jsonl is not None:
                 self._jsonl.write(json.dumps(ev) + "\n")
                 self._jsonl.flush()
+            sinks = list(self._sinks) if self._sinks else None
+        if sinks:
+            for sink in sinks:
+                try:
+                    sink(ev)
+                except Exception:
+                    pass    # a broken sink must not kill the fit loop
+
+    def add_sink(self, fn) -> None:
+        """Subscribe ``fn(event_dict)`` to every completed span (only
+        while tracing is enabled — disabled tracing records nothing)."""
+        with self._lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
 
     def events(self) -> List[dict]:
         with self._lock:

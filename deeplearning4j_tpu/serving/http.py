@@ -63,9 +63,15 @@ class ModelServer:
                  max_batch_size: int = 32, queue_limit: int = 256,
                  wait_ms: float = 2.0, slots: int = 4,
                  capacity: int = 256,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 alerts=None):
         self.registry = registry or ModelRegistry()
         self.metrics = metrics or ServingMetrics()
+        # optional observability.AlertManager: while any rule fires,
+        # /healthz reports "degraded" + the firing alerts instead of
+        # an unconditional "ok" (load balancers and pagers see the
+        # p99/queue/shed blow-up without polling /metrics)
+        self.alerts = alerts
         self.host = host
         self.port = port
         self.max_batch_size = max_batch_size
@@ -187,10 +193,22 @@ class ModelServer:
             def do_GET(self):
                 path = urlparse(self.path).path
                 if path == "/healthz":
-                    self._send(200, {
-                        "status": ("draining"
-                                   if server._draining.is_set()
-                                   else "ok")})
+                    if server._draining.is_set():
+                        self._send(200, {"status": "draining"})
+                        return
+                    firing = []
+                    if server.alerts is not None:
+                        try:
+                            server.alerts.evaluate()
+                            firing = server.alerts.firing()
+                        except Exception:
+                            logger.exception("alert evaluation "
+                                             "failed")
+                    if firing:
+                        self._send(200, {"status": "degraded",
+                                         "alerts": firing})
+                    else:
+                        self._send(200, {"status": "ok"})
                 elif path == "/metrics":
                     if self._wants_prometheus():
                         self._send_text(

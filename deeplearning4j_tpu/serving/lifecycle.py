@@ -70,6 +70,24 @@ class ServingBackend:
         # caller forever
         try:
             self._loop()
+        except BaseException as e:
+            # a dying worker is an incident, not a log line: count it
+            # on the registry and leave a flight-recorder bundle when
+            # one is installed, then let the sweep release waiters
+            try:
+                self.metrics.registry.counter(
+                    "serving_worker_crashes_total",
+                    help="serving backend worker loops that died",
+                    labels={"endpoint": self.name}).inc()
+            except Exception:
+                pass
+            try:
+                from deeplearning4j_tpu.observability import (
+                    flight_recorder)
+                flight_recorder.on_backend_crash(self.name, e)
+            except Exception:
+                pass
+            raise
         finally:
             self._stop.set()
             self._sweep_leftovers(self._abort_inflight())

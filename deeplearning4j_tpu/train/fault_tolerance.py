@@ -115,7 +115,14 @@ class ElasticTrainer:
     def __init__(self, model, checkpoint_dir: str, *,
                  save_every: int = 100, keep: int = 3,
                  max_rollbacks: int = 5, heal_after: Optional[int] = None,
-                 handle_sigterm: bool = True, wrapper=None):
+                 handle_sigterm: bool = True, wrapper=None,
+                 lr_drop_on_rollback: Optional[float] = None):
+        # lr_drop_on_rollback: multiply the configured learning rate
+        # by this factor (< 1) on every rollback — the standard
+        # "restart from the last good checkpoint with a cooler LR"
+        # move for repeated divergence. Rebuilding the optimizer
+        # resets its state (momentum), which is exactly the restart
+        # semantics wanted after a blow-up.
         # wrapper: optional ParallelWrapper around ``model`` — batches
         # then train data-parallel while checkpoint/restore still talks
         # to the underlying model (ParallelWrapper.java analog: the
@@ -130,6 +137,7 @@ class ElasticTrainer:
         self.heal_after = (save_every if heal_after is None
                            else max(1, heal_after))
         self.handle_sigterm = handle_sigterm
+        self.lr_drop_on_rollback = lr_drop_on_rollback
         self.rollbacks = 0           # current incident (decays)
         self.total_rollbacks = 0     # lifetime (never decays)
         self._healthy_streak = 0
@@ -172,11 +180,17 @@ class ElasticTrainer:
                  "skip": sorted(list(p) for p in self._skip),
                  "fp_chain": self._fp_chain}))
         os.replace(tmp, final)          # atomic on POSIX
+        # mark live trainer checkpoints protected so a co-attached
+        # CheckpointListener's keep_last pruning can never delete the
+        # file a rollback is about to restore
+        from deeplearning4j_tpu.train import listeners as _listeners
+        _listeners.protect_checkpoint(final)
         for _, path in self._ckpts()[:-self.keep]:
             try:
                 os.remove(path)
             except OSError:
                 pass
+            _listeners.unprotect_checkpoint(path)
         logger.info("checkpoint at iteration %d (epoch %d, batch %d) "
                     "-> %s", it, self._epoch, self._batch, final)
         return final
@@ -274,10 +288,25 @@ class ElasticTrainer:
                     if (self._epoch, self._batch) in self._skip:
                         self._batch += 1     # the poisoned batch
                         continue
-                    if self.wrapper is not None:
-                        self.wrapper.fit([ds])
-                    else:
-                        model.fit(ds)
+                    try:
+                        if self.wrapper is not None:
+                            self.wrapper.fit([ds])
+                        else:
+                            model.fit(ds)
+                    except Exception as e:
+                        # HealthMonitor's rollback policy raises a
+                        # rollback-flagged TrainingDivergedError from
+                        # the listener chain: restore the last good
+                        # checkpoint and continue, same as a
+                        # non-finite loss. Anything else propagates.
+                        if not getattr(e, "rollback", False):
+                            raise
+                        self._batch += 1     # batch was consumed
+                        logger.warning(
+                            "health monitor requested rollback: %s", e)
+                        self._rollback()
+                        rolled_back = True
+                        break
                     self._batch += 1
                     loss = float(model.score_value)
                     if not np.isfinite(loss):
@@ -326,8 +355,37 @@ class ElasticTrainer:
         # non-finite loss: skip it on replay, replay everything else
         self._skip.add((self._epoch, self._batch - 1))
         self._restore_into_model(path)
+        if self.lr_drop_on_rollback:
+            self._drop_lr(self.lr_drop_on_rollback)
         # immediately persist the restored state WITH the new skip
         # entry (same iteration ordinal — overwrites in place): a kill
         # right after this rollback resumes skip-aware instead of
         # paying a second rollback to rediscover the poison batch
         self.save_checkpoint()
+
+    def _drop_lr(self, factor: float) -> None:
+        """Scale the configured learning rate and rebuild the
+        optimizer (restart-with-cooler-LR; optimizer state resets by
+        design — the restored momentum pointed at the blow-up)."""
+        try:
+            cfg = self.model.conf.conf.updater_cfg
+            if cfg is None:
+                # no explicit updater: the executor trains with the
+                # default sgd() — materialize it so the drop applies
+                # instead of silently doing nothing
+                from deeplearning4j_tpu.nn.conf import updaters
+                cfg = updaters.sgd()
+                self.model.conf.conf.updater_cfg = cfg
+            if not cfg.get("lr"):
+                logger.warning(
+                    "rollback LR drop skipped: updater config %r has "
+                    "no 'lr' to scale", cfg.get("type"))
+                return
+            old = cfg["lr"]
+            cfg["lr"] = old * factor
+            if hasattr(self.model, "_build_optimizer"):
+                self.model._build_optimizer()
+            logger.warning("rollback LR drop: %g -> %g", old,
+                           cfg["lr"])
+        except Exception:
+            logger.exception("LR drop after rollback failed")

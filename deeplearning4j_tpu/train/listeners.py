@@ -24,7 +24,31 @@ logger = logging.getLogger("deeplearning4j_tpu")
 __all__ = ["TrainingListener", "ScoreIterationListener",
            "PerformanceListener", "CollectScoresIterationListener",
            "TimeIterationListener", "EvaluativeListener",
-           "SleepyTrainingListener", "CheckpointListener"]
+           "SleepyTrainingListener", "CheckpointListener",
+           "protect_checkpoint", "unprotect_checkpoint",
+           "is_checkpoint_protected"]
+
+
+# Checkpoint files that pruning must never delete. ElasticTrainer
+# registers its live checkpoints here (train/fault_tolerance.py), so
+# a CheckpointListener sharing a directory can never prune the file a
+# rollback is about to restore.
+_PROTECTED_CHECKPOINTS = set()
+
+
+def protect_checkpoint(path: str) -> None:
+    import os
+    _PROTECTED_CHECKPOINTS.add(os.path.abspath(path))
+
+
+def unprotect_checkpoint(path: str) -> None:
+    import os
+    _PROTECTED_CHECKPOINTS.discard(os.path.abspath(path))
+
+
+def is_checkpoint_protected(path: str) -> bool:
+    import os
+    return os.path.abspath(path) in _PROTECTED_CHECKPOINTS
 
 
 class TrainingListener:
@@ -185,6 +209,10 @@ class CheckpointListener(TrainingListener):
         self._saved.append(path)
         while len(self._saved) > self.keep_last:
             old = self._saved.pop(0)
+            if is_checkpoint_protected(old):
+                # e.g. ElasticTrainer's rollback restore target —
+                # keep the file, just stop tracking it
+                continue
             try:
                 os.remove(old)
             except OSError:

@@ -41,6 +41,8 @@ _PAGE = """<!DOCTYPE html>
 <body>
 <h1>Training dashboard</h1>
 <div class="meta" id="meta"></div>
+<div class="chart"><h2>Training health</h2>
+  <div id="health"><span class="meta">no health data</span></div></div>
 <div class="chart"><h2>Score vs iteration</h2>
   <svg id="score" width="800" height="220"></svg></div>
 <div class="chart"><h2>Samples/sec</h2>
@@ -93,7 +95,37 @@ function line(svg, xs, ys, color) {
     `<text x="4" y="14">${ymax.toPrecision(4)}</text>` +
     `<text x="4" y="${H-22}">${ymin.toPrecision(4)}</text>`;
 }
+async function refreshHealth() {
+  const h = await (await fetch('/api/health')).json();
+  const colors = {ok: '#2a2', degraded: '#c80', diverged: '#c22'};
+  let html = `<span style="display:inline-block;padding:2px 10px;
+    border-radius:10px;color:white;background:${colors[h.status]||'#888'}">
+    ${h.status.toUpperCase()}</span>`;
+  if (h.alerts && h.alerts.length) {
+    html += '<ul>' + h.alerts.map(a =>
+      `<li><b>${a.name}</b> (${a.severity}): ${a.metric} = ` +
+      `${a.value === null ? '?' : Number(a.value).toPrecision(4)} ` +
+      `${a.op} ${a.threshold}</li>`).join('') + '</ul>';
+  }
+  const m = h.monitor;
+  if (m) {
+    const last = m.last || {};
+    html += `<div class="meta">iteration ${last.iteration ?? '—'},
+      loss ${last.loss === undefined ? '—' :
+             Number(last.loss).toPrecision(5)},
+      |grad| ${last.grad_norm == null ? '—' :
+               Number(last.grad_norm).toPrecision(4)},
+      anomalies: ${m.anomaly_count}</div>`;
+    if (m.anomalies && m.anomalies.length) {
+      html += '<ul>' + m.anomalies.slice(-8).reverse().map(a =>
+        `<li>[${a.policy}] <b>${a.kind}</b> @${a.iteration}:
+         ${a.message}</li>`).join('') + '</ul>';
+    }
+  }
+  document.getElementById('health').innerHTML = html;
+}
 async function refresh() {
+  try { await refreshHealth(); } catch (e) {}
   const sessions = await (await fetch('/api/sessions')).json();
   if (!sessions.length) return;
   const sid = sessions[sessions.length - 1];
@@ -193,13 +225,19 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000):
+    def __init__(self, port: int = 9000,
+                 max_body_bytes: int = 8 * 1024 * 1024):
         self.port = port
         self.storage = InMemoryStatsStorage()
+        # bound on POST bodies (/api/remote, /api/tsne): oversized or
+        # malformed payloads get a 400 JSON error, never a 500
+        self.max_body_bytes = max_body_bytes
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._tsne = {"points": [], "labels": None}
         self._flow = {"nodes": [], "edges": []}
+        self._health_monitor = None
+        self._alerts = None
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -210,6 +248,37 @@ class UIServer:
 
     def attach(self, storage) -> None:
         self.storage = storage
+
+    def attach_health(self, monitor=None, alerts=None) -> None:
+        """Feed the dashboard's health panel (``/api/health``):
+        ``monitor`` is an ``observability.HealthMonitor`` (status +
+        anomaly history), ``alerts`` an ``observability.AlertManager``
+        (evaluated on each request, firing rules listed)."""
+        if monitor is not None:
+            self._health_monitor = monitor
+        if alerts is not None:
+            self._alerts = alerts
+
+    def health_payload(self) -> dict:
+        monitor = self._health_monitor
+        alerts = self._alerts
+        mstatus = monitor.status() if monitor is not None else None
+        firing = []
+        if alerts is not None:
+            try:
+                alerts.evaluate()
+                firing = alerts.firing()
+            except Exception:
+                logger.exception("alert evaluation failed")
+        if mstatus is not None and mstatus["status"] == "diverged":
+            status = "diverged"
+        elif firing or (mstatus is not None
+                        and mstatus["status"] != "ok"):
+            status = "degraded"
+        else:
+            status = "ok"
+        return {"status": status, "alerts": firing,
+                "monitor": mstatus}
 
     def attach_model(self, model) -> None:
         """Feed the network-flow view (the Play UI's flow module /
@@ -313,26 +382,58 @@ class UIServer:
                     self._send(200, json.dumps(server_ref()._tsne))
                 elif url.path == "/api/flow":
                     self._send(200, json.dumps(server_ref()._flow))
+                elif url.path == "/api/health":
+                    self._send(200,
+                               json.dumps(server_ref().health_payload()))
                 else:
                     self._send(404, json.dumps({"error": "not found"}))
 
+            def _read_body(self) -> str:
+                """Bounded body read; raises ValueError on a missing/
+                bogus Content-Length or an oversized payload."""
+                limit = server_ref().max_body_bytes
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                except (TypeError, ValueError):
+                    raise ValueError("invalid Content-Length header")
+                if n < 0:
+                    raise ValueError("invalid Content-Length header")
+                if n > limit:
+                    raise ValueError(
+                        f"payload too large: {n} bytes "
+                        f"(limit {limit})")
+                return self.rfile.read(n).decode("utf-8", "strict")
+
             def do_POST(self):
                 url = urlparse(self.path)
-                if url.path == "/api/remote":
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(n).decode()
-                    report = StatsReport.from_json(body)
-                    storage_ref().put_update(report)
-                    self._send(200, json.dumps({"ok": True}))
-                elif url.path == "/api/tsne":
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n).decode())
-                    server_ref()._tsne = {
-                        "points": body.get("points", []),
-                        "labels": body.get("labels")}
-                    self._send(200, json.dumps({"ok": True}))
-                else:
-                    self._send(404, json.dumps({"error": "not found"}))
+                try:
+                    if url.path == "/api/remote":
+                        report = StatsReport.from_json(
+                            self._read_body())
+                        storage_ref().put_update(report)
+                        self._send(200, json.dumps({"ok": True}))
+                    elif url.path == "/api/tsne":
+                        body = json.loads(self._read_body())
+                        if not isinstance(body, dict):
+                            raise ValueError(
+                                "tsne body must be a JSON object")
+                        server_ref()._tsne = {
+                            "points": body.get("points", []),
+                            "labels": body.get("labels")}
+                        self._send(200, json.dumps({"ok": True}))
+                    else:
+                        self._send(404,
+                                   json.dumps({"error": "not found"}))
+                except (ValueError, TypeError, KeyError,
+                        UnicodeDecodeError,
+                        json.JSONDecodeError) as e:
+                    # malformed / oversized payloads are CLIENT
+                    # errors: a structured 400, never a stack trace
+                    self._send(400, json.dumps(
+                        {"error": f"bad request: {e}"}))
+                except Exception as e:    # keep the listener alive
+                    logger.exception("UI POST handler error")
+                    self._send(500, json.dumps({"error": str(e)}))
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                           Handler)

@@ -59,13 +59,30 @@ class StatsReport:
     # dashboard and remote-POST route carry the profiler's reports
     # through the same storage pipe as training stats
     profile: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # training-health fields (observability/health.py): global L2
+    # norms from the fused in-step check, plus detector outputs
+    # (finite_bits, worst_dead_fraction, ...) stamped by a chained
+    # HealthMonitor
+    gradient_norm: Optional[float] = None
+    update_norm: Optional[float] = None
+    param_norm: Optional[float] = None
+    health: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
 
     @staticmethod
     def from_json(s: str) -> "StatsReport":
-        return StatsReport(**json.loads(s))
+        d = json.loads(s)
+        if not isinstance(d, dict):
+            raise ValueError("StatsReport JSON must be an object, "
+                             f"got {type(d).__name__}")
+        # tolerate unknown keys (a newer writer's extra fields) but
+        # keep every known one — the round-trip contract is pinned by
+        # the golden test in tests/test_health.py
+        known = {f.name for f in dataclasses.fields(StatsReport)}
+        return StatsReport(**{k: v for k, v in d.items()
+                              if k in known})
 
 
 class InMemoryStatsStorage:

@@ -1,0 +1,5 @@
+"""Shared test fixtures (importable helpers, not pytest fixtures)."""
+
+from .nan_injection import (  # noqa: F401
+    make_batches, poison_batch, poison_params, tiny_classifier,
+)

@@ -1,0 +1,77 @@
+"""NaN-injection helpers for training-health tests.
+
+One shared way to poison a run so every health test asserts the same
+contract: the monitor must trip WITHIN ONE STEP of the poisoned batch
+on the jitted path, and each policy (warn / raise / rollback) must do
+what it says.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+def tiny_classifier(seed: int = 0, n_in: int = 4, n_out: int = 3,
+                    hidden: int = 8):
+    """A 2-layer MLP that trains in milliseconds on CPU."""
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    conf = (NeuralNetConfiguration.builder()
+            .set_seed(seed)
+            .updater(updaters.adam(0.01))
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=n_out))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_batches(n_batches: int, *, batch: int = 8, n_in: int = 4,
+                 n_out: int = 3, seed: int = 0) -> List[DataSet]:
+    """A deterministic list of classification batches (a plain list
+    is a valid deterministic iterator for both ``fit`` and
+    ``ElasticTrainer``)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        x = rng.normal(size=(batch, n_in)).astype(np.float32)
+        y = np.eye(n_out, dtype=np.float32)[
+            rng.integers(0, n_out, batch)]
+        out.append(DataSet(x, y))
+    return out
+
+
+def poison_batch(batches: List[DataSet], index: int,
+                 where: str = "features",
+                 value: float = np.nan) -> List[DataSet]:
+    """Poison one element of batch ``index`` in place (copy-on-write
+    for that batch) and return the list for chaining."""
+    ds = batches[index]
+    arr = getattr(ds, where).copy()
+    arr.flat[0] = value
+    setattr(ds, where, arr)
+    return batches
+
+
+def poison_params(model, layer: int = 0,
+                  param: Optional[str] = None,
+                  value: float = np.nan) -> str:
+    """Overwrite one element of a parameter array mid-run (the
+    'cosmic ray' / bad-checkpoint case). Returns the poisoned param
+    name."""
+    import jax.numpy as jnp
+    params = model.params[layer]
+    name = param if param is not None else sorted(params)[0]
+    arr = np.asarray(params[name]).copy()
+    arr.flat[0] = value
+    params[name] = jnp.asarray(arr)
+    return name

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Lint: every "N.Nx" perf claim in the docs must be measured.
+"""Lint: every "N.Nx" perf claim in the docs must be measured, and
+every metric name the docs cite must exist in the code.
 
 Two rounds in a row shipped prose speedups ("4.1x over exact masked
 attention") whose numbers no bench artifact ever recorded — the
@@ -25,8 +26,19 @@ would guarantee nothing.
 Lines containing the word "target" are exempt — a declared goal
 ("BASELINE target: >= 0.70x of flax") is not a measurement claim.
 
+**Stale metric names** are the same bug class for observability docs:
+a README that tells operators to alert on ``serving_latency_seconds``
+after the code renamed it is worse than no README. Every backticked
+identifier in README/COMPONENTS that LOOKS like a registry metric
+(snake_case ending in a Prometheus unit/kind suffix — ``_total``,
+``_seconds``, ``_bytes``, ``_depth``, ``_firing``) must match a
+metric-name string literal somewhere under ``deeplearning4j_tpu/``
+(f-string name templates like ``f"{name}_queue_depth"`` match as
+wildcards).
+
 Run: ``python tools/check_perf_claims.py [--repo DIR]``; exit 0 =
-clean. Wired into the test tier via tests/test_observability.py.
+clean. Wired into the tier-1 test tier via tests/test_observability.py
+(perf claims) and tests/test_health.py (metric names).
 """
 
 from __future__ import annotations
@@ -101,6 +113,73 @@ def find_claims(path: str) -> List[Tuple[int, str, float, int]]:
     return claims
 
 
+# ---------------------------------------------------------------------------
+# stale metric names
+# ---------------------------------------------------------------------------
+
+PACKAGE_DIR = "deeplearning4j_tpu"
+
+# suffixes that mark a backticked doc token as a metric-name citation
+METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_depth",
+                   "_firing")
+_SUFFIX_ALT = "|".join(METRIC_SUFFIXES)
+
+# `serving_requests_total`-style citations in docs
+DOC_METRIC_RE = re.compile(
+    r"`([a-z][a-z0-9_]*(?:%s))`" % _SUFFIX_ALT)
+
+# metric-name string literals in source, including f-string templates
+# (f"{name}_queue_depth" — the {…} part matches any label-ish token)
+SRC_METRIC_RE = re.compile(
+    r"""["']([A-Za-z0-9_{}]*(?:%s))["']""" % _SUFFIX_ALT)
+
+
+def registered_metric_patterns(repo: str) -> List[re.Pattern]:
+    """Compile every metric-name literal under the package into a
+    matcher; ``{...}`` f-string holes become wildcards."""
+    patterns = set()
+    for root, _dirs, files in os.walk(os.path.join(repo, PACKAGE_DIR)):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(root, fname),
+                      encoding="utf-8", errors="replace") as f:
+                src = f.read()
+            for m in SRC_METRIC_RE.finditer(src):
+                patterns.add(m.group(1))
+    out = []
+    for p in sorted(patterns):
+        rx = re.escape(p).replace(r"\{", "{").replace(r"\}", "}")
+        rx = re.sub(r"\{[^{}]*\}", r"[a-zA-Z0-9_/.-]+", rx)
+        out.append(re.compile(rx + r"\Z"))
+    return out
+
+
+def find_doc_metric_names(path: str) -> List[Tuple[int, str]]:
+    names = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            for m in DOC_METRIC_RE.finditer(line):
+                names.append((i, m.group(1)))
+    return names
+
+
+def check_metric_names(repo: str) -> List[str]:
+    patterns = registered_metric_patterns(repo)
+    errors = []
+    for doc in DOC_FILES:
+        path = os.path.join(repo, doc)
+        if not os.path.exists(path):
+            continue
+        for line_no, name in find_doc_metric_names(path):
+            if not any(p.match(name) for p in patterns):
+                errors.append(
+                    f"{doc}:{line_no}: metric `{name}` is cited in "
+                    f"the docs but registered nowhere under "
+                    f"{PACKAGE_DIR}/ — stale name?")
+    return errors
+
+
 def check(repo: str) -> List[str]:
     artifact_path = os.path.join(repo, ARTIFACT)
     with open(artifact_path) as f:
@@ -117,6 +196,7 @@ def check(repo: str) -> List[str]:
                     f"{doc}:{line_no}: claim '{claim}x' has no "
                     f"measured counterpart in {ARTIFACT} "
                     f"(line: {line.strip()[:100]})")
+    errors.extend(check_metric_names(repo))
     return errors
 
 
@@ -133,7 +213,8 @@ def main(argv=None) -> int:
             print("  " + e, file=sys.stderr)
         return 1
     print("perf claims OK: every N.Nx multiplier in "
-          f"{'/'.join(DOC_FILES)} is backed by {ARTIFACT}")
+          f"{'/'.join(DOC_FILES)} is backed by {ARTIFACT}, and every "
+          "cited metric name exists in the code")
     return 0
 
 
