@@ -1,0 +1,32 @@
+"""deeplearning4j_tpu.chaos — deterministic fault injection +
+resilience primitives.
+
+The robustness analogue of the observability subsystem: named
+injection sites are threaded through checkpointing, the data path,
+training, and serving; a seed-driven process-wide injector
+(``chaos.install(plan, seed=...)``) fires declaratively-planned
+faults at them, replayably; and the hardening the injections exercise
+— the shared :mod:`~deeplearning4j_tpu.chaos.retry` policy, checkpoint
+CRC verification/quarantine, the serving CircuitBreaker — lives next
+door. See the README "Fault injection & resilience" section for the
+plan schema and site table.
+
+Stdlib-only on import (the data path imports this module at module
+scope; counters and the flight recorder are reached lazily, only when
+a fault actually fires).
+"""
+
+from deeplearning4j_tpu.chaos.injector import (  # noqa: F401
+    ChaosError, ChaosIOError, ChaosOSError, Fault, FaultInjector,
+    FaultPlan, FaultSpec, SITES, SimulatedCrashError, current,
+    file_fault, hit, install, parse_plan, step_fault, uninstall,
+)
+from deeplearning4j_tpu.chaos.retry import (  # noqa: F401
+    DEFAULT_IO_RETRY, RetryPolicy, retrying_io,
+)
+
+__all__ = ["ChaosError", "ChaosIOError", "ChaosOSError", "Fault",
+           "FaultInjector", "FaultPlan", "FaultSpec", "SITES",
+           "SimulatedCrashError", "current", "file_fault", "hit",
+           "install", "parse_plan", "step_fault", "uninstall",
+           "DEFAULT_IO_RETRY", "RetryPolicy", "retrying_io"]

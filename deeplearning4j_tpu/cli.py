@@ -25,6 +25,15 @@ pin_cpu_platform()     # a dead TPU tunnel must not hang CPU-pinned CLIs
 
 
 def _cmd_train(args):
+    if args.chaos:
+        # fault injection for the bench/soak path: the plan is JSON
+        # (inline or a file); the effective seed is printed so any
+        # chaotic run can be replayed exactly
+        from deeplearning4j_tpu import chaos
+        inj = chaos.install(args.chaos, seed=args.chaos_seed)
+        print(f"chaos: fault plan installed "
+              f"({len(inj.plan.faults)} spec(s), seed {inj.seed}; "
+              f"replay with --chaos-seed {inj.seed})")
     from deeplearning4j_tpu.data.records import (CSVRecordReader,
                                                  RecordReaderDataSetIterator)
     from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
@@ -179,6 +188,19 @@ def main(argv=None):
                         "divergence/plateau/gradient detectors); "
                         "POLICY = warn | raise | rollback "
                         "(default warn)")
+    t.add_argument("--chaos", metavar="PLAN", default=None,
+                   help="install a deterministic fault-injection "
+                        "plan for this run: inline JSON or a path to "
+                        "a JSON file (see README 'Fault injection & "
+                        "resilience' for the schema/site table); "
+                        "fired faults count as "
+                        "chaos_faults_fired_total")
+    t.add_argument("--chaos-seed", type=int, default=None,
+                   metavar="N",
+                   help="seed for the fault plan's rng streams "
+                        "(default: the plan's own seed, else a "
+                        "recorded random one) — rerunning with the "
+                        "printed seed replays the faults")
     t.set_defaults(fn=_cmd_train)
 
     u = sub.add_parser("ui", help="training dashboard server")

@@ -20,8 +20,16 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.chaos.retry import retrying_io
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+
+
+def _load_with_retry(read):
+    """One dataset-file read through the ``data.load`` chaos site and
+    the shared retry policy (an NFS blip mid-epoch-0 costs a backoff,
+    not the run)."""
+    return retrying_io("data.load", read)
 
 __all__ = ["mnist_data", "MnistDataSetIterator", "iris_data",
            "IrisDataSetIterator", "cifar10_data", "Cifar10DataSetIterator",
@@ -98,18 +106,22 @@ def synthetic_sequences(n: int, t: int, n_features: int, n_classes: int,
 # ---------------------------------------------------------------------------
 
 def _load_idx_images(path: str) -> np.ndarray:
-    op = gzip.open if path.endswith(".gz") else open
-    with op(path, "rb") as f:
-        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
-        data = np.frombuffer(f.read(), dtype=np.uint8)
-    return data.reshape(n, rows, cols)
+    def read():
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+    return _load_with_retry(read)
 
 
 def _load_idx_labels(path: str) -> np.ndarray:
-    op = gzip.open if path.endswith(".gz") else open
-    with op(path, "rb") as f:
-        magic, n = struct.unpack(">II", f.read(8))
-        return np.frombuffer(f.read(), dtype=np.uint8)
+    def read():
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), dtype=np.uint8)
+    return _load_with_retry(read)
 
 
 def mnist_data(train: bool = True, flatten: bool = True,
@@ -215,7 +227,9 @@ def cifar10_data(train: bool = True, n: Optional[int] = None,
     if all(os.path.exists(p) for p in paths):
         xs_list, ys_list = [], []
         for p in paths:
-            raw = np.fromfile(p, dtype=np.uint8).reshape(-1, 3073)
+            raw = _load_with_retry(
+                lambda p=p: np.fromfile(p, dtype=np.uint8)
+            ).reshape(-1, 3073)
             ys_list.append(raw[:, 0])
             imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
             xs_list.append(imgs)

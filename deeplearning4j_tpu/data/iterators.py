@@ -15,13 +15,26 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu.chaos.retry import retrying_io
 from deeplearning4j_tpu.data.dataset import DataSet
+
+
+def fetch_batch(make):
+    """Produce one batch through the ``data.fetch`` chaos site and
+    the shared retry policy (:func:`chaos.retry.retrying_io`): a
+    transient IOError (injected or real) costs a backoff'd retry of
+    the SAME batch, so a flaky source degrades throughput, never the
+    batch stream — the determinism ElasticTrainer's replay
+    fast-forward relies on. Every batch producer (here and in
+    records.py) goes through this one function."""
+    return retrying_io("data.fetch", make)
+
 
 __all__ = ["DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
            "AsyncDataSetIterator", "MultipleEpochsIterator",
            "EarlyTerminationDataSetIterator", "SamplingDataSetIterator",
            "BenchmarkDataSetIterator", "JointParallelDataSetIterator",
-           "FileSplitParallelDataSetIterator"]
+           "FileSplitParallelDataSetIterator", "fetch_batch"]
 
 
 class DataSetIterator:
@@ -55,7 +68,8 @@ class ListDataSetIterator(DataSetIterator):
         pass
 
     def _iterate(self):
-        yield from self._batches
+        for b in self._batches:
+            yield fetch_batch(lambda b=b: b)
 
     def batch_size(self):
         return self._batches[0].num_examples() if self._batches else None
@@ -94,12 +108,13 @@ class ArrayDataSetIterator(DataSetIterator):
             sel = idx[i:i + self._bs]
             if self._drop_last and len(sel) < self._bs:
                 return
-            yield DataSet(
+            yield fetch_batch(lambda sel=sel: DataSet(
                 self.features[sel],
                 None if self.labels is None else self.labels[sel],
                 None if self.features_mask is None
                 else self.features_mask[sel],
-                None if self.labels_mask is None else self.labels_mask[sel])
+                None if self.labels_mask is None
+                else self.labels_mask[sel]))
 
     def batch_size(self):
         return self._bs

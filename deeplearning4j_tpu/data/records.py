@@ -17,7 +17,8 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
-from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.data.iterators import (DataSetIterator,
+                                               fetch_batch)
 
 __all__ = ["CSVRecordReader", "CSVSequenceRecordReader",
            "ImageRecordReader", "RecordReaderDataSetIterator",
@@ -167,10 +168,10 @@ class RecordReaderDataSetIterator(DataSetIterator):
             feats.append(f)
             labs.append(y)
             if len(feats) == self._bs:
-                yield self._mk(feats, labs)
+                yield fetch_batch(lambda: self._mk(feats, labs))
                 feats, labs = [], []
         if feats:
-            yield self._mk(feats, labs)
+            yield fetch_batch(lambda: self._mk(feats, labs))
 
     def _mk(self, feats, labs):
         x = np.stack(feats)

@@ -22,7 +22,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "REGISTRY", "default_latency_buckets"]
+           "REGISTRY", "default_latency_buckets", "safe_inc"]
 
 
 def default_latency_buckets(lo: float = 1e-4, hi: float = 60.0,
@@ -363,3 +363,17 @@ class MetricsRegistry:
 # registries so parallel test servers don't share counters; pass
 # ``registry=REGISTRY`` to join the global pipe.
 REGISTRY = MetricsRegistry()
+
+
+def safe_inc(name: str, help: str = "",
+             labels: Optional[Dict[str, str]] = None,
+             registry: Optional[MetricsRegistry] = None) -> None:
+    """Best-effort counter increment (default: the process-wide
+    registry): NEVER raises — instrumentation on a failure path must
+    not take down the path it measures. The one copy of the
+    try/counter/except pattern the resilience call sites share."""
+    try:
+        (registry if registry is not None else REGISTRY).counter(
+            name, help=help, labels=labels).inc()
+    except Exception:
+        pass

@@ -18,6 +18,8 @@ _EXPORTS = {
     "DeadlineExceededError": "errors",
     "ModelNotFoundError": "errors",
     "ServerClosedError": "errors",
+    "CircuitOpenError": "errors",
+    "CircuitBreaker": "lifecycle",
     "LatencyHistogram": "metrics",
     "EndpointMetrics": "metrics",
     "BatchOccupancy": "metrics",

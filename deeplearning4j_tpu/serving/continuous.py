@@ -29,8 +29,10 @@ from typing import List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import chaos
 from deeplearning4j_tpu.serving.errors import DeadlineExceededError
 from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
+                                                  CircuitBreaker,
                                                   ServingBackend)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
@@ -72,18 +74,20 @@ class ContinuousBatcher(ServingBackend):
     def __init__(self, net, slots: int = 4, capacity: int = 256,
                  queue_limit: int = 64,
                  metrics: Optional[ServingMetrics] = None,
-                 name: str = "generate", dtype=None):
+                 name: str = "generate", dtype=None,
+                 breaker: Optional[CircuitBreaker] = None):
         super().__init__("contbatch", name, queue_limit, slots,
-                         metrics)
+                         metrics, breaker=breaker)
         try:
             self.session = net.slot_streaming_session(
                 capacity=capacity, slots=slots, dtype=dtype)
         except BaseException:
-            # super().__init__ already registered the queue-depth
-            # gauge; a failed construction must not leak it (a leaked
-            # gauge pins the half-built backend AND the model via the
-            # bound method — the unregister_gauge docstring's warning)
-            self.metrics.unregister_gauge(f"{self.name}_queue_depth")
+            # super().__init__ already registered the queue-depth and
+            # circuit-state gauges; a failed construction must not
+            # leak them (a leaked gauge pins the half-built backend
+            # AND the model via the bound method — the
+            # unregister_gauge docstring's warning)
+            self._unregister_gauges()
             raise
         self.slots = slots
         self.capacity = capacity
@@ -100,7 +104,7 @@ class ContinuousBatcher(ServingBackend):
                timeout: Optional[float] = None) -> _GenRequest:
         """Enqueue one generate request. ``prompt`` is a 1-d (or
         (1, T0)) sequence of token ids; returns a waitable handle."""
-        self._admit_guard()
+        probe = self._admit_guard()
         prompt = np.asarray(prompt)
         if prompt.ndim > 1 and prompt.shape[0] != 1:
             # a (B, T) batch of prompts is NOT one request: silently
@@ -122,9 +126,10 @@ class ContinuousBatcher(ServingBackend):
                 f"exceeds slot capacity {self.capacity}")
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
-        return self._enqueue(_GenRequest(
-            prompt, int(n_tokens), float(temperature), int(seed),
-            deadline))
+        r = _GenRequest(prompt, int(n_tokens), float(temperature),
+                        int(seed), deadline)
+        r.probe = probe
+        return self._enqueue(r)
 
     def generate(self, prompt, n_tokens: int, temperature: float = 0.0,
                  seed: int = 0,
@@ -181,6 +186,13 @@ class ContinuousBatcher(ServingBackend):
 
     @staticmethod
     def _sample(probs: np.ndarray, slot: _Slot) -> int:
+        if not np.isfinite(probs).all():
+            # np.argmax over an all-NaN row silently returns 0 — a
+            # poisoned/diverged decode step must fail THIS request
+            # loudly, not stream token 0 with a 200
+            raise ValueError(
+                "non-finite probabilities in decode step (device "
+                "fault or poisoned model output)")
         if slot.req.temperature <= 0:
             return int(np.argmax(probs))
         logits = np.log(probs + 1e-9) / slot.req.temperature
@@ -204,6 +216,20 @@ class ContinuousBatcher(ServingBackend):
             for i, s in enumerate(self._slots):
                 if s is not None:
                     x[i, 0, 0] = s.feed
+            # chaos site: crash kills the worker (active streams fail
+            # with the crash error, the loop restarts), hang stalls a
+            # step, poison NaNs this step's logits (each active
+            # stream then fails per-slot, never the worker)
+            try:
+                fault = chaos.step_fault("serving.worker.step")
+            except BaseException as e:
+                for i, s in enumerate(self._slots):
+                    if s is not None:
+                        self._endpoint.count_error()
+                        s.req.error = e
+                        s.req.event.set()
+                        self._slots[i] = None
+                raise
             try:
                 h = np.asarray(self.session.step_slots(x, active))
             except BaseException as e:
@@ -224,6 +250,8 @@ class ContinuousBatcher(ServingBackend):
                 except BaseException:
                     pass      # next step surfaces any persistent fault
                 continue
+            if fault is not None and fault.kind == "poison":
+                h = np.full_like(h, np.nan)
             self._occupancy.record(int(active.sum()))
             for i, s in enumerate(self._slots):
                 if s is None:
@@ -252,9 +280,17 @@ class ContinuousBatcher(ServingBackend):
                 else:
                     s.feed = nxt
 
-    def _abort_inflight(self):
-        leftovers = [s.req for s in self._slots if s is not None]
-        leftovers.extend(self._pending)
+    def _crash_casualties(self):
+        # only streams mid-decode die with the crash; _pending
+        # (admitted, never slotted — _pump drains the queue
+        # aggressively, so queued work effectively lives here) is
+        # served by the restarted loop
+        casualties = [s.req for s in self._slots if s is not None]
         self._slots = [None] * self.slots
+        return casualties
+
+    def _abort_inflight(self):
+        leftovers = self._crash_casualties()
+        leftovers.extend(self._pending)
         self._pending = []
         return leftovers

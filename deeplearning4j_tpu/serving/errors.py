@@ -14,7 +14,8 @@ serving stack, and ``serving/__init__`` re-exports lazily.
 """
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
-           "ModelNotFoundError", "ServerClosedError"]
+           "ModelNotFoundError", "ServerClosedError",
+           "CircuitOpenError"]
 
 
 class ServingError(RuntimeError):
@@ -43,3 +44,10 @@ class ModelNotFoundError(ServingError, KeyError):
 class ServerClosedError(ServingError):
     """The scheduler/server is draining or shut down: no new requests
     are admitted; in-flight requests still complete (503)."""
+
+
+class CircuitOpenError(ServingError):
+    """The backend's circuit breaker is open after repeated worker
+    crashes: the request is shed immediately instead of being queued
+    into a crash-looping worker. Retry after the breaker's cooldown
+    (HTTP maps this to 503)."""

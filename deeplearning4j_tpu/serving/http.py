@@ -36,7 +36,8 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from deeplearning4j_tpu.serving.continuous import ContinuousBatcher
-from deeplearning4j_tpu.serving.errors import (DeadlineExceededError,
+from deeplearning4j_tpu.serving.errors import (CircuitOpenError,
+                                               DeadlineExceededError,
                                                ModelNotFoundError,
                                                QueueFullError,
                                                ServerClosedError,
@@ -204,9 +205,17 @@ class ModelServer:
                         except Exception:
                             logger.exception("alert evaluation "
                                              "failed")
-                    if firing:
-                        self._send(200, {"status": "degraded",
-                                         "alerts": firing})
+                    # non-closed circuit breakers degrade health: a
+                    # crash-looping backend must be visible to load
+                    # balancers without polling /metrics
+                    circuits = server._circuit_states()
+                    if firing or circuits:
+                        payload = {"status": "degraded"}
+                        if firing:
+                            payload["alerts"] = firing
+                        if circuits:
+                            payload["circuits"] = circuits
+                        self._send(200, payload)
                     else:
                         self._send(200, {"status": "ok"})
                 elif path == "/metrics":
@@ -249,7 +258,9 @@ class ModelServer:
                     self._send(504, {"error": str(e)})
                 except ModelNotFoundError as e:
                     self._send(404, {"error": str(e)})
-                except ServerClosedError as e:
+                except (ServerClosedError, CircuitOpenError) as e:
+                    # both are "this backend cannot take work right
+                    # now, retry later" — 503 for the load balancer
                     self._send(503, {"error": str(e)})
                 except ServingError as e:
                     # remaining typed serving errors (e.g. generate
@@ -304,6 +315,19 @@ class ModelServer:
             timeout=self._timeout_s(body))
         return {"ids": np.asarray(ids).tolist(),
                 "model_version": version}
+
+    def _circuit_states(self) -> Dict[str, str]:
+        """Backend name -> breaker state, for every backend whose
+        circuit is NOT closed (the /healthz payload)."""
+        with self._lock:
+            backends = (list(self._schedulers.values())
+                        + list(self._batchers.values()))
+        out = {}
+        for b in backends:
+            state = b.breaker.state
+            if state != "closed":
+                out[b.name] = state
+        return out
 
     # ---- lifecycle ----
     def evict_model(self, name: str, version: Optional[int] = None,

@@ -1,5 +1,5 @@
 """Shared backend lifecycle: bounded admission, waitable requests,
-graceful drain.
+graceful drain, crash containment.
 
 BatchScheduler (one-shot predict) and ContinuousBatcher (generate)
 differ only in their serving loops; the request plumbing around those
@@ -8,26 +8,180 @@ shutdown race guard, waiter completion, the leftover sweep that keeps
 shutdown from stranding blocked callers, drain/shutdown ordering, and
 gauge registration/cleanup — is identical and lives here so a fix to
 one backend cannot silently miss the other.
+
+Crash containment (the chaos PR): a worker loop that dies is
+RESTARTED (its in-flight work fails with the crash error; queued work
+survives for the restarted loop), every crash counts as
+``serving_worker_crashes_total`` and feeds the per-backend
+:class:`CircuitBreaker`. The breaker is the layered defence above the
+restart: after ``failure_threshold`` crashes inside ``window_s`` it
+OPENS and admission sheds instantly with a typed
+:class:`~deeplearning4j_tpu.serving.errors.CircuitOpenError` (no more
+work queued into a crash-looping worker); after ``cooldown_s`` it
+goes HALF-OPEN and lets ``half_open_max`` probe requests through — a
+probe success closes the circuit, a further crash re-opens it. State
+is surfaced as the ``circuit_state`` gauge (0=closed, 1=half-open,
+2=open) and on ``ModelServer /healthz``.
 """
 
 from __future__ import annotations
 
+import collections
+import logging
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
-from deeplearning4j_tpu.serving.errors import (QueueFullError,
+from deeplearning4j_tpu.serving.errors import (CircuitOpenError,
+                                               QueueFullError,
                                                ServerClosedError)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
-__all__ = ["BaseRequest", "ServingBackend"]
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["BaseRequest", "ServingBackend", "CircuitBreaker"]
+
+
+class CircuitBreaker:
+    """Three-state (closed / open / half-open) breaker over a sliding
+    failure window.
+
+    Failures are recorded by the owner (here: worker-loop crashes),
+    successes by completed requests. Thread-safe; ``clock`` is
+    injectable for tests.
+    """
+
+    CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+    _CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(self, failure_threshold: int = 5,
+                 window_s: float = 30.0, cooldown_s: float = 10.0,
+                 half_open_max: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, failure_threshold)
+        self.window_s = window_s
+        self.cooldown_s = cooldown_s
+        self.half_open_max = max(1, half_open_max)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures: collections.deque = collections.deque()
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._probes = 0
+        self._last_probe_at = 0.0
+        self.opened_total = 0
+        # optional hook(old_state, new_state) for metrics/recording;
+        # called with the lock held, must not re-enter the breaker
+        self.on_transition: Optional[Callable[[str, str], None]] = None
+
+    # ---- internals (lock held) ----
+    def _transition(self, new: str) -> None:
+        old = self._state
+        if new == old:
+            return
+        self._state = new
+        if new == self.OPEN:
+            self.opened_total += 1
+            self._opened_at = self._clock()
+        if new == self.HALF_OPEN:
+            self._probes = 0
+        hook = self.on_transition
+        if hook is not None:
+            try:
+                hook(old, new)
+            except Exception:
+                logger.exception("circuit transition hook failed")
+
+    def _tick(self) -> None:
+        now = self._clock()
+        if (self._state == self.OPEN
+                and now - self._opened_at >= self.cooldown_s):
+            self._transition(self.HALF_OPEN)
+        elif (self._state == self.HALF_OPEN
+              and self._probes >= self.half_open_max
+              and now - self._last_probe_at >= self.cooldown_s):
+            # a probe that died without touching the breaker (shed at
+            # the queue, expired on its deadline) must not wedge the
+            # circuit half-open forever: replenish the probe budget
+            # one cooldown after the last grant
+            self._probes = 0
+
+    # ---- the API ----
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._tick()
+            return self._state
+
+    def state_code(self) -> int:
+        """0=closed, 1=half-open, 2=open (the ``circuit_state``
+        gauge)."""
+        return self._CODES[self.state]
+
+    def try_admit(self) -> str:
+        """Atomic admission decision: ``"normal"`` (closed),
+        ``"probe"`` (half-open, probe budget granted), or ``""``
+        (denied). Half-open admits at most ``half_open_max`` probes
+        per cooldown."""
+        with self._lock:
+            self._tick()
+            if self._state == self.CLOSED:
+                return "normal"
+            if self._state == self.OPEN:
+                return ""
+            if self._probes < self.half_open_max:
+                self._probes += 1
+                self._last_probe_at = self._clock()
+                return "probe"
+            return ""
+
+    def allow(self) -> bool:
+        """May one more request be admitted right now?"""
+        return bool(self.try_admit())
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._tick()
+            now = self._clock()
+            if self._state == self.HALF_OPEN:
+                # the probe found the backend still broken
+                self._transition(self.OPEN)
+                return
+            if self._state == self.OPEN:
+                self._opened_at = now     # re-arm the cooldown
+                return
+            self._failures.append(now)
+            while (self._failures
+                   and now - self._failures[0] > self.window_s):
+                self._failures.popleft()
+            if len(self._failures) >= self.failure_threshold:
+                self._failures.clear()
+                self._transition(self.OPEN)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._tick()
+            # only a success while a granted probe is outstanding may
+            # close the circuit: a STALE success (a request served
+            # before the crashes, whose caller only now called
+            # wait()) must not re-admit traffic into a worker no
+            # probe has touched
+            if self._state == self.HALF_OPEN and self._probes > 0:
+                self._transition(self.CLOSED)
+                self._failures.clear()
+
+    def force_open(self) -> None:
+        """Operator override (and test hook): open now."""
+        with self._lock:
+            self._transition(self.OPEN)
 
 
 class BaseRequest:
     """A waitable unit of admitted work."""
 
-    __slots__ = ("event", "result", "error", "deadline", "t_submit")
+    __slots__ = ("event", "result", "error", "deadline", "t_submit",
+                 "probe")
 
     def __init__(self, deadline: Optional[float]):
         self.event = threading.Event()
@@ -35,23 +189,36 @@ class BaseRequest:
         self.error: Optional[BaseException] = None
         self.deadline = deadline
         self.t_submit = time.monotonic()
+        # True when this request was admitted as a half-open circuit
+        # probe: ONLY its success may close the circuit (a stale
+        # pre-crash success must not vouch for a worker it never
+        # touched)
+        self.probe = False
 
 
 class ServingBackend:
     """Queue + worker-thread lifecycle shared by the serving
-    backends. Subclasses implement ``_loop`` (which must call
-    ``_sweep_leftovers`` on exit) and call ``_start_worker`` once
-    constructed."""
+    backends. Subclasses implement ``_loop`` and call
+    ``_start_worker`` once constructed. The worker is crash-proof:
+    a dying ``_loop`` is counted, fed to the circuit breaker, and
+    restarted until shutdown."""
 
     def __init__(self, kind: str, name: str, queue_limit: int,
                  occupancy_max: int,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.name = name
         self.metrics = metrics or ServingMetrics()
         self._endpoint = self.metrics.endpoint(name)
         self._occupancy = self.metrics.occupancy(name, occupancy_max)
         self.metrics.register_gauge(f"{name}_queue_depth",
                                     self.queue_depth)
+        self.breaker = breaker or CircuitBreaker()
+        self.metrics.registry.gauge(
+            "circuit_state",
+            help="per-backend circuit breaker state "
+                 "(0=closed, 1=half-open, 2=open)",
+            labels={"endpoint": name}, fn=self.breaker.state_code)
         self._queue: "queue.Queue[BaseRequest]" = queue.Queue(queue_limit)
         self._draining = threading.Event()
         self._drained = threading.Event()
@@ -64,48 +231,99 @@ class ServingBackend:
         self._worker.start()
 
     def _run(self) -> None:
-        # the worker must NEVER die without releasing waiters: a loop
-        # crash (bad request data, device fault outside the guarded
-        # step) would otherwise strand every blocked event.wait()
-        # caller forever
+        # the worker must NEVER die without releasing waiters, and —
+        # since the chaos PR — must not stay dead: a loop crash (bad
+        # request data, device fault outside the guarded step, an
+        # injected chaos crash) fails the in-flight work with the
+        # crash error, counts toward the circuit breaker, and the
+        # loop RESTARTS for the work still queued. Admission-side
+        # shedding is the breaker's job, not the worker's.
+        crashes = 0
         try:
-            self._loop()
-        except BaseException as e:
-            # a dying worker is an incident, not a log line: count it
-            # on the registry and leave a flight-recorder bundle when
-            # one is installed, then let the sweep release waiters
-            try:
-                self.metrics.registry.counter(
-                    "serving_worker_crashes_total",
-                    help="serving backend worker loops that died",
-                    labels={"endpoint": self.name}).inc()
-            except Exception:
-                pass
-            try:
-                from deeplearning4j_tpu.observability import (
-                    flight_recorder)
-                flight_recorder.on_backend_crash(self.name, e)
-            except Exception:
-                pass
-            raise
+            while True:
+                try:
+                    self._loop()
+                    break                      # clean stop
+                except BaseException as e:
+                    self._on_worker_crash(e)
+                    if self._stop.is_set():
+                        break
+                    # bounded backoff between restarts: a persistent
+                    # pre-dequeue failure must not become a hot spin
+                    # of crash/restart/metric/bundle at 100% CPU
+                    delay = min(2.0, 0.05 * (2.0 ** min(crashes, 6)))
+                    crashes += 1
+                    # exc_info: without a flight recorder this log
+                    # line is the ONLY artifact of a real crash — it
+                    # must carry the traceback the pre-restart
+                    # re-raise used to surface via the excepthook
+                    logger.warning(
+                        "%r worker restarting after crash (%.2fs "
+                        "backoff): %r", self.name, delay, e,
+                        exc_info=e)
+                    if self._stop.wait(delay):
+                        break
         finally:
             self._stop.set()
             self._sweep_leftovers(self._abort_inflight())
+
+    def _on_worker_crash(self, exc: BaseException) -> None:
+        # a dying worker is an incident, not a log line: count it,
+        # trip the breaker toward open, leave a flight-recorder
+        # bundle when one is installed, and fail the work the crashed
+        # loop held in flight (queued work survives for the restart)
+        from deeplearning4j_tpu.observability.registry import safe_inc
+        safe_inc("serving_worker_crashes_total",
+                 help="serving backend worker loops that died",
+                 labels={"endpoint": self.name},
+                 registry=self.metrics.registry)
+        try:
+            self.breaker.record_failure()
+        except Exception:
+            pass
+        try:
+            from deeplearning4j_tpu.observability import (
+                flight_recorder)
+            flight_recorder.on_backend_crash(self.name, exc)
+        except Exception:
+            pass
+        for r in self._crash_casualties():
+            if not r.event.is_set():
+                r.error = exc
+                r.event.set()
 
     def _loop(self) -> None:
         raise NotImplementedError
 
     def _abort_inflight(self) -> List["BaseRequest"]:
-        """Uncompleted requests the subclass holds outside the queue
-        (open buckets, occupied slots); called once at worker exit."""
+        """Every uncompleted request the subclass holds outside the
+        queue (open buckets, occupied slots, pending lists); called
+        once at worker exit."""
         return []
 
+    def _crash_casualties(self) -> List["BaseRequest"]:
+        """Requests that die WITH a worker crash: only work actually
+        in flight on the device. Admitted-but-unstarted work must
+        survive for the restarted loop (the crash-containment
+        contract). Defaults to everything the subclass holds."""
+        return self._abort_inflight()
+
     # ---- admission ----
-    def _admit_guard(self) -> None:
+    def _admit_guard(self) -> bool:
+        """Raises when admission is refused; returns True when this
+        admission is a half-open circuit probe (the subclass stamps
+        it on the request)."""
         if self._draining.is_set() or self._stop.is_set():
             raise ServerClosedError(
                 f"{self.name!r} is draining; not admitting new "
                 "requests")
+        kind = self.breaker.try_admit()
+        if not kind:
+            raise CircuitOpenError(
+                f"{self.name!r} circuit is {self.breaker.state} "
+                f"after repeated worker crashes; request shed — "
+                f"retry after the cooldown")
+        return kind == "probe"
 
     def _enqueue(self, r: BaseRequest) -> BaseRequest:
         """Fail-fast put: shed at the limit, and guard the race where
@@ -130,6 +348,11 @@ class ServingBackend:
         r.event.wait()
         if r.error is not None:
             raise r.error
+        # ONLY a completed probe is the breaker's success signal: a
+        # stale success (served before the crash burst, wait()ed
+        # late) must not close a circuit no probe has verified
+        if r.probe:
+            self.breaker.record_success()
         self._endpoint.observe(time.monotonic() - r.t_submit)
         return r.result
 
@@ -156,8 +379,14 @@ class ServingBackend:
             except queue.Empty:
                 break
         for r in leftovers:
-            r.error = err
-            r.event.set()
+            if not r.event.is_set():
+                r.error = err
+                r.event.set()
+
+    def _unregister_gauges(self) -> None:
+        self.metrics.unregister_gauge(f"{self.name}_queue_depth")
+        self.metrics.registry.unregister(
+            "circuit_state", labels={"endpoint": self.name})
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Stop admitting; let queued and in-flight work complete,
@@ -166,7 +395,7 @@ class ServingBackend:
         ok = self._drained.wait(timeout)
         self._stop.set()
         self._worker.join(timeout=5.0)
-        self.metrics.unregister_gauge(f"{self.name}_queue_depth")
+        self._unregister_gauges()
         return ok
 
     def shutdown(self, drain: bool = True,
@@ -176,5 +405,5 @@ class ServingBackend:
         self._draining.set()
         self._stop.set()
         self._worker.join(timeout=5.0)
-        self.metrics.unregister_gauge(f"{self.name}_queue_depth")
+        self._unregister_gauges()
         return True

@@ -23,10 +23,12 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import chaos
 from deeplearning4j_tpu.parallel.inference import (
     pow2_pad_rows, serve_batch_with_retry)
 from deeplearning4j_tpu.serving.errors import DeadlineExceededError
 from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
+                                                  CircuitBreaker,
                                                   ServingBackend)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
@@ -61,9 +63,10 @@ class BatchScheduler(ServingBackend):
     def __init__(self, model, max_batch_size: int = 32,
                  queue_limit: int = 256, wait_ms: float = 2.0,
                  metrics: Optional[ServingMetrics] = None,
-                 name: str = "predict"):
+                 name: str = "predict",
+                 breaker: Optional[CircuitBreaker] = None):
         super().__init__("batch", name, queue_limit, max_batch_size,
-                         metrics)
+                         metrics, breaker=breaker)
         self.model = model
         self.max_batch_size = max_batch_size
         self.wait_ms = wait_ms
@@ -75,13 +78,15 @@ class BatchScheduler(ServingBackend):
         """Enqueue one request of shape (n, ...features). Fail-fast
         admission: raises QueueFullError at the queue limit and
         ServerClosedError once draining."""
-        self._admit_guard()
+        probe = self._admit_guard()
         x = np.asarray(x)
         if x.ndim == 0:
             raise ValueError("request must have a leading batch axis")
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
-        return self._enqueue(_Request(x, deadline))
+        r = _Request(x, deadline)
+        r.probe = probe
+        return self._enqueue(r)
 
     def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
         return self.wait(self.submit(x, timeout=timeout))
@@ -142,6 +147,12 @@ class BatchScheduler(ServingBackend):
                     and self._queue.empty()):
                 self._drained.set()
 
+    def _crash_casualties(self) -> List[_Request]:
+        # the batch actually being served when the worker crashed is
+        # failed directly in _serve; open buckets were never started
+        # — the restarted loop cuts and serves them
+        return []
+
     def _abort_inflight(self) -> List[_Request]:
         leftovers: List[_Request] = []
         for b in self._buckets.values():
@@ -167,10 +178,26 @@ class BatchScheduler(ServingBackend):
                 live.append(r)
         if not live:
             return
+        # chaos site: crash kills the worker loop (taking this
+        # batch's waiters down with it — a real crash would), hang
+        # stalls it, poison corrupts the delivered results
+        try:
+            fault = chaos.step_fault("serving.worker.step")
+        except BaseException as e:
+            for r in live:
+                self._endpoint.count_error()
+                r.error = e
+                r.event.set()
+            raise
+        out_fn = self.model.output
+        if fault is not None and fault.kind == "poison":
+            out_fn = (lambda x:
+                      np.full_like(np.asarray(self.model.output(x)),
+                                   np.nan))
         rows = sum(r.x.shape[0] for r in live)
         self._occupancy.record(rows)
         # coalesced call + poison-request recovery: ONE shared
         # implementation with ParallelInference (the policy's home —
         # a fix there cannot silently miss this backend)
-        serve_batch_with_retry(self.model.output, live,
+        serve_batch_with_retry(out_fn, live,
                                count_error=self._endpoint.count_error)

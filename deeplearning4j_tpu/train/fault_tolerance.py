@@ -35,13 +35,27 @@ packages that loop:
   checkpoint carries a rolling fingerprint chain over every batch
   consumed this epoch, and a resumed run recomputes the chain over
   the replayed batches — any reorder, substitution, or shortfall in
-  ANY replayed ordinal fails loudly instead of silently diverging.
+  ANY replayed ordinal fails loudly instead of silently diverging;
+- checkpoint DURABILITY (the chaos PR): every restore first passes
+  :func:`~deeplearning4j_tpu.util.model_serializer.verify_checkpoint`
+  (zip CRCs + the CRC32 manifest written into every zip); a
+  truncated/corrupted generation is QUARANTINED (renamed
+  ``*.corrupt``, counted as ``checkpoint_quarantined_total``) and the
+  trainer falls back generation by generation to the newest intact
+  checkpoint instead of dying on ``BadZipFile``. A failed checkpoint
+  WRITE (ENOSPC, quota) is a missed checkpoint, not a dead run: the
+  partial tmp is removed, ``checkpoint_write_failures_total`` counts
+  it, and training continues on the previous generation. Stale
+  ``*.tmp<pid>`` files leaked by a crash mid-write are swept on
+  trainer start. The ``train.step`` chaos site fires right before
+  each step (crash / hang / nan-poison drills).
 
 Works with both executors via the zip serializer.
 """
 
 from __future__ import annotations
 
+import copy
 import hashlib
 import json
 import logging
@@ -55,11 +69,14 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import chaos
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 __all__ = ["ElasticTrainer"]
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.zip$")
+_TMP_RE = re.compile(r"ckpt_\d+\.zip\.tmp(\d+)$")
 _POS_ENTRY = "data_position.json"
 
 
@@ -148,6 +165,7 @@ class ElasticTrainer:
         self._fp_chain = ""      # rolling digest of every batch
         #                          consumed this epoch (determinism
         #                          check on replay)
+        self._sweep_stale_tmp()
         self._resume()
 
     # -- checkpoint plumbing ----------------------------------------------
@@ -163,23 +181,68 @@ class ElasticTrainer:
         cks = self._ckpts()
         return cks[-1][1] if cks else None
 
+    def _sweep_stale_tmp(self) -> None:
+        """A crash mid-``write_model`` leaks ``ckpt_N.zip.tmp<pid>``
+        forever (the pid suffix means a restarted process never
+        collides with, and so never cleans, the old name); sweep them
+        on start — but only when the owning pid is dead, so a second
+        trainer pointed at a shared directory can never delete a
+        write another live process is mid-way through."""
+        for f in os.listdir(self.dir):
+            m = _TMP_RE.match(f)
+            if not m:
+                continue
+            pid = int(m.group(1))
+            if pid != os.getpid():
+                try:
+                    os.kill(pid, 0)      # probe: is the owner alive?
+                    continue             # yes — not ours to sweep
+                except ProcessLookupError:
+                    pass                 # dead owner: stale for sure
+                except OSError:
+                    continue             # EPERM etc.: assume alive
+            path = os.path.join(self.dir, f)
+            try:
+                os.remove(path)
+                logger.info("swept stale checkpoint tmp %s", path)
+            except OSError:
+                pass
+
     def save_checkpoint(self):
         from deeplearning4j_tpu.util.model_serializer import write_model
         it = self.model.iteration_count
         final = os.path.join(self.dir, f"ckpt_{it}.zip")
         tmp = final + f".tmp{os.getpid()}"
-        write_model(self.model, tmp)
         # the data position rides in the same zip: one atomic artifact,
-        # no model/position skew after a mid-write preemption
-        with zipfile.ZipFile(tmp, "a") as z:
-            z.writestr(_POS_ENTRY, json.dumps(
-                {"epoch": self._epoch, "batch": self._batch,
-                 # the poison-skip set rides in the checkpoint: a
-                 # restart after a rollback must not pay a second
-                 # rollback to rediscover a deterministic poison batch
-                 "skip": sorted(list(p) for p in self._skip),
-                 "fp_chain": self._fp_chain}))
-        os.replace(tmp, final)          # atomic on POSIX
+        # no model/position skew after a mid-write preemption; passing
+        # it through write_model (not appending after) puts it under
+        # the integrity manifest's CRC too
+        pos = json.dumps(
+            {"epoch": self._epoch, "batch": self._batch,
+             # the poison-skip set rides in the checkpoint: a
+             # restart after a rollback must not pay a second
+             # rollback to rediscover a deterministic poison batch
+             "skip": sorted(list(p) for p in self._skip),
+             "fp_chain": self._fp_chain})
+        try:
+            write_model(self.model, tmp,
+                        extra_entries={_POS_ENTRY: pos})
+            os.replace(tmp, final)      # atomic on POSIX
+        except OSError as e:
+            # ENOSPC / quota / dying disk mid-write: a missed
+            # checkpoint must not kill the run — clean the partial
+            # tmp, count it, and keep training on the previous
+            # generation
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            self._count("checkpoint_write_failures_total",
+                        "checkpoint writes that failed (ENOSPC, ...)")
+            logger.warning("checkpoint write at iteration %d failed "
+                           "(%r); continuing on the previous "
+                           "generation", it, e)
+            return None
         # mark live trainer checkpoints protected so a co-attached
         # CheckpointListener's keep_last pruning can never delete the
         # file a rollback is about to restore
@@ -195,8 +258,15 @@ class ElasticTrainer:
                     "-> %s", it, self._epoch, self._batch, final)
         return final
 
+    @staticmethod
+    def _count(name: str, help: str) -> None:
+        from deeplearning4j_tpu.observability.registry import safe_inc
+        safe_inc(name, help=help)
+
     def _restore_into_model(self, path: str):
-        from deeplearning4j_tpu.util.model_serializer import restore_model
+        from deeplearning4j_tpu.util.model_serializer import (
+            restore_model, verify_checkpoint)
+        verify_checkpoint(path)    # CRC gate BEFORE trusting the zip
         loaded = restore_model(path)
         m = self.model
         m.params = loaded.params
@@ -218,15 +288,68 @@ class ElasticTrainer:
             # pre-position checkpoint (older format): restart the epoch
             self._epoch, self._batch = 0, 0
 
+    def _quarantine(self, path: str, err: BaseException) -> None:
+        """Rename a checkpoint that failed verification/restore to
+        ``*.corrupt`` — out of the generation sequence (so fallback
+        terminates) but kept on disk as evidence."""
+        from deeplearning4j_tpu.train import listeners as _listeners
+        q = path + ".corrupt"
+        logger.warning("checkpoint %s failed integrity/restore (%r): "
+                       "quarantining as %s and falling back to the "
+                       "previous generation", path, err, q)
+        try:
+            os.replace(path, q)
+        except FileNotFoundError:
+            return              # already gone — nothing to quarantine
+        except OSError:
+            # last resort: a file we can neither rename nor remove
+            # would make the fallback loop spin forever
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                return
+        _listeners.unprotect_checkpoint(path)
+        self._count("checkpoint_quarantined_total",
+                    "corrupt/truncated checkpoints quarantined on "
+                    "restore")
+
+    def _restore_latest_intact(self) -> Optional[str]:
+        """Restore the newest checkpoint that passes verification,
+        quarantining corrupt generations on the way down; None when
+        no intact generation remains."""
+        from deeplearning4j_tpu.chaos.retry import DEFAULT_IO_RETRY
+        from deeplearning4j_tpu.util.model_serializer import (
+            CheckpointIntegrityError)
+        while True:
+            path = self.latest_checkpoint()
+            if path is None:
+                return None
+            try:
+                # transient read errors (NFS blip, injected IOError)
+                # get the shared retry policy FIRST — a healthy file
+                # must not be quarantined for a flaky read
+                DEFAULT_IO_RETRY.call(self._restore_into_model, path)
+                return path
+            except (CheckpointIntegrityError, zipfile.BadZipFile,
+                    OSError, KeyError, ValueError) as e:
+                # BadZipFile/OSError/ValueError: rot the CRC gate
+                # could not see (or chaos injected mid-read);
+                # KeyError: arrays missing vs this model's config
+                self._quarantine(path, e)
+
     def _resume(self):
-        path = self.latest_checkpoint()
-        if path is not None:
-            if self.model.params is None:
-                self.model.init()
-            self._restore_into_model(path)
-            logger.info("resumed from %s (iteration %d, epoch %d, "
-                        "batch %d)", path, self.model.iteration_count,
-                        self._epoch, self._batch)
+        if not self._ckpts():
+            return
+        if self.model.params is None:
+            self.model.init()
+        path = self._restore_latest_intact()
+        if path is None:
+            logger.warning("no intact checkpoint in %s; starting "
+                           "fresh", self.dir)
+            return
+        logger.info("resumed from %s (iteration %d, epoch %d, "
+                    "batch %d)", path, self.model.iteration_count,
+                    self._epoch, self._batch)
 
     # -- the loop -----------------------------------------------------------
     def fit(self, iterator, *, epochs: int = 1,
@@ -288,6 +411,11 @@ class ElasticTrainer:
                     if (self._epoch, self._batch) in self._skip:
                         self._batch += 1     # the poisoned batch
                         continue
+                    # chaos site: crash raises (a simulated
+                    # preemption — resume must reproduce the
+                    # uninterrupted run), hang sleeps, nan poisons
+                    # this one batch (exercising the rollback path)
+                    ds = self._chaos_step(ds)
                     try:
                         if self.wrapper is not None:
                             self.wrapper.fit([ds])
@@ -334,6 +462,27 @@ class ElasticTrainer:
                 signal.signal(signal.SIGTERM, prev_handler)
         return self
 
+    @staticmethod
+    def _chaos_step(ds):
+        f = chaos.step_fault("train.step")
+        if f is not None and f.kind == "nan":
+            # poison one element of this batch's features (the
+            # nan_injection drill, plan-driven): copy-on-write so the
+            # source iterator's batch — which the rollback replay
+            # will re-fetch — stays clean
+            feats = ds.features
+            arr = feats[0] if isinstance(feats, (list, tuple)) \
+                else feats
+            arr = np.array(arr)
+            arr.flat[0] = np.nan
+            ds = copy.copy(ds)
+            if isinstance(feats, (list, tuple)):
+                ds.features = type(feats)(
+                    [arr] + list(feats[1:]))
+            else:
+                ds.features = arr
+        return ds
+
     def _rollback(self):
         self.rollbacks += 1
         self.total_rollbacks += 1
@@ -343,18 +492,20 @@ class ElasticTrainer:
                 f"non-finite loss persisted through "
                 f"{self.max_rollbacks} rollbacks — aborting (bad data "
                 f"or divergent learning rate)")
-        path = self.latest_checkpoint()
-        if path is None:
-            raise RuntimeError("non-finite loss and no checkpoint to "
-                               "roll back to")
         logger.warning("non-finite loss at iteration %d: rolling back "
-                       "to %s (rollback %d/%d)",
-                       self.model.iteration_count, path, self.rollbacks,
+                       "(rollback %d/%d)",
+                       self.model.iteration_count, self.rollbacks,
                        self.max_rollbacks)
         # the batch just consumed (ordinal _batch - 1) produced the
         # non-finite loss: skip it on replay, replay everything else
         self._skip.add((self._epoch, self._batch - 1))
-        self._restore_into_model(path)
+        # generation-by-generation fallback: a corrupt newest
+        # checkpoint must cost one quarantine, not the run
+        path = self._restore_latest_intact()
+        if path is None:
+            raise RuntimeError("non-finite loss and no intact "
+                               "checkpoint to roll back to")
+        logger.warning("rolled back to %s", path)
         if self.lr_drop_on_rollback:
             self._drop_lr(self.lr_drop_on_rollback)
         # immediately persist the restored state WITH the new skip

@@ -12,6 +12,17 @@ normalizer config). Restore: :func:`restore_model` (reference :137-161).
 Backward compat is a contract: ``format_version`` gates migrations and
 regression tests pin zips produced by earlier builds (reference
 regressiontest/RegressionTest050.java discipline).
+
+Durability: every zip written since the chaos PR carries a
+``manifest.json`` entry mapping each member to its CRC32, and
+:func:`verify_checkpoint` re-checks both the zip's own per-entry CRCs
+and the manifest before a restore trusts the file — a truncated or
+bit-rotted checkpoint raises :class:`CheckpointIntegrityError`
+instead of surfacing as a ``BadZipFile`` (or worse, silently wrong
+weights) deep inside the restore. Pre-manifest zips still verify via
+the zip CRCs alone, so the v1 regression fixtures keep loading. The
+``checkpoint.write`` / ``checkpoint.read`` chaos sites live here, so
+every writer and reader in the repo is injectable.
 """
 
 from __future__ import annotations
@@ -19,17 +30,27 @@ from __future__ import annotations
 import io
 import json
 import zipfile
+import zlib
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import chaos
+
 __all__ = ["write_model", "restore_model", "restore_normalizer",
-           "save_pytree_npz",
-           "load_pytree_npz"]
+           "save_pytree_npz", "load_pytree_npz",
+           "verify_checkpoint", "CheckpointIntegrityError"]
 
 _FORMAT = 1
+_MANIFEST = "manifest.json"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """The checkpoint file failed CRC/structure verification
+    (truncated write, bit rot, interrupted copy). Callers with older
+    generations available should quarantine the file and fall back."""
 
 
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
@@ -71,8 +92,14 @@ def load_pytree_npz(data: bytes, template) -> Any:
 
 
 def write_model(model, path: str, *, save_updater: bool = True,
-                normalizer: Optional[dict] = None) -> None:
-    """model: MultiLayerNetwork or ComputationGraph."""
+                normalizer: Optional[dict] = None,
+                extra_entries: Optional[Dict[str, Any]] = None) -> None:
+    """model: MultiLayerNetwork or ComputationGraph.
+
+    ``extra_entries`` (name -> str/bytes) ride inside the same zip —
+    and inside the integrity manifest — so sidecar payloads like
+    ElasticTrainer's data position are covered by the same CRC check
+    as the weights (appending after the fact would not be)."""
     meta = {
         "format_version": _FORMAT,
         "network_type": type(model).__name__,
@@ -80,14 +107,86 @@ def write_model(model, path: str, *, save_updater: bool = True,
         "epoch_count": int(model.epoch_count),
         "normalizer": normalizer,
     }
+    entries: Dict[str, bytes] = {
+        "configuration.json": model.conf.to_json().encode(),
+        "coefficients.npz": save_pytree_npz(model.params),
+        "state.npz": save_pytree_npz(model.state),
+    }
+    if save_updater and model.opt_state is not None:
+        entries["updater_state.npz"] = save_pytree_npz(model.opt_state)
+    entries["metadata.json"] = json.dumps(meta).encode()
+    for name, data in (extra_entries or {}).items():
+        entries[name] = data if isinstance(data, bytes) \
+            else str(data).encode()
+    manifest = {"format_version": _FORMAT,
+                "crc32": {n: zlib.crc32(d) & 0xFFFFFFFF
+                          for n, d in entries.items()}}
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("configuration.json", model.conf.to_json())
-        z.writestr("coefficients.npz", save_pytree_npz(model.params))
-        z.writestr("state.npz", save_pytree_npz(model.state))
-        if save_updater and model.opt_state is not None:
-            z.writestr("updater_state.npz",
-                       save_pytree_npz(model.opt_state))
-        z.writestr("metadata.json", json.dumps(meta))
+        for name, data in entries.items():
+            z.writestr(name, data)
+        z.writestr(_MANIFEST, json.dumps(manifest))
+    # chaos site: a preemption/ENOSPC/bit-rot drill against the file
+    # just written — restore-side verification must catch whatever
+    # this does
+    chaos.file_fault("checkpoint.write", path)
+
+
+def verify_checkpoint(path: str) -> dict:
+    """Integrity-check a checkpoint zip WITHOUT building a model.
+
+    Manifest-bearing zips get every manifested entry re-read and its
+    CRC32 recomputed (``ZipFile.read`` verifies the zip's own CRC on
+    the way, so one pass covers both checks); pre-manifest zips fall
+    back to ``testzip``. Corruption/truncation raises
+    :class:`CheckpointIntegrityError`; a transient I/O failure
+    (missing file, NFS blip) propagates as the original ``OSError``
+    so callers can retry a healthy file instead of quarantining it.
+    Returns the manifest (empty dict for pre-manifest zips)."""
+    try:
+        with zipfile.ZipFile(path, "r") as z:
+            names = set(z.namelist())
+            for required in ("metadata.json", "configuration.json",
+                             "coefficients.npz"):
+                if required not in names:
+                    raise CheckpointIntegrityError(
+                        f"{path}: required entry {required!r} is "
+                        "missing (interrupted write?)")
+            if _MANIFEST not in names:
+                # pre-manifest format: the zip CRCs are all we have
+                bad = z.testzip()
+                if bad is not None:
+                    raise CheckpointIntegrityError(
+                        f"{path}: entry {bad!r} fails its zip CRC "
+                        "(truncated or corrupted checkpoint)")
+                return {}
+            manifest = json.loads(z.read(_MANIFEST))
+            for name, crc in manifest.get("crc32", {}).items():
+                if name not in names:
+                    raise CheckpointIntegrityError(
+                        f"{path}: entry {name!r} is in the manifest "
+                        "but missing from the zip")
+                # stream the CRC: a multi-GB coefficients.npz must
+                # not be buffered whole just to checksum it (and
+                # ZipFile verifies its own entry CRC on this read,
+                # so one pass covers both checks)
+                actual = 0
+                with z.open(name) as fh:
+                    while True:
+                        chunk = fh.read(1 << 20)
+                        if not chunk:
+                            break
+                        actual = zlib.crc32(chunk, actual)
+                actual &= 0xFFFFFFFF
+                if actual != int(crc):
+                    raise CheckpointIntegrityError(
+                        f"{path}: entry {name!r} CRC mismatch "
+                        f"(manifest {int(crc):#010x}, actual "
+                        f"{actual:#010x})")
+            return manifest
+    except (zipfile.BadZipFile, zlib.error, EOFError,
+            json.JSONDecodeError) as e:
+        raise CheckpointIntegrityError(
+            f"{path} is not a readable checkpoint zip: {e!r}") from e
 
 
 def restore_model(path: str, *, load_updater: bool = True):
@@ -99,6 +198,9 @@ def restore_model(path: str, *, load_updater: bool = True):
     from deeplearning4j_tpu.nn.conf.multi_layer import (
         MultiLayerConfiguration)
 
+    # chaos site: at-rest rot / transient read failure discovered at
+    # restore time (truncate/corrupt mutate the file before reading)
+    chaos.file_fault("checkpoint.read", path)
     with zipfile.ZipFile(path, "r") as z:
         meta = json.loads(z.read("metadata.json"))
         conf_json = z.read("configuration.json").decode()

@@ -1,0 +1,574 @@
+"""Chaos harness: deterministic fault injection, checkpoint
+durability, retry policy, circuit breaker — and the soak acceptance:
+an ElasticTrainer run under mixed faults (checkpoint corruption +
+fetcher IOErrors + a simulated crash mid-run) converges to params
+BIT-IDENTICAL to the fault-free run of the same seed, restoring
+through a quarantined corrupt checkpoint on the way.
+"""
+
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.chaos.retry import RetryPolicy
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.observability.registry import REGISTRY
+from deeplearning4j_tpu.serving.lifecycle import CircuitBreaker
+from deeplearning4j_tpu.train.fault_tolerance import ElasticTrainer
+from deeplearning4j_tpu.util.model_serializer import (
+    CheckpointIntegrityError, restore_model, verify_checkpoint,
+    write_model)
+from fixtures import make_batches, tiny_classifier
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    chaos.uninstall()
+
+
+def _flat_params(net):
+    import jax
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        (net.params, net.state, net.opt_state))]
+
+
+# ---------------------------------------------------------------------------
+# injector
+# ---------------------------------------------------------------------------
+
+class TestFaultInjector:
+    def test_same_seed_same_fire_pattern(self):
+        plan = {"faults": [{"site": "data.fetch", "kind": "error",
+                            "p": 0.3}]}
+        a = chaos.FaultInjector(plan, seed=7)
+        b = chaos.FaultInjector(plan, seed=7)
+        pa = [a.hit("data.fetch") is not None for _ in range(200)]
+        pb = [b.hit("data.fetch") is not None for _ in range(200)]
+        assert pa == pb
+        assert 20 < sum(pa) < 120          # p=0.3 actually fires
+
+    def test_sites_have_independent_streams(self):
+        """Interleaving hits at another site must not perturb a
+        site's own fire pattern (the determinism contract)."""
+        plan = {"faults": [
+            {"site": "data.fetch", "kind": "error", "p": 0.3},
+            {"site": "train.step", "kind": "hang", "p": 0.5,
+             "args": {"delay_s": 0.0}}]}
+        a = chaos.FaultInjector(plan, seed=3)
+        pa = [a.hit("data.fetch") is not None for _ in range(100)]
+        b = chaos.FaultInjector(plan, seed=3)
+        pb = []
+        for _ in range(100):
+            b.hit("train.step")            # interleaved other-site hits
+            pb.append(b.hit("data.fetch") is not None)
+        assert pa == pb
+
+    def test_at_schedule_and_max_fires(self):
+        plan = {"faults": [
+            {"site": "train.step", "kind": "crash", "at": [3, 5]},
+            {"site": "data.fetch", "kind": "error", "p": 1.0,
+             "max_fires": 2}]}
+        inj = chaos.FaultInjector(plan, seed=0)
+        fired = [inj.hit("train.step") is not None for _ in range(6)]
+        assert fired == [False, False, True, False, True, False]
+        fetch = [inj.hit("data.fetch") is not None for _ in range(5)]
+        assert fetch == [True, True, False, False, False]
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown chaos site"):
+            chaos.parse_plan(
+                {"faults": [{"site": "nope.nope", "kind": "error",
+                             "p": 1.0}]})
+        with pytest.raises(ValueError, match="never fire"):
+            chaos.parse_plan(
+                {"faults": [{"site": "data.fetch", "kind": "error"}]})
+
+    def test_bad_kind_rejected_at_parse_time(self):
+        """A typo'd or site-incompatible kind must fail the plan, not
+        install cleanly and silently inject nothing while counting
+        as fired."""
+        with pytest.raises(ValueError, match="does not support"):
+            chaos.parse_plan(
+                {"faults": [{"site": "checkpoint.write",
+                             "kind": "corupt", "p": 1.0}]})
+        with pytest.raises(ValueError, match="does not support"):
+            chaos.parse_plan(
+                {"faults": [{"site": "data.fetch",
+                             "kind": "truncate", "p": 1.0}]})
+
+    def test_plan_from_json_string_and_file(self, tmp_path):
+        doc = {"seed": 11, "faults": [
+            {"site": "data.fetch", "kind": "slow", "p": 0.5,
+             "args": {"delay_s": 0.001}}]}
+        p1 = chaos.parse_plan(json.dumps(doc))
+        assert p1.seed == 11 and p1.faults[0].kind == "slow"
+        path = tmp_path / "plan.json"
+        path.write_text(json.dumps(doc))
+        p2 = chaos.parse_plan(str(path))
+        assert p2.to_dict() == p1.to_dict()
+
+    def test_reinstalling_same_plan_object_replays_budgets(self):
+        """max_fires budgets live on the injector, not the caller's
+        plan object: re-installing the SAME FaultPlan must replay
+        identically."""
+        plan = chaos.parse_plan(
+            {"faults": [{"site": "data.fetch", "kind": "error",
+                         "p": 1.0, "max_fires": 2}]})
+        a = chaos.FaultInjector(plan, seed=1)
+        pa = [a.hit("data.fetch") is not None for _ in range(4)]
+        b = chaos.FaultInjector(plan, seed=1)   # same object again
+        pb = [b.hit("data.fetch") is not None for _ in range(4)]
+        assert pa == pb == [True, True, False, False]
+
+    def test_install_records_replayable_seed(self):
+        plan = {"faults": [{"site": "data.fetch", "kind": "error",
+                            "p": 0.4}]}
+        inj = chaos.install(plan)            # no seed anywhere
+        seed = inj.seed                      # recorded
+        pa = [chaos.hit("data.fetch") is not None for _ in range(64)]
+        replay = chaos.install(plan, seed=seed)
+        pb = [chaos.hit("data.fetch") is not None for _ in range(64)]
+        chaos.uninstall()
+        assert replay.seed == seed
+        assert pa == pb
+        assert chaos.hit("data.fetch") is None    # uninstalled: no-op
+
+    def test_fired_faults_counted_on_registry(self):
+        c = REGISTRY.counter(
+            "chaos_faults_fired_total",
+            labels={"site": "data.fetch", "kind": "error"})
+        before = c.value
+        chaos.install({"faults": [{"site": "data.fetch",
+                                   "kind": "error", "p": 1.0}]},
+                      seed=0)
+        for _ in range(3):
+            with pytest.raises(IOError):
+                chaos.step_fault("data.fetch")
+        assert c.value == before + 3
+
+    def test_step_fault_kinds(self):
+        chaos.install({"faults": [
+            {"site": "train.step", "kind": "crash", "at": [1]},
+            {"site": "train.step", "kind": "enospc", "at": [2]},
+            {"site": "train.step", "kind": "hang", "at": [3],
+             "args": {"delay_s": 0.001}}]}, seed=0)
+        with pytest.raises(chaos.SimulatedCrashError):
+            chaos.step_fault("train.step")
+        with pytest.raises(OSError) as ei:
+            chaos.step_fault("train.step")
+        import errno
+        assert ei.value.errno == errno.ENOSPC
+        assert isinstance(ei.value, chaos.ChaosError)  # drill-marked
+        f = chaos.step_fault("train.step")
+        assert f is not None and f.kind == "hang"
+
+
+class TestChaosCLI:
+    def test_train_help_shows_chaos_flags(self, capsys):
+        from deeplearning4j_tpu.cli import main
+        with pytest.raises(SystemExit) as ei:
+            main(["train", "--help"])
+        assert ei.value.code == 0
+        out = capsys.readouterr().out
+        assert "--chaos" in out and "--chaos-seed" in out
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def _flaky(self, failures, exc=IOError):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] <= failures:
+                raise exc(f"flake {calls['n']}")
+            return "ok"
+        return fn, calls
+
+    def test_transient_failures_retried(self):
+        sleeps = []
+        pol = RetryPolicy(max_attempts=5, base_delay=0.01,
+                          sleep=sleeps.append)
+        fn, calls = self._flaky(3)
+        assert pol.call(fn) == "ok"
+        assert calls["n"] == 4
+        assert len(sleeps) == 3
+
+    def test_exhaustion_raises_last_error(self):
+        pol = RetryPolicy(max_attempts=3, base_delay=0.0,
+                          sleep=lambda s: None)
+        fn, calls = self._flaky(99)
+        with pytest.raises(IOError, match="flake 3"):
+            pol.call(fn)
+        assert calls["n"] == 3
+
+    def test_non_retryable_propagates_immediately(self):
+        pol = RetryPolicy(max_attempts=5, sleep=lambda s: None)
+        fn, calls = self._flaky(2, exc=ValueError)
+        with pytest.raises(ValueError):
+            pol.call(fn)
+        assert calls["n"] == 1
+
+    def test_backoff_grows_and_is_capped(self):
+        pol = RetryPolicy(max_attempts=10, base_delay=0.1,
+                          max_delay=0.4, multiplier=2.0,
+                          jitter=False, sleep=lambda s: None)
+        assert [pol.delay(k) for k in range(4)] == \
+            [0.1, 0.2, 0.4, 0.4]
+        # full jitter stays within the deterministic envelope
+        import random
+        pj = RetryPolicy(base_delay=0.1, max_delay=0.4,
+                         rng=random.Random(0))
+        assert all(0.0 <= pj.delay(k) <= 0.4 for k in range(8))
+
+    def test_deadline_budget_never_sleeps_past(self):
+        import time
+        sleeps = []
+        pol = RetryPolicy(max_attempts=10, base_delay=5.0,
+                          jitter=False, sleep=sleeps.append)
+        fn, calls = self._flaky(99)
+        t0 = time.monotonic()
+        with pytest.raises(IOError, match="flake 1"):
+            pol.call(fn, deadline=time.monotonic() + 0.05)
+        # the 5s backoff would overrun the 50ms budget: fail NOW,
+        # with the real error, having slept zero times
+        assert time.monotonic() - t0 < 1.0
+        assert sleeps == [] and calls["n"] == 1
+
+    def test_data_iterator_retries_injected_ioerrors(self):
+        """The data.fetch site + shared policy end-to-end: an
+        injected transient IOError costs a retry, the batch stream
+        is unchanged."""
+        batches = make_batches(6, seed=0)
+        clean = [np.array(b.features) for b in batches]
+        chaos.install({"faults": [{"site": "data.fetch",
+                                   "kind": "error", "p": 0.4,
+                                   "max_fires": 8}]}, seed=5)
+        got = [np.array(b.features)
+               for b in ListDataSetIterator(batches)]
+        assert chaos.current().fired_total > 0
+        for a, b in zip(clean, got):
+            np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (unit, fake clock)
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def _clock(self):
+        state = {"t": 0.0}
+
+        def now():
+            return state["t"]
+        return now, state
+
+    def test_opens_after_threshold_in_window(self):
+        now, st = self._clock()
+        br = CircuitBreaker(failure_threshold=3, window_s=10.0,
+                            cooldown_s=5.0, clock=now)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed" and br.allow()
+        br.record_failure()
+        assert br.state == "open" and not br.allow()
+        assert br.state_code() == 2
+        assert br.opened_total == 1
+
+    def test_old_failures_age_out_of_window(self):
+        now, st = self._clock()
+        br = CircuitBreaker(failure_threshold=3, window_s=10.0,
+                            clock=now)
+        br.record_failure()
+        br.record_failure()
+        st["t"] = 60.0                      # both outside the window
+        br.record_failure()
+        assert br.state == "closed"
+
+    def test_half_open_probe_success_closes(self):
+        now, st = self._clock()
+        br = CircuitBreaker(failure_threshold=1, window_s=10.0,
+                            cooldown_s=5.0, half_open_max=1,
+                            clock=now)
+        br.record_failure()
+        assert br.state == "open"
+        st["t"] = 6.0                       # cooldown elapsed
+        assert br.state == "half_open" and br.state_code() == 1
+        assert br.allow()                   # the single probe
+        assert not br.allow()               # second denied
+        br.record_success()
+        assert br.state == "closed"
+        assert br.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        now, st = self._clock()
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                            clock=now)
+        br.record_failure()
+        st["t"] = 6.0
+        assert br.allow()                   # probe admitted
+        br.record_failure()                 # probe crashed
+        assert br.state == "open"
+        st["t"] = 8.0                       # cooldown re-armed at t=6
+        assert br.state == "open"
+        st["t"] = 12.0
+        assert br.state == "half_open"
+
+    def test_stale_success_cannot_close_half_open(self):
+        """A success recorded while no probe is outstanding (a caller
+        wait()ing on a request served BEFORE the crashes) must not
+        close the circuit — only a granted probe's success may."""
+        now, st = self._clock()
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                            clock=now)
+        br.record_failure()
+        st["t"] = 6.0
+        assert br.state == "half_open"
+        br.record_success()                 # stale: no probe granted
+        assert br.state == "half_open"
+        assert br.allow()                   # the real probe
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_half_open_probe_budget_replenishes(self):
+        """A probe that dies without touching the breaker (shed at
+        the queue, expired deadline) must not wedge the circuit
+        half-open forever: the budget replenishes a cooldown after
+        the last grant."""
+        now, st = self._clock()
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                            half_open_max=1, clock=now)
+        br.record_failure()
+        st["t"] = 6.0
+        assert br.allow()                   # probe granted at t=6
+        assert not br.allow()               # budget spent...
+        st["t"] = 12.0                      # ...but not forever
+        assert br.state == "half_open"
+        assert br.allow()                   # fresh probe
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_transition_hook_fires(self):
+        seen = []
+        br = CircuitBreaker(failure_threshold=1)
+        br.on_transition = lambda old, new: seen.append((old, new))
+        br.record_failure()
+        assert seen == [("closed", "open")]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint durability
+# ---------------------------------------------------------------------------
+
+class TestCheckpointDurability:
+    def test_manifest_round_trip(self, tmp_path):
+        net = tiny_classifier()
+        p = str(tmp_path / "m.zip")
+        write_model(net, p, extra_entries={"data_position.json":
+                                           json.dumps({"epoch": 1})})
+        manifest = verify_checkpoint(p)
+        assert "data_position.json" in manifest["crc32"]
+        assert "coefficients.npz" in manifest["crc32"]
+
+    def test_truncation_detected(self, tmp_path):
+        net = tiny_classifier()
+        p = str(tmp_path / "m.zip")
+        write_model(net, p)
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.truncate(int(size * 0.6))
+        with pytest.raises(CheckpointIntegrityError):
+            verify_checkpoint(p)
+
+    def test_midfile_corruption_detected(self, tmp_path):
+        net = tiny_classifier()
+        p = str(tmp_path / "m.zip")
+        write_model(net, p)
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.seek(size // 2)
+            f.write(b"\xde\xad\xbe\xef" * 8)
+        with pytest.raises(CheckpointIntegrityError):
+            verify_checkpoint(p)
+
+    def test_pre_manifest_zip_still_verifies(self, tmp_path):
+        """Old-format zips (no manifest entry) pass via zip CRCs —
+        the v1 regression fixtures keep loading."""
+        net = tiny_classifier()
+        p = str(tmp_path / "old.zip")
+        write_model(net, p)
+        # strip the manifest, simulating a pre-manifest writer
+        stripped = str(tmp_path / "stripped.zip")
+        with zipfile.ZipFile(p) as zin, \
+                zipfile.ZipFile(stripped, "w") as zout:
+            for n in zin.namelist():
+                if n != "manifest.json":
+                    zout.writestr(n, zin.read(n))
+        assert verify_checkpoint(stripped) == {}
+        restore_model(stripped)
+
+    def test_resume_quarantines_corrupt_newest_and_falls_back(
+            self, tmp_path):
+        net = tiny_classifier()
+        tr = ElasticTrainer(net, str(tmp_path), save_every=2,
+                            keep=3, handle_sigterm=False)
+        tr.fit(ListDataSetIterator(make_batches(8)), epochs=1)
+        cks = tr._ckpts()
+        assert len(cks) >= 2
+        newest, previous = cks[-1][1], cks[-2][1]
+        with open(newest, "r+b") as f:
+            f.truncate(os.path.getsize(newest) // 2)
+        before = REGISTRY.counter(
+            "checkpoint_quarantined_total").value
+        net2 = tiny_classifier()
+        tr2 = ElasticTrainer(net2, str(tmp_path), save_every=2,
+                             handle_sigterm=False)
+        # the corrupt newest was quarantined, not fatal
+        assert os.path.exists(newest + ".corrupt")
+        assert not os.path.exists(newest)
+        assert REGISTRY.counter(
+            "checkpoint_quarantined_total").value == before + 1
+        # and the trainer resumed from the previous generation
+        assert tr2.latest_checkpoint() == previous
+        assert net2.iteration_count == int(
+            os.path.basename(previous)[5:-4])
+
+    def test_transient_read_error_retried_not_quarantined(
+            self, tmp_path):
+        """A flaky read (injected transient IOError on
+        checkpoint.read) costs a backoff'd retry; the healthy file
+        must NOT be quarantined."""
+        net = tiny_classifier()
+        tr = ElasticTrainer(net, str(tmp_path), save_every=2,
+                            handle_sigterm=False)
+        tr.fit(ListDataSetIterator(make_batches(4)), epochs=1)
+        latest = tr.latest_checkpoint()
+        chaos.install({"faults": [{"site": "checkpoint.read",
+                                   "kind": "error", "at": [1, 2]}]},
+                      seed=0)
+        net2 = tiny_classifier()
+        tr2 = ElasticTrainer(net2, str(tmp_path), save_every=2,
+                             handle_sigterm=False)
+        assert chaos.current().fired_total == 2     # both flakes flew
+        assert tr2.latest_checkpoint() == latest    # no quarantine
+        assert not [f for f in os.listdir(tmp_path)
+                    if f.endswith(".corrupt")]
+        assert net2.iteration_count == net.iteration_count
+
+    def test_stale_tmp_swept_on_start(self, tmp_path):
+        import subprocess
+        child = subprocess.Popen(["true"])
+        child.wait()                          # a guaranteed-dead pid
+        stale = tmp_path / f"ckpt_42.zip.tmp{child.pid}"
+        stale.write_bytes(b"partial write from a dead process")
+        # pid 1 is always alive (and not ours): its tmp must survive
+        # — a second trainer on a shared dir must never delete a
+        # write another LIVE process is mid-way through
+        live = tmp_path / "ckpt_43.zip.tmp1"
+        live.write_bytes(b"another process's in-flight write")
+        keeper = tmp_path / "notes.txt"
+        keeper.write_text("not a tmp")
+        ElasticTrainer(tiny_classifier(), str(tmp_path),
+                       handle_sigterm=False)
+        assert not stale.exists()
+        assert live.exists()
+        assert keeper.exists()
+
+    def test_enospc_checkpoint_write_is_survivable(self, tmp_path):
+        """An injected ENOSPC on checkpoint.write costs the
+        checkpoint, not the run — and leaks no tmp file."""
+        chaos.install({"faults": [{"site": "checkpoint.write",
+                                   "kind": "enospc", "at": [2]}]},
+                      seed=0)
+        net = tiny_classifier()
+        tr = ElasticTrainer(net, str(tmp_path), save_every=2,
+                            handle_sigterm=False)
+        before = REGISTRY.counter(
+            "checkpoint_write_failures_total").value
+        tr.fit(ListDataSetIterator(make_batches(6)), epochs=1)
+        assert net.iteration_count == 6          # training completed
+        assert REGISTRY.counter(
+            "checkpoint_write_failures_total").value == before + 1
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+        assert tr.latest_checkpoint() is not None
+
+    def test_nan_chaos_triggers_rollback_and_recovers(self, tmp_path):
+        """The train.step nan kind (the nan_injection drill as a
+        plan-driven site) exercises the rollback path end-to-end."""
+        chaos.install({"faults": [{"site": "train.step",
+                                   "kind": "nan", "at": [5]}]},
+                      seed=0)
+        net = tiny_classifier()
+        tr = ElasticTrainer(net, str(tmp_path), save_every=2,
+                            handle_sigterm=False)
+        tr.fit(ListDataSetIterator(make_batches(8)), epochs=1)
+        assert tr.total_rollbacks == 1
+        assert (0, 4) in tr._skip
+        assert all(np.isfinite(p).all() for p in _flat_params(net))
+
+
+# ---------------------------------------------------------------------------
+# the soak acceptance: faults change nothing the math can see
+# ---------------------------------------------------------------------------
+
+class TestChaosSoak:
+    def test_mixed_faults_converge_bit_identical(self, tmp_path):
+        """ElasticTrainer.fit under checkpoint corruption + fetcher
+        IOErrors + one simulated crash: after resume, final params
+        (and optimizer state) are bit-identical to the fault-free
+        run, the corrupt generation was quarantined, and zero
+        unhandled exceptions escaped."""
+        batches = make_batches(30, seed=3)
+
+        # ---- fault-free reference -------------------------------------
+        ref = tiny_classifier(seed=1)
+        ElasticTrainer(ref, str(tmp_path / "free"), save_every=7,
+                       keep=3, handle_sigterm=False).fit(
+            ListDataSetIterator(batches), until_epoch=3)
+
+        # ---- chaotic run ----------------------------------------------
+        # write hit 8 is the iteration-49 checkpoint — the newest one
+        # at crash time (train.step hit 51), so resume MUST walk the
+        # quarantine-and-fall-back path to the iteration-42 one
+        chaos.install({"faults": [
+            {"site": "data.fetch", "kind": "error", "p": 0.1},
+            {"site": "data.fetch", "kind": "slow", "p": 0.03,
+             "args": {"delay_s": 0.001}},
+            {"site": "checkpoint.write", "kind": "corrupt",
+             "at": [8]},
+            {"site": "train.step", "kind": "crash", "at": [51]},
+        ]}, seed=123)
+        chaos_dir = str(tmp_path / "chaotic")
+        net = tiny_classifier(seed=1)
+        with pytest.raises(chaos.SimulatedCrashError):
+            ElasticTrainer(net, chaos_dir, save_every=7, keep=3,
+                           handle_sigterm=False).fit(
+                ListDataSetIterator(batches), until_epoch=3)
+
+        # "process restart": fresh model object, same command
+        net2 = tiny_classifier(seed=1)
+        tr2 = ElasticTrainer(net2, chaos_dir, save_every=7, keep=3,
+                             handle_sigterm=False)
+        assert net2.iteration_count == 42      # fell back past 49
+        assert [f for f in os.listdir(chaos_dir)
+                if f.endswith(".corrupt")]     # evidence kept
+        tr2.fit(ListDataSetIterator(batches), until_epoch=3)
+        fired = chaos.current().fired_total
+        chaos.uninstall()
+
+        # ---- the determinism proof ------------------------------------
+        assert fired > 2                       # faults really flew
+        assert net2.iteration_count == ref.iteration_count == 90
+        for a, b in zip(_flat_params(ref), _flat_params(net2)):
+            np.testing.assert_array_equal(a, b)
+        assert float(net2.score_value) == float(ref.score_value)

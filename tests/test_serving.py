@@ -21,6 +21,7 @@ import numpy as np
 import pytest
 
 from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu import chaos
 from deeplearning4j_tpu.nn.conf import updaters
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
@@ -29,6 +30,8 @@ from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
                                                RnnOutputLayer,
                                                TransformerEncoderLayer)
 from deeplearning4j_tpu.serving import (BatchScheduler,
+                                        CircuitBreaker,
+                                        CircuitOpenError,
                                         ContinuousBatcher,
                                         DeadlineExceededError,
                                         ModelNotFoundError,
@@ -456,6 +459,199 @@ class TestContinuousBatching:
         net = MultiLayerNetwork(conf).init()
         with pytest.raises(ValueError, match="running statistic"):
             net.slot_streaming_session(capacity=8, slots=2)
+
+
+# ---------------------------------------------------------------------------
+# satellite: deadline-expired work is NEVER served late
+# ---------------------------------------------------------------------------
+
+class RecordingModel(EchoModel):
+    """Also records every batch's CONTENT, so a test can prove a
+    payload never reached the device."""
+
+    def __init__(self, delay=0.0):
+        super().__init__(delay)
+        self.batches = []
+
+    def output(self, x):
+        with self._lock:
+            self.batches.append(np.array(x))
+        return super().output(x)
+
+
+@pytest.mark.chaos
+class TestDeadlineNeverServedLate:
+    def test_scheduler_expired_payload_never_reaches_device(self):
+        model = RecordingModel(delay=0.25)
+        s = BatchScheduler(model, max_batch_size=4, queue_limit=16,
+                           wait_ms=1.0, name="predict")
+        first = s.submit(np.ones((1, 4), np.float32))
+        time.sleep(0.05)          # collector is inside the sleep
+        doomed = s.submit(np.full((1, 4), 7.0, np.float32),
+                          timeout=0.05)
+        with pytest.raises(DeadlineExceededError):
+            s.wait(doomed)
+        np.testing.assert_array_equal(s.wait(first),
+                                      np.ones((1, 4)) * 2)
+        assert s.drain()
+        # the expired payload (marker 7.0) was in no device call
+        assert not any((b == 7.0).any() for b in model.batches)
+        # and the expiry landed on the canonical counter
+        c = s.metrics.registry.get("serving_deadline_expired_total",
+                                   labels={"endpoint": "predict"})
+        assert c is not None and c.value >= 1
+
+    def test_batcher_expired_prompt_never_starts_decoding(self):
+        net = _lm()
+        cb = ContinuousBatcher(net, slots=1, capacity=LM_CAP,
+                               name="generate")
+        cb.generate(np.array([1, 2]), 2)          # warm the compile
+        long = cb.submit(np.array([1, 2]), LM_CAP - 2)
+        doomed = cb.submit(np.array([3, 4]), 4, timeout=0.02)
+        with pytest.raises(DeadlineExceededError):
+            cb.wait(doomed)
+        assert len(cb.wait(long)) == LM_CAP - 2
+        c = cb.metrics.registry.get("serving_deadline_expired_total",
+                                    labels={"endpoint": "generate"})
+        assert c is not None and c.value >= 1
+        assert cb.drain()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker e2e: crash-looping backend opens, probes, closes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestCircuitBreakerE2E:
+    @pytest.fixture(autouse=True)
+    def _clean_injector(self):
+        yield
+        chaos.uninstall()
+
+    def test_open_half_open_close(self):
+        """Three injected worker crashes open the circuit within the
+        window; admission sheds with CircuitOpenError; after the
+        cooldown the half-open probe succeeds (faults exhausted) and
+        the circuit closes."""
+        chaos.install({"faults": [{"site": "serving.worker.step",
+                                   "kind": "crash", "p": 1.0,
+                                   "max_fires": 3}]}, seed=1)
+        br = CircuitBreaker(failure_threshold=3, window_s=10.0,
+                            cooldown_s=0.2, half_open_max=1)
+        s = BatchScheduler(EchoModel(), max_batch_size=4,
+                           queue_limit=16, wait_ms=1.0, breaker=br,
+                           name="predict")
+        for _ in range(3):
+            with pytest.raises(chaos.SimulatedCrashError):
+                s.predict(np.ones((1, 4), np.float32))
+        # breaker trip happens on the worker thread; wait for it
+        for _ in range(200):
+            if br.state == "open":
+                break
+            time.sleep(0.005)
+        assert br.state == "open"
+        with pytest.raises(CircuitOpenError):
+            s.submit(np.ones((1, 4), np.float32))
+        crashes = s.metrics.registry.get(
+            "serving_worker_crashes_total",
+            labels={"endpoint": "predict"})
+        assert crashes.value == 3
+        time.sleep(0.25)                   # cooldown -> half-open
+        # the restarted worker serves the probe; success closes
+        out = s.predict(np.ones((1, 4), np.float32))
+        np.testing.assert_array_equal(out, np.ones((1, 4)) * 2)
+        assert br.state == "closed"
+        # fully recovered: subsequent traffic flows
+        out = s.predict(np.full((1, 4), 3.0, np.float32))
+        np.testing.assert_array_equal(out, np.full((1, 4), 6.0))
+        s.shutdown()
+
+    def test_worker_crash_fails_only_inflight_batch(self):
+        """One injected crash fails the in-flight waiters with the
+        crash error, the restarted worker serves later traffic, and
+        the circuit (threshold 3) never opens for a single crash."""
+        chaos.install({"faults": [{"site": "serving.worker.step",
+                                   "kind": "crash", "at": [1]}]},
+                      seed=1)
+        s = BatchScheduler(EchoModel(), max_batch_size=4,
+                           queue_limit=16, wait_ms=1.0,
+                           breaker=CircuitBreaker(failure_threshold=3),
+                           name="predict")
+        with pytest.raises(chaos.SimulatedCrashError):
+            s.predict(np.ones((1, 4), np.float32))
+        out = s.predict(np.full((1, 4), 2.0, np.float32))
+        np.testing.assert_array_equal(out, np.full((1, 4), 4.0))
+        assert s.breaker.state == "closed"
+        s.shutdown()
+
+    def test_batcher_crash_spares_pending_requests(self):
+        """A worker crash fails only the streams mid-decode; an
+        admitted-but-unslotted (pending) request survives and is
+        served by the restarted loop."""
+        chaos.install({"faults": [{"site": "serving.worker.step",
+                                   "kind": "crash", "at": [3]}]},
+                      seed=1)
+        net = _lm()
+        cb = ContinuousBatcher(
+            net, slots=1, capacity=LM_CAP,
+            breaker=CircuitBreaker(failure_threshold=5))
+        first = cb.submit(np.array([1, 2, 3]), 4)   # crashes at hit 3
+        second = cb.submit(np.array([4, 5]), 3)     # pending
+        with pytest.raises(chaos.SimulatedCrashError):
+            cb.wait(first)
+        assert len(cb.wait(second)) == 3            # restarted loop
+        assert cb.breaker.state == "closed"
+        assert cb.drain()
+
+    def test_poison_fault_fails_greedy_request_loudly(self):
+        """A poisoned device step (NaN logits) must fail the affected
+        greedy request with a typed per-slot error — never stream
+        token 0 with a success status — and must not kill the
+        worker: the next request decodes normally."""
+        # prompt [1,2,3]: steps 1-2 prefill (outputs discarded), step
+        # 3 samples the first token — poison THAT step
+        chaos.install({"faults": [{"site": "serving.worker.step",
+                                   "kind": "poison", "at": [3]}]},
+                      seed=1)
+        net = _lm()
+        cb = ContinuousBatcher(net, slots=2, capacity=LM_CAP)
+        with pytest.raises(ValueError, match="non-finite"):
+            cb.generate(np.array([1, 2, 3]), 4)
+        out = cb.generate(np.array([1, 2, 3]), 4)
+        assert len(out) == 4
+        assert cb.breaker.state == "closed"    # per-slot, not a crash
+        assert cb.drain()
+
+    def test_healthz_and_metrics_report_open_circuit(self):
+        reg = ModelRegistry()
+        reg.register("iris", _mlp())
+        srv = ModelServer(reg, port=0, wait_ms=2.0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body, code = _post(base, "/v1/predict",
+                               {"model": "iris",
+                                "inputs": [[1, 2, 3, 4]]})
+            assert code == 200
+            body, _ = _get(base, "/healthz")
+            assert body["status"] == "ok"
+            srv._schedulers[("iris", 1)].breaker.force_open()
+            body, _ = _get(base, "/healthz")
+            assert body["status"] == "degraded"
+            assert body["circuits"] == {"predict/iris/v1": "open"}
+            # the circuit_state gauge reaches Prometheus scrapers
+            import urllib.request
+            with urllib.request.urlopen(
+                    base + "/metrics?format=prometheus") as resp:
+                text = resp.read().decode()
+            assert ('circuit_state{endpoint="predict/iris/v1"} 2'
+                    in text)
+            # an open circuit sheds over HTTP as 503
+            _, code = _post(base, "/v1/predict",
+                            {"model": "iris",
+                             "inputs": [[1, 2, 3, 4]]})
+            assert code == 503
+        finally:
+            srv.stop(drain=True, timeout=10.0)
 
 
 # ---------------------------------------------------------------------------

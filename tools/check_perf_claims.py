@@ -36,6 +36,12 @@ metric-name string literal somewhere under ``deeplearning4j_tpu/``
 (f-string name templates like ``f"{name}_queue_depth"`` match as
 wildcards).
 
+**Stale chaos-site names** joined with the chaos PR: inside any doc
+section whose heading mentions fault injection / chaos, every
+backticked dotted token (``checkpoint.write``, ``data.fetch``, ...)
+must exist as a string literal under the package — the documented
+fault-plan schema must keep matching the code's injection sites.
+
 Run: ``python tools/check_perf_claims.py [--repo DIR]``; exit 0 =
 clean. Wired into the tier-1 test tier via tests/test_observability.py
 (perf claims) and tests/test_health.py (metric names).
@@ -121,7 +127,7 @@ PACKAGE_DIR = "deeplearning4j_tpu"
 
 # suffixes that mark a backticked doc token as a metric-name citation
 METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_depth",
-                   "_firing")
+                   "_firing", "_state")
 _SUFFIX_ALT = "|".join(METRIC_SUFFIXES)
 
 # `serving_requests_total`-style citations in docs
@@ -180,6 +186,87 @@ def check_metric_names(repo: str) -> List[str]:
     return errors
 
 
+# ---------------------------------------------------------------------------
+# stale chaos-site names
+# ---------------------------------------------------------------------------
+
+# the docs' fault-injection sections cite injection sites as
+# backticked dotted tokens (`checkpoint.write`, `data.fetch`, ...);
+# each must exist as a string literal under the package, or the
+# documented plan schema silently stopped matching the code
+DOC_SITE_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`")
+SRC_SITE_RE = re.compile(
+    r"""["']([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)["']""")
+
+# dotted doc tokens that are file references, not site names
+_SITE_EXT_SKIP = {"py", "json", "jsonl", "md", "zip", "npz", "npy",
+                  "txt", "ini", "csv", "bin", "gz", "log", "html",
+                  "h5", "yaml", "yml"}
+
+
+def find_doc_site_names(path: str) -> List[Tuple[int, str]]:
+    """Backticked dotted tokens inside any section whose heading
+    mentions fault injection / chaos (scoped: a dotted token
+    elsewhere in the docs — `np.ndarray`, module paths — is not a
+    site citation). Fenced code blocks are skipped entirely: a shell
+    comment's leading '#' is not a markdown heading and must not
+    toggle the section scope."""
+    names = []
+    in_section = False
+    in_fence = False
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            if line.lstrip().startswith("```"):
+                in_fence = not in_fence
+                continue
+            if in_fence:
+                continue
+            if re.match(r"#+\s", line):
+                low = line.lower()
+                in_section = ("fault injection" in low
+                              or "chaos" in low)
+                continue
+            if not in_section:
+                continue
+            for m in DOC_SITE_RE.finditer(line):
+                token = m.group(1)
+                if token.rsplit(".", 1)[-1] in _SITE_EXT_SKIP:
+                    continue
+                names.append((i, token))
+    return names
+
+
+def registered_site_literals(repo: str) -> set:
+    literals = set()
+    for root, _dirs, files in os.walk(os.path.join(repo, PACKAGE_DIR)):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(root, fname),
+                      encoding="utf-8", errors="replace") as f:
+                src = f.read()
+            for m in SRC_SITE_RE.finditer(src):
+                literals.add(m.group(1))
+    return literals
+
+
+def check_site_names(repo: str) -> List[str]:
+    literals = registered_site_literals(repo)
+    errors = []
+    for doc in DOC_FILES:
+        path = os.path.join(repo, doc)
+        if not os.path.exists(path):
+            continue
+        for line_no, name in find_doc_site_names(path):
+            if name not in literals:
+                errors.append(
+                    f"{doc}:{line_no}: chaos site `{name}` is cited "
+                    f"in the docs but exists as a string literal "
+                    f"nowhere under {PACKAGE_DIR}/ — stale site "
+                    "name?")
+    return errors
+
+
 def check(repo: str) -> List[str]:
     artifact_path = os.path.join(repo, ARTIFACT)
     with open(artifact_path) as f:
@@ -197,6 +284,7 @@ def check(repo: str) -> List[str]:
                     f"measured counterpart in {ARTIFACT} "
                     f"(line: {line.strip()[:100]})")
     errors.extend(check_metric_names(repo))
+    errors.extend(check_site_names(repo))
     return errors
 
 
