@@ -37,10 +37,16 @@ the docs lint checks the README table against these):
 ``train.step``       ``train/fault_tolerance.ElasticTrainer`` right
                      before the train step (``crash``, ``hang``,
                      ``nan`` — the nan_injection fixture's poison, as a
-                     plan-driven site)
+                     plan-driven site — and ``sigterm``, which delivers
+                     a REAL ``SIGTERM`` to the process at the seeded
+                     ordinal: preemption as a replayable plan entry)
 ``serving.worker.step`` the serving backends' device step in
                      ``serving/scheduler.py`` / ``serving/continuous.py``
                      (``crash``, ``hang``, ``poison``)
+``parallel.device``  ``parallel/wrapper.ParallelWrapper`` right before
+                     each data-parallel mesh step (``crash``, and
+                     ``loss`` — simulate losing one mesh device; the
+                     wrapper shrinks the mesh and continues)
 ==================== ====================================================
 
 Generic kinds every site understands via :func:`step_fault`:
@@ -114,6 +120,7 @@ SITES: Dict[str, str] = {
     "data.load": "one dataset file read by a fetcher",
     "train.step": "one ElasticTrainer train step",
     "serving.worker.step": "one serving-backend device step",
+    "parallel.device": "one ParallelWrapper data-parallel mesh step",
 }
 
 # kinds every site understands via step_fault(), plus the
@@ -127,8 +134,9 @@ SITE_KINDS: Dict[str, frozenset] = {
     "checkpoint.read": _GENERIC_KINDS | {"truncate", "corrupt"},
     "data.fetch": _GENERIC_KINDS,
     "data.load": _GENERIC_KINDS,
-    "train.step": _GENERIC_KINDS | {"nan"},
+    "train.step": _GENERIC_KINDS | {"nan", "sigterm"},
     "serving.worker.step": _GENERIC_KINDS | {"poison"},
+    "parallel.device": _GENERIC_KINDS | {"loss"},
 }
 
 

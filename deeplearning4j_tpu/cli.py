@@ -62,16 +62,39 @@ def _cmd_train(args):
             HealthMonitor)
         model.add_listeners(HealthMonitor(policy=args.health,
                                           recorder=get_recorder()))
+    use_elastic = args.health == "rollback" or args.async_checkpoint
     if args.workers and args.workers > 1:
+        # under ElasticTrainer the trainer owns the batch loop and
+        # drives wrapper.fit_batch — wrapper-level prefetch never
+        # runs there, so build it prefetch-free and say so rather
+        # than silently ignoring the flag
+        wrapper_prefetch = 0 if use_elastic else args.prefetch
+        if use_elastic and args.prefetch:
+            print("train: --prefetch is inactive under the elastic "
+                  "trainer (it owns the batch loop; checkpointable "
+                  "iterator state requires consuming batches in "
+                  "step order)")
         pw = (ParallelWrapper.builder(model).workers(args.workers)
-              .prefetch_buffer(args.prefetch).build())
-        pw.fit(it, epochs=args.epochs)
-    elif args.health == "rollback":
+              .prefetch_buffer(wrapper_prefetch).build())
+        if use_elastic:
+            # data-parallel AND preemption-tolerant: the trainer
+            # checkpoints (off-thread with --async-checkpoint) while
+            # the wrapper runs the mesh step
+            from deeplearning4j_tpu.train.fault_tolerance import (
+                ElasticTrainer)
+            ckpt_dir = (args.output or args.model) + ".ckpts"
+            ElasticTrainer(model, ckpt_dir, save_every=10,
+                           async_checkpoint=args.async_checkpoint,
+                           wrapper=pw).fit(it, epochs=args.epochs)
+        else:
+            pw.fit(it, epochs=args.epochs)
+    elif use_elastic:
         # the rollback policy needs a checkpoint loop to roll back TO
         from deeplearning4j_tpu.train.fault_tolerance import (
             ElasticTrainer)
         ckpt_dir = (args.output or args.model) + ".ckpts"
-        ElasticTrainer(model, ckpt_dir, save_every=10).fit(
+        ElasticTrainer(model, ckpt_dir, save_every=10,
+                       async_checkpoint=args.async_checkpoint).fit(
             it, epochs=args.epochs)
     else:
         model.fit(it, epochs=args.epochs)
@@ -188,6 +211,14 @@ def main(argv=None):
                         "divergence/plateau/gradient detectors); "
                         "POLICY = warn | raise | rollback "
                         "(default warn)")
+    t.add_argument("--async-checkpoint", action="store_true",
+                   help="train under ElasticTrainer with background "
+                        "checkpoint writes: saves cost the train "
+                        "thread a device->host snapshot only; "
+                        "serialization + zip + atomic rename run on "
+                        "a writer thread (SIGTERM still drains it "
+                        "before the process stops); write timing "
+                        "lands in checkpoint_write_seconds")
     t.add_argument("--chaos", metavar="PLAN", default=None,
                    help="install a deterministic fault-injection "
                         "plan for this run: inline JSON or a path to "

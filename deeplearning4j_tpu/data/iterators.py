@@ -38,7 +38,25 @@ __all__ = ["DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
 
 
 class DataSetIterator:
-    """Base: restartable iterator over DataSet minibatches."""
+    """Base: restartable iterator over DataSet minibatches.
+
+    **Checkpointable-state protocol (opt-in):** a stateful iterator
+    implements ``state_dict()`` (a JSON-serializable dict describing
+    its position — at minimum ``cursor``, the number of batches
+    yielded so far this epoch, plus whatever epoch/rng fields it
+    needs to reproduce the rest of the epoch) and
+    ``load_state_dict(state)`` (arm a one-shot resume: the NEXT
+    iteration starts at ``cursor`` — skipping the consumed prefix
+    WITHOUT materializing it — with the epoch/rng fields restored;
+    epochs after that start fresh). ElasticTrainer persists the state
+    inside its checkpoint zip and resumes by restore instead of the
+    O(batches) fingerprint-replay fast-forward, which also lifts the
+    deterministic-iterator requirement for stateful iterators. The
+    base returns None — stateless — and the trainer falls back to
+    replay. ``AsyncDataSetIterator`` is deliberately stateless: its
+    prefetch queue holds batches the consumer has not seen, so the
+    wrapped cursor overstates the consumed position.
+    """
 
     def reset(self) -> None:
         raise NotImplementedError
@@ -49,6 +67,63 @@ class DataSetIterator:
 
     def _iterate(self) -> Iterator[DataSet]:
         raise NotImplementedError
+
+    _resume: Optional[dict] = None
+    _cursor: int = 0
+
+    def state_dict(self) -> Optional[dict]:
+        """Position state for checkpointing, or None (stateless)."""
+        return None
+
+    def load_state_dict(self, state: dict) -> None:
+        """Arm a one-shot resume at ``state``; stateless iterators
+        raise so callers fall back to replay."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support iterator-state "
+            "resume")
+
+    def _source_signature(self) -> Optional[list]:
+        """Cheap JSON-safe identity of the data source (counts,
+        shapes, seeds). Rides inside ``state_dict`` so a resume
+        pointed at the WRONG source fails loudly — the stateful twin
+        of the replay path's fingerprint-chain check. None = no
+        signature (check skipped)."""
+        return None
+
+    def _arm_resume(self, state: dict) -> None:
+        """Shared ``load_state_dict`` body: verify the source
+        signature (when both sides carry one), then arm the one-shot
+        resume."""
+        state = dict(state)
+        theirs = state.get("source")
+        mine = self._source_signature()
+        if theirs is not None and mine is not None \
+                and list(theirs) != list(mine):
+            raise ValueError(
+                f"iterator state does not match this data source "
+                f"(checkpointed {theirs}, current {mine}) — the "
+                "wrong (or a modified) dataset was passed to the "
+                "resumed run")
+        self._resume = state
+
+    def _consume_resume(self, total: Optional[int] = None) -> int:
+        """Shared one-shot arm/consume step for ``_iterate``
+        implementations: returns the armed start cursor (0 when no
+        resume pends), clears the arm, and primes ``_cursor``. With
+        ``total`` (the number of batches this source can produce),
+        a cursor pointing past the end fails LOUDLY — a silently
+        empty resumed epoch is the shrunken-data-source bug the
+        trainer's replay path already rejects."""
+        st, self._resume = self._resume, None
+        start = 0 if st is None else int(st.get("cursor", 0))
+        if total is not None and start > total:
+            raise ValueError(
+                f"iterator state cursor {start} is beyond the "
+                f"{total} batches this source can produce — the "
+                "data source shrank (or the wrong one was passed) "
+                "since the checkpoint was written")
+        self._cursor = start
+        return start
 
     def batch_size(self) -> Optional[int]:
         return None
@@ -63,12 +138,29 @@ class ListDataSetIterator(DataSetIterator):
 
     def __init__(self, batches: Sequence[DataSet]):
         self._batches = list(batches)
+        self._cursor = 0
+        self._resume: Optional[dict] = None
 
     def reset(self):
         pass
 
+    def _source_signature(self):
+        return ["list", len(self._batches),
+                sum(b.num_examples() for b in self._batches)]
+
+    def state_dict(self):
+        return {"cursor": self._cursor,
+                "source": self._source_signature()}
+
+    def load_state_dict(self, state):
+        self._arm_resume(state)
+
     def _iterate(self):
-        for b in self._batches:
+        start = self._consume_resume(len(self._batches))
+        # skipping is a slice, not a replay: the consumed prefix is
+        # never materialized (no data.fetch hits, no retry budget)
+        for b in self._batches[start:]:
+            self._cursor += 1
             yield fetch_batch(lambda b=b: b)
 
     def batch_size(self):
@@ -94,20 +186,47 @@ class ArrayDataSetIterator(DataSetIterator):
         self._seed = seed
         self._epoch = 0
         self._drop_last = drop_last
+        self._cursor = 0
+        self._resume: Optional[dict] = None
 
     def reset(self):
-        self._epoch += 1
+        # an armed resume pins the epoch (idempotently — reset may be
+        # called more than once before iteration starts) so the
+        # restored shuffle permutation is the interrupted epoch's own
+        if self._resume is not None:
+            self._epoch = int(self._resume.get("epoch", self._epoch))
+        else:
+            self._epoch += 1
+
+    def _source_signature(self):
+        return ["array", self._bs, self._seed, int(self._shuffle),
+                str(self.features.dtype),
+                *map(int, self.features.shape)]
+
+    def state_dict(self):
+        # the shuffle permutation is a pure function of (seed, epoch),
+        # so (cursor, epoch) reproduces the rest of the epoch exactly
+        return {"cursor": self._cursor, "epoch": self._epoch,
+                "source": self._source_signature()}
+
+    def load_state_dict(self, state):
+        self._arm_resume(state)
+        self._epoch = int(self._resume.get("epoch", self._epoch))
 
     def _iterate(self):
         n = self.features.shape[0]
+        total = (n // self._bs if self._drop_last
+                 else -(-n // self._bs))
+        start = self._consume_resume(total)
         idx = np.arange(n)
         if self._shuffle:
             rng = np.random.default_rng(self._seed + self._epoch)
             rng.shuffle(idx)
-        for i in range(0, n, self._bs):
+        for i in range(start * self._bs, n, self._bs):
             sel = idx[i:i + self._bs]
             if self._drop_last and len(sel) < self._bs:
                 return
+            self._cursor += 1
             yield fetch_batch(lambda sel=sel: DataSet(
                 self.features[sel],
                 None if self.labels is None else self.labels[sel],
@@ -220,14 +339,38 @@ class SamplingDataSetIterator(DataSetIterator):
         self._n = batches_per_epoch
         self._seed = seed
         self._epoch = 0
+        self._cursor = 0
+        self._resume: Optional[dict] = None
 
     def reset(self):
-        self._epoch += 1
+        if self._resume is not None:
+            self._epoch = int(self._resume.get("epoch", self._epoch))
+        else:
+            self._epoch += 1
+
+    def _source_signature(self):
+        return ["sampling", int(self.data.num_examples()), self._bs,
+                self._n, self._seed]
+
+    def state_dict(self):
+        return {"cursor": self._cursor, "epoch": self._epoch,
+                "source": self._source_signature()}
+
+    def load_state_dict(self, state):
+        self._arm_resume(state)
+        self._epoch = int(self._resume.get("epoch", self._epoch))
 
     def _iterate(self):
+        start = self._consume_resume(self._n)
         rng = np.random.default_rng(self._seed + self._epoch)
         n = self.data.num_examples()
-        for _ in range(self._n):
+        # fast-forward the rng past the consumed draws (index draws
+        # only, no batch assembly) so the remaining samples match the
+        # uninterrupted epoch's stream exactly
+        for _ in range(start):
+            rng.integers(0, n, size=self._bs)
+        for _ in range(self._n - start):
+            self._cursor += 1
             sel = rng.integers(0, n, size=self._bs)
             yield DataSet(
                 self.data.features[sel],

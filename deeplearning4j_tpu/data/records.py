@@ -11,6 +11,7 @@ transform-process role is covered by a composable ``transforms`` list.
 from __future__ import annotations
 
 import csv
+import itertools
 import os
 from typing import Callable, List, Optional, Sequence
 
@@ -41,6 +42,12 @@ class CSVRecordReader:
 
     def __iter__(self):
         return iter(self._rows)
+
+    def iter_from(self, start: int):
+        """Iterate records starting at ordinal ``start`` without
+        touching the skipped prefix (the iterator-state resume
+        hook)."""
+        return iter(self._rows[start:])
 
     def __len__(self):
         return len(self._rows)
@@ -100,8 +107,13 @@ class ImageRecordReader:
         return len(self._items)
 
     def __iter__(self):
+        return self.iter_from(0)
+
+    def iter_from(self, start: int):
+        """Decode from ordinal ``start`` on: a state resume must skip
+        the consumed prefix without paying its image decodes."""
         from PIL import Image
-        for path, li in self._items:
+        for path, li in self._items[start:]:
             img = Image.open(path)
             if self.channels == 1:
                 img = img.convert("L")
@@ -134,20 +146,44 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.num_classes = num_classes
         self.regression = regression
         self.transforms = list(transforms)
+        self._cursor = 0
+        self._resume: Optional[dict] = None
 
     def reset(self):
         pass
 
-    def _records(self):
+    def _source_signature(self):
+        sig = ["records", self._bs,
+               -1 if self.label_index is None else self.label_index]
+        if hasattr(self.reader, "__len__"):
+            sig.append(len(self.reader))
+        return sig
+
+    def state_dict(self):
+        return {"cursor": self._cursor,
+                "source": self._source_signature()}
+
+    def load_state_dict(self, state):
+        self._arm_resume(state)
+
+    def _records(self, skip: int = 0):
+        """Yield (features, label) records, skipping the first
+        ``skip`` WITHOUT parsing or decoding them (readers expose
+        ``iter_from``; islice would still run the skipped records
+        through PIL/float parsing)."""
+        src = (self.reader.iter_from(skip)
+               if skip and hasattr(self.reader, "iter_from")
+               else itertools.islice(iter(self.reader), skip, None)
+               if skip else self.reader)
         if isinstance(self.reader, ImageRecordReader):
-            for arr, li in self.reader:
+            for arr, li in src:
                 for t in self.transforms:
                     arr = t(arr)
                 onehot = np.zeros(len(self.reader.labels), np.float32)
                 onehot[li] = 1.0
                 yield arr, onehot
         else:
-            for row in self.reader:
+            for row in src:
                 vals = [float(v) for v in row]
                 for t in self.transforms:
                     vals = t(vals)
@@ -163,14 +199,26 @@ class RecordReaderDataSetIterator(DataSetIterator):
                 yield np.asarray(vals, np.float32), y
 
     def _iterate(self):
+        # the bounds check needs len(reader), which duck-typed
+        # streaming readers may not have — only compute it when a
+        # resume is actually armed (plain iteration stays len-free)
+        total = None
+        if self._resume is not None and hasattr(self.reader, "__len__"):
+            total = -(-len(self.reader) // self._bs)
+        start = self._consume_resume(total)
+        # record-level skip INSIDE the reader: the consumed prefix
+        # costs no decode, no parse, no batch assembly, no data.fetch
+        recs = self._records(skip=start * self._bs)
         feats, labs = [], []
-        for f, y in self._records():
+        for f, y in recs:
             feats.append(f)
             labs.append(y)
             if len(feats) == self._bs:
+                self._cursor += 1
                 yield fetch_batch(lambda: self._mk(feats, labs))
                 feats, labs = [], []
         if feats:
+            self._cursor += 1
             yield fetch_batch(lambda: self._mk(feats, labs))
 
     def _mk(self, feats, labs):

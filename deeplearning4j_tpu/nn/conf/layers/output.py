@@ -142,7 +142,7 @@ class RnnOutputLayer(OutputLayer):
                 total = jax.lax.psum(jnp.sum(mask), axes)
                 n_sh = 1
                 for a in axes:
-                    n_sh *= jax.lax.axis_size(a)
+                    n_sh *= jax.lax.psum(1, a)
                 return jnp.sum(per) * n_sh / jnp.maximum(total, 1.0)
             # DL4J averages over *present* timesteps across the batch
             denom = jnp.maximum(jnp.sum(mask), 1.0)

@@ -26,7 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["MeshSpec", "build_mesh", "device_count", "data_sharding",
-           "replicated"]
+           "replicated", "shrink_data_mesh", "largest_pow2"]
 
 
 def device_count() -> int:
@@ -63,6 +63,38 @@ def build_mesh(spec: MeshSpec = MeshSpec(),
     shape = spec.resolve(len(devices))
     arr = np.array(devices).reshape(shape)
     return Mesh(arr, AXES)
+
+
+def largest_pow2(n: int) -> int:
+    """Largest power of two <= n (the usable data-parallel degree
+    over a survivor set: batch splits stay even and re-divisible)."""
+    if n < 1:
+        raise ValueError(f"need at least one device, got {n}")
+    return 1 << (n.bit_length() - 1)
+
+
+def shrink_data_mesh(mesh: Mesh, lost) -> Mesh:
+    """Rebuild a pure data-parallel mesh over the devices surviving
+    ``lost`` (an iterable of device objects), at the largest
+    power-of-two dp that fits — dp=8 with one device lost becomes
+    dp=4. Only data-parallel meshes shrink: params are REPLICATED
+    over 'data', so any survivor holds a complete copy to re-shard
+    from; a mesh that also shards 'model'/'pipe'/'seq' has state that
+    lived only on the lost device and must recover via checkpoint
+    restart instead."""
+    for ax in ("model", "pipe", "seq"):
+        if mesh.shape.get(ax, 1) > 1:
+            raise NotImplementedError(
+                f"elastic shrink supports pure data-parallel meshes; "
+                f"axis {ax!r} has size {mesh.shape[ax]} — sharded "
+                "state died with the device, restart from a "
+                "checkpoint instead")
+    lost = set(lost)
+    survivors = [d for d in mesh.devices.flat if d not in lost]
+    if not survivors:
+        raise RuntimeError("no surviving devices to shrink onto")
+    dp = largest_pow2(len(survivors))
+    return build_mesh(MeshSpec(data=dp), survivors[:dp])
 
 
 def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:

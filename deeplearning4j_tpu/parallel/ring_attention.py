@@ -127,7 +127,7 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
 def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
                             scale):
     """Runs inside shard_map: q,k,v are the LOCAL (B, T/n, H, D) blocks."""
-    n = lax.axis_size(axis_name)
+    n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
     acc_dt = jnp.promote_types(q.dtype, jnp.float32)
@@ -297,7 +297,7 @@ def _ring_flash_sharded(q, k, v, kmask=None, *, axis_name: str,
     """Forward ring with Pallas local chunks; returns (o, lse).
     ``kmask``: optional LOCAL (B, T/n) key-padding chunk — it rotates
     around the ring WITH its K/V block."""
-    n = lax.axis_size(axis_name)
+    n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
     masked = kmask is not None
@@ -339,7 +339,7 @@ def _ring_flash_bwd_sharded(q, k, v, o, lse, do, kmask=None, *,
     present) rotate with k/v."""
     from deeplearning4j_tpu.ops.attention import (
         pallas_flash_attention_bwd)
-    n = lax.axis_size(axis_name)
+    n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     blk = _blk(q)
@@ -501,7 +501,10 @@ def make_ring_attention_fn(mesh: Mesh, *, axis: str = "seq",
     flash kernels (forward AND backward) when running on TPU with
     tile-divisible local lengths and the default 1/sqrt(D) scale;
     'never' keeps the pure-jnp blockwise accumulation (any backend)."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:                  # older jax
+        from jax.experimental.shard_map import shard_map
 
     spec = P(None, axis, None, None)
 

@@ -19,6 +19,26 @@ Equivalences to the reference:
 - prefetchBuffer / MagicQueue → AsyncDataSetIterator + device put.
 - workers(n) → mesh data-axis size.
 
+ELASTIC MESH SHRINK (the preemption PR): losing a device out of a
+pure data-parallel mesh mid-``fit`` no longer kills the run. On a
+device failure (the ``parallel.device`` chaos site's ``loss`` kind
+drills it; :meth:`ParallelWrapper.lose_device` is the programmatic
+entry) the wrapper takes a host snapshot at the step boundary (params
+are replicated over 'data', so every survivor holds a complete copy),
+rebuilds the mesh over the survivors at the largest power-of-two dp
+(dp=8 → dp=4), re-places params/opt-state, rescales the per-device
+batch split, and continues — counted as
+``elastic_mesh_shrinks_total`` and recorded by the flight recorder.
+Regrow is explicit (``wrapper.regrow()`` after capacity returns,
+counted as ``elastic_mesh_regrows_total``), never automatic: capacity
+coming back is an operator decision, not an event the step loop
+should react to. What is NOT preserved across a shrink: the
+dcn-compression error-feedback residual (per-device state — it is
+re-zeroed) and compiled executables (the step retraces for the new
+topology). Meshes that also shard 'model'/'pipe'/'seq' do not shrink
+— sharded state died with the device; recover via ElasticTrainer's
+checkpoint restart.
+
 Works with both executors: MultiLayerNetwork and ComputationGraph
 (GraphParallelWrapper alias keeps call sites explicit).
 """
@@ -33,9 +53,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import chaos
 from deeplearning4j_tpu.data.iterators import (AsyncDataSetIterator,
                                                DataSetIterator)
-from deeplearning4j_tpu.parallel.mesh import MeshSpec, build_mesh
+from deeplearning4j_tpu.parallel.mesh import (MeshSpec, build_mesh,
+                                              largest_pow2,
+                                              shrink_data_mesh)
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -110,6 +133,11 @@ class ParallelWrapper:
         self._seq_collapses = False   # set by _validate_seq_model
         self._seq_gspmd = False       # set by _validate_seq_model
         self._residual = None
+        # elastic bookkeeping: the dp the wrapper was built with (the
+        # regrow target) and the devices declared lost so far
+        self._initial_dp = self.mesh.shape.get("data", 1)
+        self._lost_devices: set = set()
+        self.mesh_shrinks = 0
 
     # ---- builder parity ----
     class Builder:
@@ -544,10 +572,11 @@ class ParallelWrapper:
 
         def place(a):
             sh = getattr(a, "sharding", None)
-            if (isinstance(sh, NamedSharding)
-                    and sh.mesh.shape == self.mesh.shape
-                    and tuple(sh.mesh.axis_names)
-                    == tuple(self.mesh.axis_names)):
+            if isinstance(sh, NamedSharding) and (
+                    sh.mesh is self.mesh   # fast path: placed by us
+                    or (sh.mesh.shape == self.mesh.shape
+                        and tuple(sh.mesh.axis_names)
+                        == tuple(self.mesh.axis_names))):
                 return a                 # already placed on this mesh
             return jax.device_put(a, repl)
 
@@ -560,74 +589,221 @@ class ParallelWrapper:
     def _shard_batch(self, batch):
         return jax.tree_util.tree_map(self._shard_leaf, batch)
 
-    def fit(self, iterator: DataSetIterator, *, epochs: int = 1):
-        from deeplearning4j_tpu.models.computation_graph import (
-            ComputationGraph)
+    # ---- elastic mesh shrink / regrow ----
+    def lose_device(self, index: int = -1) -> None:
+        """Declare the mesh device at ``index`` (into the current
+        mesh's flat device list) lost and shrink onto the survivors.
+        The programmatic twin of the ``parallel.device`` chaos site's
+        ``loss`` kind."""
+        devs = list(self.mesh.devices.flat)
+        self._shrink({devs[index % len(devs)]})
+
+    def _on_device_loss(self, fault) -> None:
+        devs = list(self.mesh.devices.flat)
+        idx = int(fault.args.get("device", len(devs) - 1))
+        self._shrink({devs[idx % len(devs)]})
+
+    def _rebuild_on(self, new_mesh) -> None:
+        """Move the model onto ``new_mesh``: host snapshot from the
+        current (replicated) placement, mesh swap, re-place, reset
+        every mesh-shaped compiled artifact (steps retrace; the
+        compression error-feedback residual is per-device state and
+        re-zeroes — the one thing a topology change does NOT
+        preserve)."""
+        m = self.model
+        host = jax.device_get((m.params, m.state, m.opt_state))
+        self.mesh = new_mesh
+        self._compressed_step = None
+        self._seq_step = None
+        self._residual = None
+        m.params = self._on_mesh(host[0])
+        m.state = self._on_mesh(host[1])
+        m.opt_state = self._on_mesh(host[2])
+        if self.dcn_compression is not None:
+            self._residual = self._init_residual()
+
+    def _shrink(self, lost: set) -> None:
+        old_dp = self.mesh.shape.get("data", 1)
+        # host snapshot at the step boundary: params/opt-state are
+        # replicated over 'data', so the survivors hold a complete
+        # copy of the last committed step — the lost device
+        # contributes nothing unique (shrink_data_mesh refuses
+        # meshes where that would not hold)
+        new_mesh = shrink_data_mesh(self.mesh, lost)
+        self._lost_devices |= set(lost)
+        self._rebuild_on(new_mesh)
+        self.mesh_shrinks += 1
+        new_dp = self.mesh.shape.get("data", 1)
+        logger.warning(
+            "device loss: mesh shrunk dp=%d -> dp=%d over %d "
+            "survivor(s); per-device batch split rescaled, training "
+            "continues (regrow is explicit via wrapper.regrow())",
+            old_dp, new_dp, new_dp)
+        self._account_elastic("elastic_mesh_shrinks_total",
+                              "mesh shrinks after a device loss",
+                              "mesh_shrink", old_dp, new_dp)
+
+    def regrow(self, devices=None):
+        """Explicitly rebuild the mesh after capacity returns:
+        ``devices`` (default ``jax.devices()``) at the original dp
+        (or the largest power of two that fits). Params/opt-state are
+        re-placed from the current host copy; compiled steps retrace.
+        Returns the new mesh."""
+        if devices is not None:
+            # an explicit device list is the operator vouching for
+            # every device in it — including ones previously
+            # declared lost
+            devices = list(devices)
+            self._lost_devices.clear()
+        else:
+            # default: everything visible EXCEPT devices recorded as
+            # lost — a sick device must not silently rejoin just
+            # because the runtime still enumerates it
+            devices = [d for d in jax.devices()
+                       if d not in self._lost_devices]
+        old_dp = self.mesh.shape.get("data", 1)
+        dp = min(self._initial_dp, largest_pow2(len(devices)))
+        self._rebuild_on(build_mesh(MeshSpec(data=dp), devices[:dp]))
+        logger.warning("mesh regrown dp=%d -> dp=%d", old_dp, dp)
+        self._account_elastic("elastic_mesh_regrows_total",
+                              "explicit mesh regrows after a shrink",
+                              "mesh_regrow", old_dp, dp)
+        return self.mesh
+
+    @staticmethod
+    def _account_elastic(counter: str, help: str, event: str,
+                         dp_from: int, dp_to: int) -> None:
+        try:
+            from deeplearning4j_tpu.observability.registry import (
+                safe_inc)
+            safe_inc(counter, help=help)
+        except Exception:
+            pass
+        try:
+            from deeplearning4j_tpu.observability import (
+                flight_recorder)
+            rec = flight_recorder.get_recorder()
+            if rec is not None:
+                rec.record(event, dp_from=dp_from, dp_to=dp_to)
+        except Exception:
+            pass
+
+    def _current_step(self):
+        """Resolve the compiled step for the CURRENT mesh/config —
+        consulted every batch, so a mid-fit shrink or regrow (which
+        nulls the cached step) can never leave a stale executable
+        running against a rebuilt mesh/residual. Cache hits are a
+        couple of attribute checks."""
         model = self.model
-        if model.params is None:
-            model.init()
-        is_graph = isinstance(model, ComputationGraph)
-        compressed = self.dcn_compression is not None
-        seq_parallel = self._seq_axis_size() > 1
-        if seq_parallel:
-            self._validate_seq_model()
+        if self._seq_axis_size() > 1:
             if self._seq_step is None:
+                self._validate_seq_model()
                 self._seq_step = (self._make_seq_gspmd_step()
                                   if self._seq_gspmd
                                   else self._make_seq_step())
-            step = self._seq_step
-        elif compressed:
+            return self._seq_step
+        if self.dcn_compression is not None:
             if self._compressed_step is None:
                 self._compressed_step = self._make_compressed_step()
-            step = self._compressed_step
-        else:
-            if model._jit_train_step is None:
-                model._jit_train_step = model._make_train_step()
-            step = model._jit_train_step
+            return self._compressed_step
+        if model._jit_train_step is None:
+            model._jit_train_step = model._make_train_step()
+        return model._jit_train_step
+
+    def _place_model(self):
+        """Put params/state/opt-state on this mesh (no-op for leaves
+        already placed there) and materialize the compression
+        residual."""
+        model = self.model
         model.params = self._on_mesh(model.params)
         model.state = self._on_mesh(model.state)
         model.opt_state = self._on_mesh(model.opt_state)
-        if compressed and self._residual is None:
+        if self.dcn_compression is not None and self._residual is None:
             self._residual = self._init_residual()
+
+    def _train_batch(self, ds) -> bool:
+        """One batch through the mesh step: chaos site, divisibility
+        trim, shard, device step, iteration listeners. Returns False
+        when the batch was dropped (fewer examples than devices)."""
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
+        model = self.model
+        is_graph = isinstance(model, ComputationGraph)
+        # chaos site: 'crash' raises (process death — the
+        # ElasticTrainer checkpoint-restart path), 'loss' simulates
+        # losing one mesh device — the wrapper shrinks and trains
+        # THIS batch on the survivors
+        f = chaos.step_fault("parallel.device")
+        if f is not None and f.kind == "loss":
+            self._on_device_loss(f)
+        # step AND ndata resolved after any shrink: the per-device
+        # split and the executable both follow the current mesh
+        step = self._current_step()
+        seq_parallel = self._seq_axis_size() > 1
+        compressed = self.dcn_compression is not None
+        ndata = self.mesh.shape.get("data", 1)
+        n = ds.num_examples()
+        if n % ndata:
+            if n < ndata:
+                logger.debug("dropping final batch of %d (< %d "
+                             "devices)", n, ndata)
+                return False
+            # truncate to a device-divisible count; repeating
+            # examples would bias the mean gradient
+            ds = _truncate_batch(ds, (n // ndata) * ndata)
+            n = ds.num_examples()
+        if is_graph:
+            batch = model._batch_tuple(model._as_multi(ds))
+        else:
+            batch = model._batch_tuple(ds)
+        batch = (self._shard_seq_batch(batch) if seq_parallel
+                 else self._shard_batch(batch))
+        if compressed:
+            (model.params, model.state, model.opt_state,
+             self._residual, loss) = step(
+                model.params, model.state, model.opt_state,
+                self._residual, batch, model._rng_key,
+                np.int32(model.iteration_count))
+        else:
+            model.params, model.state, model.opt_state, loss = \
+                step(model.params, model.state, model.opt_state,
+                     batch, model._rng_key,
+                     np.int32(model.iteration_count))
+        model.score_value = loss
+        for lst in model.listeners:
+            lst.iteration_done(model, model.iteration_count, loss, n)
+        model.iteration_count += 1
+        return True
+
+    def fit_batch(self, ds):
+        """Train exactly ONE batch on the mesh with NO epoch
+        bookkeeping — no epoch hooks, no ``epoch_count`` bump, no
+        prefetch thread. The ElasticTrainer integration point: the
+        trainer owns the epoch loop (and so the listeners' epoch
+        cadence and the checkpointed epoch counter); the wrapper owns
+        the mesh step."""
+        if self.model.params is None:
+            self.model.init()
+        # seq validation happens in _current_step on step-cache miss;
+        # repeating it per batch would walk the model every step
+        self._place_model()
+        self._train_batch(ds)
+        return self.model
+
+    def fit(self, iterator: DataSetIterator, *, epochs: int = 1):
+        model = self.model
+        if model.params is None:
+            model.init()
+        if self._seq_axis_size() > 1:
+            self._validate_seq_model()
+        self._place_model()
         it = AsyncDataSetIterator(iterator, self.prefetch) \
             if self.prefetch > 0 else iterator
-        ndata = self.mesh.shape.get("data", 1)
         for _ in range(epochs):
             for lst in model.listeners:
                 lst.on_epoch_start(model)
             for ds in it:
-                n = ds.num_examples()
-                if n % ndata:
-                    if n < ndata:
-                        logger.debug("dropping final batch of %d (< %d "
-                                     "devices)", n, ndata)
-                        continue
-                    # truncate to a device-divisible count; repeating
-                    # examples would bias the mean gradient
-                    ds = _truncate_batch(ds, (n // ndata) * ndata)
-                    n = ds.num_examples()
-                if is_graph:
-                    batch = model._batch_tuple(model._as_multi(ds))
-                else:
-                    batch = model._batch_tuple(ds)
-                batch = (self._shard_seq_batch(batch) if seq_parallel
-                         else self._shard_batch(batch))
-                if compressed:
-                    (model.params, model.state, model.opt_state,
-                     self._residual, loss) = step(
-                        model.params, model.state, model.opt_state,
-                        self._residual, batch, model._rng_key,
-                        np.int32(model.iteration_count))
-                else:
-                    model.params, model.state, model.opt_state, loss = \
-                        step(model.params, model.state, model.opt_state,
-                             batch, model._rng_key,
-                             np.int32(model.iteration_count))
-                model.score_value = loss
-                for lst in model.listeners:
-                    lst.iteration_done(model, model.iteration_count, loss,
-                                       n)
-                model.iteration_count += 1
+                self._train_batch(ds)
             for lst in model.listeners:
                 lst.on_epoch_end(model)
             model.epoch_count += 1

@@ -50,6 +50,24 @@ packages that loop:
   trainer start. The ``train.step`` chaos site fires right before
   each step (crash / hang / nan-poison drills).
 
+- ASYNC CHECKPOINTING (the preemption PR): with
+  ``async_checkpoint=True`` a save costs the train thread only a
+  device→host snapshot (``snapshot_model``) — serialization, zip,
+  CRC manifest and the atomic rename run on a single background
+  writer thread (one in-flight write; a newer save supersedes any
+  queued one). ``fit()`` exit, the SIGTERM grace path, and rollback
+  BARRIER on the writer, so durability guarantees are unchanged;
+  ``checkpoint_write_seconds{phase="blocked"|"total"}`` splits what
+  the train thread paid from what the write cost.
+- CHECKPOINTABLE ITERATOR STATE: iterators implementing the opt-in
+  ``state_dict()/load_state_dict()`` protocol (see
+  ``data/iterators.DataSetIterator``) resume by direct state restore
+  — no per-batch replay, and no deterministic-iterator requirement;
+  the fingerprint-replay fast-forward remains the fallback for
+  stateless iterators, and a replay that runs DRY now raises the
+  distinct "iterator shorter than checkpointed position" error
+  instead of blaming determinism.
+
 Works with both executors via the zip serializer.
 """
 
@@ -62,6 +80,7 @@ import logging
 import os
 import re
 import signal
+import sys
 import threading
 import time
 import zipfile
@@ -78,6 +97,103 @@ __all__ = ["ElasticTrainer"]
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.zip$")
 _TMP_RE = re.compile(r"ckpt_\d+\.zip\.tmp(\d+)$")
 _POS_ENTRY = "data_position.json"
+_ITSTATE_ENTRY = "iterator_state.json"
+
+# tmp files an async writer in THIS process is writing right now:
+# the stale-tmp sweep must not treat a live same-pid write as a leak
+# (a second trainer constructed in-process — the restart-in-process
+# pattern — would otherwise delete it mid-write)
+_LIVE_TMPS: set = set()
+_LIVE_TMPS_LOCK = threading.Lock()
+
+
+class _CheckpointWriter:
+    """Single background checkpoint writer: at most ONE write in
+    flight, with a depth-1 coalescing queue — a save submitted while
+    a write is in flight SUPERSEDES any save still queued (the newest
+    state is the only one worth persisting; an old queued snapshot is
+    strictly stale). ``barrier()`` waits until both the in-flight and
+    the queued write have drained and re-raises anything a write
+    raised — the fit-exit / SIGTERM-grace / rollback sync point that
+    turns "submitted" into "durable"."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending = None          # the (single) queued job
+        self._busy = False
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self.superseded = 0           # queued saves dropped by newer
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def submit(self, job) -> bool:
+        """Queue ``job`` (a thunk); returns True when it replaced an
+        older queued job. Raises any error a PREVIOUS write left
+        behind, so a dying disk surfaces at the next save, not only
+        at fit exit."""
+        with self._cond:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            if self._closed:
+                raise RuntimeError("checkpoint writer is closed")
+            replaced = self._pending is not None
+            if replaced:
+                self.superseded += 1
+            self._pending = job
+            self._cond.notify_all()
+        return replaced
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None:
+                    return                      # closed and drained
+                job, self._pending = self._pending, None
+                self._busy = True
+            try:
+                job()
+            except BaseException as e:          # surfaced at barrier
+                with self._cond:
+                    self._error = e
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def idle(self) -> bool:
+        with self._cond:
+            return not self._busy and self._pending is None
+
+    def barrier(self, timeout: Optional[float] = None) -> None:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while self._busy or self._pending is not None:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        "checkpoint writer still busy after "
+                        f"{timeout}s")
+                self._cond.wait(remaining)
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        with self._cond:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
 
 
 def _hash_array(h, a) -> None:
@@ -133,7 +249,17 @@ class ElasticTrainer:
                  save_every: int = 100, keep: int = 3,
                  max_rollbacks: int = 5, heal_after: Optional[int] = None,
                  handle_sigterm: bool = True, wrapper=None,
-                 lr_drop_on_rollback: Optional[float] = None):
+                 lr_drop_on_rollback: Optional[float] = None,
+                 async_checkpoint: bool = False):
+        # async_checkpoint: take checkpoints OFF the train thread —
+        # save_checkpoint snapshots params/opt-state device→host at
+        # the step boundary (cheap) and hands serialization + zip +
+        # manifest + atomic rename to a single background writer
+        # (one in-flight write; a newer save supersedes a queued
+        # one). fit() exit, the SIGTERM grace path, and rollback all
+        # barrier on the writer, so "returned from fit" still means
+        # "durable". checkpoint_write_seconds{phase=blocked|total}
+        # makes the win measurable.
         # lr_drop_on_rollback: multiply the configured learning rate
         # by this factor (< 1) on every rollback — the standard
         # "restart from the last good checkpoint with a cooler LR"
@@ -155,6 +281,10 @@ class ElasticTrainer:
                            else max(1, heal_after))
         self.handle_sigterm = handle_sigterm
         self.lr_drop_on_rollback = lr_drop_on_rollback
+        self.async_checkpoint = async_checkpoint
+        self._writer_obj: Optional[_CheckpointWriter] = None
+        self._active_iterator = None   # the fit() iterator, for state
+        self._it_state: Optional[dict] = None  # restored, pending apply
         self.rollbacks = 0           # current incident (decays)
         self.total_rollbacks = 0     # lifetime (never decays)
         self._healthy_streak = 0
@@ -193,6 +323,7 @@ class ElasticTrainer:
             if not m:
                 continue
             pid = int(m.group(1))
+            path = os.path.join(self.dir, f)
             if pid != os.getpid():
                 try:
                     os.kill(pid, 0)      # probe: is the owner alive?
@@ -201,7 +332,11 @@ class ElasticTrainer:
                     pass                 # dead owner: stale for sure
                 except OSError:
                     continue             # EPERM etc.: assume alive
-            path = os.path.join(self.dir, f)
+            else:
+                with _LIVE_TMPS_LOCK:
+                    if path in _LIVE_TMPS:
+                        continue         # another trainer's writer is
+                #                          mid-write IN THIS process
             try:
                 os.remove(path)
                 logger.info("swept stale checkpoint tmp %s", path)
@@ -209,13 +344,20 @@ class ElasticTrainer:
                 pass
 
     def save_checkpoint(self):
-        from deeplearning4j_tpu.util.model_serializer import write_model
+        """Snapshot + persist the current generation. Sync mode
+        returns the final path; async mode snapshots device→host,
+        hands the write to the background writer and returns None
+        (the path is knowable only after the rename — barrier via
+        :meth:`checkpoint_barrier` when durability matters NOW).
+        ``checkpoint_write_seconds{phase="blocked"}`` records what
+        this call cost the train thread either way."""
+        from deeplearning4j_tpu.util.model_serializer import (
+            snapshot_model)
+        t0 = time.perf_counter()
         it = self.model.iteration_count
-        final = os.path.join(self.dir, f"ckpt_{it}.zip")
-        tmp = final + f".tmp{os.getpid()}"
         # the data position rides in the same zip: one atomic artifact,
         # no model/position skew after a mid-write preemption; passing
-        # it through write_model (not appending after) puts it under
+        # it through the writer (not appending after) puts it under
         # the integrity manifest's CRC too
         pos = json.dumps(
             {"epoch": self._epoch, "batch": self._batch,
@@ -224,10 +366,73 @@ class ElasticTrainer:
              # rollback to rediscover a deterministic poison batch
              "skip": sorted(list(p) for p in self._skip),
              "fp_chain": self._fp_chain})
+        extra = {_POS_ENTRY: pos}
+        it_state = self._iterator_state()
+        if it_state is not None:
+            extra[_ITSTATE_ENTRY] = json.dumps(it_state)
+        snap = snapshot_model(self.model)
+        if self.async_checkpoint:
+            # epoch/batch bound NOW: the writer runs later, when the
+            # train thread has moved on
+            self._writer().submit(
+                lambda e=self._epoch, b=self._batch:
+                self._write_generation(snap, extra, it, e, b))
+            self._observe_write("blocked",
+                                time.perf_counter() - t0)
+            return None
+        path = self._write_generation(snap, extra, it, self._epoch,
+                                      self._batch)
+        self._observe_write("blocked", time.perf_counter() - t0)
+        return path
+
+    def _iterator_state(self) -> Optional[dict]:
+        """The active iterator's checkpointable state — persisted
+        only when its cursor agrees with the trainer's batch ordinal
+        (right after a rollback the iterator still sits at the crash
+        position while the trainer has been restored; persisting that
+        skew would corrupt a later resume — omit it and let that one
+        generation fall back to replay)."""
+        # a rollback re-checkpoints BEFORE the fit loop repositions
+        # the iterator: the state restored from the rolled-back-to
+        # zip (pending in _it_state) is the truthful position then —
+        # persisting it keeps even that generation state-resumable
+        if (self._it_state is not None
+                and int(self._it_state.get("cursor", -1))
+                == self._batch):
+            return self._it_state
+        src = self._active_iterator
+        sd = getattr(src, "state_dict", None)
+        if not callable(sd):
+            return None
         try:
-            write_model(self.model, tmp,
-                        extra_entries={_POS_ENTRY: pos})
-            os.replace(tmp, final)      # atomic on POSIX
+            st = sd()
+        except Exception:
+            logger.exception("iterator state_dict() failed; "
+                             "checkpoint will resume via replay")
+            return None
+        if st is None or int(st.get("cursor", -1)) != self._batch:
+            return None
+        return st
+
+    def _write_generation(self, snap, extra, it, epoch, batch):
+        """Serialize + zip + manifest + atomic rename + prune: the
+        shared tail of sync and async saves (async runs it on the
+        writer thread). ``checkpoint_write_seconds{phase="total"}``
+        records the full cost wherever it runs."""
+        final = os.path.join(self.dir, f"ckpt_{it}.zip")
+        tmp = final + f".tmp{os.getpid()}"
+        t0 = time.perf_counter()
+        from deeplearning4j_tpu.util.model_serializer import (
+            write_snapshot)
+        with _LIVE_TMPS_LOCK:
+            _LIVE_TMPS.add(tmp)
+        try:
+            try:
+                write_snapshot(snap, tmp, extra_entries=extra)
+                os.replace(tmp, final)      # atomic on POSIX
+            finally:
+                with _LIVE_TMPS_LOCK:
+                    _LIVE_TMPS.discard(tmp)
         except OSError as e:
             # ENOSPC / quota / dying disk mid-write: a missed
             # checkpoint must not kill the run — clean the partial
@@ -243,11 +448,16 @@ class ElasticTrainer:
                            "(%r); continuing on the previous "
                            "generation", it, e)
             return None
+        self._observe_write("total", time.perf_counter() - t0)
         # mark live trainer checkpoints protected so a co-attached
         # CheckpointListener's keep_last pruning can never delete the
         # file a rollback is about to restore
         from deeplearning4j_tpu.train import listeners as _listeners
         _listeners.protect_checkpoint(final)
+        # pruning runs on whichever thread wrote the generation (the
+        # writer thread in async mode — the only thread touching
+        # checkpoint files there, so keep-pruning can never race an
+        # in-flight tmp); _CKPT_RE matches finals only, never tmps
         for _, path in self._ckpts()[:-self.keep]:
             try:
                 os.remove(path)
@@ -255,8 +465,41 @@ class ElasticTrainer:
                 pass
             _listeners.unprotect_checkpoint(path)
         logger.info("checkpoint at iteration %d (epoch %d, batch %d) "
-                    "-> %s", it, self._epoch, self._batch, final)
+                    "-> %s", it, epoch, batch, final)
         return final
+
+    def _writer(self) -> _CheckpointWriter:
+        if self._writer_obj is None:
+            self._writer_obj = _CheckpointWriter()
+        return self._writer_obj
+
+    def checkpoint_barrier(self,
+                           timeout: Optional[float] = None) -> None:
+        """Wait until no checkpoint write is queued or in flight;
+        re-raises writer errors. No-op in sync mode."""
+        if self._writer_obj is not None:
+            self._writer_obj.barrier(timeout)
+
+    def close(self) -> None:
+        """Drain and stop the background writer (if any)."""
+        if self._writer_obj is not None:
+            w, self._writer_obj = self._writer_obj, None
+            w.close()
+
+    @staticmethod
+    def _observe_write(phase: str, seconds: float) -> None:
+        try:
+            from deeplearning4j_tpu.observability.registry import (
+                REGISTRY)
+            REGISTRY.histogram(
+                "checkpoint_write_seconds",
+                help="checkpoint write time: phase=blocked is what "
+                     "the train thread paid (snapshot + handoff in "
+                     "async mode; the whole write in sync mode), "
+                     "phase=total the full serialize+zip+rename",
+                labels={"phase": phase}).record(seconds)
+        except Exception:
+            pass
 
     @staticmethod
     def _count(name: str, help: str) -> None:
@@ -274,9 +517,12 @@ class ElasticTrainer:
         m.opt_state = loaded.opt_state
         m.iteration_count = loaded.iteration_count
         m.epoch_count = loaded.epoch_count
+        self._it_state = None
         try:
             with zipfile.ZipFile(path, "r") as z:
                 pos = json.loads(z.read(_POS_ENTRY))
+                if _ITSTATE_ENTRY in z.namelist():
+                    self._it_state = json.loads(z.read(_ITSTATE_ENTRY))
             self._epoch = int(pos["epoch"])
             self._batch = int(pos["batch"])
             # MERGE the persisted skip set (a rollback restores an
@@ -287,6 +533,7 @@ class ElasticTrainer:
         except (KeyError, json.JSONDecodeError):
             # pre-position checkpoint (older format): restart the epoch
             self._epoch, self._batch = 0, 0
+            self._it_state = None
 
     def _quarantine(self, path: str, err: BaseException) -> None:
         """Rename a checkpoint that failed verification/restore to
@@ -375,9 +622,44 @@ class ElasticTrainer:
             logger.info("fit() on a non-main thread: SIGTERM handler "
                         "not installed (signal.signal would raise)")
         try:
+            self._active_iterator = iterator
             if self.latest_checkpoint() is None:
                 self.save_checkpoint()       # iteration-0 restart point
             while self._epoch < target and not self._stop_requested:
+                # STATEFUL RESUME: an iterator implementing the
+                # state_dict/load_state_dict protocol is repositioned
+                # directly to the checkpointed cursor — O(1)-ish, no
+                # batch replay, and no deterministic-iterator
+                # requirement (the state pins the epoch's rng). The
+                # fingerprint-replay fast-forward below remains the
+                # fallback for stateless iterators.
+                state_resumed = False
+                if (self._batch and self._it_state is not None
+                        and hasattr(iterator, "load_state_dict")):
+                    try:
+                        iterator.load_state_dict(self._it_state)
+                        state_resumed = True
+                        logger.info(
+                            "iterator state restored (epoch %d, "
+                            "cursor %d): resuming without replay",
+                            self._epoch, self._batch)
+                    except NotImplementedError:
+                        pass
+                elif hasattr(iterator, "load_state_dict"):
+                    # PIN the iterator's epoch to the trainer's own
+                    # counter: the shuffle permutation becomes a pure
+                    # function of (seed, trainer epoch), identical in
+                    # an uninterrupted run and in any restart — a
+                    # fresh process's iterator would otherwise count
+                    # resets from zero and replay old permutations
+                    # (epoch-boundary restarts, replay after a
+                    # rollback-skewed save)
+                    try:
+                        iterator.load_state_dict(
+                            {"cursor": 0, "epoch": self._epoch + 1})
+                    except NotImplementedError:
+                        pass
+                self._it_state = None
                 if hasattr(iterator, "reset"):
                     iterator.reset()
                 it = iter(iterator)
@@ -387,13 +669,31 @@ class ElasticTrainer:
                 # chain CHECKS that contract over EVERY replayed
                 # ordinal (any reorder or shortfall mismatches)
                 fwd_chain = ""
-                for k in range(self._batch):
+                replayed = 0
+                for k in range(0 if state_resumed else self._batch):
                     ds = next(it, None)
                     if ds is None:
                         fwd_chain = None
                         break
+                    replayed = k + 1
                     fwd_chain = _chain(fwd_chain, _fingerprint(ds))
-                if (self._batch and self._fp_chain
+                if fwd_chain is None:
+                    # a shortfall is ITS OWN failure mode — the
+                    # iterator ran dry before reaching the
+                    # checkpointed position (dataset shrank, wrong
+                    # file, truncated shard); calling that
+                    # "non-deterministic" sends the operator
+                    # debugging shuffle seeds instead of the data
+                    raise RuntimeError(
+                        f"iterator shorter than checkpointed "
+                        f"position: the resume fast-forward for "
+                        f"epoch {self._epoch} needed {self._batch} "
+                        f"batches but the iterator yielded only "
+                        f"{replayed} — the data source shrank (or "
+                        f"the wrong one was passed) since the "
+                        f"checkpoint was written")
+                if (not state_resumed and self._batch
+                        and self._fp_chain
                         and fwd_chain != self._fp_chain):
                     raise RuntimeError(
                         f"iterator is not deterministic: the "
@@ -403,8 +703,16 @@ class ElasticTrainer:
                         f"fast-forward requires a same-order iterator "
                         f"(disable shuffling or seed it per-epoch)")
                 rolled_back = False
-                for ds in it:
+                while True:
+                    # check BEFORE pulling: a batch fetched after the
+                    # stop request would never train, but it would
+                    # advance a stateful iterator's cursor past the
+                    # trainer's position and cost the grace
+                    # checkpoint its iterator state
                     if self._stop_requested:
+                        break
+                    ds = next(it, None)
+                    if ds is None:
                         break
                     self._fp_chain = _chain(self._fp_chain,
                                             _fingerprint(ds))
@@ -418,7 +726,12 @@ class ElasticTrainer:
                     ds = self._chaos_step(ds)
                     try:
                         if self.wrapper is not None:
-                            self.wrapper.fit([ds])
+                            # fit_batch, not fit([ds]): the trainer
+                            # owns the epoch loop — the wrapper must
+                            # not bump epoch_count or fire epoch
+                            # hooks per batch (and must not spin a
+                            # prefetch thread per single-batch list)
+                            self.wrapper.fit_batch(ds)
                         else:
                             model.fit(ds)
                     except Exception as e:
@@ -453,6 +766,11 @@ class ElasticTrainer:
                 self._batch = 0
                 self._fp_chain = ""
             if self._stop_requested:
+                # the preemption grace protocol: the snapshot is
+                # taken HERE (immediately), the persist rides the
+                # background writer (async mode), and the barrier in
+                # the finally below guarantees durability before fit
+                # returns — signal → snapshot → persist → clean stop
                 self.save_checkpoint()
                 logger.warning("stop requested (preemption?): "
                                "checkpointed at iteration %d",
@@ -460,11 +778,33 @@ class ElasticTrainer:
         finally:
             if prev_handler is not None:
                 signal.signal(signal.SIGTERM, prev_handler)
+            self._active_iterator = None
+            # returning from fit() means every submitted checkpoint
+            # is durable (and surfaces any write error — a crash
+            # injected into the writer thread re-raises here, dying
+            # exactly as the preempted process would); when fit is
+            # ALREADY unwinding an exception, the writer error must
+            # not mask it
+            if sys.exc_info()[0] is None:
+                self.checkpoint_barrier()
+            else:
+                try:
+                    self.checkpoint_barrier()
+                except BaseException:
+                    logger.exception("checkpoint writer failed "
+                                     "during fit-exception unwind")
         return self
 
     @staticmethod
     def _chaos_step(ds):
         f = chaos.step_fault("train.step")
+        if f is not None and f.kind == "sigterm":
+            # a REAL preemption drill: deliver SIGTERM to the process
+            # at the seeded ordinal. Under fit()'s handler this takes
+            # the grace path (snapshot → persist → clean stop); with
+            # no handler installed it kills the process, exactly like
+            # the cloud scheduler would
+            os.kill(os.getpid(), signal.SIGTERM)
         if f is not None and f.kind == "nan":
             # poison one element of this batch's features (the
             # nan_injection drill, plan-driven): copy-on-write so the
@@ -499,6 +839,10 @@ class ElasticTrainer:
         # the batch just consumed (ordinal _batch - 1) produced the
         # non-finite loss: skip it on replay, replay everything else
         self._skip.add((self._epoch, self._batch - 1))
+        # an async save may still be in flight — it IS the newest
+        # generation; restoring before it lands would silently roll
+        # back further than necessary
+        self.checkpoint_barrier()
         # generation-by-generation fallback: a corrupt newest
         # checkpoint must cost one quarantine, not the run
         path = self._restore_latest_intact()

@@ -41,6 +41,7 @@ from deeplearning4j_tpu import chaos
 
 __all__ = ["write_model", "restore_model", "restore_normalizer",
            "save_pytree_npz", "load_pytree_npz",
+           "snapshot_model", "write_snapshot",
            "verify_checkpoint", "CheckpointIntegrityError"]
 
 _FORMAT = 1
@@ -91,30 +92,50 @@ def load_pytree_npz(data: bytes, template) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def write_model(model, path: str, *, save_updater: bool = True,
-                normalizer: Optional[dict] = None,
-                extra_entries: Optional[Dict[str, Any]] = None) -> None:
-    """model: MultiLayerNetwork or ComputationGraph.
+def snapshot_model(model, *, save_updater: bool = True,
+                   normalizer: Optional[dict] = None) -> Dict[str, Any]:
+    """Device→host snapshot of everything :func:`write_model`
+    persists, decoupled from serialization so a background writer can
+    do the expensive part off the train thread.
 
-    ``extra_entries`` (name -> str/bytes) ride inside the same zip —
-    and inside the integrity manifest — so sidecar payloads like
-    ElasticTrainer's data position are covered by the same CRC check
-    as the weights (appending after the fact would not be)."""
-    meta = {
-        "format_version": _FORMAT,
-        "network_type": type(model).__name__,
-        "iteration_count": int(model.iteration_count),
-        "epoch_count": int(model.epoch_count),
-        "normalizer": normalizer,
+    The cost on the calling (train) thread is one ``jax.device_get``
+    per tree plus a config-JSON render — no npz packing, no DEFLATE,
+    no disk. The returned dict is self-contained: later mutation of
+    the model (more train steps, an LR drop rebuilding the optimizer)
+    cannot leak into a write already in flight."""
+    return {
+        "conf_json": model.conf.to_json(),
+        "params": jax.device_get(model.params),
+        "state": jax.device_get(model.state),
+        "opt_state": (jax.device_get(model.opt_state)
+                      if save_updater and model.opt_state is not None
+                      else None),
+        "meta": {
+            "format_version": _FORMAT,
+            "network_type": type(model).__name__,
+            "iteration_count": int(model.iteration_count),
+            "epoch_count": int(model.epoch_count),
+            "normalizer": normalizer,
+        },
     }
+
+
+def write_snapshot(snap: Dict[str, Any], path: str, *,
+                   extra_entries: Optional[Dict[str, Any]] = None
+                   ) -> None:
+    """Serialize a :func:`snapshot_model` dict to a checkpoint zip:
+    npz packing + DEFLATE + CRC32 manifest + the ``checkpoint.write``
+    chaos site. Runs on whatever thread calls it — this is the half
+    ElasticTrainer's async writer takes off the critical path."""
     entries: Dict[str, bytes] = {
-        "configuration.json": model.conf.to_json().encode(),
-        "coefficients.npz": save_pytree_npz(model.params),
-        "state.npz": save_pytree_npz(model.state),
+        "configuration.json": snap["conf_json"].encode(),
+        "coefficients.npz": save_pytree_npz(snap["params"]),
+        "state.npz": save_pytree_npz(snap["state"]),
     }
-    if save_updater and model.opt_state is not None:
-        entries["updater_state.npz"] = save_pytree_npz(model.opt_state)
-    entries["metadata.json"] = json.dumps(meta).encode()
+    if snap["opt_state"] is not None:
+        entries["updater_state.npz"] = save_pytree_npz(
+            snap["opt_state"])
+    entries["metadata.json"] = json.dumps(snap["meta"]).encode()
     for name, data in (extra_entries or {}).items():
         entries[name] = data if isinstance(data, bytes) \
             else str(data).encode()
@@ -129,6 +150,21 @@ def write_model(model, path: str, *, save_updater: bool = True,
     # just written — restore-side verification must catch whatever
     # this does
     chaos.file_fault("checkpoint.write", path)
+
+
+def write_model(model, path: str, *, save_updater: bool = True,
+                normalizer: Optional[dict] = None,
+                extra_entries: Optional[Dict[str, Any]] = None) -> None:
+    """model: MultiLayerNetwork or ComputationGraph.
+
+    ``extra_entries`` (name -> str/bytes) ride inside the same zip —
+    and inside the integrity manifest — so sidecar payloads like
+    ElasticTrainer's data position are covered by the same CRC check
+    as the weights (appending after the fact would not be)."""
+    write_snapshot(
+        snapshot_model(model, save_updater=save_updater,
+                       normalizer=normalizer),
+        path, extra_entries=extra_entries)
 
 
 def verify_checkpoint(path: str) -> dict:
