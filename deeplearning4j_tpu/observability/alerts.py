@@ -234,24 +234,38 @@ class AlertManager:
 
     # ------------------------------------------------------------------
     def start(self, interval_s: float = 5.0) -> "AlertManager":
-        if self._thread is not None:
-            return self
-        self._stop.clear()
+        # each loop generation gets its OWN stop event, captured by
+        # the closure: a shared event that start() clears could be
+        # cleared before the previous (stopping) loop has observed
+        # it, orphaning that loop with no handle
+        stop = threading.Event()
 
         def loop():
-            while not self._stop.wait(interval_s):
+            while not stop.wait(interval_s):
                 try:
                     self.evaluate()
                 except Exception:
                     logger.exception("alert evaluation failed")
 
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name="alert-manager")
-        self._thread.start()
+        # check-then-spawn under the lock: two racing start() calls
+        # must not each launch an evaluation loop (every on_fire
+        # callback would fire twice) — found by graftlint GL004
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop = stop
+            self._thread = threading.Thread(target=loop, daemon=True,
+                                            name="alert-manager")
+            self._thread.start()
         return self
 
     def stop(self) -> None:
-        self._stop.set()
-        t, self._thread = self._thread, None
-        if t is not None:
-            t.join(timeout=5.0)
+        # the flag must flip under the SAME lock as the thread swap:
+        # set outside, a racing start() could swap in a fresh event
+        # between our set and our swap
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        if t is not None:                   # join OUTSIDE the lock:
+            t.join(timeout=5.0)             # the loop's evaluate()
+        #                                     briefly takes _lock

@@ -273,13 +273,33 @@ class ModelServer:
                     logger.exception("serving error")
                     self._send(500, {"error": str(e)})
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port),
-                                          Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name="model-server")
-        self._thread.start()
+        # cheap pre-check before binding the socket: a second start()
+        # on a live server must not try to re-bind its own port
+        with self._lock:
+            if self._draining.is_set():
+                raise ServerClosedError(
+                    "server was stopped; not starting listener")
+            if self._httpd is not None:
+                return self
+        httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        # publish under the lock so a concurrent stop() either sees
+        # None or the live server, and re-check draining there: a
+        # stop() that already returned must not leave this listener
+        # running ownerless. Double start() is idempotent.
+        with self._lock:
+            if self._draining.is_set():
+                httpd.server_close()
+                raise ServerClosedError(
+                    "server was stopped; not starting listener")
+            if self._httpd is not None:
+                httpd.server_close()
+                return self
+            self._httpd = httpd
+            self.port = httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=httpd.serve_forever, daemon=True,
+                name="model-server")
+            self._thread.start()
         logger.info("model server on http://%s:%d/", self.host,
                     self.port)
         return self
@@ -372,7 +392,12 @@ class ModelServer:
         for t in threads:
             t.join(timeout + 10.0)
         ok = all(oks.get(b, False) for b in backends)
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd = None
+        # swap under the lock: two racing stop() calls must not both
+        # pass the None test (the loser would call shutdown() on a
+        # dead server or on None) — found by graftlint GL004; the
+        # blocking shutdown() itself runs outside the lock
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
         return ok

@@ -1,0 +1,25 @@
+"""GL001 golden NEGATIVE fixture: pure traced code plus host side
+effects that live legitimately OUTSIDE the jit boundary."""
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def pure_step(params, batch, key):
+    noise = jax.random.normal(key, batch.shape)   # device RNG: fine
+    jax.debug.print("loss {l}", l=jnp.sum(batch))  # sanctioned
+    return params + batch * noise
+
+
+def fit(params, batches, key):
+    t0 = time.time()                      # host side: fine
+    for b in batches:
+        key, sub = jax.random.split(key)
+        params = pure_step(params, b, sub)
+    logger.info("fit took %.3fs", time.time() - t0)
+    return params

@@ -1,0 +1,54 @@
+"""GL001 golden POSITIVE fixture: every flavour of host side effect
+inside traced code. Never imported — parsed only."""
+import functools
+import logging
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+logger = logging.getLogger(__name__)
+metrics_registry = object()
+
+
+@jax.jit
+def decorated_step(params, batch):
+    t0 = time.time()                       # GL001: host clock
+    noise = random.random()                # GL001: host RNG
+    print("tracing", t0)                   # GL001: print
+    logger.info("stepping")                # GL001: logging
+    return params + batch * noise
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def partial_decorated(params, batch):
+    metrics_registry.inc("steps_total")    # GL001: metrics mutation
+    return params + batch
+
+
+def plain_body(carry, x):
+    time.sleep(0.01)                       # GL001: traced via scan
+    return carry + x, x
+
+
+def run_scan(xs):
+    return lax.scan(plain_body, 0.0, xs)
+
+
+def aliased_and_wrapped(xs):
+    body = plain_helper                    # alias resolution
+    fast = jax.jit(body)
+    return fast(xs)
+
+
+def plain_helper(xs):
+    counter = 0
+
+    def bump(v):
+        nonlocal counter                   # GL001: nonlocal in trace
+        counter += 1
+        return v
+
+    return bump(jnp.sum(xs))

@@ -1,0 +1,14 @@
+"""GL001 SUPPRESSED fixture: the offense is acknowledged inline."""
+import time
+
+import jax
+
+
+@jax.jit
+def step_with_trace_stamp(params, batch):
+    # deliberate: trace-time build stamp, constant-folded by design
+    # graftlint: disable=GL001
+    built_at = time.time()
+    del built_at
+    stamp = time.time()  # graftlint: disable=GL001
+    return params + batch + 0.0 * stamp

@@ -1,0 +1,49 @@
+"""GL002 golden NEGATIVE fixture: the sanctioned versions of each
+pattern."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def pow2_bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def kernel(x, n):
+    return jnp.where(x > 0, x * n, x - n)   # device select, no branch
+
+
+@jax.jit
+def shape_static_branch(x, mask=None):
+    if mask is not None:                    # None test: static, fine
+        x = x * mask
+    if x.shape[0] > 8:                      # shape test: static, fine
+        return jnp.sum(x)
+    return x
+
+
+def call_sites(batches, x):
+    out = x
+    for b in batches:
+        out = kernel(out, pow2_bucket(b.shape[0]))   # bucketed: fine
+    return out
+
+
+_jitted = jax.jit(kernel)                   # module level, not a loop
+
+
+class BucketKeyed:
+    def __init__(self):
+        self._program_cache = {}
+
+    def run(self, x):
+        key = pow2_bucket(x.shape[0])       # bucketed key: fine
+        prog = self._program_cache.get(key)
+        if prog is None:
+            prog = self._program_cache[key] = jax.jit(lambda a: a + 1)
+        return prog(x)

@@ -1,0 +1,43 @@
+"""GL002 golden POSITIVE fixture: recompile hazards of every
+sub-check."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,),
+                   static_argnames=("tag",))
+def kernel(x, n, *, tag="k"):
+    if x > 0:                      # GL002: Python branch on traced x
+        return x * n
+    return x - n
+
+
+def call_sites(batches, x):
+    for b in batches:
+        # GL002: static arg fed straight from a data shape
+        y = kernel(x, b.shape[0])
+        # GL002: f-string static arg — unbounded executable cache
+        z = kernel(x, 4, tag=f"bucket-{b.shape[0]}")
+    return y + z
+
+
+def jit_per_iteration(fns, x):
+    outs = []
+    for f in fns:
+        jf = jax.jit(f)            # GL002: jit() inside a loop
+        outs.append(jf(x))
+    return outs
+
+
+class ShapeKeyed:
+    def __init__(self):
+        self._program_cache = {}
+
+    def run(self, x):
+        prog = self._program_cache.get(x.shape)
+        if prog is None:
+            # GL002: cache keyed on a raw shape
+            prog = self._program_cache[x.shape] = jax.jit(
+                lambda a: a + 1)
+        return prog(x)

@@ -1,0 +1,15 @@
+"""GL002 SUPPRESSED fixture."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def kernel(x, n):
+    return x * n
+
+
+def one_off(x, b):
+    # this tool runs once per process; the single recompile is paid
+    # deliberately
+    return kernel(x, b.shape[0])  # graftlint: disable=GL002

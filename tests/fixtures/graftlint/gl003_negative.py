@@ -1,0 +1,27 @@
+"""GL003 golden NEGATIVE fixture: the rebinding idiom, and donated
+names that are never touched again."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def train_step(params, opt_state, batch):
+    return params + batch, opt_state
+
+
+def good_fit(params, opt_state, batches):
+    losses = []
+    for batch in batches:
+        # the donated names are rebound by the call's own assignment
+        params, opt_state = train_step(params, opt_state, batch)
+        losses.append(jnp.sum(params))   # reads the NEW buffer: fine
+    return params, opt_state, losses
+
+
+def use_before_donation(params, batch):
+    norm = jnp.sum(params)               # read BEFORE donating: fine
+    step = jax.jit(lambda p, b: p + b, donate_argnums=(0,))
+    out = step(params, batch)
+    return out, norm

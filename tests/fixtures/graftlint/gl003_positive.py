@@ -1,0 +1,28 @@
+"""GL003 golden POSITIVE fixture: buffers read after donation."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def train_step(params, opt_state, batch):
+    return params + batch, opt_state
+
+
+def bad_fit(params, opt_state, batches):
+    for batch in batches:
+        new_params, new_opt = train_step(params, opt_state, batch)
+        # GL003: params/opt_state were donated but NOT rebound
+        loss = jnp.sum(params)          # use-after-donation
+        norm = jnp.sum(opt_state)       # use-after-donation
+        params, opt_state = new_params, new_opt
+    return loss + norm
+
+
+def bad_conditional(params, batch, debug):
+    step = jax.jit(lambda p, b: p + b, donate_argnums=(0,))
+    out = step(params, batch)
+    if debug:
+        print(jnp.sum(params))          # GL003: may-use after donate
+    return out

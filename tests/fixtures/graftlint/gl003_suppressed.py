@@ -1,0 +1,11 @@
+"""GL003 SUPPRESSED fixture."""
+import jax
+import jax.numpy as jnp
+
+
+def checked_replay(params, batch):
+    step = jax.jit(lambda p, b: p + b, donate_argnums=(0,))
+    out = step(params, batch)
+    # CPU backend ignores donation; this debug path never runs on TPU
+    dbg = jnp.sum(params)  # graftlint: disable=GL003
+    return out, dbg

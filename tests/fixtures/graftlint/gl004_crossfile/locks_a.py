@@ -1,0 +1,12 @@
+"""GL004 cross-file fixture, module A: defines two module-level
+locks and takes them A-then-B."""
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def a_then_b():
+    with LOCK_A:
+        with LOCK_B:
+            return 1

@@ -1,0 +1,11 @@
+"""GL004 cross-file fixture, module B: imports module A's locks and
+takes them in the OPPOSITE order — the cross-module deadlock the
+acquisition graph must connect."""
+from tests.fixtures.graftlint.gl004_crossfile.locks_a import (LOCK_A,
+                                                              LOCK_B)
+
+
+def b_then_a():
+    with LOCK_B:                   # GL004: inverted vs locks_a.py
+        with LOCK_A:
+            return 2

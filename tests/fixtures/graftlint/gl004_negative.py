@@ -1,0 +1,52 @@
+"""GL004 golden NEGATIVE fixture: consistent order, locked helper
+convention, init-time writes, RLock re-entry."""
+import threading
+
+
+class Disciplined:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.count = 0                 # __init__: pre-thread, fine
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _bump(self):
+        # helper: every intra-class call site holds _a -> counts as
+        # lock-held (the fixpoint), so this is NOT a bare write
+        self.count += 1
+
+    def _run(self):
+        while True:
+            with self._a:              # always a -> b
+                with self._b:
+                    self._bump()
+
+    def poke(self):
+        with self._a:                  # same order everywhere
+            with self._b:
+                self._bump()
+
+
+class ReentrantFine:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._t = threading.Thread(target=self.outer, daemon=True)
+
+    def outer(self):
+        with self._lock:
+            with self._lock:           # RLock: re-entry is the point
+                return 1
+
+
+class GuardedStart:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        with self._lock:               # check-then-act under lock
+            if self._thread is None:
+                self._thread = threading.Thread(target=lambda: None)
+                self._thread.start()
+        return self

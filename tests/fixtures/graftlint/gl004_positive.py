@@ -1,0 +1,45 @@
+"""GL004 golden POSITIVE fixture: lock-order inversion, non-reentrant
+re-acquire, sometimes-locked attribute, unlocked check-then-act."""
+import threading
+
+
+class OrderInversion:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.count = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._a:              # GL004: a -> b here ...
+                with self._b:
+                    self.count += 1
+
+    def poke(self):
+        with self._b:                  # GL004: ... b -> a there
+            with self._a:
+                self.count += 1
+        self.count = 99                # GL004: bare write elsewhere
+
+
+class Reacquire:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            with self._lock:           # GL004: self-deadlock
+                return 1
+
+
+class DoubleStart:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        if self._thread is None:       # GL004: unlocked check ...
+            self._thread = threading.Thread(target=lambda: None)
+            self._thread.start()       # ... then act
+        return self

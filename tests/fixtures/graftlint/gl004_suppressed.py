@@ -1,0 +1,19 @@
+"""GL004 SUPPRESSED fixture: a documented single-writer invariant."""
+import threading
+
+
+class SingleWriter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cursor = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.cursor += 1
+
+    def reset(self):
+        # only ever called before _run starts; single-writer by
+        # construction
+        self.cursor = 0  # graftlint: disable=GL004

@@ -1,298 +1,43 @@
 #!/usr/bin/env python
-"""Lint: every "N.Nx" perf claim in the docs must be measured, and
-every metric name the docs cite must exist in the code.
+"""DEPRECATED shim — this lint is now graftlint rule **GL005**
+(``tools/graftlint/rules/gl005_literal_drift.py``).
 
-Two rounds in a row shipped prose speedups ("4.1x over exact masked
-attention") whose numbers no bench artifact ever recorded — the
-round-5 verdict's central complaint. This lint makes that impossible
-going forward: every ``N.Nx`` / ``N.N×`` multiplier claimed in
-README.md or COMPONENTS.md must correspond to a number present in
-(or derivable from) the committed ``BENCH_DETAIL.json``:
-
-- the value of an explicit RATIO key in the artifact (any key whose
-  name contains ``vs_`` — ``vs_baseline``, ``vs_production_kernel``,
-  ``vs_exact_masked``, ``fused_vs_bounded``, ...), matched at the
-  claim's own precision (a "3.3x" claim matches a measured 3.316; a
-  "3.3x" claim against a measured 2.1 fails);
-- ratios between two configs' ``value`` fields sharing BOTH a unit
-  and a metric family (the metric's first word — the "bf16 ResNet50
-  is 1.44x the f32 ResNet50" class of claim).
-
-Matching is deliberately NOT "any number anywhere in the artifact":
-with hundreds of raw values and cross-config ratios, most fabricated
-multipliers would collide with something by accident and the lint
-would guarantee nothing.
-
-Lines containing the word "target" are exempt — a declared goal
-("BASELINE target: >= 0.70x of flax") is not a measurement claim.
-
-**Stale metric names** are the same bug class for observability docs:
-a README that tells operators to alert on ``serving_latency_seconds``
-after the code renamed it is worse than no README. Every backticked
-identifier in README/COMPONENTS that LOOKS like a registry metric
-(snake_case ending in a Prometheus unit/kind suffix — ``_total``,
-``_seconds``, ``_bytes``, ``_depth``, ``_firing``) must match a
-metric-name string literal somewhere under ``deeplearning4j_tpu/``
-(f-string name templates like ``f"{name}_queue_depth"`` match as
-wildcards).
-
-**Stale chaos-site names** joined with the chaos PR: inside any doc
-section whose heading mentions fault injection / chaos, every
-backticked dotted token (``checkpoint.write``, ``data.fetch``, ...)
-must exist as a string literal under the package — the documented
-fault-plan schema must keep matching the code's injection sites.
-
-Run: ``python tools/check_perf_claims.py [--repo DIR]``; exit 0 =
-clean. Wired into the tier-1 test tier via tests/test_observability.py
-(perf claims) and tests/test_health.py (metric names).
+Everything this script checked (unmeasured ``N.Nx`` doc perf claims,
+stale metric names, stale chaos-site names) runs as part of
+``python -m tools.graftlint`` and the ``pytest -m lint`` tier. The
+module-level API (``check``, ``check_metric_names``,
+``check_site_names``, ``measured_numbers``, ``claim_matches``,
+``find_claims``) and the CLI (``python tools/check_perf_claims.py
+[--repo DIR]``) are preserved verbatim for existing callers; new
+callers should import from the GL005 module or run graftlint.
 """
 
 from __future__ import annotations
 
 import argparse
-import itertools
-import json
 import os
-import re
 import sys
-from typing import List, Tuple
 
-DOC_FILES = ["README.md", "COMPONENTS.md"]
-ARTIFACT = "BENCH_DETAIL.json"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# an N.Nx multiplier claim: requires a decimal point (plain "2x256"
-# tensor shapes and "8x" core counts are not perf claims in this
-# repo's docs; the measured-claim convention is one decimal or more)
-CLAIM_RE = re.compile(r"(\d+\.\d+)\s*[x×]")
-
-
-def _collect_ratio_keys(obj, out: List[float]) -> None:
-    if isinstance(obj, dict):
-        for k, v in obj.items():
-            if "vs_" in str(k) and isinstance(v, (int, float)) \
-                    and not isinstance(v, bool):
-                out.append(float(v))
-            else:
-                _collect_ratio_keys(v, out)
-    elif isinstance(obj, list):
-        for v in obj:
-            _collect_ratio_keys(v, out)
-
-
-def measured_numbers(detail: dict) -> List[float]:
-    """Legitimate multiplier sources only: explicit ``*vs_*`` ratio
-    keys anywhere in the artifact, plus cross-config ``value`` ratios
-    within one (unit, metric-family) pair — NOT every raw number."""
-    out: List[float] = []
-    _collect_ratio_keys(detail, out)
-    configs = detail.get("configs", [])
-    by_family = {}
-    for c in configs:
-        if isinstance(c.get("value"), (int, float)) and c.get("unit"):
-            family = (c["unit"],
-                      str(c.get("metric", "")).split(" ")[0])
-            by_family.setdefault(family, []).append(float(c["value"]))
-    for vals in by_family.values():
-        for a, b in itertools.permutations(vals, 2):
-            if b:
-                out.append(a / b)
-    return out
-
-
-def claim_matches(claim: float, ndecimals: int,
-                  numbers: List[float]) -> bool:
-    tol = 10.0 ** (-ndecimals)
-    return any(abs(n - claim) <= tol for n in numbers)
-
-
-def find_claims(path: str) -> List[Tuple[int, str, float, int]]:
-    """(line_no, line, claim_value, n_decimals) for each N.Nx."""
-    claims = []
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            if "target" in line.lower():
-                continue
-            for m in CLAIM_RE.finditer(line):
-                txt = m.group(1)
-                claims.append((i, line.rstrip(), float(txt),
-                               len(txt.split(".")[1])))
-    return claims
-
-
-# ---------------------------------------------------------------------------
-# stale metric names
-# ---------------------------------------------------------------------------
-
-PACKAGE_DIR = "deeplearning4j_tpu"
-
-# suffixes that mark a backticked doc token as a metric-name citation
-METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_depth",
-                   "_firing", "_state")
-_SUFFIX_ALT = "|".join(METRIC_SUFFIXES)
-
-# `serving_requests_total`-style citations in docs
-DOC_METRIC_RE = re.compile(
-    r"`([a-z][a-z0-9_]*(?:%s))`" % _SUFFIX_ALT)
-
-# metric-name string literals in source, including f-string templates
-# (f"{name}_queue_depth" — the {…} part matches any label-ish token)
-SRC_METRIC_RE = re.compile(
-    r"""["']([A-Za-z0-9_{}]*(?:%s))["']""" % _SUFFIX_ALT)
-
-
-def registered_metric_patterns(repo: str) -> List[re.Pattern]:
-    """Compile every metric-name literal under the package into a
-    matcher; ``{...}`` f-string holes become wildcards."""
-    patterns = set()
-    for root, _dirs, files in os.walk(os.path.join(repo, PACKAGE_DIR)):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            with open(os.path.join(root, fname),
-                      encoding="utf-8", errors="replace") as f:
-                src = f.read()
-            for m in SRC_METRIC_RE.finditer(src):
-                patterns.add(m.group(1))
-    out = []
-    for p in sorted(patterns):
-        rx = re.escape(p).replace(r"\{", "{").replace(r"\}", "}")
-        rx = re.sub(r"\{[^{}]*\}", r"[a-zA-Z0-9_/.-]+", rx)
-        out.append(re.compile(rx + r"\Z"))
-    return out
-
-
-def find_doc_metric_names(path: str) -> List[Tuple[int, str]]:
-    names = []
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            for m in DOC_METRIC_RE.finditer(line):
-                names.append((i, m.group(1)))
-    return names
-
-
-def check_metric_names(repo: str) -> List[str]:
-    patterns = registered_metric_patterns(repo)
-    errors = []
-    for doc in DOC_FILES:
-        path = os.path.join(repo, doc)
-        if not os.path.exists(path):
-            continue
-        for line_no, name in find_doc_metric_names(path):
-            if not any(p.match(name) for p in patterns):
-                errors.append(
-                    f"{doc}:{line_no}: metric `{name}` is cited in "
-                    f"the docs but registered nowhere under "
-                    f"{PACKAGE_DIR}/ — stale name?")
-    return errors
-
-
-# ---------------------------------------------------------------------------
-# stale chaos-site names
-# ---------------------------------------------------------------------------
-
-# the docs' fault-injection sections cite injection sites as
-# backticked dotted tokens (`checkpoint.write`, `data.fetch`, ...);
-# each must exist as a string literal under the package, or the
-# documented plan schema silently stopped matching the code
-DOC_SITE_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`")
-SRC_SITE_RE = re.compile(
-    r"""["']([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)["']""")
-
-# dotted doc tokens that are file references, not site names
-_SITE_EXT_SKIP = {"py", "json", "jsonl", "md", "zip", "npz", "npy",
-                  "txt", "ini", "csv", "bin", "gz", "log", "html",
-                  "h5", "yaml", "yml"}
-
-
-def find_doc_site_names(path: str) -> List[Tuple[int, str]]:
-    """Backticked dotted tokens inside any section whose heading
-    mentions fault injection / chaos (scoped: a dotted token
-    elsewhere in the docs — `np.ndarray`, module paths — is not a
-    site citation). Fenced code blocks are skipped entirely: a shell
-    comment's leading '#' is not a markdown heading and must not
-    toggle the section scope."""
-    names = []
-    in_section = False
-    in_fence = False
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            if line.lstrip().startswith("```"):
-                in_fence = not in_fence
-                continue
-            if in_fence:
-                continue
-            if re.match(r"#+\s", line):
-                low = line.lower()
-                in_section = ("fault injection" in low
-                              or "chaos" in low)
-                continue
-            if not in_section:
-                continue
-            for m in DOC_SITE_RE.finditer(line):
-                token = m.group(1)
-                if token.rsplit(".", 1)[-1] in _SITE_EXT_SKIP:
-                    continue
-                names.append((i, token))
-    return names
-
-
-def registered_site_literals(repo: str) -> set:
-    literals = set()
-    for root, _dirs, files in os.walk(os.path.join(repo, PACKAGE_DIR)):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            with open(os.path.join(root, fname),
-                      encoding="utf-8", errors="replace") as f:
-                src = f.read()
-            for m in SRC_SITE_RE.finditer(src):
-                literals.add(m.group(1))
-    return literals
-
-
-def check_site_names(repo: str) -> List[str]:
-    literals = registered_site_literals(repo)
-    errors = []
-    for doc in DOC_FILES:
-        path = os.path.join(repo, doc)
-        if not os.path.exists(path):
-            continue
-        for line_no, name in find_doc_site_names(path):
-            if name not in literals:
-                errors.append(
-                    f"{doc}:{line_no}: chaos site `{name}` is cited "
-                    f"in the docs but exists as a string literal "
-                    f"nowhere under {PACKAGE_DIR}/ — stale site "
-                    "name?")
-    return errors
-
-
-def check(repo: str) -> List[str]:
-    artifact_path = os.path.join(repo, ARTIFACT)
-    with open(artifact_path) as f:
-        detail = json.load(f)
-    numbers = measured_numbers(detail)
-    errors = []
-    for doc in DOC_FILES:
-        path = os.path.join(repo, doc)
-        if not os.path.exists(path):
-            continue
-        for line_no, line, claim, nd in find_claims(path):
-            if not claim_matches(claim, nd, numbers):
-                errors.append(
-                    f"{doc}:{line_no}: claim '{claim}x' has no "
-                    f"measured counterpart in {ARTIFACT} "
-                    f"(line: {line.strip()[:100]})")
-    errors.extend(check_metric_names(repo))
-    errors.extend(check_site_names(repo))
-    return errors
+from tools.graftlint.rules.gl005_literal_drift import (  # noqa: E402,F401
+    ARTIFACT, CLAIM_RE, DOC_FILES, METRIC_SUFFIXES,
+    check, check_metric_names, check_site_names, claim_matches,
+    find_claims, find_doc_metric_names, find_doc_site_names,
+    measured_numbers, registered_metric_patterns,
+    registered_site_literals)
+from tools.graftlint.core import PACKAGE_DIR  # noqa: E402,F401
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--repo", default=os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--repo", default=_REPO)
     args = ap.parse_args(argv)
+    print("note: check_perf_claims.py is deprecated; this is "
+          "graftlint rule GL005 (python -m tools.graftlint)",
+          file=sys.stderr)
     errors = check(args.repo)
     if errors:
         print(f"{len(errors)} unmeasured perf claim(s):",

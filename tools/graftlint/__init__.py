@@ -1,0 +1,36 @@
+"""graftlint — repo-specific static analysis for the jax_graft tree.
+
+Five AST-level checkers enforce the invariants the threaded, jitted
+production substrate depends on, BEFORE execution (the runtime
+watchdogs in ``observability/`` catch the same bug classes only after
+they cost a compile or a deadlock):
+
+========  ==================================================
+GL001     jit-purity: no host side effects inside traced code
+GL002     recompile-hazard: shape/f-string static args, traced
+          branches, jit-in-loop, raw-shape cache keys
+GL003     donation-audit: no use of a buffer after it was
+          donated to a jitted call
+GL004     lock-discipline: consistent acquisition order and
+          no shared attribute mutated both with and without
+          its lock in thread-spawning classes
+GL005     literal-drift: doc perf claims / metric names /
+          chaos sites must match code and bench artifacts
+========  ==================================================
+
+Run ``python -m tools.graftlint [paths]``; suppress one finding with
+``# graftlint: disable=GL001`` (same line or the line above), a whole
+file with ``# graftlint: disable-file=GL001``. Pre-existing findings
+live in ``tools/graftlint/baseline.json`` (the ratchet): they do not
+fail the run, but any NEW finding does.
+"""
+
+from tools.graftlint.core import (Baseline, Finding, LintReport,
+                                  ParsedModule, RepoContext,
+                                  format_json, format_text,
+                                  format_stats, run_lint)
+from tools.graftlint.rules import ALL_RULES
+
+__all__ = ["ALL_RULES", "Baseline", "Finding", "LintReport",
+           "ParsedModule", "RepoContext", "format_json",
+           "format_text", "format_stats", "run_lint"]

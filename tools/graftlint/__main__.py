@@ -1,0 +1,110 @@
+"""CLI: ``python -m tools.graftlint [paths] [options]``.
+
+Exit codes: 0 = no new findings (baselined/suppressed ones are
+reported but do not fail), 1 = new findings, 2 = bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _ensure_repo_on_path() -> None:
+    here = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if here not in sys.path:
+        sys.path.insert(0, here)
+
+
+_ensure_repo_on_path()
+
+from tools.graftlint.core import (Baseline, DEFAULT_BASELINE,  # noqa: E402
+                                  PACKAGE_DIR, format_json,
+                                  format_stats, format_text,
+                                  run_lint)
+from tools.graftlint.rules import ALL_RULES  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="repo-specific static analysis: "
+                    + "; ".join(f"{rid} {cls.title}"
+                                for rid, cls in sorted(
+                                    ALL_RULES.items())))
+    ap.add_argument("paths", nargs="*", default=[PACKAGE_DIR],
+                    help=f"files/directories to lint "
+                         f"(default: {PACKAGE_DIR}/)")
+    ap.add_argument("--repo", default=None,
+                    help="repo root (default: the directory holding "
+                         "tools/)")
+    ap.add_argument("--rule", action="append", default=[],
+                    help="run only these rules (comma-separated, "
+                         "repeatable), e.g. --rule GL001,GL004")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"ratchet baseline file (default: "
+                         f"{DEFAULT_BASELINE} when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding is new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline to the current "
+                         "findings (keeps recorded justifications "
+                         "for surviving entries) and exit 0")
+    ap.add_argument("--stats", action="store_true",
+                    help="print the per-rule ratchet report "
+                         "(current vs baseline allowance)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs git HEAD "
+                         "(plus untracked)")
+    args = ap.parse_args(argv)
+
+    repo = os.path.abspath(args.repo or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    rules = [r.strip() for spec in args.rule
+             for r in spec.split(",") if r.strip()] or None
+
+    baseline_path = args.baseline or os.path.join(
+        repo, DEFAULT_BASELINE)
+    baseline = None
+    if not args.no_baseline and os.path.exists(baseline_path):
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"graftlint: cannot read baseline "
+                  f"{baseline_path}: {e}", file=sys.stderr)
+            return 2
+
+    try:
+        report = run_lint(repo, paths=args.paths, rules=rules,
+                          baseline=baseline,
+                          changed_only=args.changed_only)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        new_base = Baseline.from_findings(
+            report.new + report.baselined, previous=baseline)
+        new_base.save(baseline_path)
+        print(f"graftlint: baseline rewritten to {baseline_path} "
+              f"({len(report.new) + len(report.baselined)} "
+              "entries); review the diff and add a 'why' to "
+              "anything kept deliberately")
+        return 0
+
+    if args.stats:
+        print(format_stats(report, baseline))
+        return 0 if report.ok else 1
+
+    out = (format_json(report) if args.format == "json"
+           else format_text(report))
+    print(out)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,435 @@
+"""graftlint core: findings, suppressions, the ratchet baseline, the
+runner, and the output formats.
+
+Design points that matter to rule authors:
+
+- A :class:`Finding`'s baseline identity (``key``) deliberately
+  EXCLUDES the line number: an unrelated edit above a pre-existing
+  finding must not turn it "new" and break CI. Identity is
+  ``rule|path|symbol|message``; duplicates within one key are
+  ratcheted by count (two pre-existing, three now -> one new).
+- Suppression comments are parsed from the RAW text of whichever file
+  a finding points at, so ``# graftlint: disable=GL001`` works in
+  Python and ``<!-- graftlint: disable=GL005 -->`` works in the
+  markdown GL005 lints. An inline marker suppresses its own line; a
+  marker on a line of its own also suppresses the next line;
+  ``disable-file=`` suppresses the whole file. ``disable=all`` is
+  accepted.
+- File-scope rules run per parsed module; repo-scope rules (GL004's
+  cross-file lock graph, GL005's doc lint) run once over a
+  :class:`RepoContext`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import subprocess
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+PACKAGE_DIR = "deeplearning4j_tpu"
+DEFAULT_BASELINE = os.path.join("tools", "graftlint", "baseline.json")
+
+_SUPPRESS_RE = re.compile(
+    r"graftlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str           # "GL001"
+    path: str           # repo-relative, posix separators
+    line: int           # 1-based; 0 = whole file
+    message: str
+    symbol: str = ""    # enclosing function/class, for stable identity
+
+    @property
+    def key(self) -> str:
+        """Baseline identity — no line number (see module doc)."""
+        return "|".join((self.rule, self.path, self.symbol,
+                         self.message))
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}" if self.line else self.path
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.rule}{sym}: {self.message}"
+
+
+class Suppressions:
+    """Per-file suppression map parsed from raw text lines."""
+
+    def __init__(self, text: str):
+        self.file_rules: set = set()
+        self.line_rules: Dict[int, set] = {}
+        for i, line in enumerate(text.splitlines(), 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip().upper()
+                     for r in m.group("rules").split(",") if r.strip()}
+            if m.group("file"):
+                self.file_rules |= rules
+                continue
+            self.line_rules.setdefault(i, set()).update(rules)
+            # a marker on a comment-only line guards the line below
+            stripped = line.strip()
+            if stripped.startswith(("#", "<!--", "//")):
+                self.line_rules.setdefault(i + 1, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        hits = self.file_rules | self.line_rules.get(line, set())
+        return rule in hits or "ALL" in hits
+
+
+class ParsedModule:
+    """One analyzed Python file: source, AST, repo-relative path."""
+
+    def __init__(self, path: str, repo: str):
+        self.path = os.path.abspath(path)
+        self.relpath = os.path.relpath(self.path, repo).replace(
+            os.sep, "/")
+        with open(self.path, encoding="utf-8", errors="replace") as f:
+            self.source = f.read()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[Finding] = None
+        self._jit_info = None
+        try:
+            self.tree = ast.parse(self.source, filename=self.relpath)
+        except SyntaxError as e:
+            self.parse_error = Finding(
+                rule="GL000", path=self.relpath, line=e.lineno or 0,
+                message=f"file does not parse: {e.msg}")
+
+    @property
+    def jit_info(self):
+        """Shared :class:`jitscope.ModuleJitInfo` — built once per
+        module per run, not once per rule (GL001-GL004 all need
+        it)."""
+        if self._jit_info is None:
+            from tools.graftlint import jitscope
+            self._jit_info = jitscope.ModuleJitInfo(self.tree)
+        return self._jit_info
+
+
+class RepoContext:
+    """What repo-scope rules see: the repo root plus every module the
+    current invocation parsed."""
+
+    def __init__(self, repo: str, modules: Sequence[ParsedModule]):
+        self.repo = repo
+        self.modules = list(modules)
+
+
+# ---------------------------------------------------------------------------
+# baseline (the ratchet)
+# ---------------------------------------------------------------------------
+
+class Baseline:
+    """``{key: {count, why}}``. Findings matching a key are absorbed
+    up to ``count``; everything beyond — and every unknown key — is
+    NEW and fails the run. ``why`` records the one-line justification
+    for keeping a finding instead of fixing it."""
+
+    def __init__(self, entries: Optional[Dict[str, dict]] = None):
+        self.entries: Dict[str, dict] = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        entries = {}
+        for e in data.get("entries", []):
+            entries[e["key"]] = {"count": int(e.get("count", 1)),
+                                 "why": e.get("why", "")}
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        data = {"version": 1,
+                "entries": [{"key": k,
+                             "count": v["count"],
+                             **({"why": v["why"]} if v.get("why")
+                                else {})}
+                            for k, v in sorted(self.entries.items())]}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1, sort_keys=False)
+            f.write("\n")
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """-> (new, baselined)."""
+        budget = {k: v["count"] for k, v in self.entries.items()}
+        new, old = [], []
+        for f in findings:
+            if budget.get(f.key, 0) > 0:
+                budget[f.key] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding],
+                      previous: Optional["Baseline"] = None
+                      ) -> "Baseline":
+        """Rewrite the baseline to the current findings, keeping any
+        ``why`` already recorded for surviving keys."""
+        entries: Dict[str, dict] = {}
+        for f in findings:
+            e = entries.setdefault(f.key, {"count": 0, "why": ""})
+            e["count"] += 1
+        if previous is not None:
+            for k, e in entries.items():
+                prev = previous.entries.get(k)
+                if prev and prev.get("why"):
+                    e["why"] = prev["why"]
+        return cls(entries)
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LintReport:
+    new: List[Finding]
+    baselined: List[Finding]
+    suppressed: int
+    rules_run: List[str]
+    files_checked: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def per_rule(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for rid in self.rules_run:
+            out[rid] = {"new": 0, "baselined": 0}
+        for f in self.new:
+            out.setdefault(f.rule, {"new": 0, "baselined": 0})
+            out[f.rule]["new"] += 1
+        for f in self.baselined:
+            out.setdefault(f.rule, {"new": 0, "baselined": 0})
+            out[f.rule]["baselined"] += 1
+        return out
+
+
+def discover_files(repo: str, paths: Sequence[str]) -> List[str]:
+    """Expand the CLI path arguments into .py files (sorted,
+    deduplicated). Directories recurse; __pycache__ is skipped. A
+    path that exists as neither file nor directory is an ERROR — a
+    typo'd CI invocation must not lint nothing and exit 0."""
+    out = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(repo, p)
+        if not os.path.exists(full):
+            raise ValueError(
+                f"path {p!r} does not exist under {repo} — nothing "
+                "would be linted")
+        if os.path.isfile(full):
+            if not full.endswith(".py"):
+                raise ValueError(
+                    f"path {p!r} is not a .py file — it would not "
+                    "be linted")
+            out.append(os.path.abspath(full))
+        elif os.path.isdir(full):
+            for root, dirs, files in os.walk(full):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        out.append(os.path.abspath(
+                            os.path.join(root, fname)))
+    return sorted(set(out))
+
+
+def changed_files(repo: str) -> Optional[set]:
+    """Repo-relative paths touched vs HEAD (staged, unstaged and
+    untracked). None when git is unavailable — callers fall back to
+    the full tree rather than silently linting nothing."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=repo, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "-o", "--exclude-standard"],
+            cwd=repo, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    def parse(stdout: str) -> set:
+        # one path per LINE (paths may contain spaces); git quotes
+        # and escapes non-ASCII/space-odd names under core.quotepath
+        # — decode those back to the literal path
+        names = set()
+        for line in stdout.splitlines():
+            if not line:
+                continue
+            if line.startswith('"') and line.endswith('"'):
+                line = line[1:-1].encode("latin-1", "replace") \
+                    .decode("unicode_escape") \
+                    .encode("latin-1", "replace").decode("utf-8",
+                                                         "replace")
+            names.add(line)
+        return names
+
+    names = parse(diff.stdout)
+    if untracked.returncode == 0:
+        names |= parse(untracked.stdout)
+    return {n.replace(os.sep, "/") for n in names}
+
+
+_suppression_cache: Dict[str, Suppressions] = {}
+
+
+def _suppressions_for(repo: str, relpath: str) -> Suppressions:
+    full = os.path.join(repo, relpath)
+    try:
+        mtime = os.path.getmtime(full)
+    except OSError:
+        return Suppressions("")
+    cache_key = f"{full}:{mtime}"
+    if cache_key not in _suppression_cache:
+        try:
+            with open(full, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            text = ""
+        _suppression_cache[cache_key] = Suppressions(text)
+    return _suppression_cache[cache_key]
+
+
+def run_lint(repo: str,
+             paths: Sequence[str] = (PACKAGE_DIR,),
+             rules: Optional[Sequence[str]] = None,
+             baseline: Optional[Baseline] = None,
+             changed_only: bool = False) -> LintReport:
+    from tools.graftlint.rules import ALL_RULES
+
+    repo = os.path.abspath(repo)
+    selected = {r.upper() for r in rules} if rules else set(ALL_RULES)
+    unknown = selected - set(ALL_RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {sorted(unknown)}; "
+            f"available: {sorted(ALL_RULES)}")
+
+    all_files = discover_files(repo, paths)
+    changed = changed_files(repo) if changed_only else None
+    files = all_files
+    if changed is not None:
+        files = [f for f in all_files
+                 if os.path.relpath(f, repo).replace(os.sep, "/")
+                 in changed]
+
+    modules = [ParsedModule(f, repo) for f in files]
+    raw: List[Finding] = [m.parse_error for m in modules
+                          if m.parse_error is not None]
+    parsed = [m for m in modules if m.tree is not None]
+    ctx = RepoContext(repo, parsed)
+    full_ctx = ctx if changed is None else None
+
+    for rid in sorted(selected):
+        rule = ALL_RULES[rid]()
+        if rule.scope == "file":
+            for m in parsed:
+                raw.extend(rule.check(m))
+        else:
+            # repo-scope rules still honour --changed-only: with a
+            # change set and nothing relevant touched, skip the pass
+            if changed is not None and not any(
+                    rule.repo_triggered(p) for p in changed):
+                continue
+            # a triggered repo-scope rule analyzes the FULL tree —
+            # cross-file context (GL004's acquisition graph) must see
+            # unchanged modules or an inversion against one is
+            # invisible — but only findings in changed files are
+            # reported (the unchanged half of a new inversion is a
+            # pre-existing site)
+            if full_ctx is None:
+                fm = [ParsedModule(f, repo) for f in all_files]
+                full_ctx = RepoContext(
+                    repo, [m for m in fm if m.tree is not None])
+            found = rule.check_repo(full_ctx)
+            if changed is not None:
+                found = [f for f in found if f.path in changed]
+            raw.extend(found)
+
+    kept, suppressed = [], 0
+    for f in raw:
+        if _suppressions_for(repo, f.path).suppressed(f.rule, f.line):
+            suppressed += 1
+        else:
+            kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    base = baseline or Baseline()
+    new, old = base.split(kept)
+    return LintReport(new=new, baselined=old, suppressed=suppressed,
+                      rules_run=sorted(selected),
+                      files_checked=len(modules))
+
+
+# ---------------------------------------------------------------------------
+# output
+# ---------------------------------------------------------------------------
+
+def format_text(report: LintReport) -> str:
+    lines = [f.render() for f in report.new]
+    lines.append(
+        f"graftlint: {len(report.new)} new finding(s), "
+        f"{len(report.baselined)} baselined, "
+        f"{report.suppressed} suppressed "
+        f"({report.files_checked} file(s), "
+        f"rules {','.join(report.rules_run)})")
+    return "\n".join(lines)
+
+
+def format_json(report: LintReport) -> str:
+    def enc(f: Finding) -> dict:
+        return {"rule": f.rule, "path": f.path, "line": f.line,
+                "symbol": f.symbol, "message": f.message,
+                "key": f.key}
+    return json.dumps(
+        {"ok": report.ok,
+         "new": [enc(f) for f in report.new],
+         "baselined": [enc(f) for f in report.baselined],
+         "suppressed": report.suppressed,
+         "files_checked": report.files_checked,
+         "rules_run": report.rules_run},
+        indent=1)
+
+
+def format_stats(report: LintReport,
+                 baseline: Optional[Baseline] = None) -> str:
+    """The ratchet report: per-rule current findings vs the baseline
+    allowance, so a PR can cite "N fixed, M baselined"."""
+    from tools.graftlint.rules import ALL_RULES
+    allowance: Dict[str, int] = {}
+    for key, e in (baseline.entries if baseline else {}).items():
+        allowance[key.split("|", 1)[0]] = (
+            allowance.get(key.split("|", 1)[0], 0) + e["count"])
+    per = report.per_rule()
+    rows = [("rule", "current", "baselined", "new", "allowance")]
+    for rid in sorted(set(per) | set(allowance)):
+        c = per.get(rid, {"new": 0, "baselined": 0})
+        title = getattr(ALL_RULES.get(rid), "title", "")
+        rows.append((f"{rid} {title}".strip(),
+                     str(c["new"] + c["baselined"]),
+                     str(c["baselined"]), str(c["new"]),
+                     str(allowance.get(rid, 0))))
+    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+    out = ["  ".join(cell.ljust(widths[i])
+                     for i, cell in enumerate(row)).rstrip()
+           for row in rows]
+    fixed = sum(max(0, allowance.get(rid, 0)
+                    - per.get(rid, {}).get("baselined", 0))
+                for rid in allowance)
+    out.append(f"total: {len(report.new) + len(report.baselined)} "
+               f"finding(s) ({len(report.new)} new, "
+               f"{len(report.baselined)} baselined, "
+               f"{fixed} baseline slot(s) no longer hit)")
+    return "\n".join(out)

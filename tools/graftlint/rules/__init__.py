@@ -1,0 +1,15 @@
+"""Rule registry. Each rule module defines one class with a unique
+``id``; importing this package registers all of them."""
+
+from tools.graftlint.rules.base import Rule
+from tools.graftlint.rules.gl001_jit_purity import JitPurityRule
+from tools.graftlint.rules.gl002_recompile import RecompileHazardRule
+from tools.graftlint.rules.gl003_donation import DonationAuditRule
+from tools.graftlint.rules.gl004_locks import LockDisciplineRule
+from tools.graftlint.rules.gl005_literal_drift import LiteralDriftRule
+
+ALL_RULES = {cls.id: cls for cls in (
+    JitPurityRule, RecompileHazardRule, DonationAuditRule,
+    LockDisciplineRule, LiteralDriftRule)}
+
+__all__ = ["ALL_RULES", "Rule"]

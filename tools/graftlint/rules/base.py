@@ -1,0 +1,25 @@
+"""Rule base class."""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from tools.graftlint.core import Finding, ParsedModule, RepoContext
+
+
+class Rule:
+    id: str = "GL000"
+    title: str = ""
+    rationale: str = ""
+    scope: str = "file"          # "file" | "repo"
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        return []
+
+    def check_repo(self, ctx: RepoContext) -> Iterable[Finding]:
+        return []
+
+    def repo_triggered(self, relpath: str) -> bool:
+        """Under ``--changed-only``, should this repo-scope rule run
+        given that ``relpath`` changed?"""
+        return relpath.endswith(".py")

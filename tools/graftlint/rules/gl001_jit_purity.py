@@ -1,0 +1,157 @@
+"""GL001 — jit purity.
+
+Host side effects inside a traced function run ONCE at trace time
+(not per step) or, worse, capture a stale host value into the
+compiled program: ``time.time()`` freezes the timestamp,
+``random.random()`` freezes the "random" number, a metrics ``inc()``
+counts compiles instead of steps, and ``nonlocal``/``global``
+mutation desynchronizes host state from device state. The runtime
+compile watchdog only notices these when they also change shapes;
+this rule rejects them before execution.
+
+Flags, inside any function traced by ``jax.jit`` / ``pmap`` /
+``shard_map`` / ``lax.scan``-family (resolved through
+``functools.partial`` and local aliases):
+
+- ``time.*`` calls (``time.time``, ``perf_counter``, ``sleep``...)
+- host RNG: ``random.*``, ``np.random.*`` (``jax.random`` is fine)
+- ``print`` (``jax.debug.print``/``callback`` are the sanctioned
+  escape hatches and are not flagged)
+- logging calls (``logging.*`` or ``logger.info``-style methods)
+- metrics-registry mutations (``.inc/.observe/.record/...`` on a
+  receiver that is recognizably a metric object, and ``safe_inc``)
+- ``open()``
+- ``global`` / ``nonlocal`` declarations
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.graftlint.core import Finding, ParsedModule
+from tools.graftlint import jitscope
+from tools.graftlint.rules.base import Rule
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                "exception", "critical", "log"}
+_LOG_RECEIVERS = {"logger", "log", "logging"}
+_METRIC_METHODS = {"inc", "dec", "observe", "record", "set_gauge",
+                   "safe_inc", "count_shed", "count_error",
+                   "count_expired", "time"}
+_METRIC_HINTS = ("metric", "registry", "counter", "gauge",
+                 "histogram", "stats", "endpoint")
+
+
+def _symbol(info: jitscope.ModuleJitInfo, ctx: ast.AST) -> str:
+    if isinstance(ctx, jitscope.FunctionNode):
+        return ctx.name
+    return "<lambda>"
+
+
+class JitPurityRule(Rule):
+    id = "GL001"
+    title = "jit-purity"
+    rationale = ("host side effects inside traced code run at trace "
+                 "time, not per step")
+    scope = "file"
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        info = module.jit_info
+        if not info.contexts:
+            return []
+        out: List[Finding] = []
+
+        def flag(node: ast.AST, ctx: ast.AST, what: str,
+                 hint: str) -> None:
+            out.append(Finding(
+                rule=self.id, path=module.relpath,
+                line=getattr(node, "lineno", 0),
+                symbol=_symbol(info, ctx),
+                message=f"{what} inside jitted function "
+                        f"'{_symbol(info, ctx)}' — {hint}"))
+
+        def visit(node: ast.AST, ctx: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                # a nested def that is itself a registered context is
+                # walked on its own pass — skip it here so one
+                # offense reports once, under the innermost function
+                if child in info.contexts:
+                    continue
+                self._check_node(child, ctx, info, flag)
+                visit(child, ctx)
+
+        for ctx in info.contexts:
+            visit(ctx, ctx)
+        return self._dedup(out)
+
+    def _check_node(self, node: ast.AST, ctx, info, flag) -> None:
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kw = ("global" if isinstance(node, ast.Global)
+                  else "nonlocal")
+            flag(node, ctx,
+                 f"{kw} mutation of {', '.join(node.names)}",
+                 "host state mutated during tracing runs "
+                 "once per compile, not once per step")
+        elif isinstance(node, ast.Call):
+            self._check_call(node, ctx, info, flag)
+
+    # ------------------------------------------------------------------
+    def _check_call(self, node: ast.Call, ctx, info, flag) -> None:
+        canon = info.canon(node.func)
+        if canon.startswith("jax."):
+            return                      # jax.debug.*, jax.random.* ok
+        if canon == "print":
+            flag(node, ctx, "print()",
+                 "prints once at trace time; use jax.debug.print")
+            return
+        if canon == "open":
+            flag(node, ctx, "open()",
+                 "file I/O during tracing; hoist out of the jit or "
+                 "use jax.debug.callback")
+            return
+        root = canon.split(".")[0] if canon else ""
+        if root == "time":
+            flag(node, ctx, f"host clock call '{canon}'",
+                 "the timestamp freezes into the compiled program; "
+                 "time on the host around the jit boundary")
+            return
+        if canon.startswith(("random.", "np.random.",
+                             "numpy.random.")):
+            flag(node, ctx, f"host RNG call '{canon}'",
+                 "the value freezes at trace time; thread a "
+                 "jax.random key instead")
+            return
+        if canon.startswith("logging.") or (
+                "." in canon
+                and canon.rsplit(".", 1)[1] in _LOG_METHODS
+                and (canon.split(".")[0] in _LOG_RECEIVERS
+                     or canon.split(".")[-2] in _LOG_RECEIVERS
+                     or canon.split(".")[0].endswith("logger"))):
+            flag(node, ctx, f"logging call '{canon}'",
+                 "logs once at trace time; use jax.debug.print or "
+                 "log outside the step")
+            return
+        if canon == "safe_inc" or canon.endswith(".safe_inc"):
+            flag(node, ctx, f"metrics call '{canon}'",
+                 "counts compiles, not steps; move to the host side "
+                 "of the step")
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _METRIC_METHODS:
+            recv = jitscope.dotted_name(node.func.value).lower()
+            if recv and any(h in recv for h in _METRIC_HINTS):
+                flag(node, ctx,
+                     f"metrics call '{recv}.{node.func.attr}'",
+                     "registry mutation during tracing counts "
+                     "compiles, not steps")
+
+    @staticmethod
+    def _dedup(findings: List[Finding]) -> List[Finding]:
+        seen, out = set(), []
+        for f in findings:
+            k = (f.path, f.line, f.message)
+            if k not in seen:
+                seen.add(k)
+                out.append(f)
+        return out

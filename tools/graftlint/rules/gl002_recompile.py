@@ -1,0 +1,217 @@
+"""GL002 — recompile hazards.
+
+The ROADMAP's dispatch-overhead work drives post-warmup compile count
+to ZERO; these are the call patterns that silently regress that. The
+runtime ``observability/compile_watch.py`` watchdog only fires after
+a recompile already cost its ~seconds; this rule rejects the hazard
+statically.
+
+Sub-checks:
+
+- **static-shape**: a call to a jitted callable passes a value
+  derived from a data shape (``x.shape[...]``) or an f-string into a
+  ``static_argnums``/``static_argnames`` position without going
+  through a bucketing helper (any callable whose name mentions
+  ``bucket``/``pow2``) — every distinct value compiles a fresh
+  executable.
+- **traced-branch**: Python ``if``/``while`` on a traced parameter
+  inside a jitted body. Shape/dtype/None tests are allowed (static
+  under tracing); a value test either recompiles per value or fails
+  tracing outright — use ``lax.cond``/``jnp.where``.
+- **jit-in-loop**: ``jax.jit``/``pmap`` wrap evaluated inside a
+  ``for``/``while`` body — a fresh executable (and cache entry)
+  per iteration.
+- **raw-shape-key**: an executable cache subscripted with a raw
+  ``.shape`` expression (``cache[x.shape]``) — unbucketed shapes
+  make the cache (and compile count) unbounded.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.graftlint.core import Finding, ParsedModule
+from tools.graftlint import jitscope
+from tools.graftlint.rules.base import Rule
+
+_STATIC_UNDER_TRACE = {"shape", "ndim", "dtype", "size"}
+_BUCKET_HINTS = ("bucket", "pow2")
+_CACHE_HINTS = ("cache", "compiled", "executables", "programs")
+
+
+def _contains_shape(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Attribute) and n.attr == "shape"
+               for n in ast.walk(node))
+
+
+def _bucketed(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = jitscope.dotted_name(n.func).lower()
+            if any(h in name for h in _BUCKET_HINTS):
+                return True
+    return False
+
+
+class RecompileHazardRule(Rule):
+    id = "GL002"
+    title = "recompile-hazard"
+    rationale = ("shape-derived static args, traced branches and "
+                 "per-iteration jit wraps each compile a fresh "
+                 "executable")
+    scope = "file"
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        info = module.jit_info
+        out: List[Finding] = []
+        out += self._static_shape(module, info)
+        out += self._traced_branch(module, info)
+        out += self._jit_in_loop(module, info)
+        out += self._raw_shape_key(module, info)
+        return out
+
+    # --- static args fed from shapes / f-strings ----------------------
+    def _static_shape(self, module, info) -> List[Finding]:
+        out = []
+        donors = {}           # (scope, name) -> JitSite
+        for site in info.sites:
+            if site.bound_name and (site.static_argnums
+                                    or site.static_argnames):
+                donors[(site.scope, site.bound_name)] = site
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            site = self._lookup(donors, info, node)
+            if site is None:
+                continue
+            hazards = []
+            for i in site.static_argnums:
+                if i < len(node.args):
+                    hazards.append((node.args[i], f"position {i}"))
+            for kw in node.keywords:
+                if kw.arg in site.static_argnames:
+                    hazards.append((kw.value, f"'{kw.arg}'"))
+            for expr, where in hazards:
+                if isinstance(expr, ast.JoinedStr):
+                    out.append(self._f(
+                        module, node,
+                        f"f-string passed as static arg {where} of "
+                        f"jitted '{node.func.id}' — every distinct "
+                        "string compiles a fresh executable"))
+                elif _contains_shape(expr) and not _bucketed(expr):
+                    out.append(self._f(
+                        module, node,
+                        f"shape-derived value passed as static arg "
+                        f"{where} of jitted '{node.func.id}' without "
+                        "bucketing — compiles per distinct shape"))
+        return out
+
+    @staticmethod
+    def _lookup(donors, info, call) -> Optional[jitscope.JitSite]:
+        name = call.func.id
+        scope = info.enclosing_scope(call)
+        while scope is not None:
+            if (scope, name) in donors:
+                return donors[(scope, name)]
+            if scope is info.tree:
+                return None
+            scope = info.enclosing_scope(scope)
+        return None
+
+    # --- Python branches on traced values -----------------------------
+    def _traced_branch(self, module, info) -> List[Finding]:
+        out = []
+        for site in info.sites:
+            if site.target is None or not isinstance(
+                    site.target, jitscope.FunctionNode):
+                continue
+            traced = info.context_params(
+                site.target, site.static_argnames,
+                site.static_argnums)
+            if not traced:
+                continue
+            for node in ast.walk(site.target):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                bad = self._traced_test_name(node.test, traced)
+                if bad:
+                    kw = "if" if isinstance(node, ast.If) else "while"
+                    out.append(self._f(
+                        module, node,
+                        f"Python `{kw}` on traced value '{bad}' "
+                        f"inside jitted '{site.target.name}' — "
+                        "either fails tracing or recompiles per "
+                        "value; use lax.cond/lax.while_loop/"
+                        "jnp.where",
+                        symbol=site.target.name))
+        return out
+
+    @staticmethod
+    def _traced_test_name(test: ast.AST, traced) -> str:
+        """Name of a traced param the test branches on, or ''.
+        Shape/dtype/None/isinstance tests are static and fine."""
+        if isinstance(test, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot))
+                for op in test.ops):
+            return ""
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call):
+                name = jitscope.dotted_name(n.func)
+                if name in ("isinstance", "len", "hasattr",
+                            "getattr", "callable"):
+                    return ""
+            if isinstance(n, ast.Attribute) and \
+                    n.attr in _STATIC_UNDER_TRACE:
+                return ""
+        for n in ast.walk(test):
+            if isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Load) and n.id in traced:
+                return n.id
+        return ""
+
+    # --- jit() evaluated inside a loop --------------------------------
+    def _jit_in_loop(self, module, info) -> List[Finding]:
+        out = []
+        for site in info.sites:
+            if not isinstance(site.node, ast.Call):
+                continue
+            cur = info.parents.get(site.node)
+            while cur is not None and not isinstance(
+                    cur, jitscope.FunctionNode + (ast.Lambda,)):
+                if isinstance(cur, (ast.For, ast.While)):
+                    out.append(self._f(
+                        module, site.node,
+                        f"{site.wrapper}(...) evaluated inside a "
+                        "loop — a fresh executable (and compile) "
+                        "per iteration; hoist the wrap out of the "
+                        "loop"))
+                    break
+                cur = info.parents.get(cur)
+        return out
+
+    # --- executable caches keyed on raw shapes ------------------------
+    def _raw_shape_key(self, module, info) -> List[Finding]:
+        out = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Subscript):
+                continue
+            base = jitscope.dotted_name(node.value).lower()
+            if not base or not any(h in base.split(".")[-1]
+                                   for h in _CACHE_HINTS):
+                continue
+            key = node.slice
+            if _contains_shape(key) and not _bucketed(key):
+                out.append(self._f(
+                    module, node,
+                    f"cache '{base}' keyed on a raw .shape — "
+                    "unbucketed shape keys make the executable "
+                    "cache (and compile count) unbounded; bucket "
+                    "the shape (pow2) first"))
+        return out
+
+    def _f(self, module, node, msg, symbol="") -> Finding:
+        return Finding(rule=self.id, path=module.relpath,
+                       line=getattr(node, "lineno", 0),
+                       symbol=symbol, message=msg)

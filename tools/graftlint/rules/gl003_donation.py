@@ -1,0 +1,199 @@
+"""GL003 — donation audit.
+
+``donate_argnums`` hands a buffer to XLA for in-place reuse: after
+the call, the Python name still points at an invalidated array, and
+touching it raises (on real backends) or silently reads garbage
+through a stale host copy. The repo's executors donate params /
+state / opt-state on every train step, so the fit loops MUST follow
+the ``x = step(x, ...)`` rebinding idiom; this rule flags any read
+of a donated name after the donating call, in the same scope,
+before the name is rebound.
+
+Analysis is per lexical scope: a callable is "donating" when the
+scope can see its ``donate_argnums`` — a decorated local ``def``, or
+a ``name = jax.jit(f, donate_argnums=...)`` binding (resolved
+through ``functools.partial`` / aliases). Reads inside conditional
+branches count (the branch MAY execute); a rebind only clears the
+poison when it is unconditional at the same statement level.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graftlint.core import Finding, ParsedModule
+from tools.graftlint import jitscope
+from tools.graftlint.rules.base import Rule
+
+
+def _stmt_lists(node: ast.AST):
+    """Yield every list-of-statements field of a compound node."""
+    for field in ("body", "orelse", "finalbody"):
+        lst = getattr(node, field, None)
+        if isinstance(lst, list) and lst and isinstance(
+                lst[0], ast.stmt):
+            yield lst
+    for h in getattr(node, "handlers", []) or []:
+        yield h.body
+
+
+def _loads(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _direct_stores(stmt: ast.stmt) -> Set[str]:
+    """Names UNCONDITIONALLY rebound by this statement (assignment
+    targets at its own level — not inside a nested if/for body)."""
+    out: Set[str] = set()
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+class DonationAuditRule(Rule):
+    id = "GL003"
+    title = "donation-audit"
+    rationale = ("a buffer read after being donated to a jitted call "
+                 "is invalid memory")
+    scope = "file"
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        info = module.jit_info
+        donors: Dict[Tuple[ast.AST, str], jitscope.JitSite] = {}
+        for site in info.sites:
+            if site.bound_name and site.donate_argnums:
+                donors[(site.scope, site.bound_name)] = site
+        if not donors:
+            return []
+        out: List[Finding] = []
+        scopes = {s for (s, _n) in donors}
+        seen = set()
+        for scope in scopes:
+            for fn in self._functions_under(info, scope):
+                if fn in seen:
+                    continue            # reachable from two donor
+                seen.add(fn)            # scopes: scan once
+                out.extend(self._scan_function(
+                    module, info, donors, fn))
+        return out
+
+    @staticmethod
+    def _functions_under(info, scope) -> Iterable[ast.AST]:
+        """Function bodies that can call a name bound in ``scope``:
+        the scope itself (if a function/module) plus every function
+        nested below it."""
+        if isinstance(scope, jitscope.FunctionNode + (ast.Module,)):
+            yield scope
+        for node in ast.walk(scope):
+            if node is not scope and isinstance(
+                    node, jitscope.FunctionNode):
+                yield node
+
+    def _scan_function(self, module, info, donors, fn
+                       ) -> List[Finding]:
+        """Linear may-use scan over ``fn``'s statements."""
+        out: List[Finding] = []
+        poisoned: Dict[str, Tuple[str, int]] = {}  # name -> (callee, line)
+        reported: Set[Tuple[int, str]] = set()
+
+        def donating_site(call: ast.Call):
+            if not isinstance(call.func, ast.Name):
+                return None
+            scope = info.enclosing_scope(call)
+            while scope is not None:
+                if (scope, call.func.id) in donors:
+                    return donors[(scope, call.func.id)]
+                if scope is info.tree:
+                    return None
+                scope = info.enclosing_scope(scope)
+            return None
+
+        def report(name: str, line: int) -> None:
+            # NOTE: the donating call's line number must stay OUT of
+            # the message — the message is part of the baseline key,
+            # which is line-independent by contract (core.py)
+            callee, _dline = poisoned.pop(name)    # report once
+            if (line, name) in reported:     # loop bodies are walked
+                return                       # twice — dedup sites
+            reported.add((line, name))
+            out.append(Finding(
+                rule=self.id, path=module.relpath, line=line,
+                symbol=getattr(fn, "name", "<module>"),
+                message=(
+                    f"'{name}' used after being donated to "
+                    f"'{callee}' — the buffer was handed to XLA; "
+                    "rebind the result (x = step(x, ...)) or drop "
+                    "donate_argnums")))
+
+        def process_compound(stmt, nested) -> None:
+            # compound statement: check only its HEADER
+            # (test/iter/with-items) here, then recurse —
+            # body-level donations and uses must be seen in
+            # their real order
+            inner: Set[str] = set()
+            for lst in nested:
+                for s in lst:
+                    inner |= _loads(s)
+            header = _loads(stmt) - inner
+            for name in sorted(header & set(poisoned)):
+                report(name, stmt.lineno)
+            for name in _direct_stores(stmt):
+                poisoned.pop(name, None)
+            for lst in nested:
+                walk_stmts(lst)
+
+        def walk_stmts(stmts: List[ast.stmt]) -> None:
+            for stmt in stmts:
+                nested = list(_stmt_lists(stmt))
+                if nested and not isinstance(
+                        stmt, jitscope.FunctionNode):
+                    process_compound(stmt, nested)
+                    if isinstance(stmt, (ast.For, ast.AsyncFor,
+                                         ast.While)):
+                        # symbolic SECOND iteration: a name donated
+                        # in the body and not rebound by loop top is
+                        # read as invalid memory next time around
+                        # (`for b in xs: outs.append(step(params, b))`)
+                        process_compound(stmt, nested)
+                    continue
+                if isinstance(stmt, jitscope.FunctionNode):
+                    continue           # nested defs scan separately
+                # simple statement: uses first (the donating
+                # statement's own arg reads are not uses-after).
+                # An AugAssign target reads the buffer before
+                # writing (x += g desugars to x = x + g) even though
+                # its Name ctx is Store — count it as a use.
+                uses = _loads(stmt) & set(poisoned)
+                if isinstance(stmt, ast.AugAssign) and isinstance(
+                        stmt.target, ast.Name) and \
+                        stmt.target.id in poisoned:
+                    uses.add(stmt.target.id)
+                for name in sorted(uses):
+                    report(name, stmt.lineno)
+                stores = _direct_stores(stmt)
+                for name in stores:
+                    poisoned.pop(name, None)
+                for call in [n for n in ast.walk(stmt)
+                             if isinstance(n, ast.Call)]:
+                    site = donating_site(call)
+                    if site is None:
+                        continue
+                    for i in site.donate_argnums:
+                        if i < len(call.args) and isinstance(
+                                call.args[i], ast.Name):
+                            name = call.args[i].id
+                            if name not in stores:
+                                poisoned[name] = (call.func.id,
+                                                  call.lineno)
+
+        body = getattr(fn, "body", None)
+        if isinstance(body, list):
+            walk_stmts(body)
+        return out

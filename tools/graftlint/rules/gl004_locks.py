@@ -1,0 +1,436 @@
+"""GL004 — lock discipline.
+
+The serving workers, async checkpoint writer, prefetch threads and
+watchdogs share state under ``threading`` locks; the two bug classes
+that actually bite are (a) two code paths taking the same pair of
+locks in opposite orders — a deadlock that only fires under load —
+and (b) an attribute protected by a lock on one path and mutated
+bare on another, which is a data race the GIL hides until a
+preemption lands between read and write.
+
+Sub-checks (repo scope — the acquisition graph must span files):
+
+- **order**: build a lock-acquisition graph from lexically nested
+  ``with <lock>:`` blocks across every analyzed module; any cycle
+  (A→B somewhere, B→A elsewhere) is flagged at each participating
+  site.
+- **reacquire**: ``with self._lock:`` nested inside itself when the
+  attribute was created as a plain (non-reentrant)
+  ``threading.Lock`` — guaranteed self-deadlock.
+- **unlocked-write**: in a class that spawns threads and owns at
+  least one lock, an instance attribute assigned both inside a
+  ``with``-lock region and outside one (``__init__`` is exempt:
+  pre-thread construction is single-threaded). A helper method whose
+  every intra-class call site is lock-held counts as lock-held
+  itself (one-level call-graph fixpoint), so the
+  ``_locked_helper()`` convention does not false-positive.
+- **check-then-act**: in the same class population, a method that
+  TESTS an instance attribute (``if self._thread is None:``) and
+  WRITES it, both outside any lock — the classic double-start race:
+  two concurrent callers both pass the test and both act.
+
+Lock identity is lexical: ``<module>.<Class>.<attr>`` for instance
+locks, ``<module>.<NAME>`` for module-level locks.
+``threading.Lock/RLock/Condition/Semaphore`` (and ``Condition``'s
+implicit lock) all count.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.core import Finding, ParsedModule, RepoContext
+from tools.graftlint import jitscope
+from tools.graftlint.rules.base import Rule
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_THREAD_SPAWNERS = {"threading.Thread", "Thread",
+                    "concurrent.futures.ThreadPoolExecutor",
+                    "ThreadPoolExecutor"}
+
+
+def _attr_targets(stmt):
+    """Every ``x.attr`` assignment target of a statement, including
+    those nested in tuple/list unpacking (``a, self.x = ...``)."""
+    targets = (stmt.targets if isinstance(stmt, ast.Assign)
+               else [stmt.target])
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.value, ast.Name):
+                yield node
+
+
+def _lock_ctor(canon: str) -> Optional[str]:
+    """'Lock'/'RLock'/... when the canonical call name constructs a
+    threading lock."""
+    last = canon.rsplit(".", 1)[-1]
+    if last in _LOCK_CTORS and (
+            canon.startswith("threading.") or canon == last
+            or canon.startswith("multiprocessing.")):
+        return last
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, module: ParsedModule, node: ast.ClassDef,
+                 info: jitscope.ModuleJitInfo):
+        self.module = module
+        self.node = node
+        self.info = info
+        self.name = node.name
+        self.lock_attrs: Dict[str, str] = {}     # attr -> ctor kind
+        self.spawns_threads = False
+        self.methods: Dict[str, ast.AST] = {}
+        for stmt in node.body:
+            if isinstance(stmt, jitscope.FunctionNode):
+                self.methods[stmt.name] = stmt
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                canon = info.canon(n.func)
+                kind = _lock_ctor(canon)
+                if kind:
+                    tgt = self._self_attr_target(n)
+                    if tgt:
+                        self.lock_attrs[tgt] = kind
+                if canon in _THREAD_SPAWNERS:
+                    self.spawns_threads = True
+
+    def _self_attr_target(self, call: ast.Call) -> Optional[str]:
+        parent = self.info.parents.get(call)
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Attribute) and isinstance(
+                        t.value, ast.Name) and t.value.id == "self":
+                    return t.attr
+        return None
+
+
+class LockDisciplineRule(Rule):
+    id = "GL004"
+    title = "lock-discipline"
+    rationale = ("inconsistent lock order deadlocks under load; a "
+                 "sometimes-locked attribute is a data race")
+    scope = "repo"
+
+    def check_repo(self, ctx: RepoContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        # lockA -> lockB -> [(path, line, holder_desc)]
+        edges: Dict[str, Dict[str, List[Tuple[str, int]]]] = {}
+        # pass 1: every module-level lock in the analyzed set, keyed
+        # by canonical dotted identity — so pass 2 can recognize a
+        # lock IMPORTED from another module (`from a.b import LOCK`)
+        # and a genuine cross-file order inversion connects
+        per_module = []
+        global_locks: Dict[str, str] = {}
+        for module in ctx.modules:
+            info = module.jit_info
+            modname = os.path.splitext(
+                module.relpath.replace("/", "."))[0]
+            classes = [
+                _ClassInfo(module, n, info)
+                for n in ast.walk(module.tree)
+                if isinstance(n, ast.ClassDef)]
+            module_locks = self._module_locks(module, info)
+            for name, kind in module_locks.items():
+                global_locks[f"{modname}.{name}"] = kind
+            per_module.append((module, info, modname, classes,
+                               module_locks))
+        for module, info, modname, classes, module_locks in \
+                per_module:
+            by_node = {c.node: c for c in classes}
+            self._collect_edges(module, info, modname, by_node,
+                                module_locks, global_locks, edges,
+                                out)
+            for c in classes:
+                out.extend(self._unlocked_writes(c))
+        out.extend(self._order_cycles(edges))
+        return out
+
+    # ------------------------------------------------------------- locks
+    @staticmethod
+    def _module_locks(module, info) -> Dict[str, str]:
+        locks = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                kind = _lock_ctor(info.canon(node.value.func))
+                if kind and len(node.targets) == 1 and isinstance(
+                        node.targets[0], ast.Name) and isinstance(
+                        info.enclosing_scope(node), ast.Module):
+                    locks[node.targets[0].id] = kind
+        return locks
+
+    def _lock_identity(self, expr: ast.AST, modname: str,
+                       cls: Optional[_ClassInfo],
+                       module_locks: Dict[str, str],
+                       global_locks: Dict[str, str],
+                       info) -> Optional[Tuple[str, str]]:
+        """(identity, ctor_kind) when ``with <expr>`` takes a known
+        lock — a ``self.attr`` lock of this class, a module-level
+        lock of this module, or a module-level lock IMPORTED from
+        another analyzed module (resolved through the import alias
+        map to the same canonical identity its definition
+        registered)."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self" \
+                and cls is not None and expr.attr in cls.lock_attrs:
+            return (f"{modname}.{cls.name}.{expr.attr}",
+                    cls.lock_attrs[expr.attr])
+        if isinstance(expr, ast.Name) and expr.id in module_locks:
+            return (f"{modname}.{expr.id}", module_locks[expr.id])
+        canon = info.canon(expr)
+        if canon and canon in global_locks:
+            return (canon, global_locks[canon])
+        return None
+
+    def _collect_edges(self, module, info, modname, by_node,
+                       module_locks, global_locks, edges,
+                       out) -> None:
+        """Walk each function; record held-lock nesting."""
+
+        def owner_class(node) -> Optional[_ClassInfo]:
+            cur = info.parents.get(node)
+            while cur is not None:
+                if cur in by_node:
+                    return by_node[cur]
+                cur = info.parents.get(cur)
+            return None
+
+        def visit(node, held: List[Tuple[str, str]]):
+            for child in ast.iter_child_nodes(node):
+                # a nested def/lambda runs LATER (thread target,
+                # callback): the lexically enclosing lock is not
+                # held when its body executes
+                if isinstance(child,
+                              jitscope.FunctionNode + (ast.Lambda,)):
+                    visit(child, [])
+                    continue
+                new_held = held
+                if isinstance(child, ast.With):
+                    cls = owner_class(child)
+                    acquired = []
+                    for item in child.items:
+                        ident = self._lock_identity(
+                            item.context_expr, modname, cls,
+                            module_locks, global_locks, info)
+                        if ident:
+                            acquired.append(ident)
+                    for ident, kind in acquired:
+                        for h_ident, _h_kind in held + acquired[
+                                :acquired.index((ident, kind))]:
+                            if h_ident == ident:
+                                if kind == "Lock":
+                                    out.append(Finding(
+                                        rule=self.id,
+                                        path=module.relpath,
+                                        line=child.lineno,
+                                        symbol=ident,
+                                        message=(
+                                            f"non-reentrant lock "
+                                            f"'{ident}' re-acquired "
+                                            "while already held — "
+                                            "self-deadlock")))
+                                continue
+                            edges.setdefault(h_ident, {}).setdefault(
+                                ident, []).append(
+                                (module.relpath, child.lineno))
+                    new_held = held + acquired
+                visit(child, new_held)
+
+        visit(module.tree, [])
+
+    def _order_cycles(self, edges) -> List[Finding]:
+        out = []
+        seen_pairs = set()
+        for a, targets in edges.items():
+            for b in targets:
+                if a == b:
+                    continue
+                if b in edges and a in edges[b]:
+                    pair = tuple(sorted((a, b)))
+                    if pair in seen_pairs:
+                        continue
+                    seen_pairs.add(pair)
+                    sites = edges[a][b] + edges[b][a]
+                    for path, line in sites:
+                        out.append(Finding(
+                            rule=self.id, path=path, line=line,
+                            symbol=f"{pair[0]}<->{pair[1]}",
+                            message=(
+                                f"inconsistent lock order between "
+                                f"'{pair[0]}' and '{pair[1]}': both "
+                                "acquisition orders occur — "
+                                "deadlock under contention; pick "
+                                "one order")))
+        return out
+
+    # ------------------------------------------------- unlocked writes
+    def _unlocked_writes(self, c: _ClassInfo) -> List[Finding]:
+        if not c.spawns_threads or not c.lock_attrs:
+            return []
+        info = c.info
+
+        def with_is_lock(w: ast.With) -> bool:
+            for item in w.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute) and isinstance(
+                        e.value, ast.Name) and e.value.id == "self" \
+                        and e.attr in c.lock_attrs:
+                    return True
+            return False
+
+        def inside_lock(node: ast.AST) -> bool:
+            # stop at the first def/lambda boundary: a nested closure
+            # (thread target, callback) runs LATER, when the
+            # lexically enclosing ``with self._lock:`` is no longer
+            # held — only a lock taken inside the same executing
+            # function counts
+            cur = info.parents.get(node)
+            while cur is not None and cur is not c.node:
+                if isinstance(cur, ast.With) and with_is_lock(cur):
+                    return True
+                if isinstance(cur,
+                              jitscope.FunctionNode + (ast.Lambda,)):
+                    return False
+                cur = info.parents.get(cur)
+            return False
+
+        def method_of(node: ast.AST) -> Optional[str]:
+            cur = node
+            while cur is not None:
+                parent = info.parents.get(cur)
+                if parent is c.node and isinstance(
+                        cur, jitscope.FunctionNode):
+                    return cur.name
+                cur = parent
+            return None
+
+        def in_closure(node: ast.AST) -> bool:
+            """True when a def/lambda sits strictly between ``node``
+            and its class-level method — the node executes on the
+            closure's schedule, so the method's lock-held status
+            does not transfer to it."""
+            cur = info.parents.get(node)
+            while cur is not None and cur is not c.node:
+                parent = info.parents.get(cur)
+                if isinstance(cur,
+                              jitscope.FunctionNode + (ast.Lambda,)) \
+                        and parent is not c.node:
+                    return True
+                cur = parent
+            return False
+
+        # intra-class call sites:
+        # method -> [(caller, locked_ctx, in_closure)]
+        calls: Dict[str, List[Tuple[str, bool, bool]]] = {}
+        for n in ast.walk(c.node):
+            if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and isinstance(
+                    n.func.value, ast.Name) and \
+                    n.func.value.id == "self" and \
+                    n.func.attr in c.methods:
+                caller = method_of(n)
+                if caller:
+                    calls.setdefault(n.func.attr, []).append(
+                        (caller, inside_lock(n), in_closure(n)))
+
+        # greatest-fixpoint "this method only ever runs lock-held";
+        # a call made from a nested closure inherits nothing from
+        # its caller's lock status (the closure runs later)
+        locked_m = {m: bool(calls.get(m)) for m in c.methods}
+        for _ in range(len(c.methods) + 1):
+            changed = False
+            for m, sites in calls.items():
+                if not locked_m.get(m):
+                    continue
+                ok = all(held or (locked_m.get(caller, False)
+                                  and not in_clo)
+                         for caller, held, in_clo in sites)
+                if not ok:
+                    locked_m[m] = False
+                    changed = True
+            if not changed:
+                break
+
+        # attribute write sites — walk INTO tuple-unpacking targets
+        # (`t, self._x = self._x, None` writes self._x too)
+        writes: Dict[str, List[Tuple[int, bool]]] = {}
+        for n in ast.walk(c.node):
+            if isinstance(n, (ast.Assign, ast.AugAssign,
+                              ast.AnnAssign)):
+                for t in _attr_targets(n):
+                    if t.value.id == "self":
+                        m = method_of(n)
+                        if m is None or m == "__init__":
+                            continue
+                        if t.attr in c.lock_attrs:
+                            continue
+                        held = (inside_lock(n)
+                                or (locked_m.get(m, False)
+                                    and not in_closure(n)))
+                        writes.setdefault(t.attr, []).append(
+                            (n.lineno, held))
+        out = []
+        for attr, sites in sorted(writes.items()):
+            locked = [s for s in sites if s[1]]
+            bare = [s for s in sites if not s[1]]
+            if locked and bare:
+                for line, _h in bare:
+                    out.append(Finding(
+                        rule=self.id, path=c.module.relpath,
+                        line=line, symbol=f"{c.name}.{attr}",
+                        message=(
+                            f"attribute 'self.{attr}' of "
+                            f"thread-spawning class '{c.name}' is "
+                            "written without its lock here but "
+                            "under a lock elsewhere — take the "
+                            "lock or document the single-writer "
+                            "invariant with a suppression")))
+        out.extend(self._check_then_act(
+            c, inside_lock, method_of, locked_m))
+        return out
+
+    def _check_then_act(self, c: _ClassInfo, inside_lock, method_of,
+                        locked_m) -> List[Finding]:
+        """Per method: a bare ``if``/``while`` TEST of ``self.X``
+        plus a bare WRITE of ``self.X`` = a double-start race."""
+        info = c.info
+        out = []
+        for mname, mnode in c.methods.items():
+            if mname == "__init__" or locked_m.get(mname):
+                continue
+            tests: Dict[str, int] = {}
+            bare_writes: Set[str] = set()
+            for n in ast.walk(mnode):
+                if isinstance(n, (ast.If, ast.While)) and \
+                        not inside_lock(n):
+                    for sub in ast.walk(n.test):
+                        if isinstance(sub, ast.Attribute) and \
+                                isinstance(sub.value, ast.Name) and \
+                                sub.value.id == "self" and \
+                                isinstance(sub.ctx, ast.Load):
+                            tests.setdefault(sub.attr, n.lineno)
+                if isinstance(n, (ast.Assign, ast.AugAssign)):
+                    for t in _attr_targets(n):
+                        if t.value.id == "self" and \
+                                not inside_lock(n):
+                            bare_writes.add(t.attr)
+            for attr in sorted(set(tests) & bare_writes):
+                if attr in c.lock_attrs:
+                    continue
+                out.append(Finding(
+                    rule=self.id, path=c.module.relpath,
+                    line=tests[attr], symbol=f"{c.name}.{attr}",
+                    message=(
+                        f"unlocked check-then-act on "
+                        f"'self.{attr}' in "
+                        f"'{c.name}.{mname}': the attribute is "
+                        "tested and written with no lock held — "
+                        "two concurrent callers both pass the "
+                        "test; take one of the class's locks "
+                        "around the check and the act")))
+        return out
