@@ -1331,6 +1331,131 @@ def _leg_serving_throughput(peak):
                  "shapes pre-warmed; in-process, no HTTP")}
 
 
+TRACE_SAMPLE_RATES = (0.0, 0.01, 1.0)
+TRACE_OVERHEAD_BAR = 0.02      # ≤2% throughput cost at 1% sampling
+
+
+def _leg_tracing_overhead(peak):
+    """What request-scoped tracing costs the serving hot path: the
+    serving_throughput harness re-run at head-sampling 0% / 1% /
+    100%. Every request carries a RequestContext (the phase ledger
+    feeds the attribution histograms unconditionally); sampling only
+    gates span EMISSION — so the 1%-vs-0% delta is the number the
+    default config actually pays. Bar: ≤2% at 1% sampling."""
+    import threading
+
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.observability.tracing import (
+        RequestContext, Sampler, trace)
+    from deeplearning4j_tpu.serving.metrics import ServingMetrics
+    from deeplearning4j_tpu.serving.scheduler import BatchScheduler
+
+    feat, hidden, classes, max_bs = 32, 128, 16, 64
+    conf = (NeuralNetConfiguration.builder().set_seed(0)
+            .updater(updaters.adam(1e-3)).list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=classes, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(feat)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    xs = rng.normal(0, 1, (SERVE_CONC, 1, feat)).astype("float32")
+    s = 1
+    while s <= max_bs:
+        np.asarray(net.output(np.zeros((s, feat), np.float32)))
+        s *= 2
+
+    def run_at(rate):
+        sampler = Sampler(rate=rate)
+        metrics = ServingMetrics()
+        sched = BatchScheduler(net, max_batch_size=max_bs,
+                               queue_limit=4 * SERVE_CONC,
+                               wait_ms=1.0, metrics=metrics)
+        per_client = SERVE_REQUESTS // SERVE_CONC
+        errs = []
+
+        def client(c):
+            try:
+                for _ in range(per_client):
+                    ctx = RequestContext.new(
+                        "/v1/predict", sampler)
+                    sched.predict(xs[c], ctx=ctx)
+            except BaseException as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(SERVE_CONC)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        sched.shutdown()
+        if errs:
+            raise errs[0]
+        trace.clear()     # don't let the 100% run's buffer linger
+        return per_client * SERVE_CONC / dt
+
+    # PAIRED back-to-back runs, median of ratios: single-run
+    # scheduler throughput swings ±50% on a noisy host and the drift
+    # is not monotone, so best-of / averaged absolute numbers charge
+    # machine weather to whichever rate ran at the wrong time. A
+    # ratio within one adjacent pair cancels the drift; the median
+    # over pairs (with pair order alternating) is robust to the
+    # outlier rounds. This is the same drift problem the interleaved
+    # bench_ours/bench_ref measurement solves, at percent scale.
+    import statistics
+
+    def paired_ratio(rate, pairs=6):
+        ratios = []
+        for i in range(pairs):
+            if i % 2 == 0:
+                base, test = run_at(0.0), run_at(rate)
+            else:
+                test, base = run_at(rate), run_at(0.0)
+            ratios.append(test / base)
+        return statistics.median(ratios)
+
+    rel_1pct = paired_ratio(0.01)
+    rel_full = paired_ratio(1.0)
+    rps_base = run_at(0.0)
+    overhead_1pct = max(0.0, 1.0 - rel_1pct)
+    overhead_full = max(0.0, 1.0 - rel_full)
+    print(f"tracing overhead: ~{rps_base:.0f} req/s; 1% sampling "
+          f"{rel_1pct:.3f}x of unsampled "
+          f"({overhead_1pct * 100:.1f}% cost), 100% sampling "
+          f"{rel_full:.3f}x ({overhead_full * 100:.1f}% cost)",
+          file=sys.stderr)
+    return {
+        "metric": (f"request-tracing overhead (serving scheduler, "
+                   f"{SERVE_CONC} closed-loop clients, 1-row "
+                   "requests)"),
+        "value": round(rel_1pct, 3),
+        "unit": "throughput ratio (1% sampling / unsampled)",
+        "baseline": 1.0,
+        "vs_baseline": round(rel_1pct, 3),
+        "rps_unsampled": round(rps_base, 1),
+        "ratio_sampled_100pct": round(rel_full, 3),
+        "overhead_at_1pct": round(overhead_1pct, 4),
+        "overhead_at_100pct": round(overhead_full, 4),
+        "bar_overhead_at_1pct": TRACE_OVERHEAD_BAR,
+        "passed_bar": bool(overhead_1pct <= TRACE_OVERHEAD_BAR),
+        "mfu": None,
+        "note": ("serving_throughput harness with every request "
+                 "carrying a RequestContext; sampling gates span "
+                 "emission only (phase ledger + attribution "
+                 "histograms record at EVERY rate). Median of 6 "
+                 "paired back-to-back ratios, pair order "
+                 "alternating — drift-robust on noisy hosts; "
+                 "bar: ≤2% cost at 1% sampling")}
+
+
 DECODE_STEPS = 128
 DECODE_CAP = 256
 MASKED_ATTN_SHAPE = (4, 4096, 8, 64)     # B, T, H, D
@@ -1666,6 +1791,8 @@ _LEGS = [
     ("resnet_native_etl", _leg_resnet_native_etl, 480),
     # host-side (no device step in the loop): cheap, runs last
     ("checkpoint_async", _leg_checkpoint_async, 120),
+    # CPU-dominated (tiny MLP, scheduler hot path): cheap, runs last
+    ("tracing_overhead", _leg_tracing_overhead, 180),
 ]
 
 # every runnable --leg (the burst headline rides outside the ordered

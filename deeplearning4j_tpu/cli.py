@@ -138,6 +138,7 @@ def _cmd_serve_knn(args):
 def _cmd_serve(args):
     import time
     from deeplearning4j_tpu.serving.http import ModelServer
+    from deeplearning4j_tpu.serving.metrics import ServingMetrics
     from deeplearning4j_tpu.serving.registry import ModelRegistry
     from deeplearning4j_tpu.util.model_serializer import restore_model
     registry = ModelRegistry()
@@ -151,14 +152,27 @@ def _cmd_serve(args):
             name, path = "default", spec
         version = registry.register(name, restore_model(path))
         print(f"registered {name} v{version} from {path}")
+    metrics = ServingMetrics()
+    slos = None
+    if args.slo:
+        # declarative SLO rules (JSON inline or a file); burn rates
+        # are evaluated on /healthz and /metrics reads, breaches
+        # degrade health and leave flight-recorder bundles carrying
+        # the offending trace ids
+        from deeplearning4j_tpu.observability.slo import SLOMonitor
+        slos = SLOMonitor.from_config(metrics.registry, args.slo)
+        print(f"SLOs: {', '.join(s['name'] for s in slos.status())}")
     server = ModelServer(
         registry, port=args.port, host=args.host,
         max_batch_size=args.max_batch_size,
         queue_limit=args.queue_limit, wait_ms=args.wait_ms,
-        slots=args.slots, capacity=args.capacity).start()
+        slots=args.slots, capacity=args.capacity, metrics=metrics,
+        sample_rate=args.trace_sample, slow_ms=args.slow_ms,
+        slos=slos).start()
     print(f"serving on http://{args.host}:{server.port}/ "
-          f"(/v1/predict /v1/generate /v1/models /healthz /metrics; "
-          "ctrl-c drains and stops)")
+          f"(/v1/predict /v1/generate /v1/models /healthz /metrics "
+          f"/debug/requests /debug/slots /debug/traces; trace "
+          f"sampling {args.trace_sample:g}; ctrl-c drains and stops)")
     try:
         while True:
             time.sleep(3600)
@@ -266,6 +280,20 @@ def main(argv=None):
                    help="continuous-batching KV-cache slots")
     v.add_argument("--capacity", type=int, default=256,
                    help="max prompt+generated tokens per request")
+    v.add_argument("--trace-sample", type=float, default=0.01,
+                   metavar="RATE",
+                   help="head-based request-trace sampling rate in "
+                        "[0, 1] (default 0.01); deterministic in the "
+                        "trace id, honours inbound W3C traceparent "
+                        "headers, errors always sampled")
+    v.add_argument("--slow-ms", type=float, default=250.0,
+                   help="requests at or above this duration land in "
+                        "the /debug/traces slow ring")
+    v.add_argument("--slo", metavar="RULES", default=None,
+                   help="declarative SLOs: inline JSON or a JSON "
+                        "file (see README 'Request tracing & SLOs' "
+                        "for the rule schema); multi-window burn-rate "
+                        "breaches flip /healthz to degraded")
     v.set_defaults(fn=_cmd_serve)
 
     s = sub.add_parser("summary", help="inspect a model file")

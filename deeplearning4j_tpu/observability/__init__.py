@@ -41,12 +41,16 @@ from deeplearning4j_tpu.observability.health import (
 from deeplearning4j_tpu.observability.registry import (
     REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
 )
+from deeplearning4j_tpu.observability.slo import (
+    SLO, BurnWindow, SLOMonitor,
+)
 from deeplearning4j_tpu.observability.step_profile import (
     ProfilerListener, detect_peak_flops, model_flops_utilization,
     peak_flops_for_kind,
 )
 from deeplearning4j_tpu.observability.tracing import (
-    Tracer, get_tracer, trace,
+    RequestContext, Sampler, Tracer, current_context, get_tracer,
+    trace,
 )
 
 __all__ = [
@@ -56,5 +60,6 @@ __all__ = [
     "watch", "REGISTRY", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "ProfilerListener", "detect_peak_flops",
     "model_flops_utilization", "peak_flops_for_kind", "Tracer",
-    "get_tracer", "trace",
+    "get_tracer", "trace", "RequestContext", "Sampler",
+    "current_context", "SLO", "BurnWindow", "SLOMonitor",
 ]

@@ -85,6 +85,14 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._events: collections.deque = collections.deque(
             maxlen=capacity)
+        # spans the tracer announced OPEN but has not yet closed:
+        # keyed by span id (fallback: name+thread+ts); a crash-time
+        # bundle includes these with an ``unclosed`` marker — the
+        # work in flight at the moment of death, which close-only
+        # sinks used to lose entirely
+        self._open_spans: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._open_cap = 4096
         self.total_events = 0       # including ones the ring dropped
         self.dumps: List[str] = []
         self._last_dump = -float("inf")
@@ -118,12 +126,30 @@ class FlightRecorder:
             self._events.append(ev)
             self.total_events += 1
 
+    @staticmethod
+    def _span_key(span_event: dict) -> str:
+        sid = span_event.get("span_id")
+        if sid:
+            return sid
+        return (f"{span_event.get('name')}|{span_event.get('tid')}|"
+                f"{span_event.get('ts_us')}")
+
     def _on_span(self, span_event: dict) -> None:
-        # tracer sink: called for every completed span while tracing
-        # is enabled; the ring bounds memory, never the tracer
+        # tracer sink: span-open events maintain the open-span table
+        # (never the ring); close events retire their open entry and
+        # land in the ring. The ring bounds memory, never the tracer.
+        if span_event.get("ph") == "open":
+            ev = {"t": time.time(), "kind": "span_open"}
+            ev.update(span_event)
+            with self._lock:
+                self._open_spans[self._span_key(span_event)] = ev
+                while len(self._open_spans) > self._open_cap:
+                    self._open_spans.popitem(last=False)
+            return
         ev = {"t": time.time(), "kind": "span"}
         ev.update(span_event)
         with self._lock:
+            self._open_spans.pop(self._span_key(span_event), None)
             self._events.append(ev)
             self.total_events += 1
 
@@ -227,8 +253,16 @@ class FlightRecorder:
         files = []
 
         evs = self.events()
+        with self._lock:
+            open_now = [dict(ev, unclosed=True,
+                             age_s=round(time.time() - ev["t"], 3))
+                        for ev in self._open_spans.values()]
         with open(os.path.join(bundle, "events.jsonl"), "w") as f:
             for ev in evs:
+                f.write(json.dumps(ev, default=_jsonable) + "\n")
+            # spans still open at dump time (the work in flight when
+            # the process died) ride the same file, marked unclosed
+            for ev in open_now:
                 f.write(json.dumps(ev, default=_jsonable) + "\n")
         files.append("events.jsonl")
 
@@ -258,6 +292,7 @@ class FlightRecorder:
             json.dump({"reason": reason, "created": time.time(),
                        "files": sorted(files + ["MANIFEST.json"]),
                        "events": len(evs),
+                       "unclosed_spans": len(open_now),
                        "events_total": self.total_events,
                        "events_dropped_from_ring": dropped}, f,
                       indent=2)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -104,7 +105,7 @@ class Counter(_Metric):
         with self._lock:
             return self._value
 
-    def expose(self) -> List[str]:
+    def expose(self, openmetrics: bool = False) -> List[str]:
         return [f"{self.name}{_fmt_labels(self.labels)} "
                 f"{_fmt_value(self.value)}"]
 
@@ -141,7 +142,7 @@ class Gauge(_Metric):
         with self._lock:
             return self._value
 
-    def expose(self) -> List[str]:
+    def expose(self, openmetrics: bool = False) -> List[str]:
         v = self.value()
         if v is None:
             return []
@@ -164,8 +165,15 @@ class Histogram(_Metric):
         self.counts = [0] * (len(self.edges) + 1)   # +1 overflow
         self.count = 0
         self.sum = 0.0
+        # per-bucket exemplars: bucket index -> (labels, value, unix
+        # ts). An exemplar links an aggregate bucket back to ONE
+        # concrete observation (a sampled trace id), so a p99 spike
+        # on a dashboard resolves to a trace in the flight recorder.
+        self._exemplars: Dict[int, Tuple[Dict[str, str], float,
+                                         float]] = {}
 
-    def record(self, v: float) -> None:
+    def record(self, v: float,
+               exemplar: Optional[Dict[str, str]] = None) -> None:
         i = 0
         edges = self.edges
         while i < len(edges) and v > edges[i]:
@@ -174,9 +182,32 @@ class Histogram(_Metric):
             self.counts[i] += 1
             self.count += 1
             self.sum += v
+            if exemplar:
+                self._exemplars[i] = (dict(exemplar), float(v),
+                                      time.time())
 
     # alias matching prometheus client naming
     observe = record
+
+    def bucket_counts(self) -> Tuple[List[float], List[int], int,
+                                     float]:
+        """Consistent snapshot of ``(edges, counts, count, sum)`` —
+        the SLO layer derives good/total counts from the buckets."""
+        with self._lock:
+            return (list(self.edges), list(self.counts), self.count,
+                    self.sum)
+
+    def exemplars(self) -> List[dict]:
+        """Current per-bucket exemplars: ``{le, labels, value, ts}``
+        (``le`` is the bucket's upper edge; ``inf`` for overflow)."""
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        out = []
+        for i, (labels, v, ts) in items:
+            le = self.edges[i] if i < len(self.edges) else math.inf
+            out.append({"le": le, "labels": labels, "value": v,
+                        "ts": ts})
+        return out
 
     def quantile(self, q: float) -> float:
         """Approximate quantile: linear interpolation inside the
@@ -207,21 +238,39 @@ class Histogram(_Metric):
                 "p95": self.quantile(0.95),
                 "p99": self.quantile(0.99)}
 
-    def expose(self) -> List[str]:
+    @staticmethod
+    def _exemplar_suffix(ex) -> str:
+        # OpenMetrics exemplar syntax: `... # {trace_id="abc"} v ts`.
+        # Exemplars are ONLY legal in the OpenMetrics exposition —
+        # the classic text format (text/plain; version=0.0.4) allows
+        # nothing after the value but an integer timestamp, and a real
+        # Prometheus scrape of a classic payload with this tail fails
+        # to parse ENTIRELY — so expose() emits it only when asked
+        # for openmetrics output.
+        if ex is None:
+            return ""
+        labels, v, ts = ex
+        return (f" # {_fmt_labels(None, labels)} {_fmt_value(v)} "
+                f"{ts:.3f}")
+
+    def expose(self, openmetrics: bool = False) -> List[str]:
         with self._lock:
             counts = list(self.counts)
             count, total = self.count, self.sum
+            exemplars = (dict(self._exemplars) if openmetrics else {})
         out = []
         cum = 0
-        for edge, c in zip(self.edges, counts):
+        for i, (edge, c) in enumerate(zip(self.edges, counts)):
             cum += c
             out.append(
                 f"{self.name}_bucket"
                 f"{_fmt_labels(self.labels, {'le': f'{edge:.6g}'})}"
-                f" {cum}")
+                f" {cum}"
+                f"{self._exemplar_suffix(exemplars.get(i))}")
         out.append(f"{self.name}_bucket"
                    f"{_fmt_labels(self.labels, {'le': '+Inf'})}"
-                   f" {count}")
+                   f" {count}"
+                   f"{self._exemplar_suffix(exemplars.get(len(self.edges)))}")
         out.append(f"{self.name}_sum{_fmt_labels(self.labels)} "
                    f"{_fmt_value(total)}")
         out.append(f"{self.name}_count{_fmt_labels(self.labels)} "
@@ -335,10 +384,15 @@ class MetricsRegistry:
                 out[key] = m.snapshot()
         return out
 
-    def prometheus_text(self) -> str:
-        """The standard exposition format (text/plain; version=0.0.4).
-        Families are grouped so a name shared by many label sets gets
-        one # TYPE header."""
+    def prometheus_text(self, openmetrics: bool = False) -> str:
+        """The standard exposition format (text/plain; version=0.0.4),
+        or — with ``openmetrics=True`` — OpenMetrics text
+        (application/openmetrics-text): same families plus per-bucket
+        exemplars and the mandatory ``# EOF`` terminator. Exemplars
+        are NOT emitted in the classic format, where they are a
+        parse error that would kill the whole scrape. Families are
+        grouped so a name shared by many label sets gets one # TYPE
+        header."""
         families: Dict[str, List[_Metric]] = {}
         order: List[str] = []
         for m in self.collect():
@@ -350,11 +404,22 @@ class MetricsRegistry:
         for name in order:
             members = families[name]
             head = members[0]
+            family = name
+            if openmetrics and head.kind == "counter" \
+                    and family.endswith("_total"):
+                # OpenMetrics counter families are named WITHOUT the
+                # _total suffix (the sample keeps it); declaring the
+                # family as `foo_total` makes the bare `foo_total`
+                # sample a clashing name that strict parsers reject,
+                # killing the whole scrape
+                family = family[:-len("_total")]
             if head.help:
-                lines.append(f"# HELP {name} {head.help}")
-            lines.append(f"# TYPE {name} {head.kind}")
+                lines.append(f"# HELP {family} {head.help}")
+            lines.append(f"# TYPE {family} {head.kind}")
             for m in members:
-                lines.extend(m.expose())
+                lines.extend(m.expose(openmetrics=openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -57,7 +57,8 @@ def pow2_pad_rows(x: np.ndarray) -> np.ndarray:
     return np.concatenate([x, pad], axis=0)
 
 
-def serve_batch_with_retry(output_fn, batch, count_error=None) -> None:
+def serve_batch_with_retry(output_fn, batch, count_error=None,
+                           before_complete=None) -> None:
     """Serve one coalesced batch of waitable requests (items with
     ``.x``/``.result``/``.error``/``.event``), with the poison-request
     recovery policy shared by this collector and the serving
@@ -69,7 +70,20 @@ def serve_batch_with_retry(output_fn, batch, count_error=None) -> None:
     hammering it once per waiter would wedge the collector for the
     whole outage. Retries are pow2-padded: the raw row count may be a
     shape the bucketing never compiled, and a cold compile
-    mid-recovery would wedge the collector."""
+    mid-recovery would wedge the collector.
+
+    ``before_complete(r)`` (optional) runs right before each item's
+    ``event.set()`` — the serving scheduler closes the request's
+    device-step trace segment there, which must happen before the
+    waiter thread can wake and stamp the respond segment."""
+    def _done(r):
+        if before_complete is not None:
+            try:
+                before_complete(r)
+            except Exception:
+                pass      # instrumentation must not fail delivery
+        r.event.set()
+
     try:
         x = np.concatenate([r.x for r in batch], axis=0)
         out = np.asarray(output_fn(pow2_pad_rows(x)))
@@ -78,7 +92,7 @@ def serve_batch_with_retry(output_fn, batch, count_error=None) -> None:
             n = r.x.shape[0]
             r.result = out[off:off + n]
             off += n
-            r.event.set()
+            _done(r)
     except BaseException as batch_err:
         consecutive = 0
         for r in batch:
@@ -86,7 +100,7 @@ def serve_batch_with_retry(output_fn, batch, count_error=None) -> None:
                 r.error = batch_err
                 if count_error is not None:
                     count_error()
-                r.event.set()
+                _done(r)
                 continue
             try:
                 out = np.asarray(output_fn(pow2_pad_rows(r.x)))
@@ -97,7 +111,7 @@ def serve_batch_with_retry(output_fn, batch, count_error=None) -> None:
                 r.error = e
                 if count_error is not None:
                     count_error()
-            r.event.set()
+            _done(r)
 
 
 class InferenceMode:

@@ -23,6 +23,7 @@ _EXPORTS = {
     "LatencyHistogram": "metrics",
     "EndpointMetrics": "metrics",
     "BatchOccupancy": "metrics",
+    "StreamingMetrics": "metrics",
     "ServingMetrics": "metrics",
     "ModelRegistry": "registry",
     "BatchScheduler": "scheduler",

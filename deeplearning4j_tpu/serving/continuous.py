@@ -30,6 +30,7 @@ from typing import List, Optional
 import numpy as np
 
 from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.observability.tracing import RequestContext
 from deeplearning4j_tpu.serving.errors import DeadlineExceededError
 from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
                                                   CircuitBreaker,
@@ -51,7 +52,8 @@ class _GenRequest(BaseRequest):
 
 
 class _Slot:
-    __slots__ = ("req", "feed", "prompt_left", "out", "rng")
+    __slots__ = ("req", "feed", "prompt_left", "out", "rng",
+                 "t_slotted", "t_last_token")
 
     def __init__(self, req: _GenRequest):
         self.req = req
@@ -60,6 +62,8 @@ class _Slot:
         self.out: List[int] = []
         self.rng = (np.random.default_rng(req.seed)
                     if req.temperature > 0 else None)
+        self.t_slotted = time.monotonic()
+        self.t_last_token: Optional[float] = None
 
 
 class ContinuousBatcher(ServingBackend):
@@ -75,7 +79,8 @@ class ContinuousBatcher(ServingBackend):
                  queue_limit: int = 64,
                  metrics: Optional[ServingMetrics] = None,
                  name: str = "generate", dtype=None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 version: str = "0"):
         super().__init__("contbatch", name, queue_limit, slots,
                          metrics, breaker=breaker)
         try:
@@ -89,6 +94,10 @@ class ContinuousBatcher(ServingBackend):
             # unregister_gauge docstring's warning)
             self._unregister_gauges()
             raise
+        # streaming latency (TTFT / inter-token), labeled by model
+        # version — a whole-request histogram can't show a
+        # first-token stall inside an otherwise-fast stream
+        self._stream = self.metrics.streaming(name, version)
         self.slots = slots
         self.capacity = capacity
         self._slots: List[Optional[_Slot]] = [None] * slots
@@ -101,9 +110,13 @@ class ContinuousBatcher(ServingBackend):
     # ---- admission ----
     def submit(self, prompt, n_tokens: int, temperature: float = 0.0,
                seed: int = 0,
-               timeout: Optional[float] = None) -> _GenRequest:
+               timeout: Optional[float] = None,
+               ctx=None) -> _GenRequest:
         """Enqueue one generate request. ``prompt`` is a 1-d (or
-        (1, T0)) sequence of token ids; returns a waitable handle."""
+        (1, T0)) sequence of token ids; returns a waitable handle.
+        ``ctx`` is the request's trace context (minted at HTTP
+        admission); a fresh unsampled one is created for in-process
+        callers so phase attribution covers them too."""
         probe = self._admit_guard()
         prompt = np.asarray(prompt)
         if prompt.ndim > 1 and prompt.shape[0] != 1:
@@ -126,16 +139,21 @@ class ContinuousBatcher(ServingBackend):
                 f"exceeds slot capacity {self.capacity}")
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
+        if ctx is None:
+            ctx = RequestContext(route=self.name, deadline=deadline)
+        ctx.phase_done("admission", now_in="queue_wait")
         r = _GenRequest(prompt, int(n_tokens), float(temperature),
                         int(seed), deadline)
+        r.ctx = ctx
         r.probe = probe
         return self._enqueue(r)
 
     def generate(self, prompt, n_tokens: int, temperature: float = 0.0,
                  seed: int = 0,
-                 timeout: Optional[float] = None) -> np.ndarray:
+                 timeout: Optional[float] = None,
+                 ctx=None) -> np.ndarray:
         return self.wait(self.submit(prompt, n_tokens, temperature,
-                                     seed, timeout=timeout))
+                                     seed, timeout=timeout, ctx=ctx))
 
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s is not None)
@@ -170,6 +188,8 @@ class ContinuousBatcher(ServingBackend):
                 r.error = DeadlineExceededError(
                     "generate request deadline expired while queued "
                     "(decoding never started)")
+                if r.ctx is not None:
+                    r.ctx.set_error(r.error)
                 r.event.set()
             else:
                 keep.append(r)
@@ -182,6 +202,11 @@ class ContinuousBatcher(ServingBackend):
                 return
             r = self._pending.pop(0)
             self.session.reset_slot(free[0])
+            if r.ctx is not None:
+                # slotted: queue_wait ends, prefill begins (prompt
+                # tokens ride the decode steps teacher-forced)
+                r.ctx.phase_done("queue_wait", now_in="prefill",
+                                 attrs={"slot": free[0]})
             self._slots[free[0]] = _Slot(r)
 
     @staticmethod
@@ -273,12 +298,56 @@ class ContinuousBatcher(ServingBackend):
                     self._slots[i] = None
                     continue
                 s.out.append(nxt)
+                now_t = time.monotonic()
+                ctx = s.req.ctx
+                tid = (ctx.trace_id
+                       if ctx is not None and ctx.sampled else None)
+                if len(s.out) == 1:
+                    # first emitted token: prefill ends, decode
+                    # begins; TTFT measured from admission (what the
+                    # caller actually waited for a first token)
+                    if ctx is not None:
+                        ctx.phase_done("prefill", now_in="decode")
+                    self._stream.record_ttft(
+                        now_t - s.req.t_submit, trace_id=tid)
+                elif s.t_last_token is not None:
+                    self._stream.record_itl(
+                        now_t - s.t_last_token, trace_id=tid)
+                s.t_last_token = now_t
                 if len(s.out) >= s.req.n_tokens:
                     s.req.result = np.asarray(s.out, np.int64)
+                    if ctx is not None:
+                        # decode segment closes BEFORE the event: the
+                        # waiter's respond stamp must come after
+                        ctx.phase_done(
+                            "decode", now_in="respond",
+                            attrs={"tokens": len(s.out)})
                     s.req.event.set()
                     self._slots[i] = None    # slot recycled next admit
                 else:
                     s.feed = nxt
+
+    def slots_debug(self) -> List[dict]:
+        """Per-slot state for ``/debug/slots``: what each KV-cache
+        slot is doing right now, with the trace id to chase it by.
+        Read from request threads while the worker mutates the slot
+        list — the snapshot is best-effort, never blocking."""
+        now = time.monotonic()
+        out = []
+        for i, s in enumerate(list(self._slots)):
+            if s is None:
+                out.append({"slot": i, "state": "free"})
+                continue
+            entry = {"slot": i,
+                     "state": "prefill" if s.prompt_left else "decode",
+                     "tokens_out": len(s.out),
+                     "prompt_left": len(s.prompt_left),
+                     "age_ms": round((now - s.t_slotted) * 1e3, 3)}
+            if s.req.ctx is not None:
+                entry["trace_id"] = s.req.ctx.trace_id
+                entry["sampled"] = s.req.ctx.sampled
+            out.append(entry)
+        return out
 
     def _crash_casualties(self):
         # only streams mid-decode die with the crash; _pending

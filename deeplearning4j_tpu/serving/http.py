@@ -26,15 +26,21 @@ then the listener stops.
 
 from __future__ import annotations
 
+import collections
+import itertools
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from deeplearning4j_tpu.observability.tracing import (RequestContext,
+                                                      Sampler,
+                                                      get_tracer)
 from deeplearning4j_tpu.serving.continuous import ContinuousBatcher
 from deeplearning4j_tpu.serving.errors import (CircuitOpenError,
                                                DeadlineExceededError,
@@ -65,7 +71,9 @@ class ModelServer:
                  wait_ms: float = 2.0, slots: int = 4,
                  capacity: int = 256,
                  metrics: Optional[ServingMetrics] = None,
-                 alerts=None):
+                 alerts=None, sample_rate: float = 0.01,
+                 sample_routes: Optional[Dict[str, float]] = None,
+                 slow_ms: float = 250.0, slos=None, tracer=None):
         self.registry = registry or ModelRegistry()
         self.metrics = metrics or ServingMetrics()
         # optional observability.AlertManager: while any rule fires,
@@ -73,6 +81,23 @@ class ModelServer:
         # an unconditional "ok" (load balancers and pagers see the
         # p99/queue/shed blow-up without polling /metrics)
         self.alerts = alerts
+        # optional observability.slo.SLOMonitor: burn rates are
+        # re-evaluated on every /healthz poll so a breach degrades
+        # health even without the background alert thread
+        self.slos = slos
+        # request-scoped tracing: head-based sampling decided at
+        # admission (default 1%, per-route overrides, always-sample
+        # on error), spans recorded on the process tracer
+        self.sampler = Sampler(rate=sample_rate, routes=sample_routes)
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.slow_ms = float(slow_ms)
+        self._inflight: Dict[int, dict] = {}
+        self._inflight_lock = threading.Lock()
+        self._req_seq = itertools.count()
+        # completed-request ring for /debug/traces (slow + errored
+        # requests stay inspectable after the fact)
+        self._recent: collections.deque = collections.deque(
+            maxlen=256)
         self.host = host
         self.port = port
         self.max_batch_size = max_batch_size
@@ -149,7 +174,8 @@ class ModelServer:
             lambda: ContinuousBatcher(
                 model, slots=self.slots, capacity=self.capacity,
                 queue_limit=self.queue_limit, metrics=self.metrics,
-                name=f"generate/{name}/v{version}"))
+                name=f"generate/{name}/v{version}",
+                version=str(version)))
         return b, version
 
     # ---- HTTP plumbing ----
@@ -160,11 +186,13 @@ class ModelServer:
             def log_message(self, fmt, *args):
                 pass
 
-            def _send(self, code, obj):
+            def _send(self, code, obj, headers=None):
                 data = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -176,16 +204,25 @@ class ModelServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _wants_prometheus(self) -> bool:
+            def _metrics_mode(self) -> str:
+                # "json" | "text" (classic 0.0.4) | "openmetrics".
+                # Exemplars are only legal in OpenMetrics, so a scraper
+                # that wants them must say so (format=openmetrics or
+                # the Accept header real Prometheus sends).
                 q = parse_qs(urlparse(self.path).query)
                 fmt = (q.get("format") or [None])[0]
+                if fmt == "openmetrics":
+                    return "openmetrics"
                 if fmt == "prometheus":
-                    return True
+                    return "text"
                 if fmt == "json":
-                    return False
+                    return "json"
                 accept = self.headers.get("Accept", "")
-                return ("text/plain" in accept
-                        or "openmetrics" in accept)
+                if "openmetrics" in accept:
+                    return "openmetrics"
+                if "text/plain" in accept:
+                    return "text"
+                return "json"
 
             def _body(self):
                 n = int(self.headers.get("Content-Length", 0))
@@ -205,21 +242,44 @@ class ModelServer:
                         except Exception:
                             logger.exception("alert evaluation "
                                              "failed")
+                    slo_status = None
+                    if server.slos is not None:
+                        try:
+                            server.slos.evaluate()
+                            slo_status = server.slos.status()
+                        except Exception:
+                            logger.exception("SLO evaluation failed")
                     # non-closed circuit breakers degrade health: a
                     # crash-looping backend must be visible to load
                     # balancers without polling /metrics
                     circuits = server._circuit_states()
-                    if firing or circuits:
+                    breached = [s for s in (slo_status or [])
+                                if s.get("breached")]
+                    if firing or circuits or breached:
                         payload = {"status": "degraded"}
                         if firing:
                             payload["alerts"] = firing
                         if circuits:
                             payload["circuits"] = circuits
+                        if breached:
+                            payload["slo_breaches"] = breached
+                        if slo_status is not None:
+                            payload["slos"] = slo_status
                         self._send(200, payload)
                     else:
-                        self._send(200, {"status": "ok"})
+                        payload = {"status": "ok"}
+                        if slo_status is not None:
+                            payload["slos"] = slo_status
+                        self._send(200, payload)
                 elif path == "/metrics":
-                    if self._wants_prometheus():
+                    mode = self._metrics_mode()
+                    if mode == "openmetrics":
+                        self._send_text(
+                            200, server.metrics.prometheus_text(
+                                openmetrics=True),
+                            "application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
+                    elif mode == "text":
                         self._send_text(
                             200, server.metrics.prometheus_text(),
                             "text/plain; version=0.0.4; "
@@ -229,19 +289,25 @@ class ModelServer:
                 elif path == "/v1/models":
                     self._send(200, {"models":
                                      server.registry.models()})
+                elif path == "/debug/requests":
+                    self._send(200, server.debug_requests())
+                elif path == "/debug/slots":
+                    self._send(200, server.debug_slots())
+                elif path == "/debug/traces":
+                    self._send(200, server.debug_traces())
                 else:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):
                 path = urlparse(self.path).path
                 if path == "/v1/predict":
-                    self._serve_request(server._handle_predict)
+                    self._serve_request(server._handle_predict, path)
                 elif path == "/v1/generate":
-                    self._serve_request(server._handle_generate)
+                    self._serve_request(server._handle_generate, path)
                 else:
                     self._send(404, {"error": "not found"})
 
-            def _serve_request(self, handler):
+            def _serve_request(self, handler, route):
                 if server._draining.is_set():
                     self._send(503, {"error": "server is draining"})
                     return
@@ -250,28 +316,61 @@ class ModelServer:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._send(400, {"error": f"bad JSON: {e}"})
                     return
+                # admission: adopt the upstream trace (router hop) or
+                # mint a fresh one; the head sampling decision is
+                # made here and rides the context end to end. Bad
+                # client input (e.g. a non-numeric timeout_ms) must
+                # still produce a 400, not a dropped connection.
                 try:
-                    self._send(200, handler(body))
+                    ctx = server._mint_ctx(self.headers, route, body)
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                key = server._track_request(ctx, body)
+                code = 500
+                hdrs = {"traceparent": ctx.traceparent()}
+
+                def send(c, obj):
+                    nonlocal code
+                    code = c
+                    self._send(c, obj, headers=hdrs)
+
+                def err(c, e):
+                    ctx.set_error(e)
+                    # the promoted sampling decision must reach the
+                    # next hop's response header too
+                    hdrs["traceparent"] = ctx.traceparent()
+                    send(c, {"error": str(e),
+                             "trace_id": ctx.trace_id})
+
+                try:
+                    # attach() scopes the context to THIS handler
+                    # thread only, restored on exit — pooled HTTP
+                    # threads cannot leak a request's context
+                    with ctx.attach():
+                        send(200, handler(body, ctx=ctx))
                 except QueueFullError as e:
-                    self._send(429, {"error": str(e)})
+                    err(429, e)
                 except DeadlineExceededError as e:
-                    self._send(504, {"error": str(e)})
+                    err(504, e)
                 except ModelNotFoundError as e:
-                    self._send(404, {"error": str(e)})
+                    err(404, e)
                 except (ServerClosedError, CircuitOpenError) as e:
                     # both are "this backend cannot take work right
                     # now, retry later" — 503 for the load balancer
-                    self._send(503, {"error": str(e)})
+                    err(503, e)
                 except ServingError as e:
                     # remaining typed serving errors (e.g. generate
                     # against a model with no streaming session) are
                     # client mistakes, not server faults
-                    self._send(400, {"error": str(e)})
+                    err(400, e)
                 except (ValueError, KeyError, TypeError) as e:
-                    self._send(400, {"error": str(e)})
+                    err(400, e)
                 except Exception as e:    # keep the listener alive
                     logger.exception("serving error")
-                    self._send(500, {"error": str(e)})
+                    err(500, e)
+                finally:
+                    server._finish_request(key, ctx, code, body)
 
         # cheap pre-check before binding the socket: a second start()
         # on a live server must not try to re-bind its own port
@@ -310,7 +409,7 @@ class ModelServer:
         t = body.get("timeout_ms")
         return None if t is None else float(t) / 1e3
 
-    def _handle_predict(self, body: dict) -> dict:
+    def _handle_predict(self, body: dict, ctx=None) -> dict:
         if "model" not in body or "inputs" not in body:
             raise ValueError('predict body needs "model" and "inputs"')
         sched, version = self.scheduler_for(body["model"],
@@ -318,23 +417,105 @@ class ModelServer:
         x = np.asarray(body["inputs"], np.float32)
         if x.ndim == 1:
             x = x[None, :]
-        out = sched.predict(x, timeout=self._timeout_s(body))
+        if ctx is not None:
+            ctx.attrs["model_version"] = version
+        out = sched.predict(x, timeout=self._timeout_s(body), ctx=ctx)
         return {"outputs": np.asarray(out).tolist(),
                 "model_version": version}
 
-    def _handle_generate(self, body: dict) -> dict:
+    def _handle_generate(self, body: dict, ctx=None) -> dict:
         if "model" not in body or "prompt" not in body:
             raise ValueError('generate body needs "model" and '
                              '"prompt"')
         batcher, version = self.batcher_for(body["model"],
                                             body.get("version"))
+        if ctx is not None:
+            ctx.attrs["model_version"] = version
         ids = batcher.generate(
             body["prompt"], int(body.get("n_tokens", 16)),
             temperature=float(body.get("temperature", 0.0)),
             seed=int(body.get("seed", 0)),
-            timeout=self._timeout_s(body))
+            timeout=self._timeout_s(body), ctx=ctx)
         return {"ids": np.asarray(ids).tolist(),
                 "model_version": version}
+
+    # ---- request-scoped tracing plumbing ----
+    def _mint_ctx(self, headers, route: str,
+                  body: dict) -> RequestContext:
+        t = self._timeout_s(body)
+        deadline = time.monotonic() + t if t is not None else None
+        ctx = RequestContext.from_traceparent(
+            headers.get("traceparent"), route, self.sampler,
+            deadline=deadline, tracer=self.tracer)
+        if ctx is None:
+            ctx = RequestContext.new(route, self.sampler,
+                                     deadline=deadline,
+                                     tracer=self.tracer)
+        # announce the root span to the sinks: a crash bundle lists
+        # this request as an unclosed span until finish() closes it
+        ctx.open_root()
+        return ctx
+
+    def _track_request(self, ctx: RequestContext, body: dict) -> int:
+        key = next(self._req_seq)
+        with self._inflight_lock:
+            self._inflight[key] = {"ctx": ctx,
+                                   "model": body.get("model")}
+        return key
+
+    def _finish_request(self, key: int, ctx: RequestContext,
+                        code: int, body: dict) -> None:
+        with self._inflight_lock:
+            self._inflight.pop(key, None)
+        total_s = ctx.finish(attrs={"http_status": code})
+        entry = {"trace_id": ctx.trace_id, "route": ctx.route,
+                 "model": body.get("model"), "status": code,
+                 "duration_ms": round(total_s * 1e3, 3),
+                 "phases_ms": {k: round(v * 1e3, 3)
+                               for k, v in ctx.phases.items()},
+                 "sampled": ctx.sampled,
+                 "slow": total_s * 1e3 >= self.slow_ms
+                 or code >= 400,
+                 "t_end": time.time()}
+        if ctx.error is not None:
+            entry["error"] = ctx.error
+        with self._inflight_lock:
+            self._recent.append(entry)
+
+    # ---- /debug payloads ----
+    def debug_requests(self) -> dict:
+        """In-flight requests (current phase + age + deadline), the
+        most recent completions, and the latency-attribution report
+        — the first page an operator opens for a slow server."""
+        with self._inflight_lock:
+            inflight = [dict(v["ctx"].to_debug(), model=v["model"])
+                        for v in self._inflight.values()]
+            recent = list(self._recent)[-20:]
+        return {"in_flight": inflight,
+                "in_flight_count": len(inflight),
+                "recent": recent,
+                "latency_attribution":
+                    self.metrics.latency_attribution()}
+
+    def debug_slots(self) -> dict:
+        """Continuous-batching slot states per generate backend."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        return {"backends": {
+            b.name: {"active_slots": b.active_slots(),
+                     "pending": len(b._pending),
+                     "slots": b.slots_debug()}
+            for b in batchers.values()}}
+
+    def debug_traces(self) -> dict:
+        """Recent slow/errored traces with their phase breakdown —
+        what an exemplar trace id from /metrics resolves to."""
+        with self._inflight_lock:
+            recent = list(self._recent)
+        slow = [e for e in recent if e.get("slow")]
+        return {"slow": slow[-50:],
+                "sample_rate": self.sampler.rate,
+                "slow_ms": self.slow_ms}
 
     def _circuit_states(self) -> Dict[str, str]:
         """Backend name -> breaker state, for every backend whose

@@ -181,9 +181,9 @@ class BaseRequest:
     """A waitable unit of admitted work."""
 
     __slots__ = ("event", "result", "error", "deadline", "t_submit",
-                 "probe")
+                 "probe", "ctx")
 
-    def __init__(self, deadline: Optional[float]):
+    def __init__(self, deadline: Optional[float], ctx=None):
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -194,6 +194,13 @@ class BaseRequest:
         # pre-crash success must not vouch for a worker it never
         # touched)
         self.probe = False
+        # the request-scoped trace context
+        # (observability.tracing.RequestContext): trace id, sampling
+        # decision, deadline, per-phase ledger. It RIDES the request
+        # across queues / buckets / slots / worker crash-restarts, so
+        # the retried work keeps its original trace id and the span
+        # tree stays parented to the same root.
+        self.ctx = ctx
 
 
 class ServingBackend:
@@ -290,6 +297,10 @@ class ServingBackend:
         for r in self._crash_casualties():
             if not r.event.is_set():
                 r.error = exc
+                if r.ctx is not None:
+                    # promote to sampled: a request killed by a
+                    # worker crash must leave a trace
+                    r.ctx.set_error(exc)
                 r.event.set()
 
     def _loop(self) -> None:
@@ -347,13 +358,34 @@ class ServingBackend:
     def wait(self, r: BaseRequest):
         r.event.wait()
         if r.error is not None:
+            if r.ctx is not None:
+                # always-sample on failure: the error (deadline
+                # expiry, crash, poison) promotes the trace
+                r.ctx.set_error(r.error)
             raise r.error
         # ONLY a completed probe is the breaker's success signal: a
         # stale success (served before the crash burst, wait()ed
         # late) must not close a circuit no probe has verified
         if r.probe:
             self.breaker.record_success()
-        self._endpoint.observe(time.monotonic() - r.t_submit)
+        ctx = r.ctx
+        if ctx is not None:
+            # close the final contiguous segment (result ready ->
+            # waiter woken), then feed the attribution pipeline: the
+            # whole-request histogram gets the sampled trace id as an
+            # exemplar, the phase ledger the per-phase histograms
+            ctx.phase_done("respond")
+            tid = ctx.trace_id if ctx.sampled else None
+            # observe the SAME interval the ledger covers (context
+            # mint → respond done, ctx.age_s()), not submit → now:
+            # the HTTP path mints the context before parse/resolve,
+            # so measuring from t_submit would make the phase sums
+            # exceed the whole-request histogram on payload-heavy
+            # requests and break the attribution reconciliation
+            self._endpoint.observe(ctx.age_s(), trace_id=tid)
+            self._endpoint.record_phases(ctx.phases, trace_id=tid)
+        else:
+            self._endpoint.observe(time.monotonic() - r.t_submit)
         return r.result
 
     # ---- observability ----
@@ -381,6 +413,8 @@ class ServingBackend:
         for r in leftovers:
             if not r.event.is_set():
                 r.error = err
+                if r.ctx is not None:
+                    r.ctx.set_error(err)
                 r.event.set()
 
     def _unregister_gauges(self) -> None:

@@ -67,6 +67,13 @@ class EndpointMetrics:
                  name: str = "endpoint"):
         reg = registry or MetricsRegistry()
         lbl = {"endpoint": name}
+        self.name = name
+        self._registry = reg
+        # per-phase latency histograms (serving_phase_seconds), keyed
+        # by phase name; phases form a small fixed set per backend so
+        # this cache stays tiny — instruments are created once per
+        # (endpoint, phase), never per request
+        self._phases: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
         self._requests = reg.counter(
             "serving_requests_total", help="completed requests",
@@ -104,11 +111,38 @@ class EndpointMetrics:
     def expired(self) -> int:
         return int(self._expired.value)
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float,
+                trace_id: Optional[str] = None) -> None:
         self._requests.inc()
         with self._lock:
             self._recent.append(time.monotonic())
-        self.latency.record(seconds)
+        # a sampled request leaves its trace id as the bucket's
+        # exemplar: the /metrics p99 spike links to a concrete trace
+        self.latency.record(
+            seconds,
+            exemplar={"trace_id": trace_id} if trace_id else None)
+
+    def phase_histogram(self, phase: str) -> Histogram:
+        with self._lock:
+            h = self._phases.get(phase)
+            if h is None:
+                h = self._phases[phase] = self._registry.histogram(
+                    "serving_phase_seconds",
+                    help="per-phase request latency decomposition "
+                         "(seconds)",
+                    labels={"endpoint": self.name, "phase": phase},
+                    buckets=_EDGES)
+            return h
+
+    def record_phases(self, phases: Dict[str, float],
+                      trace_id: Optional[str] = None) -> None:
+        """Record one completed request's phase ledger. Phases are
+        contiguous segments of the request's wall time, so per-phase
+        histogram sums reconcile against the whole-request histogram
+        (the latency-attribution contract)."""
+        ex = {"trace_id": trace_id} if trace_id else None
+        for phase, dur in phases.items():
+            self.phase_histogram(phase).record(dur, exemplar=ex)
 
     def count_error(self) -> None:
         # an errored response is still a completed request: folding it
@@ -194,6 +228,40 @@ class BatchOccupancy:
                 "max_batch_size": self.max_batch_size}
 
 
+class StreamingMetrics:
+    """Token-streaming latency for one generate backend:
+    time-to-first-token and inter-token latency, labeled by model
+    version (``serving_ttft_seconds`` / ``serving_itl_seconds``) —
+    the two numbers a whole-request histogram can never show for a
+    stream (a fast total can still mean a terrible first-token
+    stall)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 name: str = "generate", version: str = "0"):
+        reg = registry or MetricsRegistry()
+        lbl = {"endpoint": name, "model_version": str(version)}
+        self.ttft = reg.histogram(
+            "serving_ttft_seconds",
+            help="time from admission to first generated token "
+                 "(seconds)", labels=lbl, buckets=_EDGES)
+        self.itl = reg.histogram(
+            "serving_itl_seconds",
+            help="inter-token latency within one stream (seconds)",
+            labels=lbl, buckets=_EDGES)
+
+    def record_ttft(self, seconds: float,
+                    trace_id: Optional[str] = None) -> None:
+        self.ttft.record(
+            seconds,
+            exemplar={"trace_id": trace_id} if trace_id else None)
+
+    def record_itl(self, seconds: float,
+                   trace_id: Optional[str] = None) -> None:
+        self.itl.record(
+            seconds,
+            exemplar={"trace_id": trace_id} if trace_id else None)
+
+
 class ServingMetrics:
     """Aggregated registry of endpoint metrics, occupancy trackers and
     queue-depth gauges; one ``snapshot()`` is the /metrics JSON
@@ -205,8 +273,67 @@ class ServingMetrics:
             else MetricsRegistry()
         self._endpoints: Dict[str, EndpointMetrics] = {}
         self._occupancy: Dict[str, BatchOccupancy] = {}
+        self._streaming: Dict[tuple, StreamingMetrics] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._iteration = 0
+
+    def streaming(self, name: str,
+                  version: str = "0") -> StreamingMetrics:
+        with self._lock:
+            key = (name, str(version))
+            if key not in self._streaming:
+                self._streaming[key] = StreamingMetrics(
+                    registry=self.registry, name=name,
+                    version=str(version))
+            return self._streaming[key]
+
+    def latency_attribution(self) -> dict:
+        """Tail-latency attribution: per endpoint, the whole-request
+        p50/p95/p99 decomposed by phase, the dominant phase at each
+        quantile, and the phase-sum/whole reconciliation ratio (means
+        are additive, so ``phase_sum_over_total`` ~= 1.0 says the
+        decomposition accounts for the request's wall time)."""
+        whole: Dict[str, Histogram] = {}
+        phases: Dict[str, Dict[str, Histogram]] = {}
+        for m in self.registry.collect():
+            if not isinstance(m, Histogram) or not m.labels:
+                continue
+            ep = m.labels.get("endpoint")
+            if ep is None:
+                continue
+            if m.name == "serving_latency_seconds":
+                whole[ep] = m
+            elif m.name == "serving_phase_seconds":
+                phases.setdefault(ep, {})[m.labels["phase"]] = m
+        out = {}
+        for ep, ph in phases.items():
+            w = whole.get(ep)
+            rep = {"phases_ms": {}, "count": 0}
+            if w is not None:
+                rep["count"] = w.count
+                rep["whole_ms"] = {
+                    q: round(w.quantile(p) * 1e3, 3)
+                    for q, p in (("p50", .5), ("p95", .95),
+                                 ("p99", .99))}
+            phase_sum = 0.0
+            for name, h in sorted(ph.items()):
+                c = h.count
+                rep["phases_ms"][name] = {
+                    "p50": round(h.quantile(0.50) * 1e3, 3),
+                    "p95": round(h.quantile(0.95) * 1e3, 3),
+                    "p99": round(h.quantile(0.99) * 1e3, 3),
+                    "mean": round(h.sum / c * 1e3, 3) if c else 0.0}
+                phase_sum += h.sum
+            if rep["phases_ms"]:
+                rep["dominant_phase"] = {
+                    q: max(rep["phases_ms"],
+                           key=lambda n: rep["phases_ms"][n][q])
+                    for q in ("p50", "p99")}
+            if w is not None and w.sum > 0:
+                rep["phase_sum_over_total"] = round(
+                    phase_sum / w.sum, 4)
+            out[ep] = rep
+        return out
 
     def endpoint(self, name: str) -> EndpointMetrics:
         with self._lock:
@@ -259,8 +386,8 @@ class ServingMetrics:
                 out["gauges"][name] = None
         return out
 
-    def prometheus_text(self) -> str:
-        return self.registry.prometheus_text()
+    def prometheus_text(self, openmetrics: bool = False) -> str:
+        return self.registry.prometheus_text(openmetrics=openmetrics)
 
     # ---- bridge into the training-UI stats pipeline ----
     def publish_to(self, storage, session_id: str = "serving",

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.observability.tracing import RequestContext
 from deeplearning4j_tpu.parallel.inference import (
     pow2_pad_rows, serve_batch_with_retry)
 from deeplearning4j_tpu.serving.errors import DeadlineExceededError
@@ -38,8 +39,8 @@ __all__ = ["BatchScheduler", "pow2_pad_rows"]
 class _Request(BaseRequest):
     __slots__ = ("x",)
 
-    def __init__(self, x, deadline: Optional[float]):
-        super().__init__(deadline)
+    def __init__(self, x, deadline: Optional[float], ctx=None):
+        super().__init__(deadline, ctx=ctx)
         self.x = x
 
 
@@ -74,22 +75,33 @@ class BatchScheduler(ServingBackend):
         self._start_worker()
 
     # ---- admission ----
-    def submit(self, x, timeout: Optional[float] = None) -> _Request:
+    def submit(self, x, timeout: Optional[float] = None,
+               ctx=None) -> _Request:
         """Enqueue one request of shape (n, ...features). Fail-fast
         admission: raises QueueFullError at the queue limit and
-        ServerClosedError once draining."""
+        ServerClosedError once draining. ``ctx`` is an optional
+        :class:`~deeplearning4j_tpu.observability.tracing.RequestContext`
+        (the HTTP front end mints one at admission); without one a
+        fresh unsampled context is created so phase attribution
+        covers in-process callers too."""
         probe = self._admit_guard()
         x = np.asarray(x)
         if x.ndim == 0:
             raise ValueError("request must have a leading batch axis")
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
-        r = _Request(x, deadline)
+        if ctx is None:
+            ctx = RequestContext(route=self.name, deadline=deadline)
+        # close the admission segment (parse/resolve/validate) as the
+        # queue_wait segment opens — the enqueue below is the boundary
+        ctx.phase_done("admission", now_in="queue_wait")
+        r = _Request(x, deadline, ctx=ctx)
         r.probe = probe
         return self._enqueue(r)
 
-    def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
-        return self.wait(self.submit(x, timeout=timeout))
+    def predict(self, x, timeout: Optional[float] = None,
+                ctx=None) -> np.ndarray:
+        return self.wait(self.submit(x, timeout=timeout, ctx=ctx))
 
     def _extra_depth(self) -> int:
         # list() snapshots the dict in one GIL-held C call — the
@@ -119,6 +131,13 @@ class BatchScheduler(ServingBackend):
                 if r.deadline is not None and now > r.deadline:
                     self._expire(r)
                 else:
+                    # dequeued by the collector: queue_wait ends,
+                    # batch formation begins (this stamp runs on the
+                    # worker thread — the cross-thread handoff the
+                    # span tree is built from)
+                    if r.ctx is not None:
+                        r.ctx.phase_done("queue_wait",
+                                         now_in="batch_form")
                     key = self._key(r.x)
                     b = self._buckets.get(key)
                     if (b is not None and b.rows + r.x.shape[0] >
@@ -166,6 +185,9 @@ class BatchScheduler(ServingBackend):
             f"request deadline expired after "
             f"{time.monotonic() - r.t_submit:.3f}s in the "
             f"{self.name!r} queue (work was never started)")
+        if r.ctx is not None:
+            # always-sample on deadline-exceeded
+            r.ctx.set_error(r.error)
         r.event.set()
 
     def _serve(self, items: List[_Request]) -> None:
@@ -196,8 +218,20 @@ class BatchScheduler(ServingBackend):
                                    np.nan))
         rows = sum(r.x.shape[0] for r in live)
         self._occupancy.record(rows)
+        for r in live:
+            if r.ctx is not None:
+                r.ctx.phase_done("batch_form", now_in="device_step",
+                                 attrs={"batch_rows": rows})
+
+        def _served(r):
+            # runs BEFORE r.event.set(): the device_step segment must
+            # close before the waiter thread can stamp respond
+            if r.ctx is not None:
+                r.ctx.phase_done("device_step", now_in="respond")
+
         # coalesced call + poison-request recovery: ONE shared
         # implementation with ParallelInference (the policy's home —
         # a fix there cannot silently miss this backend)
         serve_batch_with_retry(out_fn, live,
-                               count_error=self._endpoint.count_error)
+                               count_error=self._endpoint.count_error,
+                               before_complete=_served)

@@ -238,6 +238,7 @@ class UIServer:
         self._flow = {"nodes": [], "edges": []}
         self._health_monitor = None
         self._alerts = None
+        self._slos = None
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -249,19 +250,24 @@ class UIServer:
     def attach(self, storage) -> None:
         self.storage = storage
 
-    def attach_health(self, monitor=None, alerts=None) -> None:
+    def attach_health(self, monitor=None, alerts=None,
+                      slos=None) -> None:
         """Feed the dashboard's health panel (``/api/health``):
         ``monitor`` is an ``observability.HealthMonitor`` (status +
         anomaly history), ``alerts`` an ``observability.AlertManager``
-        (evaluated on each request, firing rules listed)."""
+        (evaluated on each request, firing rules listed), ``slos`` an
+        ``observability.SLOMonitor`` (burn rates + breach state)."""
         if monitor is not None:
             self._health_monitor = monitor
         if alerts is not None:
             self._alerts = alerts
+        if slos is not None:
+            self._slos = slos
 
     def health_payload(self) -> dict:
         monitor = self._health_monitor
         alerts = self._alerts
+        slos = getattr(self, "_slos", None)
         mstatus = monitor.status() if monitor is not None else None
         firing = []
         if alerts is not None:
@@ -270,15 +276,27 @@ class UIServer:
                 firing = alerts.firing()
             except Exception:
                 logger.exception("alert evaluation failed")
+        slo_status = None
+        if slos is not None:
+            try:
+                slos.evaluate()
+                slo_status = slos.status()
+            except Exception:
+                logger.exception("SLO evaluation failed")
+        breached = [s for s in (slo_status or [])
+                    if s.get("breached")]
         if mstatus is not None and mstatus["status"] == "diverged":
             status = "diverged"
-        elif firing or (mstatus is not None
-                        and mstatus["status"] != "ok"):
+        elif firing or breached or (mstatus is not None
+                                    and mstatus["status"] != "ok"):
             status = "degraded"
         else:
             status = "ok"
-        return {"status": status, "alerts": firing,
-                "monitor": mstatus}
+        out = {"status": status, "alerts": firing,
+               "monitor": mstatus}
+        if slo_status is not None:
+            out["slos"] = slo_status
+        return out
 
     def attach_model(self, model) -> None:
         """Feed the network-flow view (the Play UI's flow module /

@@ -1,0 +1,40 @@
+"""GL006 golden NEGATIVE fixture: bounded labels, init-time
+creation, exemplars as the per-request channel. Never imported —
+parsed only."""
+
+registry = object()
+
+# module-import-time creation: the sanctioned place
+REQUESTS = registry.counter("requests_total",
+                            labels={"endpoint": "predict"})
+
+
+class Backend:
+    def __init__(self, registry, name, version):
+        # init-time creation with bounded labels (endpoint names and
+        # model versions are small finite sets)
+        self._latency = registry.histogram(
+            "latency_seconds",
+            labels={"endpoint": name,
+                    "model_version": str(version)})
+        self._gauges = {}
+        for phase in ("queue_wait", "device_step"):
+            # loop-stored creation at init: the cache-fill pattern
+            self._gauges[phase] = registry.gauge(
+                "phase_depth", labels={"phase": phase})
+
+    def serve(self, requests):
+        for r in requests:
+            REQUESTS.inc()                    # recording in a loop: fine
+            # per-request identity rides the EXEMPLAR, not a label
+            self._latency.record(r.seconds,
+                                 exemplar={"trace_id": r.trace_id})
+
+
+def evaluation_labels_are_not_metric_labels(y_true, labels):
+    # `labels=` on a non-metric call (classification targets)
+    return confusion(y_true, labels=labels)
+
+
+def confusion(y, labels=None):
+    return (y, labels)

@@ -1,0 +1,36 @@
+"""GL006 golden POSITIVE fixture: every flavour of metrics-hygiene
+violation. Never imported — parsed only."""
+
+registry = object()
+metrics = object()
+
+
+def label_key_is_request_id(trace_id, user):
+    registry.counter(
+        "requests_total",
+        labels={"trace_id": trace_id})           # GL006: key trace_id
+    registry.histogram(
+        "latency_seconds",
+        labels={"request_id": "abc"})            # GL006: key request_id
+
+
+def label_value_reads_request_id(ctx, endpoint):
+    registry.counter(
+        "requests_total",
+        labels={"id": ctx.trace_id,              # GL006: value trace_id
+                "endpoint": endpoint})
+    registry.gauge(
+        "depth",
+        labels={"who": f"req-{ctx.request_id}"})  # GL006: f-string
+
+
+def creates_counter_per_event(registry, items):
+    for item in items:
+        # GL006: get-or-create + inc per iteration
+        registry.counter("events_total",
+                         labels={"endpoint": "predict"}).inc()
+
+
+def discards_in_loop(reg):
+    while True:
+        reg.histogram("h_seconds")               # GL006: discarded

@@ -330,6 +330,39 @@ class TestGL005LiteralDrift:
         assert errors[0].startswith("README.md:1:")
 
 
+class TestGL006MetricsHygiene:
+    def test_positive(self):
+        r = lint_fixture("gl006_positive.py", ["GL006"])
+        msgs = [f.message for f in r.new]
+        assert len(r.new) == 6, "\n".join(msgs)
+        for needle in ("label key 'trace_id'",
+                       "label key 'request_id'",
+                       "label value reads 'trace_id'",
+                       "label value reads 'request_id'",
+                       "registry.counter() inside a loop",
+                       "registry.histogram() inside a loop"):
+            assert any(needle in m for m in msgs), needle
+        syms = {f.symbol for f in r.new}
+        assert "creates_counter_per_event" in syms
+        assert "discards_in_loop" in syms
+
+    def test_negative(self):
+        # bounded labels, import-time creation, the loop-stored
+        # cache-fill pattern, exemplars, and a non-metric `labels=`
+        # kwarg all stay clean
+        assert lint_fixture("gl006_negative.py", ["GL006"]).new == []
+
+    def test_suppressed(self):
+        r = lint_fixture("gl006_suppressed.py", ["GL006"])
+        assert r.new == [] and r.suppressed == 2
+
+    def test_package_tree_is_clean(self):
+        # the serving/observability stack itself obeys the rule it
+        # ships with: trace ids ride exemplars, never labels
+        r = run_lint(REPO, rules=["GL006"])
+        assert r.new == [], "\n".join(f.render() for f in r.new)
+
+
 class TestCheckPerfClaimsShim:
     """The deprecated tools/check_perf_claims.py keeps its API."""
 
@@ -585,9 +618,9 @@ class TestChangedOnly:
 # ---------------------------------------------------------------------------
 
 class TestRegistry:
-    def test_all_five_rules_present(self):
+    def test_all_six_rules_present(self):
         assert sorted(ALL_RULES) == ["GL001", "GL002", "GL003",
-                                     "GL004", "GL005"]
+                                     "GL004", "GL005", "GL006"]
         for cls in ALL_RULES.values():
             assert cls.title and cls.rationale
             assert cls.scope in ("file", "repo")

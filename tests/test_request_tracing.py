@@ -1,0 +1,950 @@
+"""Request-scoped distributed tracing, tail-latency attribution and
+SLO burn-rate alerting (the serving-observability tentpole).
+
+The acceptance end-to-end this file carries: one trace id produces a
+complete cross-thread span tree for a ``/v1/predict`` and a streamed
+``/v1/generate`` (both backends), per-phase attribution sums to the
+whole-request latency within 5%, ``/metrics`` exposes exemplars on
+the serving latency histograms, and an SLO burn-rate breach flips
+``/healthz`` to degraded with the offending trace ids captured in a
+flight-recorder bundle — plus the chaos leg: a worker crash-restart
+where the surviving work keeps its original trace id.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerNetwork,
+                                NeuralNetConfiguration, chaos)
+from deeplearning4j_tpu.nn.conf import updaters
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                               EmbeddingSequenceLayer,
+                                               OutputLayer,
+                                               RnnOutputLayer,
+                                               TransformerEncoderLayer)
+from deeplearning4j_tpu.observability import flight_recorder
+from deeplearning4j_tpu.observability.registry import MetricsRegistry
+from deeplearning4j_tpu.observability.slo import (SLO, BurnWindow,
+                                                  SLOMonitor)
+from deeplearning4j_tpu.observability.tracing import (RequestContext,
+                                                      Sampler, Tracer,
+                                                      current_context)
+from deeplearning4j_tpu.serving import (BatchScheduler,
+                                        CircuitBreaker,
+                                        ContinuousBatcher,
+                                        ModelRegistry, ModelServer,
+                                        ServingMetrics)
+
+pytestmark = pytest.mark.tracing
+
+PREDICT_PHASES = ["admission", "queue_wait", "batch_form",
+                  "device_step", "respond"]
+GENERATE_PHASES = ["admission", "queue_wait", "prefill", "decode",
+                   "respond"]
+
+
+class EchoModel:
+    """output = 2 * input, optional per-batch delay."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def output(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(x) * 2.0
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.builder().set_seed(seed)
+            .updater(updaters.adam(0.01)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+LM_V, LM_CAP = 13, 32
+
+
+def _lm(seed=0):
+    conf = (NeuralNetConfiguration.builder().set_seed(seed)
+            .updater(updaters.adam(1e-3)).list()
+            .layer(EmbeddingSequenceLayer(n_in=LM_V, n_out=16))
+            .layer(TransformerEncoderLayer(n_heads=2, causal=True))
+            .layer(RnnOutputLayer(n_out=LM_V, loss="mcxent"))
+            .set_input_type(InputType.recurrent(LM_V, LM_CAP)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(base, path, body, headers=None):
+    req = urllib.request.Request(
+        base + path, json.dumps(body).encode(),
+        dict({"Content-Type": "application/json"}, **(headers or {})))
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read()), resp.status, resp.headers
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read()), e.code, e.headers
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path) as resp:
+            return json.loads(resp.read()), resp.status
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read()), e.code
+
+
+def _spans_for(tracer, trace_id, want_names, timeout=5.0):
+    """Wait for (and return) the trace's spans: the root ``request``
+    span lands AFTER the HTTP response is written, so readers poll."""
+    deadline = time.monotonic() + timeout
+    while True:
+        spans = [e for e in tracer.events()
+                 if e.get("trace_id") == trace_id]
+        if want_names <= {s["name"] for s in spans}:
+            return spans
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"trace {trace_id}: wanted {sorted(want_names)}, "
+                f"got {sorted({s['name'] for s in spans})}")
+        time.sleep(0.01)
+
+
+def _trace_id_from(headers):
+    tp = headers["traceparent"]
+    ver, tid, span, flags = tp.split("-")
+    assert ver == "00" and len(tid) == 32 and len(span) == 16
+    return tid, span, flags
+
+
+# ---------------------------------------------------------------------------
+# head-based sampling
+# ---------------------------------------------------------------------------
+
+class TestSampler:
+    def test_deterministic_in_trace_id(self):
+        """Fleet consistency: every replica samples the SAME ids."""
+        s1, s2 = Sampler(rate=0.25), Sampler(rate=0.25)
+        ids = [RequestContext().trace_id for _ in range(200)]
+        assert [s1.sample(t) for t in ids] == \
+            [s2.sample(t) for t in ids]
+
+    def test_rate_bounds(self):
+        ids = [RequestContext().trace_id for _ in range(50)]
+        assert not any(Sampler(rate=0.0).sample(t) for t in ids)
+        assert all(Sampler(rate=1.0).sample(t) for t in ids)
+
+    def test_rate_is_roughly_honoured(self):
+        s = Sampler(rate=0.25)
+        n = sum(s.sample(RequestContext().trace_id)
+                for _ in range(2000))
+        assert 0.15 < n / 2000 < 0.35
+
+    def test_per_route_override(self):
+        s = Sampler(rate=0.0, routes={"/v1/generate": 1.0})
+        tid = RequestContext().trace_id
+        assert not s.sample(tid, "/v1/predict")
+        assert s.sample(tid, "/v1/generate")
+
+
+# ---------------------------------------------------------------------------
+# RequestContext: W3C header, attach, phase ledger
+# ---------------------------------------------------------------------------
+
+class TestRequestContext:
+    def test_traceparent_round_trip(self):
+        up = RequestContext(sampled=True, route="/v1/predict")
+        hdr = up.traceparent()
+        assert hdr == f"00-{up.trace_id}-{up.root_span_id}-01"
+        down = RequestContext.from_traceparent(hdr, "/v1/predict")
+        assert down.trace_id == up.trace_id         # identity kept
+        assert down.parent_id == up.root_span_id    # correct linkage
+        assert down.root_span_id != up.root_span_id
+        assert down.sampled                         # flag honoured
+
+    def test_malformed_headers_rejected(self):
+        for bad in (None, "", "garbage", "00-xyz-abc-01",
+                    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",
+                    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",
+                    "00-" + "a" * 32 + "-" + "0" * 16 + "-01"):
+            assert RequestContext.from_traceparent(
+                bad, "/v1/predict") is None
+
+    def test_unsampled_upstream_gets_own_head_decision(self):
+        up = RequestContext(sampled=False)
+        down = RequestContext.from_traceparent(
+            up.traceparent(), "/v1/predict", Sampler(rate=1.0))
+        assert down.sampled
+
+    def test_attach_restores_previous_context(self):
+        outer, inner = RequestContext(), RequestContext()
+        assert current_context() is None
+        with outer.attach():
+            assert current_context() is outer
+            with inner.attach():
+                assert current_context() is inner
+            assert current_context() is outer    # no leakage
+        assert current_context() is None
+
+    def test_phase_ledger_sums_to_total(self):
+        """Phases are contiguous segments: the ledger reconciles
+        against the whole-request wall time by construction."""
+        ctx = RequestContext(sampled=False)
+        ctx.phase_done("admission", now_in="queue_wait")
+        time.sleep(0.01)
+        ctx.phase_done("queue_wait", now_in="device_step")
+        ctx.phase_done("device_step")
+        total = ctx.finish()
+        assert ctx.phases["queue_wait"] >= 0.01
+        assert sum(ctx.phases.values()) == pytest.approx(
+            total, rel=1e-6)
+
+    def test_error_promotes_to_sampled(self):
+        tr = Tracer(enabled=False)
+        ctx = RequestContext(sampled=False, route="/v1/predict",
+                             tracer=tr)
+        ctx.set_error(ValueError("boom"))
+        assert ctx.sampled
+        ctx.finish()
+        roots = [e for e in tr.events() if e["name"] == "request"]
+        assert len(roots) == 1
+        assert "boom" in roots[0]["args"]["error"]
+
+    def test_finish_idempotent_and_unsampled_emits_nothing(self):
+        tr = Tracer(enabled=False)
+        ctx = RequestContext(sampled=False, tracer=tr)
+        t1 = ctx.finish()
+        assert ctx.finish() == t1
+        assert tr.events() == []
+
+    def test_to_debug_shape(self):
+        ctx = RequestContext(sampled=True, route="/v1/predict",
+                             deadline=time.monotonic() + 5.0)
+        ctx.phase_done("admission", now_in="queue_wait")
+        d = ctx.to_debug()
+        assert d["trace_id"] == ctx.trace_id
+        assert d["phase"] == "queue_wait"
+        assert d["age_ms"] >= 0
+        assert 0 < d["deadline_remaining_ms"] <= 5000
+        assert "admission" in d["phases_ms"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: cross-thread span trees over HTTP, both backends
+# ---------------------------------------------------------------------------
+
+class TestTraceContinuityHTTP:
+    @pytest.fixture()
+    def served(self):
+        tracer = Tracer(enabled=False)   # request spans bypass enable
+        reg = ModelRegistry()
+        reg.register("iris", _mlp())
+        reg.register("lm", _lm())
+        srv = ModelServer(reg, port=0, slots=2, capacity=LM_CAP,
+                          wait_ms=2.0, sample_rate=1.0, slow_ms=0.0,
+                          tracer=tracer).start()
+        yield srv, tracer, f"http://127.0.0.1:{srv.port}"
+        srv.stop(drain=True, timeout=10.0)
+
+    def test_predict_yields_complete_cross_thread_span_tree(
+            self, served):
+        srv, tracer, base = served
+        body, code, headers = _post(
+            base, "/v1/predict",
+            {"model": "iris", "inputs": [[0.1, 0.2, 0.3, 0.4]]})
+        assert code == 200
+        tid, root_span, flags = _trace_id_from(headers)
+        assert flags == "01"                      # sampled, and says so
+        spans = _spans_for(tracer, tid,
+                           set(PREDICT_PHASES) | {"request"})
+        by_name = {s["name"]: s for s in spans}
+        root = by_name["request"]
+        assert root["span_id"] == root_span
+        assert "parent_id" not in root            # tree root
+        for phase in PREDICT_PHASES:
+            assert by_name[phase]["parent_id"] == root["span_id"]
+        # CROSS-THREAD: admission/respond stamp on the handler
+        # thread, queue_wait/batch_form/device_step on the worker
+        assert len({s["tid"] for s in spans}) >= 2
+        assert root["args"]["route"] == "/v1/predict"
+        assert root["args"]["model_version"] == 1
+        assert root["args"]["http_status"] == 200
+
+    def test_generate_stream_span_tree_and_streaming_histograms(
+            self, served):
+        srv, tracer, base = served
+        body, code, headers = _post(
+            base, "/v1/generate",
+            {"model": "lm", "prompt": [1, 2, 3], "n_tokens": 4})
+        assert code == 200 and len(body["ids"]) == 4
+        tid, root_span, _ = _trace_id_from(headers)
+        spans = _spans_for(tracer, tid,
+                           set(GENERATE_PHASES) | {"request"})
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["request"]["span_id"] == root_span
+        for phase in GENERATE_PHASES:
+            assert by_name[phase]["parent_id"] == root_span
+        assert by_name["decode"]["args"]["tokens"] == 4
+        assert len({s["tid"] for s in spans}) >= 2
+        # TTFT / inter-token histograms, labeled by model version,
+        # with the sampled trace id as an exemplar (exemplars are
+        # OpenMetrics-only syntax)
+        with urllib.request.urlopen(
+                base + "/metrics?format=openmetrics") as resp:
+            text = resp.read().decode()
+        ttft_lines = [ln for ln in text.splitlines()
+                      if ln.startswith("serving_ttft_seconds_bucket")
+                      and 'endpoint="generate/lm/v1"' in ln
+                      and 'model_version="1"' in ln]
+        itl_lines = [ln for ln in text.splitlines()
+                     if ln.startswith("serving_itl_seconds_bucket")
+                     and 'endpoint="generate/lm/v1"' in ln
+                     and 'model_version="1"' in ln]
+        assert ttft_lines and itl_lines
+        ttft = [ln for ln in ttft_lines
+                if f'trace_id="{tid}"' in ln]
+        assert ttft, "TTFT bucket lost its exemplar"
+
+    def test_phase_attribution_reconciles_within_5pct(self, served):
+        srv, tracer, base = served
+        for _ in range(8):
+            _post(base, "/v1/predict",
+                  {"model": "iris", "inputs": [[1, 2, 3, 4]]})
+        deadline = time.monotonic() + 5.0
+        while True:       # recent entries land after the response
+            dbg, _ = _get(base, "/debug/requests")
+            if len(dbg["recent"]) >= 8 or time.monotonic() > deadline:
+                break
+            time.sleep(0.01)
+        recent = dbg["recent"]
+        assert len(recent) >= 8
+        for entry in recent:
+            phase_sum = sum(entry["phases_ms"].values())
+            assert phase_sum == pytest.approx(
+                entry["duration_ms"], rel=0.05), entry
+        # the aggregate report agrees: per-endpoint decomposition
+        # accounts for the request's wall time and names a culprit
+        att = dbg["latency_attribution"]["predict/iris/v1"]
+        assert att["count"] >= 8
+        assert set(att["phases_ms"]) >= set(PREDICT_PHASES)
+        assert att["phase_sum_over_total"] == pytest.approx(
+            1.0, abs=0.25)
+        assert att["dominant_phase"]["p99"] in att["phases_ms"]
+
+    def test_metrics_expose_latency_exemplars(self, served):
+        srv, tracer, base = served
+        _, _, headers = _post(
+            base, "/v1/predict",
+            {"model": "iris", "inputs": [[1, 2, 3, 4]]})
+        tid, _, _ = _trace_id_from(headers)
+        with urllib.request.urlopen(
+                base + "/metrics?format=openmetrics") as resp:
+            ctype = resp.headers["Content-Type"]
+            text = resp.read().decode()
+        assert "application/openmetrics-text" in ctype
+        assert text.rstrip().endswith("# EOF")
+        hits = [ln for ln in text.splitlines()
+                if ln.startswith("serving_latency_seconds_bucket")
+                and "# {" in ln and 'trace_id="' in ln]
+        assert hits, "no exemplar on the serving latency histogram"
+        # the classic text format must NOT carry exemplars — they are
+        # a parse error that would kill a whole 0.0.4 scrape
+        with urllib.request.urlopen(
+                base + "/metrics?format=prometheus") as resp:
+            classic = resp.read().decode()
+        assert "# {" not in classic and "# EOF" not in classic
+
+    def test_router_hop_adopts_upstream_trace(self, served):
+        """A router→replica hop keeps the request's identity: the
+        replica's whole span tree lives under the caller's trace id,
+        parented to the caller's span."""
+        srv, tracer, base = served
+        upstream = RequestContext(sampled=True, route="/v1/predict")
+        body, code, headers = _post(
+            base, "/v1/predict",
+            {"model": "iris", "inputs": [[1, 2, 3, 4]]},
+            headers={"traceparent": upstream.traceparent()})
+        assert code == 200
+        tid, root_span, _ = _trace_id_from(headers)
+        assert tid == upstream.trace_id
+        spans = _spans_for(tracer, upstream.trace_id,
+                           set(PREDICT_PHASES) | {"request"})
+        root = {s["name"]: s for s in spans}["request"]
+        assert root["parent_id"] == upstream.root_span_id
+        assert root["span_id"] == root_span
+
+    def test_debug_slots_and_traces_endpoints(self, served):
+        srv, tracer, base = served
+        _post(base, "/v1/generate",
+              {"model": "lm", "prompt": [1, 2], "n_tokens": 3})
+        dbg, code = _get(base, "/debug/slots")
+        assert code == 200
+        slots = dbg["backends"]["generate/lm/v1"]["slots"]
+        assert len(slots) == 2
+        assert all(s["state"] in ("free", "prefill", "decode")
+                   for s in slots)
+        dbg, code = _get(base, "/debug/traces")
+        assert code == 200 and dbg["sample_rate"] == 1.0
+        # slow_ms=0 ⇒ every completed request is a "slow" trace
+        deadline = time.monotonic() + 5.0
+        while not dbg["slow"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+            dbg, _ = _get(base, "/debug/traces")
+        assert dbg["slow"] and dbg["slow"][-1]["trace_id"]
+
+    def test_in_flight_request_visible_with_current_phase(self):
+        reg = ModelRegistry()
+        reg.register("echo", EchoModel(delay=0.4))
+        tracer = Tracer(enabled=False)
+        srv = ModelServer(reg, port=0, wait_ms=1.0, sample_rate=1.0,
+                          tracer=tracer).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            t = threading.Thread(
+                target=_post, args=(base, "/v1/predict",
+                                    {"model": "echo",
+                                     "inputs": [[1.0, 2.0]]}))
+            t.start()
+            seen = None
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                dbg, _ = _get(base, "/debug/requests")
+                if dbg["in_flight"]:
+                    seen = dbg["in_flight"][0]
+                    if seen["phase"] == "device_step":
+                        break
+                time.sleep(0.02)
+            t.join()
+            assert seen is not None
+            assert seen["trace_id"] and seen["age_ms"] >= 0
+            assert seen["phase"] in ("queue_wait", "batch_form",
+                                     "device_step", "respond")
+        finally:
+            srv.stop(drain=True, timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# sampling gates emission; errors are always sampled
+# ---------------------------------------------------------------------------
+
+class TestSamplingGates:
+    @pytest.fixture()
+    def unsampled(self):
+        tracer = Tracer(enabled=False)
+        reg = ModelRegistry()
+        reg.register("iris", _mlp())
+        srv = ModelServer(reg, port=0, wait_ms=2.0, sample_rate=0.0,
+                          tracer=tracer).start()
+        yield srv, tracer, f"http://127.0.0.1:{srv.port}"
+        srv.stop(drain=True, timeout=10.0)
+
+    def test_unsampled_success_emits_no_spans(self, unsampled):
+        srv, tracer, base = unsampled
+        body, code, headers = _post(
+            base, "/v1/predict",
+            {"model": "iris", "inputs": [[1, 2, 3, 4]]})
+        assert code == 200
+        tid, _, flags = _trace_id_from(headers)
+        assert flags == "00"
+        time.sleep(0.1)
+        assert [e for e in tracer.events()
+                if e.get("trace_id") == tid] == []
+        # but the attribution histograms recorded it anyway: phase
+        # ledgers feed metrics at EVERY sampling rate
+        att = srv.metrics.latency_attribution()["predict/iris/v1"]
+        assert att["count"] == 1
+
+    def test_errors_promote_to_sampled(self, unsampled):
+        srv, tracer, base = unsampled
+        body, code, headers = _post(
+            base, "/v1/predict", {"model": "ghost",
+                                  "inputs": [[1]]})
+        assert code == 404
+        assert body["trace_id"]            # error body names the trace
+        tid, _, flags = _trace_id_from(headers)
+        assert tid == body["trace_id"] and flags == "01"
+        spans = _spans_for(tracer, tid, {"request"})
+        root = {s["name"]: s for s in spans}["request"]
+        assert "ghost" in root["args"]["error"]
+        assert root["args"]["http_status"] == 404
+
+
+# ---------------------------------------------------------------------------
+# chaos: crash-restart keeps the original trace id
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestCrashRestartContinuity:
+    @pytest.fixture(autouse=True)
+    def _clean_injector(self):
+        yield
+        chaos.uninstall()
+
+    def test_batcher_pending_request_survives_with_trace_id(self):
+        """A worker crash kills the stream mid-decode; the pending
+        (admitted, unslotted) request is served by the RESTARTED
+        worker loop — same trace id, complete span tree, spans
+        stamped on both sides of the restart."""
+        chaos.install({"faults": [{"site": "serving.worker.step",
+                                   "kind": "crash", "at": [3]}]},
+                      seed=1)
+        tr = Tracer(enabled=False)
+        cb = ContinuousBatcher(
+            _lm(), slots=1, capacity=LM_CAP,
+            breaker=CircuitBreaker(failure_threshold=5))
+        try:
+            first_ctx = RequestContext(sampled=True, route="gen",
+                                       tracer=tr)
+            second_ctx = RequestContext(sampled=True, route="gen",
+                                        tracer=tr)
+            first = cb.submit(np.array([1, 2, 3]), 4, ctx=first_ctx)
+            second = cb.submit(np.array([4, 5]), 3, ctx=second_ctx)
+            with pytest.raises(chaos.SimulatedCrashError):
+                cb.wait(first)
+            out = cb.wait(second)
+            assert len(out) == 3
+            second_ctx.finish()
+            # original identity, end to end across the restart
+            spans = _spans_for(tr, second_ctx.trace_id,
+                               set(GENERATE_PHASES) | {"request"})
+            assert {s["trace_id"] for s in spans} == \
+                {second_ctx.trace_id}
+            # the crashed stream is promoted to sampled: the casualty
+            # leaves a trace naming the crash
+            first_ctx.finish()
+            root = {s["name"]: s for s in _spans_for(
+                tr, first_ctx.trace_id, {"request"})}["request"]
+            assert "SimulatedCrash" in root["args"]["error"]
+        finally:
+            assert cb.drain()
+
+    def test_scheduler_crash_then_restart_full_tree(self):
+        """The batch mid-device dies with the crash (its trace is
+        promoted + error-stamped); the restarted worker serves the
+        next request with a complete tree under its original id."""
+        chaos.install({"faults": [{"site": "serving.worker.step",
+                                   "kind": "crash", "at": [1]}]},
+                      seed=1)
+        tr = Tracer(enabled=False)
+        s = BatchScheduler(EchoModel(), max_batch_size=4,
+                           queue_limit=16, wait_ms=1.0,
+                           breaker=CircuitBreaker(failure_threshold=3),
+                           name="predict")
+        try:
+            dead_ctx = RequestContext(sampled=False, route="pred",
+                                      tracer=tr)
+            with pytest.raises(chaos.SimulatedCrashError):
+                s.predict(np.ones((1, 4), np.float32), ctx=dead_ctx)
+            assert dead_ctx.sampled          # crash promoted it
+            ok_ctx = RequestContext(sampled=True, route="pred",
+                                    tracer=tr)
+            out = s.predict(np.full((1, 4), 2.0, np.float32),
+                            ctx=ok_ctx)
+            np.testing.assert_array_equal(out, np.full((1, 4), 4.0))
+            ok_ctx.finish()
+            spans = _spans_for(tr, ok_ctx.trace_id,
+                               set(PREDICT_PHASES) | {"request"})
+            assert {s_["trace_id"] for s_ in spans} == \
+                {ok_ctx.trace_id}
+        finally:
+            s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# span-open sink delivery: unclosed spans reach the crash bundle
+# ---------------------------------------------------------------------------
+
+class TestUnclosedSpans:
+    def test_sink_sees_open_then_close(self):
+        tr = Tracer(enabled=True)
+        got = []
+        tr.add_sink(got.append)
+        try:
+            with tr.span("op"):
+                opens = [e for e in got if e.get("ph") == "open"]
+                assert [e["name"] for e in opens] == ["op"]
+                assert opens[0]["span_id"]
+            closes = [e for e in got if e.get("ph") != "open"]
+            assert [e["name"] for e in closes] == ["op"]
+            assert closes[0]["span_id"] == opens[0]["span_id"]
+        finally:
+            tr.remove_sink(got.append)
+
+    def test_bundle_includes_unclosed_spans(self, tmp_path):
+        """The post-mortem contract the satellite names: work still
+        open at dump time rides events.jsonl with an ``unclosed``
+        marker — and is retired once it closes."""
+        tr = Tracer(enabled=True)
+        rec = flight_recorder.FlightRecorder(
+            out_dir=str(tmp_path), tracer=tr,
+            registry=MetricsRegistry(), min_dump_interval_s=0.0)
+        try:
+            ctx = RequestContext(sampled=True, route="/v1/predict",
+                                 tracer=tr)
+            ctx.open_root()
+            span = tr.span("device_step")
+            span.__enter__()
+            bundle = rec.dump(reason="crash", force=True)
+            lines = [json.loads(ln) for ln in
+                     open(os.path.join(bundle, "events.jsonl"))]
+            unclosed = {e["name"]: e for e in lines
+                        if e.get("unclosed")}
+            assert set(unclosed) == {"request", "device_step"}
+            assert unclosed["request"]["trace_id"] == ctx.trace_id
+            assert unclosed["request"]["age_s"] >= 0
+            manifest = json.load(
+                open(os.path.join(bundle, "MANIFEST.json")))
+            assert manifest["unclosed_spans"] == 2
+            # closing retires the entries: the next bundle is clean
+            span.__exit__(None, None, None)
+            ctx.finish()
+            bundle2 = rec.dump(reason="later", force=True)
+            lines2 = [json.loads(ln) for ln in
+                      open(os.path.join(bundle2, "events.jsonl"))]
+            assert not any(e.get("unclosed") for e in lines2)
+            # the closed spans themselves DID land in the ring
+            assert any(e.get("kind") == "span"
+                       and e.get("name") == "request"
+                       for e in lines2)
+        finally:
+            rec.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO layer: burn rates, config schema, alert wiring
+# ---------------------------------------------------------------------------
+
+def _fast_windows():
+    return [BurnWindow(short_s=5.0, long_s=10.0, factor=2.0)]
+
+
+class TestSLOMonitor:
+    def _latency_fixture(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("serving_latency_seconds", help="t",
+                          labels={"endpoint": "predict"})
+        clock = [0.0]
+        mon = SLOMonitor(
+            reg, [SLO(name="predict_fast", objective=0.9,
+                      threshold_s=0.05,
+                      labels={"endpoint": "predict"}, window_s=60.0,
+                      windows=_fast_windows())],
+            clock=lambda: clock[0], min_eval_interval_s=0.0)
+        return reg, h, clock, mon
+
+    def test_healthy_traffic_never_breaches(self):
+        reg, h, clock, mon = self._latency_fixture()
+        for t in range(10):
+            for _ in range(50):
+                h.record(0.01)
+            clock[0] = float(t)
+            assert mon.evaluate() == []
+        assert not mon.status()[0]["breached"]
+
+    def test_burn_rate_breach_and_recovery(self):
+        reg, h, clock, mon = self._latency_fixture()
+        for _ in range(50):
+            h.record(0.01)
+        clock[0] = 1.0
+        mon.evaluate()
+        # budget is 10%; 100% of fresh traffic is bad ⇒ burn 10x,
+        # past the 2x factor on BOTH windows
+        for i in range(50):
+            h.record(0.5, exemplar={"trace_id": f"slow{i:02d}"})
+        clock[0] = 2.0
+        changes = mon.evaluate()
+        assert [c["event"] for c in changes] == ["breach"]
+        assert changes[0]["slo"] == "predict_fast"
+        assert changes[0]["burn_long"] > 2.0
+        # the page ships concrete offenders from the exemplars
+        assert changes[0]["traces"]
+        assert all(t.startswith("slow") for t in changes[0]["traces"])
+        st = mon.status()[0]
+        assert st["breached"] and st["burn_rates"]
+        # breach gauge + burn-rate gauges live on the registry
+        assert reg.get("slo_breach",
+                       labels={"slo": "predict_fast"}).value() == 1.0
+        assert reg.get("slo_burn_rate",
+                       labels={"slo": "predict_fast",
+                               "window": "10s"}).value() > 2.0
+        # no re-fire while still breached
+        for _ in range(10):
+            h.record(0.5)
+        clock[0] = 3.0
+        assert all(c["event"] != "breach" for c in mon.evaluate())
+        # recovery: enough good traffic drowns the burn once both
+        # windows have moved past the incident's samples
+        for _ in range(5000):
+            h.record(0.01)
+        clock[0] = 20.0
+        changes = mon.evaluate()
+        assert [c["event"] for c in changes] == ["recover"]
+        assert not mon.status()[0]["breached"]
+
+    def test_short_window_clears_stale_incident(self):
+        """Multi-window semantics: once the burst stops, the short
+        window goes quiet and the incident CLEARS — even while the
+        long window still remembers enough burn to exceed the
+        factor. A stale incident cannot keep paging."""
+        reg, h, clock, mon = self._latency_fixture()
+        clock[0] = 0.0
+        mon.evaluate()                     # baseline sample at t=0
+        for _ in range(50):
+            h.record(0.5)                  # the burst
+        clock[0] = 2.0
+        changes = mon.evaluate()           # mid-incident: pages
+        assert [c["event"] for c in changes] == ["breach"]
+        # burst ends; nothing recorded. At t=7 the short window's
+        # base is the post-burst sample (t=2, delta 0 ⇒ burn 0)
+        # while the long window's base is still t=0 (burn 10x)
+        clock[0] = 7.0
+        changes = mon.evaluate()
+        assert [c["event"] for c in changes] == ["recover"]
+        assert not mon.status()[0]["breached"]
+
+    def test_availability_slo_over_counters(self):
+        reg = MetricsRegistry()
+        total = reg.counter("serving_requests_total", help="r",
+                            labels={"endpoint": "predict"})
+        errs = reg.counter("serving_errors_total", help="e",
+                           labels={"endpoint": "predict"})
+        clock = [0.0]
+        mon = SLOMonitor(
+            reg, [SLO(name="availability", objective=0.95,
+                      labels={"endpoint": "predict"}, window_s=60.0,
+                      windows=_fast_windows())],
+            clock=lambda: clock[0], min_eval_interval_s=0.0)
+        total.inc(100)
+        clock[0] = 1.0
+        mon.evaluate()
+        total.inc(100)
+        errs.inc(50)                       # 50% errors vs 5% budget
+        clock[0] = 2.0
+        changes = mon.evaluate()
+        assert [c["event"] for c in changes] == ["breach"]
+
+    def test_from_config_human_units(self):
+        slo = SLO.from_config({"name": "p99", "objective": 0.99,
+                               "threshold_ms": 50,
+                               "window_m": 30,
+                               "endpoint": "predict/iris/v1"})
+        assert slo.threshold_s == 0.05
+        assert slo.window_s == 1800.0
+        assert slo.labels == {"endpoint": "predict/iris/v1"}
+        with pytest.raises(ValueError, match="unknown SLO config"):
+            SLO.from_config({"name": "x", "objectve": 0.9})
+        with pytest.raises(ValueError, match="objective"):
+            SLO.from_config({"name": "x", "objective": 1.5})
+
+    def test_monitor_from_config_json_and_file(self, tmp_path):
+        rules = [{"name": "a", "objective": 0.9,
+                  "threshold_ms": 10.0}]
+        reg = MetricsRegistry()
+        m1 = SLOMonitor.from_config(reg, json.dumps(rules))
+        assert [s["name"] for s in m1.status()] == ["a"]
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps({"slos": rules}))
+        m2 = SLOMonitor.from_config(MetricsRegistry(), str(p))
+        assert [s["name"] for s in m2.status()] == ["a"]
+
+    def test_install_registers_alert_rules(self):
+        from deeplearning4j_tpu.observability.alerts import (
+            AlertManager)
+        reg, h, clock, mon = self._latency_fixture()
+        mgr = AlertManager(registry=reg)
+        mon.install(mgr)
+        for _ in range(20):
+            h.record(0.01)
+        clock[0] = 1.0
+        mon.evaluate()
+        for _ in range(20):
+            h.record(0.5)
+        clock[0] = 2.0
+        mon.evaluate()
+        # the slo_breach pull gauge feeds the standard alert pipeline
+        firing = mgr.evaluate()
+        assert any(a["name"] == "slo_burn:predict_fast"
+                   for a in firing)
+
+
+class TestSLOEndToEnd:
+    def test_breach_degrades_healthz_with_bundled_traces(
+            self, tmp_path):
+        """The acceptance chain: slow traffic ⇒ burn-rate breach ⇒
+        /healthz degraded, offending trace ids in the breach payload
+        AND captured in a flight-recorder bundle."""
+        tracer = Tracer(enabled=False)
+        reg = ModelRegistry()
+        reg.register("echo", EchoModel(delay=0.03))
+        metrics = ServingMetrics()
+        slos = SLOMonitor(
+            metrics.registry,
+            [SLO(name="echo_fast", objective=0.5, threshold_s=1e-4,
+                 labels={"endpoint": "predict/echo/v1"},
+                 window_s=60.0,
+                 windows=[BurnWindow(short_s=0.3, long_s=0.6,
+                                     factor=1.5)])],
+            min_eval_interval_s=0.0)
+        rec = flight_recorder.install(flight_recorder.FlightRecorder(
+            out_dir=str(tmp_path), tracer=tracer,
+            registry=metrics.registry, min_dump_interval_s=0.0))
+        srv = ModelServer(reg, port=0, wait_ms=1.0, sample_rate=1.0,
+                          metrics=metrics, slos=slos,
+                          tracer=tracer).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body, _ = _get(base, "/healthz")
+            assert body["status"] == "ok"
+            assert body["slos"][0]["name"] == "echo_fast"
+            # keep bad traffic FLOWING while polling: burn must show
+            # on the short window too (a stopped burst cannot page —
+            # that is the multi-window point)
+            traced = set()
+            deadline = time.monotonic() + 10.0
+            while True:
+                _, _, headers = _post(
+                    base, "/v1/predict",
+                    {"model": "echo", "inputs": [[1.0, 2.0]]})
+                traced.add(_trace_id_from(headers)[0])
+                body, _ = _get(base, "/healthz")
+                if body["status"] == "degraded" \
+                        or time.monotonic() > deadline:
+                    break
+            assert body["status"] == "degraded"
+            breach = body["slo_breaches"][0]
+            assert breach["name"] == "echo_fast" and \
+                breach["breached"]
+            # the bundle landed, carrying the offending trace ids
+            assert rec.dumps, "no flight-recorder bundle on breach"
+            lines = [json.loads(ln) for ln in
+                     open(os.path.join(rec.dumps[-1],
+                                       "events.jsonl"))]
+            ev = next(e for e in lines if e["kind"] == "slo_breach")
+            assert ev["slo"] == "echo_fast"
+            assert ev["traces"] and set(ev["traces"]) <= traced
+        finally:
+            srv.stop(drain=True, timeout=10.0)
+            flight_recorder.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# trace_report CLI
+# ---------------------------------------------------------------------------
+
+class TestTraceReportCLI:
+    def _make_spans(self, tmp_path, n=5):
+        tr = Tracer(enabled=False)
+        s = BatchScheduler(EchoModel(), max_batch_size=4,
+                           queue_limit=16, wait_ms=1.0,
+                           name="predict")
+        ids = []
+        try:
+            for _ in range(n):
+                ctx = RequestContext(sampled=True,
+                                     route="/v1/predict", tracer=tr)
+                s.predict(np.ones((1, 4), np.float32), ctx=ctx)
+                ctx.finish()
+                ids.append(ctx.trace_id)
+        finally:
+            s.shutdown()
+        path = str(tmp_path / "spans.jsonl")
+        tr.write_jsonl(path)
+        return tr, path, ids
+
+    def test_file_report_phases_and_tree(self, tmp_path, capsys):
+        from tools.trace_report import main
+        tr, path, ids = self._make_spans(tmp_path)
+        assert main([path]) == 0
+        out = capsys.readouterr().out
+        assert f"{len(ids)} trace(s)" in out
+        for phase in PREDICT_PHASES:
+            assert phase in out
+        assert "dominant phase:" in out
+        assert "request" in out                 # rendered tree root
+
+    def test_trace_id_prefix_selection(self, tmp_path, capsys):
+        from tools.trace_report import main
+        tr, path, ids = self._make_spans(tmp_path, n=3)
+        assert main([path, "--trace", ids[0][:12]]) == 0
+        out = capsys.readouterr().out
+        assert f"trace {ids[0]}" in out
+        assert ids[1] not in out
+        assert main([path, "--trace", "ffffnotthere"]) == 0
+        assert "no trace matching" in capsys.readouterr().out
+
+    def test_chrome_trace_input(self, tmp_path, capsys):
+        from tools.trace_report import main
+        tr, _, ids = self._make_spans(tmp_path, n=2)
+        chrome = str(tmp_path / "trace.json")
+        tr.export_chrome_trace(chrome)
+        assert main([chrome]) == 0
+        out = capsys.readouterr().out
+        assert "2 trace(s)" in out and "device_step" in out
+
+    def test_url_mode_against_live_server(self, capsys):
+        from tools.trace_report import main
+        reg = ModelRegistry()
+        reg.register("iris", _mlp())
+        srv = ModelServer(reg, port=0, wait_ms=2.0, sample_rate=1.0,
+                          slow_ms=0.0,
+                          tracer=Tracer(enabled=False)).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            for _ in range(3):
+                _post(base, "/v1/predict",
+                      {"model": "iris", "inputs": [[1, 2, 3, 4]]})
+            assert main(["--url", base]) == 0
+            out = capsys.readouterr().out
+            assert "endpoint predict/iris/v1" in out
+            assert "dominant:" in out
+        finally:
+            srv.stop(drain=True, timeout=10.0)
+
+    def test_usage_errors(self, tmp_path, capsys):
+        from tools.trace_report import main
+        assert main([]) == 2                        # neither input
+        assert main(["x.jsonl", "--url", "http://h"]) == 2   # both
+        assert main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# UI surface: SLO verdicts ride the dashboard health payload
+# ---------------------------------------------------------------------------
+
+class TestUIHealthSLOs:
+    def test_health_payload_degrades_on_breach(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        reg = MetricsRegistry()
+        h = reg.histogram("serving_latency_seconds", help="t",
+                          labels={"endpoint": "predict"})
+        clock = [0.0]
+        mon = SLOMonitor(
+            reg, [SLO(name="ui_slo", objective=0.9, threshold_s=0.05,
+                      labels={"endpoint": "predict"}, window_s=60.0,
+                      windows=_fast_windows())],
+            clock=lambda: clock[0], min_eval_interval_s=0.0)
+        ui = UIServer(port=0)
+        ui.attach_health(slos=mon)
+        payload = ui.health_payload()
+        assert payload["status"] == "ok"
+        assert payload["slos"][0]["name"] == "ui_slo"
+        for _ in range(20):
+            h.record(0.01)
+        clock[0] = 1.0
+        mon.evaluate()
+        for _ in range(20):
+            h.record(0.5)
+        clock[0] = 2.0
+        payload = ui.health_payload()
+        assert payload["status"] == "degraded"
+        assert payload["slos"][0]["breached"]

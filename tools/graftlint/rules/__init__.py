@@ -7,9 +7,11 @@ from tools.graftlint.rules.gl002_recompile import RecompileHazardRule
 from tools.graftlint.rules.gl003_donation import DonationAuditRule
 from tools.graftlint.rules.gl004_locks import LockDisciplineRule
 from tools.graftlint.rules.gl005_literal_drift import LiteralDriftRule
+from tools.graftlint.rules.gl006_metrics_hygiene import (
+    MetricsHygieneRule)
 
 ALL_RULES = {cls.id: cls for cls in (
     JitPurityRule, RecompileHazardRule, DonationAuditRule,
-    LockDisciplineRule, LiteralDriftRule)}
+    LockDisciplineRule, LiteralDriftRule, MetricsHygieneRule)}
 
 __all__ = ["ALL_RULES", "Rule"]

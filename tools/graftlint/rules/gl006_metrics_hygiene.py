@@ -1,0 +1,225 @@
+"""GL006 — metrics hygiene.
+
+Two ways a metrics registry quietly dies in production:
+
+- **Unbounded label cardinality.** A label value that is unique per
+  request (trace id, request id, span id, a raw user string) creates
+  one time series PER REQUEST: the registry grows without bound, the
+  Prometheus exposition becomes megabytes, and every scrape slows the
+  server it measures. Per-request identity belongs in an **exemplar**
+  (bounded: one per bucket), a span, or the flight recorder — never
+  in a label.
+- **Instrument creation in hot loops.** ``registry.counter(...)`` is
+  get-or-create behind a lock; calling it per iteration to ``inc()``
+  churns the registry lock and re-hashes the label key on every
+  event. Instruments are created ONCE (module import or ``__init__``)
+  and the loop calls ``.inc()``/``.record()`` on the held reference.
+
+What the rule flags:
+
+- any ``labels={...}`` dict (registry calls, metric constructors,
+  ``safe_inc``) whose KEY names a per-request id
+  (``trace_id``/``request_id``/...) or whose VALUE expression
+  mentions one (a name, attribute, ``str(...)`` of one, or an
+  f-string interpolating one);
+- a registry-method call (``counter``/``gauge``/``histogram``/
+  ``adopt``/``register`` on a receiver that is recognizably a
+  registry) lexically inside a ``for``/``while`` loop, when the
+  created instrument is used inline (``.inc()`` etc.) or discarded —
+  storing the result (``self._g[k] = reg.gauge(...)``) is the
+  sanctioned init-time pattern and is NOT flagged; ``safe_inc`` is
+  the sanctioned never-raise wrapper and is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.graftlint.core import Finding, ParsedModule
+from tools.graftlint.rules.base import Rule
+
+# label keys / identifier substrings that mean "one series per
+# request" (or per user) — the cardinality explosion
+_BAD_LABEL_KEYS = {"trace_id", "request_id", "span_id", "session_id",
+                   "user_id", "uuid", "uid", "prompt", "query"}
+_BAD_SUBSTRINGS = ("trace_id", "request_id", "span_id", "session_id",
+                   "user_id", "uuid", "traceparent")
+
+_REGISTRY_METHODS = {"counter", "gauge", "histogram", "adopt",
+                     "register"}
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram",
+                 "LatencyHistogram"}
+_USE_METHODS = {"inc", "dec", "set", "observe", "record"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted source text of a Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _registry_receiver(func: ast.AST) -> bool:
+    """Is this call's receiver recognizably a metrics registry?"""
+    if not isinstance(func, ast.Attribute):
+        return False
+    recv = _dotted(func.value).lower()
+    if not recv:
+        return False
+    last = recv.split(".")[-1]
+    return last in ("registry", "reg") or "registry" in last
+
+
+def _mentions_request_id(node: ast.AST) -> Optional[str]:
+    """The first per-request identifier this expression mentions
+    (walking names, attributes, f-strings, str()/format calls)."""
+    for n in ast.walk(node):
+        text = ""
+        if isinstance(n, ast.Name):
+            text = n.id
+        elif isinstance(n, ast.Attribute):
+            text = n.attr
+        low = text.lower()
+        for bad in _BAD_SUBSTRINGS:
+            if bad in low:
+                return text
+    return None
+
+
+class MetricsHygieneRule(Rule):
+    id = "GL006"
+    title = "metrics-hygiene"
+    rationale = ("per-request label values explode cardinality; "
+                 "instrument creation belongs at init time, not in "
+                 "hot loops")
+    scope = "file"
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        if module.tree is None:
+            return []
+        out: List[Finding] = []
+        self._check_labels(module, out)
+        self._check_loop_creation(module, out)
+        return out
+
+    # -- unbounded label values ------------------------------------
+    def _metric_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute) \
+                and f.attr in _REGISTRY_METHODS:
+            return True
+        name = f.id if isinstance(f, ast.Name) else \
+            f.attr if isinstance(f, ast.Attribute) else ""
+        return name in _METRIC_CTORS or name == "safe_inc"
+
+    def _check_labels(self, module: ParsedModule,
+                      out: List[Finding]) -> None:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) \
+                    or not self._metric_call(node):
+                continue
+            labels = next((kw.value for kw in node.keywords
+                           if kw.arg == "labels"), None)
+            if not isinstance(labels, ast.Dict):
+                continue
+            sym = self._enclosing(module, node)
+            for key, value in zip(labels.keys, labels.values):
+                if isinstance(key, ast.Constant) \
+                        and isinstance(key.value, str) \
+                        and key.value.lower() in _BAD_LABEL_KEYS:
+                    out.append(Finding(
+                        rule=self.id, path=module.relpath,
+                        line=key.lineno, symbol=sym,
+                        message=f"label key {key.value!r} is a "
+                                "per-request identifier — one time "
+                                "series per request; use an "
+                                "exemplar, a span, or the flight "
+                                "recorder instead"))
+                    continue
+                if value is None:
+                    continue
+                hit = _mentions_request_id(value)
+                if hit is not None:
+                    out.append(Finding(
+                        rule=self.id, path=module.relpath,
+                        line=value.lineno, symbol=sym,
+                        message=f"label value reads {hit!r} — a "
+                                "per-request identifier as a label "
+                                "value explodes cardinality; use an "
+                                "exemplar, a span, or the flight "
+                                "recorder instead"))
+
+    # -- instrument creation inside loops --------------------------
+    def _check_loop_creation(self, module: ParsedModule,
+                             out: List[Finding]) -> None:
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            for loop in ast.walk(fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    if not (isinstance(f, ast.Attribute)
+                            and f.attr in _REGISTRY_METHODS
+                            and _registry_receiver(f)):
+                        continue
+                    if self._stored(module.tree, node):
+                        continue      # init-time cache fill: fine
+                    out.append(Finding(
+                        rule=self.id, path=module.relpath,
+                        line=node.lineno, symbol=fn.name,
+                        message=f"registry.{f.attr}() inside a loop "
+                                f"in '{fn.name}' — get-or-create "
+                                "churns the registry lock per "
+                                "iteration; create the instrument "
+                                "once at init/import time and call "
+                                ".inc()/.record() on the held "
+                                "reference"))
+
+    @staticmethod
+    def _stored(tree: ast.Module, call: ast.Call) -> bool:
+        """Is this creation's result stored for reuse (the sanctioned
+        init pattern) rather than used inline or discarded?"""
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                if child is call:
+                    # the direct parent decides: an Assign stores it;
+                    # an Expr discards it; an Attribute receiver
+                    # (`reg.counter(...).inc()`) uses it inline
+                    if isinstance(parent, (ast.Assign,
+                                           ast.AnnAssign,
+                                           ast.AugAssign)):
+                        return True
+                    if isinstance(parent, ast.keyword) \
+                            or isinstance(parent, ast.Call):
+                        return True    # passed onward: caller stores
+                    if isinstance(parent, ast.Return):
+                        return True
+                    return False
+        return False
+
+    @staticmethod
+    def _enclosing(module: ParsedModule, node: ast.AST) -> str:
+        """Name of the function/class lexically holding ``node``."""
+        best = ""
+        best_span = None
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= node.lineno <= end:
+                span = end - fn.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = fn.name, span
+        return best

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""trace_report — tail-latency attribution from traces, offline or live.
+
+Two modes:
+
+``python tools/trace_report.py FILE [--trace ID] [--top N]``
+    FILE is span data: a tracer JSONL dump (``Tracer.write_jsonl`` /
+    ``enable(jsonl_path=...)``), a Chrome trace-event JSON
+    (``export_chrome_trace`` / a flight-recorder bundle's
+    ``trace.json``), or a flight-recorder ``events.jsonl``. Spans are
+    grouped by trace id; the report shows per-phase p50/p95/p99
+    across traces, the dominant phase, and (``--trace`` or ``--top``)
+    rendered span trees for the slowest requests.
+
+``python tools/trace_report.py --url http://HOST:PORT [--top N]``
+    Ask a live ModelServer: prints ``/debug/requests``'s
+    latency-attribution report, in-flight requests, and recent slow
+    traces.
+
+Exit codes: 0 ok, 2 usage / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+__all__ = ["load_spans", "group_traces", "phase_percentiles",
+           "render_trace", "report_text", "main"]
+
+# span names that are request phases (contiguous segments of one
+# request); everything else in a trace renders but does not enter the
+# phase table
+PHASE_ORDER = ["admission", "queue_wait", "batch_form", "prefill",
+               "device_step", "decode", "respond", "finalize"]
+
+
+def load_spans(path: str) -> List[dict]:
+    """Normalize any supported file into a span-dict list:
+    ``{name, trace_id?, span_id?, parent_id?, ts_us, dur_us,
+    args?, unclosed?}``."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    # a Chrome trace is ONE JSON document; JSONL fails that parse.
+    # Require the traceEvents key before taking this branch — a
+    # single-line JSONL dump also parses as one dict and must fall
+    # through to the per-line path, not vanish into an empty report
+    data = None
+    try:
+        data = json.loads(text)
+    except ValueError:
+        pass
+    if isinstance(data, dict) and "traceEvents" in data:
+        events = data.get("traceEvents", [])
+        out = []
+        for ev in events:
+            if ev.get("ph") not in (None, "X"):
+                continue
+            args = ev.get("args") or {}
+            out.append({
+                "name": ev.get("name"),
+                "ts_us": float(ev.get("ts", 0.0)),
+                "dur_us": float(ev.get("dur", 0.0)),
+                "trace_id": args.get("trace_id"),
+                "span_id": args.get("span_id"),
+                "parent_id": args.get("parent_id"),
+                "args": args})
+        return out
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            # a crash-truncated final line is exactly this tool's
+            # post-mortem input — keep the readable spans
+            continue
+        if ev.get("kind") == "span_open" or ev.get("ph") == "open" \
+                or ev.get("unclosed"):
+            ev = dict(ev, unclosed=True, dur_us=0.0)
+        elif ev.get("kind") not in (None, "span"):
+            continue              # non-span flight-recorder events
+        if "ts_us" not in ev or "name" not in ev:
+            continue
+        out.append(ev)
+    return out
+
+
+def group_traces(spans: List[dict]) -> Dict[str, List[dict]]:
+    """trace id -> its spans, time-ordered; id-less spans are
+    dropped (they are fit-loop spans, not request spans)."""
+    traces: Dict[str, List[dict]] = {}
+    for s in spans:
+        tid = s.get("trace_id")
+        if tid:
+            traces.setdefault(tid, []).append(s)
+    for tid in traces:
+        traces[tid].sort(key=lambda s: s.get("ts_us", 0.0))
+    return traces
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def phase_percentiles(traces: Dict[str, List[dict]]) -> dict:
+    """Across traces: per-phase duration percentiles (ms) and the
+    whole-request percentiles, plus the dominant phase."""
+    per_phase: Dict[str, List[float]] = {}
+    wholes: List[float] = []
+    for spans in traces.values():
+        for s in spans:
+            name = s.get("name")
+            dur_ms = float(s.get("dur_us", 0.0)) / 1e3
+            if name == "request":
+                wholes.append(dur_ms)
+            elif name in PHASE_ORDER:
+                per_phase.setdefault(name, []).append(dur_ms)
+    report = {"traces": len(traces), "phases_ms": {},
+              "whole_ms": {}}
+    wholes.sort()
+    for q, p in (("p50", .5), ("p95", .95), ("p99", .99)):
+        report["whole_ms"][q] = round(_percentile(wholes, p), 3)
+    for name, vals in per_phase.items():
+        vals.sort()
+        report["phases_ms"][name] = {
+            q: round(_percentile(vals, p), 3)
+            for q, p in (("p50", .5), ("p95", .95), ("p99", .99))}
+    if report["phases_ms"]:
+        report["dominant_phase"] = {
+            q: max(report["phases_ms"],
+                   key=lambda n: report["phases_ms"][n][q])
+            for q in ("p50", "p99")}
+    return report
+
+
+def render_trace(trace_id: str, spans: List[dict]) -> str:
+    """One trace's span tree, children indented under their parent."""
+    by_parent: Dict[Optional[str], List[dict]] = {}
+    ids = {s.get("span_id") for s in spans if s.get("span_id")}
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent not in ids:
+            parent = None          # root (or parent from another hop)
+        by_parent.setdefault(parent, []).append(s)
+    lines = [f"trace {trace_id}"]
+
+    def walk(parent: Optional[str], depth: int) -> None:
+        for s in sorted(by_parent.get(parent, []),
+                        key=lambda s: s.get("ts_us", 0.0)):
+            mark = "  " * depth + ("└─ " if depth else "")
+            dur = float(s.get("dur_us", 0.0)) / 1e3
+            extra = ""
+            args = s.get("args") or {}
+            if s.get("unclosed"):
+                extra = "  [UNCLOSED]"
+            elif args.get("error") or "error" in s:
+                extra = f"  error={args.get('error') or s.get('error')}"
+            lines.append(f"{mark}{s.get('name'):<12} "
+                         f"{dur:10.3f} ms{extra}")
+            sid = s.get("span_id")
+            if sid:
+                walk(sid, depth + 1)
+
+    walk(None, 0)
+    return "\n".join(lines)
+
+
+def report_text(spans: List[dict], top: int = 3,
+                only_trace: Optional[str] = None) -> str:
+    traces = group_traces(spans)
+    out: List[str] = []
+    if only_trace is not None:
+        matches = {t: s for t, s in traces.items()
+                   if t.startswith(only_trace)}
+        if not matches:
+            return f"no trace matching {only_trace!r} " \
+                   f"({len(traces)} trace(s) in file)"
+        for tid, s in matches.items():
+            out.append(render_trace(tid, s))
+        return "\n\n".join(out)
+    rep = phase_percentiles(traces)
+    out.append(f"{rep['traces']} trace(s)")
+    if rep["whole_ms"]:
+        out.append("whole-request ms: " + "  ".join(
+            f"{q}={v}" for q, v in rep["whole_ms"].items()))
+    if rep["phases_ms"]:
+        out.append(f"{'phase':<12} {'p50':>10} {'p95':>10} "
+                   f"{'p99':>10}")
+        for name in PHASE_ORDER:
+            if name in rep["phases_ms"]:
+                p = rep["phases_ms"][name]
+                out.append(f"{name:<12} {p['p50']:>10.3f} "
+                           f"{p['p95']:>10.3f} {p['p99']:>10.3f}")
+        out.append("dominant phase: "
+                   f"p50={rep['dominant_phase']['p50']} "
+                   f"p99={rep['dominant_phase']['p99']}")
+    # slowest requests, rendered
+    def total(spans):
+        return max((s.get("dur_us", 0.0) for s in spans
+                    if s.get("name") == "request"), default=0.0)
+    slowest = sorted(traces.items(), key=lambda kv: -total(kv[1]))
+    for tid, s in slowest[:top]:
+        out.append("")
+        out.append(render_trace(tid, s))
+    return "\n".join(out)
+
+
+def report_url(base: str, top: int) -> str:
+    import urllib.request
+    base = base.rstrip("/")
+    with urllib.request.urlopen(base + "/debug/requests") as r:
+        dbg = json.load(r)
+    out = [f"server {base}",
+           f"in flight: {dbg.get('in_flight_count', 0)}"]
+    for e in dbg.get("in_flight", []):
+        out.append(f"  {e.get('trace_id')} {e.get('route')} "
+                   f"phase={e.get('phase')} "
+                   f"age={e.get('age_ms', 0):.1f}ms")
+    att = dbg.get("latency_attribution", {})
+    for ep, rep in att.items():
+        out.append(f"\nendpoint {ep} ({rep.get('count', 0)} "
+                   "request(s))")
+        whole = rep.get("whole_ms")
+        if whole:
+            out.append("  whole ms: " + "  ".join(
+                f"{q}={v}" for q, v in whole.items()))
+        for name, p in rep.get("phases_ms", {}).items():
+            out.append(f"  {name:<12} p50={p['p50']:>9.3f} "
+                       f"p95={p['p95']:>9.3f} p99={p['p99']:>9.3f}")
+        dom = rep.get("dominant_phase")
+        if dom:
+            out.append(f"  dominant: p50={dom['p50']} "
+                       f"p99={dom['p99']}")
+        ratio = rep.get("phase_sum_over_total")
+        if ratio is not None:
+            out.append(f"  phase-sum / whole: {ratio}")
+    slow = dbg.get("recent", [])
+    slow = [e for e in slow if e.get("slow")][-top:]
+    if slow:
+        out.append("\nrecent slow:")
+        for e in slow:
+            out.append(f"  {e.get('trace_id')} {e.get('route')} "
+                       f"{e.get('duration_ms')}ms "
+                       f"status={e.get('status')} "
+                       f"phases={e.get('phases_ms')}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="trace_report",
+        description="tail-latency attribution from span data or a "
+                    "live ModelServer")
+    p.add_argument("file", nargs="?", default=None,
+                   help="span JSONL / Chrome trace / flight-recorder "
+                        "events.jsonl")
+    p.add_argument("--url", default=None,
+                   help="live server base URL (uses /debug/requests)")
+    p.add_argument("--trace", default=None, metavar="ID",
+                   help="render only the trace(s) whose id starts "
+                        "with ID")
+    p.add_argument("--top", type=int, default=3,
+                   help="how many slowest traces to render (file "
+                        "mode) / slow requests to list (url mode)")
+    args = p.parse_args(argv)
+    if (args.file is None) == (args.url is None):
+        p.print_usage(sys.stderr)
+        print("trace_report: give exactly one of FILE or --url",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.url:
+            print(report_url(args.url, args.top))
+        else:
+            spans = load_spans(args.file)
+            print(report_text(spans, top=args.top,
+                              only_trace=args.trace))
+    except OSError as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
