@@ -43,6 +43,12 @@ the docs lint checks the README table against these):
 ``serving.worker.step`` the serving backends' device step in
                      ``serving/scheduler.py`` / ``serving/continuous.py``
                      (``crash``, ``hang``, ``poison``)
+``serving.replica``  one request routed by ``serving/router.py`` —
+                     the WHOLE-replica fault site (``kill``: hard-stop
+                     the replica at ``args.replica`` mid-load, the
+                     seed-replayable SIGKILL; ``hang``/``slow``: stall
+                     every handler on it by ``args.delay_s``, auto-
+                     recovering after ``args.for_s`` when given)
 ``parallel.device``  ``parallel/wrapper.ParallelWrapper`` right before
                      each data-parallel mesh step (``crash``, and
                      ``loss`` — simulate losing one mesh device; the
@@ -120,6 +126,7 @@ SITES: Dict[str, str] = {
     "data.load": "one dataset file read by a fetcher",
     "train.step": "one ElasticTrainer train step",
     "serving.worker.step": "one serving-backend device step",
+    "serving.replica": "one request routed to a fleet replica",
     "parallel.device": "one ParallelWrapper data-parallel mesh step",
 }
 
@@ -136,6 +143,11 @@ SITE_KINDS: Dict[str, frozenset] = {
     "data.load": _GENERIC_KINDS,
     "train.step": _GENERIC_KINDS | {"nan", "sigterm"},
     "serving.worker.step": _GENERIC_KINDS | {"poison"},
+    # whole-replica faults are interpreted by the FLEET, not
+    # step_fault: kill hard-stops a replica, hang/slow stall all its
+    # handlers (the generic kinds would fault the ROUTER's own
+    # dispatch thread, which is not what a replica fault means)
+    "serving.replica": frozenset({"kill", "hang", "slow"}),
     "parallel.device": _GENERIC_KINDS | {"loss"},
 }
 

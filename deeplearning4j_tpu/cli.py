@@ -135,6 +135,17 @@ def _cmd_serve_knn(args):
         server.stop()
 
 
+def _parse_model_spec(spec):
+    """[NAME=]PATH: an existing file wins outright — a bare path
+    may itself contain '=' (run=3/m.zip); otherwise split on
+    the first '=' only when the prefix looks like a name."""
+    name, sep, path = spec.partition("=")
+    if os.path.exists(spec) or not sep or os.sep in name \
+            or "/" in name:
+        name, path = "default", spec
+    return name, path
+
+
 def _cmd_serve(args):
     import time
     from deeplearning4j_tpu.serving.http import ModelServer
@@ -143,13 +154,7 @@ def _cmd_serve(args):
     from deeplearning4j_tpu.util.model_serializer import restore_model
     registry = ModelRegistry()
     for spec in args.model:
-        # [NAME=]PATH: an existing file wins outright — a bare path
-        # may itself contain '=' (run=3/m.zip); otherwise split on
-        # the first '=' only when the prefix looks like a name
-        name, sep, path = spec.partition("=")
-        if os.path.exists(spec) or not sep or os.sep in name \
-                or "/" in name:
-            name, path = "default", spec
+        name, path = _parse_model_spec(spec)
         version = registry.register(name, restore_model(path))
         print(f"registered {name} v{version} from {path}")
     metrics = ServingMetrics()
@@ -179,6 +184,49 @@ def _cmd_serve(args):
     except KeyboardInterrupt:
         print("draining...")
         server.stop(drain=True)
+
+
+def _cmd_serve_fleet(args):
+    import time
+    from deeplearning4j_tpu.serving.fleet import ReplicaFleet
+    from deeplearning4j_tpu.serving.router import Router
+    from deeplearning4j_tpu.util.model_serializer import restore_model
+    if args.chaos:
+        from deeplearning4j_tpu import chaos
+        inj = chaos.install(args.chaos, seed=args.chaos_seed)
+        print(f"chaos: fault plan installed "
+              f"({len(inj.plan.faults)} spec(s), seed {inj.seed}; "
+              f"replay with --chaos-seed {inj.seed})")
+    specs = [_parse_model_spec(s) for s in args.model]
+
+    def factory(specs=specs):
+        # called once per replica boot: each replica owns its model
+        # instances (and their compiled executables) outright
+        return {name: restore_model(path) for name, path in specs}
+
+    fleet = ReplicaFleet(
+        factory, n=args.replicas,
+        server_kwargs=dict(max_batch_size=args.max_batch_size,
+                           queue_limit=args.queue_limit,
+                           wait_ms=args.wait_ms, slots=args.slots,
+                           capacity=args.capacity)).start()
+    router = Router(
+        fleet, port=args.port, host=args.host,
+        probe_interval_s=args.probe_interval,
+        hedge_after_s=None if args.hedge_after_ms <= 0
+        else args.hedge_after_ms / 1e3,
+        sample_rate=args.trace_sample).start()
+    print(f"fleet router on http://{args.host}:{router.port}/ over "
+          f"{fleet.size()} replica(s) "
+          f"(/v1/predict /v1/generate /v1/models /healthz /readyz "
+          f"/metrics /fleet; ctrl-c drains the fleet and stops)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("draining fleet...")
+        router.stop()
+        fleet.stop(drain=True)
 
 
 def _cmd_summary(args):
@@ -295,6 +343,44 @@ def main(argv=None):
                         "for the rule schema); multi-window burn-rate "
                         "breaches flip /healthz to degraded")
     v.set_defaults(fn=_cmd_serve)
+
+    f = sub.add_parser(
+        "serve-fleet",
+        help="N-replica serving fleet behind the health-aware "
+             "router (failover, hedging, session affinity, "
+             "zero-downtime drain)")
+    f.add_argument("--model", action="append", required=True,
+                   metavar="[NAME=]PATH",
+                   help="model zip hosted on EVERY replica; "
+                        "repeatable")
+    f.add_argument("--replicas", type=int, default=2,
+                   help="fleet size (in-process ModelServer "
+                        "replicas on loopback ports)")
+    f.add_argument("--host", default="127.0.0.1")
+    f.add_argument("--port", type=int, default=8080,
+                   help="the ROUTER's port (replicas pick free "
+                        "loopback ports)")
+    f.add_argument("--max-batch-size", type=int, default=32)
+    f.add_argument("--queue-limit", type=int, default=256)
+    f.add_argument("--wait-ms", type=float, default=2.0)
+    f.add_argument("--slots", type=int, default=4)
+    f.add_argument("--capacity", type=int, default=256)
+    f.add_argument("--probe-interval", type=float, default=1.0,
+                   metavar="S",
+                   help="active health-probe period (seconds)")
+    f.add_argument("--hedge-after-ms", type=float, default=750.0,
+                   help="fire a hedged /v1/predict on a second "
+                        "replica after this quiet interval; <= 0 "
+                        "disables hedging")
+    f.add_argument("--trace-sample", type=float, default=0.01,
+                   metavar="RATE")
+    f.add_argument("--chaos", metavar="PLAN", default=None,
+                   help="deterministic fault plan (the "
+                        "serving.replica site kills/hangs whole "
+                        "replicas mid-load)")
+    f.add_argument("--chaos-seed", type=int, default=None,
+                   metavar="N")
+    f.set_defaults(fn=_cmd_serve_fleet)
 
     s = sub.add_parser("summary", help="inspect a model file")
     s.add_argument("--model", required=True)

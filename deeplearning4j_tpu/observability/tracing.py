@@ -358,6 +358,16 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    def events_for_trace(self, trace_id: str) -> List[dict]:
+        """Every buffered span carrying ``trace_id`` — the hop
+        verification a fleet soak asserts on: one trace id must span
+        the router's root request span AND the replica spans it
+        parented via the forwarded ``traceparent`` header (including
+        every failed-over attempt)."""
+        with self._lock:
+            return [ev for ev in self._events
+                    if ev.get("trace_id") == trace_id]
+
     # ---- export ----
     def export_chrome_trace(self, path: str) -> int:
         """Write the buffered spans as Chrome trace-event JSON

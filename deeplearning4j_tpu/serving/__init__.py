@@ -19,6 +19,8 @@ _EXPORTS = {
     "ModelNotFoundError": "errors",
     "ServerClosedError": "errors",
     "CircuitOpenError": "errors",
+    "ReplicaGoneError": "errors",
+    "NoReplicaAvailableError": "errors",
     "CircuitBreaker": "lifecycle",
     "LatencyHistogram": "metrics",
     "EndpointMetrics": "metrics",
@@ -30,6 +32,10 @@ _EXPORTS = {
     "pow2_pad_rows": "scheduler",
     "ContinuousBatcher": "continuous",
     "ModelServer": "http",
+    "ReplicaFleet": "fleet",
+    "InProcessReplica": "fleet",
+    "SubprocessReplica": "fleet",
+    "Router": "router",
 }
 
 __all__ = list(_EXPORTS)

@@ -15,11 +15,25 @@ serving stack, and ``serving/__init__`` re-exports lazily.
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
            "ModelNotFoundError", "ServerClosedError",
-           "CircuitOpenError"]
+           "CircuitOpenError", "ReplicaGoneError",
+           "NoReplicaAvailableError"]
 
 
 class ServingError(RuntimeError):
-    """Base class for serving-layer failures."""
+    """Base class for serving-layer failures.
+
+    ``retry_after_s`` is the raiser's backoff hint: the HTTP layer
+    turns it into a ``Retry-After`` header on 429/503 responses so
+    routers and load generators can back off for a meaningful
+    interval (breaker cooldown remaining, queue-depth estimate)
+    instead of a blind constant."""
+
+    retry_after_s = None
+
+    def __init__(self, *args, retry_after_s=None):
+        super().__init__(*args)
+        if retry_after_s is not None:
+            self.retry_after_s = float(retry_after_s)
 
 
 class QueueFullError(ServingError):
@@ -51,3 +65,17 @@ class CircuitOpenError(ServingError):
     crashes: the request is shed immediately instead of being queued
     into a crash-looping worker. Retry after the breaker's cooldown
     (HTTP maps this to 503)."""
+
+
+class ReplicaGoneError(ServingError):
+    """The replica pinned to this request (a session-affine
+    ``/v1/generate`` stream) died mid-flight. The router does NOT
+    silently fail the stream over — generation state lived on the
+    dead replica — so the client gets this typed error carrying the
+    trace id and must restart the stream (HTTP maps this to 502)."""
+
+
+class NoReplicaAvailableError(ServingError):
+    """Every replica in the fleet is dead, ejected, or draining: the
+    router has nowhere to send the request (HTTP maps this to 503;
+    ``retry_after_s`` is the soonest a replica may be readmitted)."""

@@ -1,0 +1,409 @@
+"""Replica fleet: N serving replicas behind one stable router.
+
+One ``ModelServer`` is a throughput AND availability ceiling — a
+worker crash, a recompile storm, or a drain takes the whole serving
+surface down. The fleet makes servers expendable the way the TF
+runtime treats workers (PAPERS.md 1603.04467): N replicas, each a
+full ``ModelServer`` (own registry, schedulers, metrics, breaker
+stack), managed as cattle behind ``serving/router.py``.
+
+Two replica flavours:
+
+- :class:`InProcessReplica` — a ``ModelServer`` in this process on a
+  loopback port. Cheap to boot, fully introspectable (the chaos
+  ``hang`` kind reaches straight into ``server.chaos_delay_s``), the
+  test/bench workhorse.
+- :class:`SubprocessReplica` — ``python -m deeplearning4j_tpu serve``
+  in a child process. ``kill()`` is a REAL ``SIGKILL``; drain rides
+  SIGINT (the CLI's ctrl-c drain path).
+
+Fleet operations:
+
+- ``kill(pos)`` — hard-stop, no drain: in-flight work fails, the
+  listener socket closes (connection-refused to the router, which
+  fails over). The SIGKILL drill.
+- ``hang(pos, delay_s, for_s=None)`` — stall EVERY handler on the
+  replica (health probes included) so it looks exactly like a
+  wedged process; auto-recovers after ``for_s`` when given.
+- ``replace(pos)`` — zero-downtime rotation: the successor boots
+  FIRST (capacity never dips), the old replica flips to
+  ``draining`` (the router stops new sends at the next pick, its
+  in-flight streams finish), then drains and leaves the pool.
+- ``apply_fault(fault)`` — the ``serving.replica`` chaos-site
+  interpreter: ``kill`` / ``hang`` / ``slow`` faults from a seeded
+  plan, so a SIGKILL-mid-load soak is replayable bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["ReplicaFleet", "InProcessReplica", "SubprocessReplica"]
+
+# fleet_state lifecycle: up -> draining -> dead (kill skips draining)
+UP, DRAINING, DEAD = "up", "draining", "dead"
+
+
+class _BaseReplica:
+    """What the router needs from a replica: an id, a URL, a fleet
+    state, and the kill/drain verbs."""
+
+    def __init__(self, rid: int):
+        self.id = rid
+        self.host = "127.0.0.1"
+        self.port = 0
+        # fleet_state is the FLEET's intent (up/draining/dead); the
+        # router's health view (ok/degraded/dead) is probed, not told
+        self.fleet_state = UP
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "_BaseReplica":
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:
+        raise NotImplementedError
+
+    def hang(self, delay_s: float) -> None:
+        raise NotImplementedError
+
+
+class InProcessReplica(_BaseReplica):
+    """A full ``ModelServer`` on a loopback port in this process.
+
+    Each replica owns its registry, metrics, schedulers and circuit
+    breakers — nothing is shared across replicas except the model
+    FACTORY, so one replica's crash loop cannot poison another's
+    backends.
+    """
+
+    def __init__(self, rid: int, model_factory: Callable[[], Dict],
+                 server_kwargs: Optional[dict] = None):
+        super().__init__(rid)
+        self._model_factory = model_factory
+        self._server_kwargs = dict(server_kwargs or {})
+        self.server = None
+
+    def start(self) -> "InProcessReplica":
+        from deeplearning4j_tpu.serving.http import ModelServer
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        models = ModelRegistry()
+        for name, model in self._model_factory().items():
+            models.register(name, model)
+        kw = dict(self._server_kwargs)
+        kw.pop("registry", None)
+        kw.setdefault("port", 0)
+        self.server = ModelServer(models, **kw).start()
+        self.host, self.port = self.server.host, self.server.port
+        logger.info("replica %d up on %s", self.id, self.url)
+        return self
+
+    def kill(self) -> None:
+        """SIGKILL-equivalent: no drain — in-flight and queued work
+        fails, and ModelServer.stop closes the listener SOCKET so
+        new connections are refused (the router's failover signal),
+        not just unserved."""
+        self.fleet_state = DEAD
+        srv = self.server
+        if srv is None:
+            return
+        srv.stop(drain=False, timeout=0.0)
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:
+        self.fleet_state = DEAD
+        srv = self.server
+        if srv is None:
+            return True
+        return srv.stop(drain=drain, timeout=timeout)
+
+    def hang(self, delay_s: float) -> None:
+        if self.server is not None:
+            self.server.chaos_delay_s = float(delay_s)
+
+
+class SubprocessReplica(_BaseReplica):
+    """``python -m deeplearning4j_tpu serve`` in a child process —
+    the replica the SIGKILL drill means literally."""
+
+    def __init__(self, rid: int, model_specs: List[str], port: int,
+                 extra_args: Optional[List[str]] = None):
+        super().__init__(rid)
+        self.port = port
+        self._model_specs = list(model_specs)
+        self._extra_args = list(extra_args or [])
+        self.proc: Optional[subprocess.Popen] = None
+
+    def start(self) -> "SubprocessReplica":
+        cmd = [sys.executable, "-m", "deeplearning4j_tpu", "serve",
+               "--host", self.host, "--port", str(self.port)]
+        for spec in self._model_specs:
+            cmd += ["--model", spec]
+        cmd += self._extra_args
+        self.proc = subprocess.Popen(cmd,
+                                     stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.DEVNULL)
+        return self
+
+    def kill(self) -> None:
+        self.fleet_state = DEAD
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()        # the real signal 9
+            try:
+                # reap: a SIGKILLed child exits immediately; without
+                # the wait it stays a zombie for the parent's life
+                self.proc.wait(5.0)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:
+        self.fleet_state = DEAD
+        if self.proc is None or self.proc.poll() is not None:
+            return True
+        if drain:
+            # SIGINT rides the CLI's KeyboardInterrupt drain path
+            self.proc.send_signal(signal.SIGINT)
+            try:
+                self.proc.wait(timeout)
+                return True
+            except subprocess.TimeoutExpired:
+                pass
+        self.proc.kill()
+        try:
+            self.proc.wait(5.0)
+        except subprocess.TimeoutExpired:
+            # a D-state child that outlives SIGKILL must not escape
+            # here — replace() still has to drop it from the pool
+            pass
+        return not drain
+
+    def hang(self, delay_s: float) -> None:
+        raise NotImplementedError(
+            "hang needs in-process reach; use an InProcessReplica "
+            "or SIGSTOP the child yourself")
+
+
+class ReplicaFleet:
+    """N replicas managed as one unit; the router holds a reference
+    and reads ``snapshot()`` per routing decision (so a drain is
+    visible at the very next pick, not a probe interval later)."""
+
+    def __init__(self, model_factory: Optional[Callable[[], Dict]] = None,
+                 n: int = 2, server_kwargs: Optional[dict] = None,
+                 model_specs: Optional[List[str]] = None,
+                 base_port: int = 0):
+        if model_factory is None and not model_specs:
+            raise ValueError("fleet needs a model_factory (in-process"
+                             " replicas) or model_specs (subprocess)")
+        if model_factory is None and base_port <= 0:
+            # subprocess replicas advertise base_port + rid to the
+            # router; 0 would mean "probe http://127.0.0.1:0 forever"
+            # — a silently unreachable fleet
+            raise ValueError("subprocess replicas need an explicit "
+                             "base_port (each child listens on "
+                             "base_port + replica id)")
+        self._model_factory = model_factory
+        self._server_kwargs = dict(server_kwargs or {})
+        self._model_specs = list(model_specs or [])
+        self._base_port = base_port
+        self.n = n
+        self._lock = threading.Lock()
+        self._replicas: List[_BaseReplica] = []
+        self._next_id = 0
+        self._timers: List[threading.Timer] = []
+        self._subscribers: List[Callable[[], None]] = []
+
+    def subscribe(self, fn: Callable[[], None]) -> None:
+        """Register a pool-mutation hook (the router uses it to
+        reconcile its views the moment the pool changes, instead of
+        a probe interval later)."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def _notify(self) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        for fn in subs:
+            try:
+                fn()
+            except Exception:
+                logger.exception("fleet change subscriber failed")
+
+    # ---- construction ----
+    def _new_replica(self) -> _BaseReplica:
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        if self._model_factory is not None:
+            return InProcessReplica(rid, self._model_factory,
+                                    self._server_kwargs)
+        return SubprocessReplica(rid, self._model_specs,
+                                 self._base_port + rid)
+
+    def start(self) -> "ReplicaFleet":
+        fresh = [self._new_replica().start() for _ in range(self.n)]
+        with self._lock:
+            self._replicas.extend(fresh)
+        return self
+
+    # ---- introspection ----
+    def snapshot(self) -> List[_BaseReplica]:
+        """The live pool (including draining members), as a copy —
+        the router's per-request view."""
+        with self._lock:
+            return list(self._replicas)
+
+    def replica(self, pos: int) -> _BaseReplica:
+        with self._lock:
+            return self._replicas[pos]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    # ---- fault verbs ----
+    def kill(self, pos: int) -> Optional[_BaseReplica]:
+        """Hard-stop the replica at pool position ``pos`` (no drain,
+        socket closed) and remove it from the pool. No-op (None) on
+        an empty pool — a seeded chaos plan can fire more kills
+        than there are replicas."""
+        with self._lock:
+            if not self._replicas:
+                logger.warning("fleet: kill requested on an empty "
+                               "pool; ignored")
+                return None
+            r = self._replicas.pop(pos % len(self._replicas))
+        logger.warning("fleet: killing replica %d (SIGKILL drill)",
+                       r.id)
+        r.kill()
+        self._notify()
+        return r
+
+    def hang(self, pos: int, delay_s: float = 5.0,
+             for_s: Optional[float] = None
+             ) -> Optional[_BaseReplica]:
+        """Stall every handler on the replica (probes included); with
+        ``for_s`` a timer lifts the stall — the
+        ejection-then-readmission drill in one call. No-op (None) on
+        an empty pool — a seeded chaos plan can outlive the pool."""
+        with self._lock:
+            if not self._replicas:
+                logger.warning("fleet: hang requested on an empty "
+                               "pool; ignored")
+                return None
+            r = self._replicas[pos % len(self._replicas)]
+        r.hang(delay_s)
+        if for_s is not None:
+            t = threading.Timer(for_s, r.hang, args=(0.0,))
+            t.daemon = True
+            t.start()
+            with self._lock:
+                # prune fired timers as we go: a long seeded soak
+                # fires many hang/slow faults and must not grow the
+                # list (and the shutdown cancel loop) without bound
+                self._timers = [x for x in self._timers
+                                if x.is_alive()]
+                self._timers.append(t)
+        return r
+
+    def apply_fault(self, fault) -> None:
+        """Interpret one fired ``serving.replica`` chaos fault (the
+        router hits the site once per routed request, so a seeded
+        ``at`` schedule names the exact request ordinal the replica
+        dies at)."""
+        pos = int(fault.args.get("replica", 0))
+        with self._lock:
+            if not self._replicas:
+                return
+        if fault.kind == "kill":
+            self.kill(pos)
+        elif fault.kind in ("hang", "slow"):
+            default = 5.0 if fault.kind == "hang" else 0.25
+            self.hang(pos, float(fault.args.get("delay_s", default)),
+                      for_s=fault.args.get("for_s"))
+
+    # ---- rotation ----
+    def replace(self, pos: int, drain_timeout: float = 30.0
+                ) -> _BaseReplica:
+        """Zero-downtime replace: boot the successor FIRST, then
+        drain the incumbent out of the pool. Returns the successor.
+
+        Order matters: capacity never dips below N — subscribers
+        (the router) are notified as soon as the successor joins, so
+        it is probed and routable the moment it answers, and the
+        router (which reads ``snapshot()`` per pick and skips
+        ``draining`` members) stops new sends the moment the flag
+        flips, while the old replica's in-flight streams run to
+        completion."""
+        successor = self._new_replica().start()
+        with self._lock:
+            if not self._replicas:
+                # the pool was emptied (seeded kills can outpace a
+                # soak): there is nobody to drain — the successor
+                # just becomes the pool's new capacity instead of
+                # leaking as an orphaned listener
+                self._replicas.append(successor)
+                old = None
+            else:
+                old = self._replicas[pos % len(self._replicas)]
+                self._replicas.append(successor)
+                old.fleet_state = DRAINING
+        self._notify()     # the router can admit the successor NOW
+        if old is None:
+            logger.warning("fleet: replace on an empty pool — "
+                           "replica %d booted as fresh capacity",
+                           successor.id)
+            return successor
+        logger.info("fleet: replacing replica %d with %d", old.id,
+                    successor.id)
+        ok = old.stop(drain=True, timeout=drain_timeout)
+        if not ok:
+            logger.warning("fleet: replica %d drain timed out after "
+                           "%.1fs; stragglers failed typed", old.id,
+                           drain_timeout)
+        with self._lock:
+            if old in self._replicas:
+                self._replicas.remove(old)
+        self._notify()
+        return successor
+
+    # ---- shutdown ----
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:
+        with self._lock:
+            replicas = list(self._replicas)
+            self._replicas.clear()
+            timers = list(self._timers)
+            self._timers.clear()
+        for t in timers:
+            t.cancel()
+        if not replicas:
+            return True
+        # drain concurrently: each replica's drain may wait out its
+        # full timeout, and paying that serially would make fleet
+        # shutdown wall-clock N x timeout instead of one
+        results: Dict[int, bool] = {}
+
+        def _stop(r: _BaseReplica) -> None:
+            results[r.id] = r.stop(drain=drain, timeout=timeout)
+
+        threads = [threading.Thread(target=_stop, args=(r,),
+                                    daemon=True,
+                                    name=f"fleet-stop-{r.id}")
+                   for r in replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return all(results.get(r.id, False) for r in replicas)

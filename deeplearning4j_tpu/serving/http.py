@@ -9,7 +9,12 @@ front of the registry + schedulers:
 - ``POST /v1/generate`` {"model", "version"?, "prompt", "n_tokens",
   "temperature"?, "seed"?, "timeout_ms"?} → {"ids", "model_version"}
 - ``GET  /v1/models``   → registry listing
-- ``GET  /healthz``     → {"status": "ok" | "draining"}
+- ``GET  /healthz``     → {"status": "ok" | "degraded" | "draining"}
+  — always 200 for humans; the STATUS field carries the judgement
+- ``GET  /readyz`` (or ``/healthz?ready``) → the same payload, but
+  503 when draining or degraded: the form a dumb load-balancer
+  check (and the fleet router's prober) consumes — ready means
+  "send me traffic", not "the process is up"
 - ``GET  /metrics``     → ServingMetrics snapshot (JSON), or
   Prometheus text exposition when the client asks for it —
   ``?format=prometheus``, or an ``Accept`` header naming
@@ -55,6 +60,89 @@ from deeplearning4j_tpu.serving.scheduler import BatchScheduler
 logger = logging.getLogger("deeplearning4j_tpu")
 
 __all__ = ["ModelServer"]
+
+
+def _retry_after_header(seconds: float) -> str:
+    """RFC-compliant delta-seconds (integer, >= 1): routers and the
+    in-repo loadgen parse it numerically; standard LBs expect an
+    int."""
+    return str(max(1, int(-(-float(seconds) // 1))))
+
+
+class _JsonRequestHandler(BaseHTTPRequestHandler):
+    """Shared base for the serving listeners (ModelServer and the
+    fleet Router): quiet logging, the Nagle fix, and a JSON/bytes
+    response helper — one copy, so a transport fix lands on both."""
+
+    # headers and body go out as two small writes; with Nagle on,
+    # the second stalls until the client ACKs the first, and the
+    # client's delayed-ACK timer makes that ~40ms PER HOP — at a
+    # router in front, ~80ms on every request. TCP_NODELAY removes
+    # the stall outright.
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, code, obj, headers=None):
+        data = obj if isinstance(obj, bytes) \
+            else json.dumps(obj).encode()
+        self.send_response(code)
+        if not (headers or {}).get("Content-Type"):
+            self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_text(self, code, text, content_type):
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _metrics_mode(self) -> str:
+        # "json" | "text" (classic 0.0.4) | "openmetrics".
+        # Exemplars are only legal in OpenMetrics, so a scraper
+        # that wants them must say so (format=openmetrics or
+        # the Accept header real Prometheus sends).
+        q = parse_qs(urlparse(self.path).query)
+        fmt = (q.get("format") or [None])[0]
+        if fmt == "openmetrics":
+            return "openmetrics"
+        if fmt == "prometheus":
+            return "text"
+        if fmt == "json":
+            return "json"
+        accept = self.headers.get("Accept", "")
+        if "openmetrics" in accept:
+            return "openmetrics"
+        if "text/plain" in accept:
+            return "text"
+        return "json"
+
+    def _content_length(self) -> int:
+        n = int(self.headers.get("Content-Length", 0))
+        if n < 0:
+            # rfile.read(-1) would read to EOF — on a keep-alive
+            # connection that blocks forever, wedging the handler
+            raise ValueError(f"negative Content-Length: {n}")
+        return n
+
+
+def _make_listener(host: str, port: int, handler_cls):
+    """ThreadingHTTPServer with a raised listen backlog: the stdlib
+    default of 5 drops SYNs under connection-churn load (a
+    closed-loop client pool opening a fresh connection per request);
+    the dropped SYN retries after ~1s — a hard 1s floor on the
+    latency tail."""
+    class _Httpd(ThreadingHTTPServer):
+        request_queue_size = 128
+
+    return _Httpd((host, port), handler_cls)
 
 
 class ModelServer:
@@ -112,6 +200,14 @@ class ModelServer:
         self._draining = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Retry-After hint on the draining 503: a drained server is
+        # being replaced, so "come back soon" is measured in seconds
+        self.drain_retry_after_s = 2.0
+        # chaos hook (site serving.replica, kind hang/slow): every
+        # handler — health probes included — stalls this long, so a
+        # hung replica looks to the router exactly like a real one:
+        # probe timeouts, rising latency, passive ejection
+        self.chaos_delay_s = 0.0
 
     # ---- backend resolution ----
     def _get_or_create(self, cache: dict, key: tuple, factory):
@@ -182,94 +278,30 @@ class ModelServer:
     def start(self) -> "ModelServer":
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):
-                pass
-
-            def _send(self, code, obj, headers=None):
-                data = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in (headers or {}).items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(data)
-
-            def _send_text(self, code, text, content_type):
-                data = text.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", content_type)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def _metrics_mode(self) -> str:
-                # "json" | "text" (classic 0.0.4) | "openmetrics".
-                # Exemplars are only legal in OpenMetrics, so a scraper
-                # that wants them must say so (format=openmetrics or
-                # the Accept header real Prometheus sends).
-                q = parse_qs(urlparse(self.path).query)
-                fmt = (q.get("format") or [None])[0]
-                if fmt == "openmetrics":
-                    return "openmetrics"
-                if fmt == "prometheus":
-                    return "text"
-                if fmt == "json":
-                    return "json"
-                accept = self.headers.get("Accept", "")
-                if "openmetrics" in accept:
-                    return "openmetrics"
-                if "text/plain" in accept:
-                    return "text"
-                return "json"
-
+        class Handler(_JsonRequestHandler):
             def _body(self):
-                n = int(self.headers.get("Content-Length", 0))
+                n = self._content_length()
                 return json.loads(self.rfile.read(n).decode() or "{}")
 
             def do_GET(self):
                 path = urlparse(self.path).path
-                if path == "/healthz":
-                    if server._draining.is_set():
-                        self._send(200, {"status": "draining"})
-                        return
-                    firing = []
-                    if server.alerts is not None:
-                        try:
-                            server.alerts.evaluate()
-                            firing = server.alerts.firing()
-                        except Exception:
-                            logger.exception("alert evaluation "
-                                             "failed")
-                    slo_status = None
-                    if server.slos is not None:
-                        try:
-                            server.slos.evaluate()
-                            slo_status = server.slos.status()
-                        except Exception:
-                            logger.exception("SLO evaluation failed")
-                    # non-closed circuit breakers degrade health: a
-                    # crash-looping backend must be visible to load
-                    # balancers without polling /metrics
-                    circuits = server._circuit_states()
-                    breached = [s for s in (slo_status or [])
-                                if s.get("breached")]
-                    if firing or circuits or breached:
-                        payload = {"status": "degraded"}
-                        if firing:
-                            payload["alerts"] = firing
-                        if circuits:
-                            payload["circuits"] = circuits
-                        if breached:
-                            payload["slo_breaches"] = breached
-                        if slo_status is not None:
-                            payload["slos"] = slo_status
-                        self._send(200, payload)
+                if server.chaos_delay_s:
+                    # chaos hang: the whole replica stalls, health
+                    # probes included — the router must see it
+                    time.sleep(server.chaos_delay_s)
+                if path in ("/healthz", "/readyz"):
+                    payload = server.health_payload()
+                    q = parse_qs(urlparse(self.path).query,
+                                 keep_blank_values=True)
+                    ready = path == "/readyz" or "ready" in q
+                    if ready and payload["status"] != "ok":
+                        # the load-balancer form: draining/degraded IS
+                        # a 503 (stop sending), with a backoff hint
+                        self._send(503, payload, headers={
+                            "Retry-After": _retry_after_header(
+                                server._unready_retry_after_s(
+                                    payload))})
                     else:
-                        payload = {"status": "ok"}
-                        if slo_status is not None:
-                            payload["slos"] = slo_status
                         self._send(200, payload)
                 elif path == "/metrics":
                     mode = self._metrics_mode()
@@ -308,8 +340,14 @@ class ModelServer:
                     self._send(404, {"error": "not found"})
 
             def _serve_request(self, handler, route):
+                if server.chaos_delay_s:
+                    time.sleep(server.chaos_delay_s)
                 if server._draining.is_set():
-                    self._send(503, {"error": "server is draining"})
+                    self._send(503, {"error": "server is draining"},
+                               headers={"Retry-After":
+                                        _retry_after_header(
+                                            server.drain_retry_after_s
+                                        )})
                     return
                 try:
                     body = self._body()
@@ -340,6 +378,14 @@ class ModelServer:
                     # the promoted sampling decision must reach the
                     # next hop's response header too
                     hdrs["traceparent"] = ctx.traceparent()
+                    if c in (429, 503):
+                        # backpressure responses carry the raiser's
+                        # backoff hint (breaker cooldown remaining,
+                        # queue-depth estimate, drain default)
+                        ra = getattr(e, "retry_after_s", None)
+                        hdrs["Retry-After"] = _retry_after_header(
+                            server.drain_retry_after_s
+                            if ra is None else ra)
                     send(c, {"error": str(e),
                              "trace_id": ctx.trace_id})
 
@@ -380,7 +426,7 @@ class ModelServer:
                     "server was stopped; not starting listener")
             if self._httpd is not None:
                 return self
-        httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        httpd = _make_listener(self.host, self.port, Handler)
         # publish under the lock so a concurrent stop() either sees
         # None or the live server, and re-check draining there: a
         # stop() that already returned must not leave this listener
@@ -517,6 +563,65 @@ class ModelServer:
                 "sample_rate": self.sampler.rate,
                 "slow_ms": self.slow_ms}
 
+    # ---- health ----
+    def health_payload(self) -> dict:
+        """The /healthz body — status ``ok`` | ``degraded`` |
+        ``draining`` plus the evidence (firing alerts, non-closed
+        circuits, SLO breaches). ``/healthz`` serves it with 200
+        always (humans read the status field); ``/readyz`` and
+        ``/healthz?ready`` turn a non-ok status into a 503 so dumb
+        LB checks and the fleet router's prober work unmodified."""
+        if self._draining.is_set():
+            return {"status": "draining"}
+        firing = []
+        if self.alerts is not None:
+            try:
+                self.alerts.evaluate()
+                firing = self.alerts.firing()
+            except Exception:
+                logger.exception("alert evaluation failed")
+        slo_status = None
+        if self.slos is not None:
+            try:
+                self.slos.evaluate()
+                slo_status = self.slos.status()
+            except Exception:
+                logger.exception("SLO evaluation failed")
+        # non-closed circuit breakers degrade health: a crash-looping
+        # backend must be visible to load balancers without polling
+        # /metrics
+        circuits = self._circuit_states()
+        breached = [s for s in (slo_status or [])
+                    if s.get("breached")]
+        if firing or circuits or breached:
+            payload = {"status": "degraded"}
+            if firing:
+                payload["alerts"] = firing
+            if circuits:
+                payload["circuits"] = circuits
+            if breached:
+                payload["slo_breaches"] = breached
+        else:
+            payload = {"status": "ok"}
+        if slo_status is not None:
+            payload["slos"] = slo_status
+        return payload
+
+    def _unready_retry_after_s(self, payload: dict) -> float:
+        """Backoff hint for a not-ready 503: the longest breaker
+        cooldown still running when circuits degraded us, else the
+        drain default."""
+        if payload.get("circuits"):
+            with self._lock:
+                backends = (list(self._schedulers.values())
+                            + list(self._batchers.values()))
+            cooldowns = [b.breaker.cooldown_remaining()
+                         for b in backends]
+            longest = max(cooldowns, default=0.0)
+            if longest > 0:
+                return longest
+        return self.drain_retry_after_s
+
     def _circuit_states(self) -> Dict[str, str]:
         """Backend name -> breaker state, for every backend whose
         circuit is NOT closed (the /healthz payload)."""
@@ -581,4 +686,11 @@ class ModelServer:
             httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.shutdown()
+            try:
+                # release the bound port NOW, not at GC: fleet
+                # replicas cycle on loopback ports, and an embedder
+                # restarting on the same port would hit EADDRINUSE
+                httpd.server_close()
+            except OSError:
+                pass
         return ok

@@ -171,6 +171,17 @@ class CircuitBreaker:
                 self._transition(self.CLOSED)
                 self._failures.clear()
 
+    def cooldown_remaining(self) -> float:
+        """Seconds until an OPEN circuit half-opens (0.0 when the
+        circuit already admits work) — what a ``Retry-After`` header
+        should tell the caller."""
+        with self._lock:
+            self._tick()
+            if self._state != self.OPEN:
+                return 0.0
+            return max(0.0, self.cooldown_s
+                       - (self._clock() - self._opened_at))
+
     def force_open(self) -> None:
         """Operator override (and test hook): open now."""
         with self._lock:
@@ -333,7 +344,8 @@ class ServingBackend:
             raise CircuitOpenError(
                 f"{self.name!r} circuit is {self.breaker.state} "
                 f"after repeated worker crashes; request shed — "
-                f"retry after the cooldown")
+                f"retry after the cooldown",
+                retry_after_s=self.breaker.cooldown_remaining())
         return kind == "probe"
 
     def _enqueue(self, r: BaseRequest) -> BaseRequest:
@@ -344,10 +356,17 @@ class ServingBackend:
             self._queue.put_nowait(r)
         except queue.Full:
             self._endpoint.count_shed()
+            # backoff hint derived from queue depth: the time the
+            # backlog roughly needs to clear before a retry can even
+            # be admitted (10 ms/queued item, floor 100 ms) — crude,
+            # but proportional to the actual congestion instead of a
+            # constant the caller would have to guess
             raise QueueFullError(
                 f"{self.name!r} queue is at its limit "
                 f"({self._queue.maxsize}); request shed — retry with "
-                "backoff") from None
+                "backoff",
+                retry_after_s=max(0.1, 0.01 * self._queue.maxsize)
+            ) from None
         if self._stop.is_set() and not r.event.is_set():
             r.error = ServerClosedError(
                 f"{self.name!r} shut down while the request was "

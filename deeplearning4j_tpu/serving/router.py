@@ -1,0 +1,1147 @@
+"""Health-aware HTTP router over a :class:`~.fleet.ReplicaFleet`.
+
+The stable frontend of the serving fleet (the TF-Serving shape from
+PAPERS.md 1605.08695: expendable workers behind one address). A
+stateless stdlib-HTTP ``Router`` — the same ``ThreadingHTTPServer``
+idiom as ``ModelServer`` — that makes the fleet provably survivable:
+
+**Health-aware balancing.** A prober thread polls each replica's
+``/healthz?ready`` + ``/metrics`` every ``probe_interval_s`` and
+classifies it ``ok`` / ``degraded`` / ``draining`` / ``dead``;
+routing picks the least-loaded eligible replica by probed queue
+depth + router-side in-flight count, penalized by degraded health
+and non-closed replica circuits. Draining is read from the FLEET
+snapshot per pick, so ``fleet.replace()`` stops new sends at the
+very next request, not a probe interval later.
+
+**Outlier ejection.** Passive signals (consecutive connect errors /
+timeouts / 5xx from live traffic) force the replica's router-side
+:class:`~.lifecycle.CircuitBreaker` open — the lifecycle.py state
+machine reused at fleet level. An ejected replica receives NO new
+traffic; after the cooldown the breaker half-opens and the PROBER
+(not live traffic) spends the probe budget against ``/healthz?ready``
+— success closes the breaker and readmits the replica
+(``router_readmissions_total``), failure re-opens it.
+
+**Failover + bounded hedging.** ``/v1/predict`` is idempotent: a
+connect-error, read-timeout or 503 (admission refusal — the replica
+never started the work) fails over to a different replica inside the
+request's deadline budget; a 5xx AFTER response bytes means the
+replica processed the request and is returned as-is, never retried.
+``Retry-After`` on a 503 marks the replica unavailable for that long.
+When the primary attempt is quiet past ``hedge_after_s`` and the
+remaining budget affords it, ONE hedged request races it on another
+replica; first definitive answer wins (``router_hedges_total`` /
+``router_hedge_wins_total``).
+
+**Session affinity.** A ``/v1/generate`` request carrying a
+``session`` key is pinned to one replica for the stream's life —
+decode state (KV-cache slots) lives there. Mid-request death
+returns a typed :class:`~.errors.ReplicaGoneError` (502) carrying
+the trace id; death or unavailability (ejected, draining, benched
+by Retry-After) between requests re-pins the session silently — an
+admission refusal advances no decode state, so the re-pin loses
+nothing, while keeping the pin would wedge the session forever.
+
+**Tracing.** The router mints (or adopts) the W3C ``traceparent`` and
+forwards it, so one trace id spans router -> replica -> backend — a
+failed-over request keeps its identity across every attempt.
+"""
+
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import logging
+import queue
+import socket
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse, urlsplit
+
+from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.observability.registry import MetricsRegistry
+from deeplearning4j_tpu.observability.tracing import (RequestContext,
+                                                      Sampler,
+                                                      get_tracer)
+from deeplearning4j_tpu.serving.errors import (NoReplicaAvailableError,
+                                               ReplicaGoneError,
+                                               ServerClosedError)
+from deeplearning4j_tpu.serving.fleet import DRAINING, UP, ReplicaFleet
+from deeplearning4j_tpu.serving.http import (_JsonRequestHandler,
+                                              _make_listener,
+                                              _retry_after_header)
+from deeplearning4j_tpu.serving.lifecycle import CircuitBreaker
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["Router"]
+
+# router_replica_state gauge codes
+_STATE_CODES = {"ok": 0, "degraded": 1, "draining": 2, "ejected": 3,
+                "dead": 4}
+
+
+class _NetError(Exception):
+    """A forwarding failure BEFORE a complete response: retry-safe
+    for idempotent routes. ``connect`` means the request never
+    reached the replica at all (retry-safe even for non-idempotent
+    work)."""
+
+    def __init__(self, phase: str, cause: BaseException):
+        super().__init__(f"{phase}: {cause!r}")
+        self.phase = phase            # "connect" | "exchange"
+        self.cause = cause
+
+
+class _ReplicaView:
+    """Router-side state for one replica id. Mutated under the
+    router's lock (health/queue_depth by the prober, counters by
+    request threads) — primitive reads for the gauge callbacks are
+    tear-free."""
+
+    __slots__ = ("rid", "url", "breaker", "health", "queue_depth",
+                 "circuits", "inflight", "consecutive_failures",
+                 "unavailable_until", "probe_ok_total", "ejections",
+                 "readmissions")
+
+    def __init__(self, rid: int, url: str, breaker: CircuitBreaker):
+        self.rid = rid
+        self.url = url
+        self.breaker = breaker
+        # probed: ok|degraded|draining|dead. Starts NOT-eligible:
+        # "eligible" must mean probe-confirmed, or a readiness gate
+        # polling /healthz right after start() would pass while the
+        # replicas are still booting (Router.start() runs one
+        # synchronous probe pass so live replicas are eligible from
+        # the first request on)
+        self.health = "unprobed"
+        self.queue_depth = 0.0
+        self.circuits = 0             # non-closed breakers on replica
+        self.inflight = 0             # router-side outstanding sends
+        self.consecutive_failures = 0
+        self.unavailable_until = 0.0  # Retry-After honor
+        self.probe_ok_total = 0
+        self.ejections = None         # counters bound at view
+        self.readmissions = None      # registration time
+
+
+class Router:
+    """Stateless HTTP router in front of a :class:`ReplicaFleet`.
+
+    Stateless = no request payload state beyond the in-flight
+    forwarding; everything it knows about replicas is re-derivable
+    from probing, so a router restart loses nothing but affinity
+    pins (which re-pin on the next request).
+    """
+
+    def __init__(self, fleet: ReplicaFleet, port: int = 0,
+                 host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None,
+                 probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 2.0,
+                 attempt_timeout_s: float = 10.0,
+                 request_timeout_s: float = 30.0,
+                 max_attempts: int = 3,
+                 eject_consecutive: int = 3,
+                 eject_cooldown_s: float = 5.0,
+                 hedge_after_s: Optional[float] = 0.75,
+                 hedge_min_budget_s: float = 1.0,
+                 affinity_max: int = 4096,
+                 sample_rate: float = 0.01, tracer=None):
+        self.fleet = fleet
+        self.host = host
+        self.port = port
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.attempt_timeout_s = attempt_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self.max_attempts = max(1, max_attempts)
+        self.eject_consecutive = max(1, eject_consecutive)
+        self.eject_cooldown_s = eject_cooldown_s
+        self.hedge_after_s = hedge_after_s
+        self.hedge_min_budget_s = hedge_min_budget_s
+        self.affinity_max = affinity_max
+        self.sampler = Sampler(rate=sample_rate)
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self._lock = threading.Lock()
+        # serializes whole view-reconciliation passes (prober loop
+        # vs request threads after a chaos fault): without it two
+        # threads can both miss a new rid in their `known` snapshot
+        # and build duplicate views, stranding the gauges on the
+        # orphan
+        self._sync_lock = threading.Lock()
+        self._views: Dict[int, _ReplicaView] = {}
+        # (monotonic ts, {rid: fleet_state}) memo for the gauge
+        # callbacks: a /metrics scrape collects N per-replica gauges
+        # and each would otherwise take its own fleet snapshot
+        self._fs_cache: Tuple[float, Dict[int, str]] = (0.0, {})
+        self._affinity: "Dict[str, int]" = {}
+        self._rr = itertools.count()
+        self._stop_evt = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._prober: Optional[threading.Thread] = None
+        # instruments created ONCE here (GL006): per-route counters
+        # are a small fixed set; per-replica ones are created at
+        # view-registration time and unregistered with the view
+        self._requests = {
+            route: self.registry.counter(
+                "router_requests_total",
+                help="requests routed, by route",
+                labels={"route": route})
+            for route in ("/v1/predict", "/v1/generate")}
+        self._latency = {
+            route: self.registry.histogram(
+                "router_latency_seconds",
+                help="router-side whole-request latency (seconds)",
+                labels={"route": route})
+            for route in ("/v1/predict", "/v1/generate")}
+        self._failovers = self.registry.counter(
+            "router_failovers_total",
+            help="attempts re-sent to a different replica after a "
+                 "retry-safe failure")
+        self._hedges = self.registry.counter(
+            "router_hedges_total",
+            help="hedged second requests fired for tail latency")
+        self._hedge_wins = self.registry.counter(
+            "router_hedge_wins_total",
+            help="hedged requests that answered first")
+        self._errors = self.registry.counter(
+            "router_errors_total",
+            help="requests the router could not complete on any "
+                 "replica")
+        self._affinity_breaks = self.registry.counter(
+            "router_affinity_breaks_total",
+            help="session pins broken by replica death")
+        self._sync_views()
+        # pool-mutation hook: a replace()'s successor becomes
+        # routable the moment it answers a probe, not a probe
+        # interval later (and a kill()'s view drops immediately)
+        if hasattr(fleet, "subscribe"):
+            fleet.subscribe(self._fleet_changed)
+
+    def _fleet_changed(self) -> None:
+        if self._stop_evt.is_set():
+            return
+        self._sync_views()
+        with self._lock:
+            fresh = [v for v in self._views.values()
+                     if v.health == "unprobed"]
+        for v in fresh:
+            self._probe_one(v)
+
+    # ------------------------------------------------------------------
+    # replica views & metrics
+    # ------------------------------------------------------------------
+    def _sync_views(self) -> None:
+        """Reconcile router-side views with the fleet pool: new
+        replicas get a view + gauges, removed ones are dropped and
+        their gauges unregistered."""
+        with self._sync_lock:
+            self._sync_views_locked()
+
+    def _sync_views_locked(self) -> None:
+        pool = {r.id: r for r in self.fleet.snapshot()}
+        with self._lock:
+            known = set(self._views)
+        for rid, replica in pool.items():
+            if rid in known:
+                continue
+            view = _ReplicaView(rid, replica.url, CircuitBreaker(
+                failure_threshold=self.eject_consecutive,
+                window_s=max(4 * self.eject_cooldown_s, 30.0),
+                cooldown_s=self.eject_cooldown_s, half_open_max=1))
+            lbl = {"replica": str(rid)}
+            _g1 = self.registry.gauge(
+                "router_replica_state",
+                help="router's view of each replica (0=ok 1=degraded "
+                     "2=draining 3=ejected 4=dead)",
+                labels=lbl, fn=lambda v=view: self._state_code(
+                    v, self._fleet_states_memo()))
+            _g2 = self.registry.gauge(
+                "router_replica_queue_depth",
+                help="replica queue depth from the last probe",
+                labels=lbl, fn=lambda v=view: v.queue_depth)
+            view.ejections = self.registry.counter(
+                "router_ejections_total",
+                help="outlier ejections per replica", labels=lbl)
+            view.readmissions = self.registry.counter(
+                "router_readmissions_total",
+                help="post-cooldown probe readmissions per replica",
+                labels=lbl)
+            with self._lock:
+                self._views[rid] = view
+        gone = known - set(pool)
+        for rid in gone:
+            with self._lock:
+                self._views.pop(rid, None)
+            lbl = {"replica": str(rid)}
+            for name in ("router_replica_state",
+                         "router_replica_queue_depth",
+                         "router_ejections_total",
+                         "router_readmissions_total"):
+                self.registry.unregister(name, labels=lbl)
+
+    def _fleet_states_memo(self, max_age_s: float = 0.05
+                           ) -> Dict[int, str]:
+        """One fleet snapshot shared across a gauge-collection pass
+        (the memo only covers fleet MEMBERSHIP/intent; breaker and
+        probed health are always read live)."""
+        now = time.monotonic()
+        ts, states = self._fs_cache
+        if now - ts > max_age_s:
+            states = {r.id: r.fleet_state
+                      for r in self.fleet.snapshot()}
+            self._fs_cache = (now, states)
+        return states
+
+    def _state_code(self, view: _ReplicaView,
+                    fleet_states: Optional[Dict[int, str]] = None
+                    ) -> int:
+        # callers scoring many views pass one shared fleet_states
+        # map — a snapshot per view would make every /healthz and
+        # /metrics scrape O(N^2) lock-and-copy on the fleet
+        if fleet_states is None:
+            fleet_states = {r.id: r.fleet_state
+                            for r in self.fleet.snapshot()}
+        fleet_state = fleet_states.get(view.rid)
+        if fleet_state is None:
+            return _STATE_CODES["dead"]
+        if fleet_state == DRAINING or view.health == "draining":
+            return _STATE_CODES["draining"]
+        if view.breaker.state != CircuitBreaker.CLOSED:
+            # ejected outranks probed-dead: the breaker records the
+            # ROUTER's decision (and its readmission schedule), which
+            # is what the ejection drill asserts on
+            return _STATE_CODES["ejected"]
+        if view.health == "degraded":
+            return _STATE_CODES["degraded"]
+        if view.health != "ok":
+            # dead, or not yet probed: never advertised as serving
+            return _STATE_CODES["dead"]
+        return _STATE_CODES["ok"]
+
+    def replica_states(self) -> Dict[int, str]:
+        """id -> state name (the /fleet debug payload and the tests'
+        assertion surface)."""
+        code_names = {v: k for k, v in _STATE_CODES.items()}
+        fleet_states = {r.id: r.fleet_state
+                        for r in self.fleet.snapshot()}
+        with self._lock:
+            views = list(self._views.values())
+        return {v.rid: code_names[self._state_code(v, fleet_states)]
+                for v in views}
+
+    # ------------------------------------------------------------------
+    # probing
+    # ------------------------------------------------------------------
+    def _probe_one(self, view: _ReplicaView) -> None:
+        """One active health check: classify, refresh load signals,
+        and spend the half-open probe budget on ejected replicas."""
+        ok, health, circuits = self._check_ready(view.url)
+        depth = self._read_queue_depth(view.url) if ok or health \
+            else None
+        st = view.breaker.state
+        if st == CircuitBreaker.HALF_OPEN:
+            # cooldown has passed: the PROBER is the readmission
+            # gate, so an ejected replica sees zero live traffic
+            # until a probe vouches for it
+            kind = view.breaker.try_admit()
+            if kind == "probe":
+                # readmission bar == eligibility bar: _eligible
+                # routes to degraded replicas, so a degraded probe
+                # answer must also readmit — demanding a strict 200
+                # would wedge an ejected replica whose own internal
+                # breaker can only close via the live traffic that
+                # ejection denies it
+                if ok or health == "degraded":
+                    view.breaker.record_success()
+                    view.readmissions.inc()
+                    logger.info("router: replica %d readmitted "
+                                "after probe", view.rid)
+                else:
+                    view.breaker.record_failure()
+        elif st == CircuitBreaker.CLOSED and health is None:
+            # unreachable probe (timeout / refused) = the same
+            # outlier signal as a failed live request: consecutive
+            # ones eject, so a hung replica is ejected within the
+            # probe window even with zero traffic pointed at it.
+            # Only while the fleet still calls it up — a draining or
+            # already-removed replica going dark is not an outlier —
+            # and only if a probe has EVER succeeded: a subprocess
+            # replica still importing jax at cold start is booting,
+            # not an outlier (it is already ineligible while
+            # unprobed; ejecting it would pollute
+            # router_ejections_total and delay first eligibility by
+            # the cooldown)
+            if view.probe_ok_total > 0 and any(
+                    r.id == view.rid and r.fleet_state == UP
+                    for r in self.fleet.snapshot()):
+                self._note_failure(view)
+        with self._lock:
+            view.health = health if health is not None else "dead"
+            if depth is not None:
+                view.queue_depth = depth
+            view.circuits = circuits
+            if ok:
+                view.probe_ok_total += 1
+
+    def _check_ready(self, url: str
+                     ) -> Tuple[bool, Optional[str], int]:
+        """(ready, health-classification, non-closed circuit count)
+        from /healthz?ready. ``health`` None means unreachable."""
+        try:
+            status, body, _ = _http_call(
+                url, "GET", "/healthz?ready",
+                timeout=self.probe_timeout_s)
+        except _NetError:
+            return False, None, 0
+        try:
+            payload = json.loads(body.decode() or "{}")
+        except ValueError:
+            payload = {}
+        circuits = len(payload.get("circuits") or {})
+        health = payload.get("status", "dead")
+        if health == "draining":
+            # the fleet snapshot is authoritative for draining; the
+            # probed form only matters for replicas the fleet still
+            # calls up (an external drain)
+            return False, "draining", circuits
+        return status == 200, health, circuits
+
+    def _read_queue_depth(self, url: str) -> Optional[float]:
+        try:
+            status, body, _ = _http_call(
+                url, "GET", "/metrics", timeout=self.probe_timeout_s)
+            if status != 200:
+                return None
+            snap = json.loads(body.decode() or "{}")
+        except (_NetError, ValueError):
+            return None
+        gauges = snap.get("gauges") or {}
+        total = 0.0
+        for name, value in gauges.items():
+            if name.endswith("_queue_depth") \
+                    and isinstance(value, (int, float)):
+                total += value
+        return total
+
+    def _probe_all(self) -> None:
+        """One whole probe pass, replicas probed CONCURRENTLY: a
+        wedged replica costs probe_timeout_s, and paying that
+        serially per replica would stretch the pass far past
+        probe_interval_s — delaying ejection of other outliers and
+        readmission of recovered ones."""
+        self._sync_views()
+        with self._lock:
+            views = list(self._views.values())
+        if len(views) <= 1:
+            for view in views:
+                self._probe_one(view)
+            return
+        threads = [threading.Thread(
+            target=self._probe_one, args=(v,), daemon=True,
+            name=f"router-probe-{v.rid}") for v in views]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _probe_loop(self) -> None:
+        while not self._stop_evt.wait(self.probe_interval_s):
+            try:
+                self._probe_all()
+            except Exception:
+                logger.exception("router prober iteration failed")
+
+    # ------------------------------------------------------------------
+    # passive outlier signals
+    # ------------------------------------------------------------------
+    def _note_failure(self, view: _ReplicaView) -> None:
+        with self._lock:
+            view.consecutive_failures += 1
+            n = view.consecutive_failures
+            should_eject = (n >= self.eject_consecutive
+                            and view.breaker.state
+                            == CircuitBreaker.CLOSED)
+            if should_eject:
+                view.consecutive_failures = 0
+        if should_eject:
+            view.breaker.force_open()
+            view.ejections.inc()
+            logger.warning(
+                "router: ejecting replica %d after %d consecutive "
+                "failures (cooldown %.1fs)", view.rid,
+                self.eject_consecutive, self.eject_cooldown_s)
+
+    def _note_success(self, view: _ReplicaView) -> None:
+        with self._lock:
+            view.consecutive_failures = 0
+
+    # ------------------------------------------------------------------
+    # replica selection
+    # ------------------------------------------------------------------
+    def _eligible(self, exclude=()) -> List[_ReplicaView]:
+        now = time.monotonic()
+        pool = [r for r in self.fleet.snapshot()
+                if r.fleet_state == UP]
+        with self._lock:
+            views = dict(self._views)
+        out = []
+        for r in pool:
+            v = views.get(r.id)
+            if v is None or v.rid in exclude:
+                continue
+            if v.health not in ("ok", "degraded"):
+                continue              # dead or externally draining
+            if v.breaker.state != CircuitBreaker.CLOSED:
+                continue              # ejected: no new traffic
+            if now < v.unavailable_until:
+                continue              # honoring its Retry-After
+            v.url = r.url
+            out.append(v)
+        return out
+
+    def _pick(self, exclude=()) -> _ReplicaView:
+        """Least-loaded eligible replica: probed queue depth +
+        router-side in-flight, degraded and open-circuit penalties;
+        round-robin tie-break."""
+        candidates = self._eligible(exclude)
+        if not candidates:
+            raise NoReplicaAvailableError(
+                "no replica is eligible (all dead, ejected, "
+                "draining, or backing off)",
+                retry_after_s=self._soonest_retry_s())
+        with self._lock:
+            def weight(v: _ReplicaView) -> float:
+                w = v.queue_depth + 2.0 * v.inflight \
+                    + 10.0 * v.circuits
+                if v.health == "degraded":
+                    w += 1000.0       # only when everyone is degraded
+                return w
+            # rotate before min so equal weights round-robin (min is
+            # stable: without rotation the first candidate would win
+            # every tie and starve the rest)
+            start = next(self._rr) % len(candidates)
+            rotated = candidates[start:] + candidates[:start]
+            best = min(rotated, key=weight)
+            best.inflight += 1
+        return best
+
+    def _release(self, view: _ReplicaView) -> None:
+        with self._lock:
+            view.inflight = max(0, view.inflight - 1)
+
+    def _soonest_retry_s(self) -> float:
+        with self._lock:
+            views = list(self._views.values())
+        now = time.monotonic()
+        waits = [max(0.0, v.unavailable_until - now) for v in views]
+        waits += [v.breaker.cooldown_remaining() for v in views]
+        positive = [w for w in waits if w > 0]
+        return min(positive) if positive else 1.0
+
+    # ------------------------------------------------------------------
+    # forwarding
+    # ------------------------------------------------------------------
+    def _forward(self, view: _ReplicaView, method: str, path: str,
+                 body: Optional[bytes], headers: Dict[str, str],
+                 timeout: float) -> Tuple[int, bytes, Dict[str, str]]:
+        return _http_call(view.url, method, path, body=body,
+                          headers=headers, timeout=timeout)
+
+    def _attempt(self, view: _ReplicaView, path: str, body: bytes,
+                 headers: Dict[str, str], timeout: float,
+                 results: "queue.Queue", tag: str) -> None:
+        """One forwarding attempt; the outcome (response or net
+        error) lands on ``results`` for the coordinating handler."""
+        try:
+            status, data, resp_headers = self._forward(
+                view, "POST", path, body, headers, timeout)
+            results.put((tag, view, status, data, resp_headers, None))
+        except _NetError as e:
+            results.put((tag, view, None, b"", {}, e))
+        finally:
+            self._release(view)
+
+    @staticmethod
+    def _retryable(status: Optional[int],
+                   neterr: Optional[_NetError]) -> bool:
+        """Retry-safe failures for an idempotent route: the work
+        never produced a response (connect error, send/read failure,
+        timeout) or was refused at admission (503 circuit/drain, 429
+        queue full — both mean the replica never started the work).
+        A 5xx AFTER response bytes (500/504 from the replica) means
+        the replica RAN the request — return it, never re-run it."""
+        if neterr is not None:
+            return True
+        return status in (503, 429)
+
+    def _account_response(self, view: _ReplicaView, status: int,
+                          resp_headers: Dict[str, str]) -> None:
+        """Post-attempt outcome accounting for a COMPLETE response
+        on the affinity route (generate's first and retry attempts
+        share it so their failure accounting can never drift)."""
+        if status >= 500:
+            self._note_failure(view)
+            if status == 503:
+                self._honor_retry_after(view, resp_headers)
+        else:
+            self._note_success(view)
+
+    def _honor_retry_after(self, view: _ReplicaView,
+                           headers: Dict[str, str]) -> None:
+        ra = headers.get("Retry-After")
+        if not ra:
+            return
+        try:
+            delay = float(ra)
+        except ValueError:
+            return
+        with self._lock:
+            view.unavailable_until = max(
+                view.unavailable_until, time.monotonic() + delay)
+
+    # ---- /v1/predict: failover + hedging ----
+    def _route_predict(self, body_bytes: bytes, body: dict,
+                       ctx: RequestContext
+                       ) -> Tuple[int, bytes, Dict[str, str]]:
+        deadline = ctx.deadline if ctx.deadline is not None \
+            else time.monotonic() + self.request_timeout_s
+        fwd_headers = {"Content-Type": "application/json",
+                       "traceparent": ctx.traceparent()}
+        results: "queue.Queue" = queue.Queue()
+        tried: List[int] = []
+        outstanding = 0
+
+        def launch(tag: str) -> bool:
+            nonlocal outstanding
+            view = self._pick(exclude=tried)
+            tried.append(view.rid)
+            remaining = deadline - time.monotonic()
+            t = max(0.05, min(self.attempt_timeout_s, remaining))
+            if self.hedge_after_s is None:
+                # hedging off: no second attempt can ever need to
+                # race this one, so run it inline on the handler
+                # thread instead of paying a thread per request
+                self._attempt(view, "/v1/predict", body_bytes,
+                              fwd_headers, t, results, tag)
+            else:
+                threading.Thread(
+                    target=self._attempt,
+                    args=(view, "/v1/predict", body_bytes,
+                          fwd_headers, t, results, tag),
+                    daemon=True, name=f"router-attempt-{view.rid}"
+                ).start()
+            outstanding += 1
+            return True
+
+        launch("primary")
+        hedged = self.hedge_after_s is None  # None = hedging off
+        last_failure: Tuple[int, bytes, Dict[str, str]] = (
+            503, b"", {})
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._errors.inc()
+                raise TimeoutError(
+                    f"deadline exhausted after {len(tried)} "
+                    f"attempt(s) across replicas {tried}")
+            wait_t = remaining if hedged \
+                else min(remaining, self.hedge_after_s)
+            try:
+                (tag, view, status, data, resp_headers,
+                 neterr) = results.get(timeout=wait_t)
+            except queue.Empty:
+                if not hedged:
+                    hedged = True
+                    if remaining > self.hedge_min_budget_s:
+                        try:
+                            launch("hedge")
+                            self._hedges.inc()
+                        except NoReplicaAvailableError:
+                            pass      # nobody to hedge on; keep waiting
+                continue
+            outstanding -= 1
+            if not self._retryable(status, neterr):
+                # definitive: success OR a processed-5xx — hand it
+                # through untouched either way
+                self._note_success(view) if (
+                    status is not None and status < 500) \
+                    else self._note_failure(view)
+                if tag == "hedge" and status is not None \
+                        and status < 500:
+                    # only a SUCCESSFUL hedge is a win — a hedge
+                    # whose replica answered with a processed 5xx
+                    # would otherwise inflate hedging effectiveness
+                    # exactly when replicas are failing
+                    self._hedge_wins.inc()
+                return status, data, resp_headers
+            # retry-safe failure
+            if status == 429:
+                # queue-full is an OVERLOAD signal, not a liveness
+                # failure: bench the replica for the hinted interval
+                # but never count it toward ejection — a fleet-wide
+                # burst must not eject every healthy replica
+                self._honor_retry_after(view, resp_headers)
+            else:
+                self._note_failure(view)
+                if status == 503:
+                    self._honor_retry_after(view, resp_headers)
+            if status in (503, 429):
+                last_failure = (status, data, resp_headers)
+            if len(tried) < self.max_attempts:
+                try:
+                    launch("failover")
+                    self._failovers.inc()
+                    continue
+                except NoReplicaAvailableError:
+                    pass
+            if outstanding == 0:
+                # every launched attempt has failed retry-safe: pass
+                # a replica's own 503 body through when we have one
+                # (it carries the typed error + Retry-After), else
+                # this is the router's no-replica answer
+                self._errors.inc()
+                status, data, resp_headers = last_failure
+                if not data:
+                    raise NoReplicaAvailableError(
+                        f"all {len(tried)} attempt(s) failed "
+                        f"retry-safe; replicas tried: {tried}",
+                        retry_after_s=self._soonest_retry_s())
+                return status, data, resp_headers
+
+    # ---- /v1/generate: session affinity ----
+    def _route_generate(self, body_bytes: bytes, body: dict,
+                        ctx: RequestContext
+                        ) -> Tuple[int, bytes, Dict[str, str]]:
+        session = body.get("session")
+        fwd_headers = {"Content-Type": "application/json",
+                       "traceparent": ctx.traceparent()}
+        # ONE overall deadline covering both attempts (like
+        # predict): without it a connect-timeout first attempt plus
+        # the retry would each get a full request_timeout_s, 2x the
+        # per-request budget
+        deadline = ctx.deadline if ctx.deadline is not None \
+            else time.monotonic() + self.request_timeout_s
+        timeout = max(0.05, min(deadline - time.monotonic(),
+                                self.request_timeout_s))
+        view = self._pin(session)
+        try:
+            status, data, resp_headers = self._forward(
+                view, "POST", "/v1/generate", body_bytes,
+                fwd_headers, timeout)
+        except _NetError as e:
+            self._note_failure(view)
+            self._break_pin(session)
+            if e.phase != "connect":
+                # the stream DIED mid-flight: its decode state lived
+                # on that replica — no silent failover, typed error
+                self._errors.inc()
+                raise ReplicaGoneError(
+                    f"replica {view.rid} died mid-stream ({e}); the "
+                    f"generation state is lost — restart the "
+                    f"stream; trace {ctx.trace_id}") from e
+        else:
+            self._account_response(view, status, resp_headers)
+            return status, data, resp_headers
+        finally:
+            self._release(view)
+        # connect-refused: the stream never STARTED on the dead
+        # replica, so re-pinning and retrying once loses nothing —
+        # but never back onto the replica that just refused (the
+        # fleet may still call it up for a probe interval after an
+        # unannounced death), and only inside the remaining deadline
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            self._errors.inc()
+            raise TimeoutError(
+                f"deadline exhausted after a connect-refused "
+                f"generate attempt on replica {view.rid}")
+        timeout = max(0.05, min(remaining, self.request_timeout_s))
+        retry = self._pin(session, exclude=(view.rid,))
+        self._failovers.inc()
+        try:
+            status, data, resp_headers = self._forward(
+                retry, "POST", "/v1/generate", body_bytes,
+                fwd_headers, timeout)
+        except _NetError as e2:
+            self._note_failure(retry)
+            self._break_pin(session)
+            self._errors.inc()
+            raise ReplicaGoneError(
+                f"replica {retry.rid} died before the stream "
+                f"started ({e2}); trace {ctx.trace_id}") from e2
+        else:
+            self._account_response(retry, status, resp_headers)
+            return status, data, resp_headers
+        finally:
+            self._release(retry)
+
+    def _pin(self, session: Optional[str],
+             exclude=()) -> _ReplicaView:
+        """Resolve the replica for a session (pinning it on first
+        use); sessionless requests route least-loaded as usual. The
+        returned view's in-flight count is already incremented."""
+        if session is None:
+            return self._pick(exclude)
+        with self._lock:
+            rid = self._affinity.get(str(session))
+            if rid is not None:
+                # touch-on-use: overflow eviction below is LRU, so
+                # the pin sacrificed at affinity_max is an idle
+                # session's, never an active stream's
+                self._affinity.pop(str(session))
+                self._affinity[str(session)] = rid
+        if rid is not None:
+            live = {r.id for r in self.fleet.snapshot()
+                    if r.fleet_state == UP}
+            with self._lock:
+                view = self._views.get(rid)
+            # the pinned replica must pass the SAME eligibility bar
+            # as _eligible(): a session pinned to an ejected,
+            # externally-draining, or Retry-After-benched replica
+            # would otherwise be forwarded into a guaranteed
+            # admission refusal on every request, forever — and an
+            # admission refusal advances no decode state, so
+            # breaking the pin between requests loses nothing
+            usable = (view is not None and rid in live
+                      and rid not in exclude
+                      and view.health in ("ok", "degraded")
+                      and view.breaker.state == CircuitBreaker.CLOSED
+                      and time.monotonic() >= view.unavailable_until)
+            if usable:
+                with self._lock:
+                    view.inflight += 1
+                return view
+            # pinned replica left the pool or stopped accepting
+            # work: the pin breaks here, a fresh one forms below
+            self._break_pin(session)
+        view = self._pick(exclude)
+        # pin with a locked get-or-set: two concurrent FIRST
+        # requests for the same session must agree on one replica,
+        # or the stream's decode state silently splits across two
+        winner = None
+        evicted = 0
+        with self._lock:
+            rid = self._affinity.setdefault(str(session), view.rid)
+            if rid != view.rid:
+                winner = self._views.get(rid)
+                if winner is None or rid in exclude:
+                    winner = None       # stale pin: take it over
+                    self._affinity[str(session)] = view.rid
+                else:
+                    winner.inflight += 1
+            while len(self._affinity) > self.affinity_max:
+                # LRU eviction (insertion order + touch-on-use);
+                # still a broken pin for whoever owned it, so it is
+                # COUNTED, not silent
+                self._affinity.pop(next(iter(self._affinity)))
+                evicted += 1
+        if evicted:
+            self._affinity_breaks.inc(evicted)
+        if winner is not None:
+            self._release(view)
+            return winner
+        return view
+
+    def _break_pin(self, session: Optional[str]) -> None:
+        if session is None:
+            return
+        with self._lock:
+            gone = self._affinity.pop(str(session), None)
+        if gone is not None:
+            self._affinity_breaks.inc()
+
+    # ------------------------------------------------------------------
+    # HTTP front end
+    # ------------------------------------------------------------------
+    def start(self) -> "Router":
+        router = self
+
+        class Handler(_JsonRequestHandler):
+            def do_GET(self):
+                path = urlparse(self.path).path
+                if path in ("/healthz", "/readyz"):
+                    payload = router.health_payload()
+                    q = parse_qs(urlparse(self.path).query,
+                                 keep_blank_values=True)
+                    ready = path == "/readyz" or "ready" in q
+                    # the ROUTER's readiness is "can I serve
+                    # anything", not "is every replica ok": one
+                    # draining/wedged replica out of N is routed
+                    # around (status says degraded for humans), and
+                    # a 503 here would pull the whole router from an
+                    # upstream LB during a zero-downtime replace
+                    unready = (payload["status"] == "draining"
+                               or payload["eligible"] == 0)
+                    if ready and unready:
+                        self._send(503, payload, headers={
+                            "Retry-After": _retry_after_header(
+                                router._soonest_retry_s())})
+                    else:
+                        self._send(200, payload)
+                elif path == "/metrics":
+                    # ModelServer's negotiation, shared: without the
+                    # OpenMetrics form the exemplars recorded on
+                    # router_latency_seconds would be unreachable
+                    # (classic 0.0.4 text must stay exemplar-free)
+                    mode = self._metrics_mode()
+                    if mode == "openmetrics":
+                        self._send_text(
+                            200, router.registry.prometheus_text(
+                                openmetrics=True),
+                            "application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
+                    elif mode == "text":
+                        self._send_text(
+                            200, router.registry.prometheus_text(),
+                            "text/plain; version=0.0.4; "
+                            "charset=utf-8")
+                    else:
+                        self._send(200,
+                                   router.registry.snapshot())
+                elif path == "/fleet":
+                    self._send(200, router.fleet_debug())
+                elif path == "/v1/models":
+                    # proxy the listing from any eligible replica
+                    try:
+                        view = router._pick()
+                    except NoReplicaAvailableError as e:
+                        self._send(503, {"error": str(e)}, headers={
+                            "Retry-After": _retry_after_header(
+                                e.retry_after_s or 1.0)})
+                        return
+                    try:
+                        status, data, _ = _http_call(
+                            view.url, "GET", "/v1/models",
+                            timeout=router.probe_timeout_s)
+                        self._send(status, data)
+                    except _NetError as e:
+                        router._note_failure(view)
+                        self._send(502, {"error": str(e)})
+                    finally:
+                        router._release(view)
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                if path == "/v1/predict":
+                    self._route(router._route_predict, path)
+                elif path == "/v1/generate":
+                    self._route(router._route_generate, path)
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def _route(self, route_fn, route):
+                # bad client input (malformed Content-Length, JSON,
+                # or timeout_ms) must produce a 400, not a dropped
+                # connection — the ModelServer._mint_ctx lesson
+                try:
+                    n = self._content_length()
+                except (ValueError, TypeError) as e:
+                    self._send(400, {"error": f"bad request: {e}"})
+                    return
+                raw = self.rfile.read(n)
+                try:
+                    body = json.loads(raw.decode() or "{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"bad JSON: {e}"})
+                    return
+                router._requests[route].inc()
+                # the whole-replica chaos site: one hit per ROUTED
+                # request, so a seeded `at` ordinal kills/hangs a
+                # replica at an exact, replayable point mid-load
+                fault = chaos.hit("serving.replica")
+                if fault is not None:
+                    try:
+                        router.fleet.apply_fault(fault)
+                    except Exception:
+                        logger.exception("serving.replica fault "
+                                         "application failed")
+                    router._sync_views()
+                t = body.get("timeout_ms")
+                try:
+                    deadline = (time.monotonic() + float(t) / 1e3
+                                if t is not None else None)
+                except (ValueError, TypeError) as e:
+                    self._send(400, {"error":
+                                     f"bad timeout_ms: {e}"})
+                    return
+                ctx = RequestContext.from_traceparent(
+                    self.headers.get("traceparent"), route,
+                    router.sampler, deadline=deadline,
+                    tracer=router.tracer)
+                if ctx is None:
+                    ctx = RequestContext.new(
+                        route, router.sampler, deadline=deadline,
+                        tracer=router.tracer)
+                ctx.open_root()
+                code = 500
+                try:
+                    with ctx.attach():
+                        ctx.phase_done("admission", now_in="forward")
+                        status, data, resp_headers = route_fn(
+                            raw, body, ctx)
+                        ctx.phase_done("forward", now_in="respond")
+                    code = status
+                    out_headers = {"traceparent": ctx.traceparent()}
+                    for k in ("Retry-After",):
+                        if k in resp_headers:
+                            out_headers[k] = resp_headers[k]
+                    self._send(status, data, headers=out_headers)
+                except NoReplicaAvailableError as e:
+                    ctx.set_error(e)
+                    code = 503
+                    self._send(503, {
+                        "error": str(e),
+                        "error_type": "NoReplicaAvailableError",
+                        "trace_id": ctx.trace_id},
+                        headers={
+                            "traceparent": ctx.traceparent(),
+                            "Retry-After": _retry_after_header(
+                                e.retry_after_s or 1.0)})
+                except ReplicaGoneError as e:
+                    ctx.set_error(e)
+                    code = 502
+                    self._send(502, {
+                        "error": str(e),
+                        "error_type": "ReplicaGoneError",
+                        "trace_id": ctx.trace_id},
+                        headers={"traceparent": ctx.traceparent()})
+                except TimeoutError as e:
+                    ctx.set_error(e)
+                    code = 504
+                    self._send(504, {
+                        "error": str(e),
+                        "error_type": "DeadlineExceededError",
+                        "trace_id": ctx.trace_id},
+                        headers={"traceparent": ctx.traceparent()})
+                except Exception as e:   # keep the listener alive
+                    logger.exception("router error")
+                    ctx.set_error(e)
+                    code = 500
+                    self._send(500, {"error": str(e),
+                                     "trace_id": ctx.trace_id})
+                finally:
+                    total_s = ctx.finish(attrs={"http_status": code})
+                    router._latency[route].record(
+                        total_s,
+                        exemplar={"trace_id": ctx.trace_id}
+                        if ctx.sampled else None)
+
+        with self._lock:
+            if self._stop_evt.is_set():
+                raise ServerClosedError(
+                    "router was stopped; not starting listener")
+            if self._httpd is not None:
+                return self
+        # one synchronous probe pass before the listener opens:
+        # views start "unprobed" (not eligible), so without this an
+        # already-live replica would 503 every request until the
+        # first prober tick, and a frozen/slow prober would never
+        # admit anyone
+        self._probe_all()
+        httpd = _make_listener(self.host, self.port, Handler)
+        with self._lock:
+            if self._httpd is not None:
+                httpd.server_close()
+                return self
+            self._httpd = httpd
+            self.port = httpd.server_address[1]
+            self._http_thread = threading.Thread(
+                target=httpd.serve_forever, daemon=True,
+                name="fleet-router")
+            self._http_thread.start()
+            self._prober = threading.Thread(
+                target=self._probe_loop, daemon=True,
+                name="router-prober")
+            self._prober.start()
+        logger.info("router on http://%s:%d/ over %d replica(s)",
+                    self.host, self.port, self.fleet.size())
+        return self
+
+    # ---- router health & debug ----
+    def health_payload(self) -> dict:
+        states = self.replica_states()
+        eligible = len(self._eligible())
+        if self._stop_evt.is_set():
+            status = "draining"
+        elif eligible == 0:
+            status = "degraded"
+        elif any(s != "ok" for s in states.values()):
+            status = "degraded"
+        else:
+            status = "ok"
+        return {"status": status, "eligible": eligible,
+                "replicas": {str(k): v for k, v in states.items()}}
+
+    def fleet_debug(self) -> dict:
+        with self._lock:
+            views = list(self._views.values())
+        states = self.replica_states()
+        return {"replicas": [
+            {"id": v.rid, "url": v.url,
+             "state": states.get(v.rid, "dead"),
+             "health": v.health,
+             "breaker": v.breaker.state,
+             "queue_depth": v.queue_depth,
+             "inflight": v.inflight,
+             "consecutive_failures": v.consecutive_failures}
+            for v in sorted(views, key=lambda v: v.rid)]}
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+            prober = self._prober
+        if prober is not None:
+            prober.join(timeout=5.0)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# low-level HTTP client
+# ---------------------------------------------------------------------------
+
+def _http_call(url: str, method: str, path: str,
+               body: Optional[bytes] = None,
+               headers: Optional[Dict[str, str]] = None,
+               timeout: float = 10.0
+               ) -> Tuple[int, bytes, Dict[str, str]]:
+    """One HTTP exchange with the failure taxonomy failover needs:
+    raises :class:`_NetError` with phase ``connect`` (the request
+    never reached the peer — retry-safe always) or ``exchange``
+    (sent, but no complete response: timeout / reset — retry-safe
+    only for idempotent work). A complete response, whatever its
+    status, is returned, never raised."""
+    u = urlsplit(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port,
+                                      timeout=timeout)
+    try:
+        try:
+            conn.connect()
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+        except (OSError, socket.timeout) as e:
+            raise _NetError("connect", e) from e
+        try:
+            conn.request(method, path, body=body,
+                         headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+        except (OSError, socket.timeout,
+                http.client.HTTPException) as e:
+            raise _NetError("exchange", e) from e
+        return resp.status, data, dict(resp.getheaders())
+    finally:
+        conn.close()

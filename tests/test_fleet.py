@@ -1,0 +1,768 @@
+"""Serving fleet & router: health-aware balancing, outlier ejection,
+failover + hedging, session affinity, zero-downtime drain/replace,
+and the SIGKILL-mid-load soak.
+
+The acceptance pair from ISSUE 8:
+
+- soak: loadgen drives a 4-replica fleet while one replica is
+  SIGKILLed (seeded ``serving.replica`` chaos) and another is
+  drain-replaced; zero non-hedged requests are dropped, in-flight
+  ``/v1/generate`` streams on surviving replicas complete, and one
+  trace id spans router -> replica (traceparent) for a failed-over
+  request.
+- ejection e2e: a replica forced degraded (chaos hang) is ejected
+  within the probe window, receives no new traffic, and is
+  readmitted after recovery — asserted via the router metrics
+  (``router_replica_state``, ``router_ejections_total``).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.serving.fleet import ReplicaFleet
+from deeplearning4j_tpu.serving.router import Router, _NetError
+from tools.loadgen import LoadGen
+
+pytestmark = pytest.mark.fleet
+
+
+# ---------------------------------------------------------------------------
+# cheap models: a threadsafe echo predictor + a fake streaming LM
+# ---------------------------------------------------------------------------
+
+class EchoModel:
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def output(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(x) * 2.0
+
+
+class _FakeSession:
+    """Deterministic decode: next token = (feed + 1) % vocab."""
+
+    def __init__(self, slots, vocab, step_delay):
+        self.slots = slots
+        self.vocab = vocab
+        self.step_delay = step_delay
+
+    def reset_slot(self, i):
+        pass
+
+    def reinit_states(self):
+        pass
+
+    def step_slots(self, x, active):
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        h = np.zeros((self.slots, 1, self.vocab), np.float32)
+        for i in range(self.slots):
+            nxt = (int(x[i, 0, 0]) + 1) % self.vocab
+            h[i, 0, nxt] = 1.0
+        return h
+
+
+class FakeStreamModel:
+    """Implements the ``slot_streaming_session`` protocol
+    ContinuousBatcher needs, with a controllable per-step delay so a
+    'stream' has real wall-clock life."""
+
+    VOCAB = 16
+
+    def __init__(self, step_delay=0.0):
+        self.step_delay = step_delay
+
+    def slot_streaming_session(self, capacity=64, slots=2,
+                               dtype=None):
+        return _FakeSession(slots, self.VOCAB, self.step_delay)
+
+
+def expected_ids(prompt, n_tokens, vocab=FakeStreamModel.VOCAB):
+    out, feed = [], int(prompt[-1])
+    for _ in range(n_tokens):
+        feed = (feed + 1) % vocab
+        out.append(feed)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HTTP helpers
+# ---------------------------------------------------------------------------
+
+def _post(base, path, body, timeout=10.0, headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), \
+                dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def _get(base, path, timeout=5.0):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), \
+                dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def _counter(router, name, **labels):
+    m = router.registry.get(name, labels=labels or None)
+    return 0.0 if m is None else m.value
+
+
+def _predict_body(i=0):
+    return {"model": "default",
+            "inputs": [[float(i % 5), 1.0, 2.0, 3.0]]}
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def stack():
+    """Factory building a fleet+router pair with test-speed knobs;
+    everything built through it is torn down afterwards."""
+    built = []
+
+    def build(n=3, delay=0.0, stream_delay=0.005, delays=None,
+              **router_kw):
+        seq = {"i": 0}
+
+        def factory():
+            d = delay
+            if delays is not None:
+                d = delays[min(seq["i"], len(delays) - 1)]
+                seq["i"] += 1
+            return {"default": EchoModel(delay=d),
+                    "lm": FakeStreamModel(step_delay=stream_delay)}
+
+        fleet = ReplicaFleet(factory, n=n, server_kwargs=dict(
+            wait_ms=1.0, slots=2, capacity=64)).start()
+        kw = dict(probe_interval_s=0.05, probe_timeout_s=0.4,
+                  eject_consecutive=2, eject_cooldown_s=0.5,
+                  attempt_timeout_s=2.0, request_timeout_s=10.0,
+                  hedge_after_s=None, sample_rate=1.0)
+        kw.update(router_kw)
+        router = Router(fleet, **kw).start()
+        built.append((fleet, router))
+        return fleet, router
+
+    yield build
+    chaos.uninstall()
+    for fleet, router in built:
+        router.stop()
+        fleet.stop(drain=False, timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# routing basics
+# ---------------------------------------------------------------------------
+
+class TestRouterBasics:
+    def test_routes_and_spreads_load(self, stack):
+        fleet, router = stack(n=3)
+        base = f"http://127.0.0.1:{router.port}"
+        for i in range(30):
+            st, body, hdrs = _post(base, "/v1/predict",
+                                   _predict_body(i))
+            assert st == 200
+            assert "traceparent" in hdrs
+            np.testing.assert_allclose(
+                np.asarray(body["outputs"]),
+                np.asarray(_predict_body(i)["inputs"]) * 2.0)
+        served = [r.server.metrics.snapshot()["endpoints"]
+                  .get("predict/default/v1", {}).get("requests", 0)
+                  for r in fleet.snapshot()]
+        assert sum(served) == 30
+        assert all(s > 0 for s in served)   # least-loaded spreads
+
+    def test_router_health_and_fleet_debug(self, stack):
+        fleet, router = stack(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        st, body, _ = _get(base, "/healthz")
+        assert st == 200 and body["status"] == "ok"
+        assert body["eligible"] == 2
+        st, body, _ = _get(base, "/readyz")
+        assert st == 200
+        st, body, _ = _get(base, "/fleet")
+        assert {r["state"] for r in body["replicas"]} == {"ok"}
+        st, body, _ = _get(base, "/v1/models")
+        assert st == 200
+        assert {m["name"] for m in body["models"]} == {"default",
+                                                       "lm"}
+
+    def test_generate_through_router(self, stack):
+        fleet, router = stack(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        st, body, _ = _post(base, "/v1/generate",
+                            {"model": "lm", "prompt": [1, 2],
+                             "n_tokens": 4})
+        assert st == 200
+        assert body["ids"] == expected_ids([1, 2], 4)
+
+
+# ---------------------------------------------------------------------------
+# failover & chaos kill
+# ---------------------------------------------------------------------------
+
+class TestFailover:
+    def test_kill_mid_load_zero_drops(self, stack):
+        fleet, router = stack(n=3, delay=0.01)
+        base = f"http://127.0.0.1:{router.port}"
+        gen = LoadGen(base, body_fn=_predict_body, concurrency=8,
+                      total=150, timeout_s=10.0, max_retries=3)
+        t = threading.Thread(target=lambda: results.append(gen.run()),
+                             daemon=True)
+        results = []
+        t.start()
+        time.sleep(0.15)          # mid-load
+        fleet.kill(0)
+        t.join(60.0)
+        assert results, "loadgen did not finish"
+        rep = results[0]
+        assert rep["failed"] == 0, rep
+        assert rep["errors"] == {}, rep
+        assert rep["ok"] == 150
+        assert fleet.size() == 2
+
+    def test_seeded_chaos_kill_is_deterministic(self, stack):
+        fleet, router = stack(n=3)
+        base = f"http://127.0.0.1:{router.port}"
+        inj = chaos.install({"faults": [
+            {"site": "serving.replica", "kind": "kill", "at": [10],
+             "args": {"replica": 0}}]}, seed=77)
+        for i in range(15):
+            st, _, _ = _post(base, "/v1/predict", _predict_body(i))
+            assert st == 200          # the kill never drops a request
+        assert fleet.size() == 2      # fired exactly at ordinal 10
+        assert inj.hits("serving.replica") == 15
+        assert inj.fired_total == 1
+
+    def test_failed_over_request_keeps_one_trace_id(self, stack):
+        """The traceparent hop acceptance: a request that fails over
+        after an unannounced replica death carries ONE trace id
+        through router root span AND the replica's adopted span."""
+        from deeplearning4j_tpu.observability.tracing import trace
+        # freeze the prober so the router cannot learn about the
+        # death actively — failover is what must save the request
+        fleet, router = stack(n=2, probe_interval_s=30.0)
+        base = f"http://127.0.0.1:{router.port}"
+        rep = fleet.replica(0)
+        httpd = rep.server._httpd
+        rep.server.stop(drain=False, timeout=0.0)   # unannounced
+        httpd.server_close()
+        rep.fleet_state = "up"      # the fleet has NOT noticed
+        found = None
+        for i in range(1, 40):
+            tid = f"{i:032x}"
+            before = _counter(router, "router_failovers_total")
+            st, body, hdrs = _post(
+                base, "/v1/predict", _predict_body(i),
+                headers={"traceparent":
+                         f"00-{tid}-00f067aa0ba902b7-01"})
+            assert st == 200
+            if _counter(router, "router_failovers_total") > before:
+                found = tid
+                break
+        assert found, "no request ever failed over"
+        evs = trace.events_for_trace(found)
+        roots = [e for e in evs if e["name"] == "request"]
+        # the router's root (parented to the CLIENT span) and the
+        # replica's root (parented to the ROUTER's root) — one trace
+        assert len(roots) >= 2
+        span_ids = {e.get("span_id") for e in roots}
+        assert any(e.get("parent_id") in span_ids for e in roots)
+        assert any(e.get("parent_id") == "00f067aa0ba902b7"
+                   for e in roots)
+
+
+# ---------------------------------------------------------------------------
+# outlier ejection e2e (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestOutlierEjection:
+    def test_hang_ejects_then_readmits(self, stack):
+        fleet, router = stack(n=3, probe_timeout_s=0.15)
+        base = f"http://127.0.0.1:{router.port}"
+        rep = fleet.replica(0)
+        rid = rep.id
+        for i in range(6):
+            assert _post(base, "/v1/predict",
+                         _predict_body(i))[0] == 200
+        # chaos hang: the whole replica (probes included) stalls far
+        # past the probe timeout
+        fleet.hang(0, delay_s=1.0)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if router.replica_states().get(rid) == "ejected":
+                break
+            time.sleep(0.05)
+        assert router.replica_states()[rid] == "ejected"
+        assert _counter(router, "router_ejections_total",
+                        replica=str(rid)) >= 1
+        # no new traffic while ejected: let stragglers finish, then
+        # drive traffic and check the hung replica's counters freeze
+        time.sleep(1.2)
+        before = rep.server.metrics.snapshot()["endpoints"].get(
+            "predict/default/v1", {}).get("requests", 0)
+        for i in range(20):
+            st, _, _ = _post(base, "/v1/predict", _predict_body(i))
+            assert st == 200
+        after = rep.server.metrics.snapshot()["endpoints"].get(
+            "predict/default/v1", {}).get("requests", 0)
+        assert after == before
+        # recovery: the hang lifts; the PROBER half-open probe
+        # readmits the replica after the cooldown
+        fleet.hang(0, delay_s=0.0)
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            if router.replica_states().get(rid) == "ok":
+                break
+            time.sleep(0.05)
+        assert router.replica_states()[rid] == "ok"
+        assert _counter(router, "router_readmissions_total",
+                        replica=str(rid)) >= 1
+        # and it serves again
+        for i in range(12):
+            assert _post(base, "/v1/predict",
+                         _predict_body(i))[0] == 200
+        final = rep.server.metrics.snapshot()["endpoints"].get(
+            "predict/default/v1", {}).get("requests", 0)
+        assert final > after
+
+
+# ---------------------------------------------------------------------------
+# hedging & Retry-After honoring
+# ---------------------------------------------------------------------------
+
+class TestHedgingAndBackoff:
+    def test_hedge_cuts_tail_latency(self, stack):
+        # replica 0 slow (1s), replica 1 fast: a request whose
+        # primary lands on the slow one is hedged onto the fast one
+        # and returns in ~hedge_after, not ~1s
+        fleet, router = stack(n=2, delays=[1.0, 0.01],
+                              hedge_after_s=0.15,
+                              hedge_min_budget_s=0.5,
+                              attempt_timeout_s=5.0)
+        base = f"http://127.0.0.1:{router.port}"
+        t0 = time.monotonic()
+        for i in range(6):
+            st, _, _ = _post(base, "/v1/predict", _predict_body(i),
+                             timeout=8.0)
+            assert st == 200
+        wall = time.monotonic() - t0
+        assert _counter(router, "router_hedges_total") >= 1
+        assert _counter(router, "router_hedge_wins_total") >= 1
+        assert wall < 6 * 1.0      # hedging beat the slow replica
+
+    def test_retry_after_503_backs_replica_off(self, stack):
+        fleet, router = stack(n=2, probe_interval_s=30.0)
+        base = f"http://127.0.0.1:{router.port}"
+        # external drain the fleet has NOT noticed: replies are 503
+        # + Retry-After, which the router honors by benching the
+        # replica rather than retrying into it
+        slow = fleet.replica(0)
+        slow.server._draining.set()
+        for i in range(10):
+            st, _, _ = _post(base, "/v1/predict", _predict_body(i))
+            assert st == 200       # always failed over
+        view = router._views[slow.id]
+        assert view.unavailable_until > time.monotonic() - 0.5
+        served = fleet.replica(1).server.metrics.snapshot()[
+            "endpoints"]["predict/default/v1"]["requests"]
+        assert served == 10
+
+    def test_429_queue_full_fails_over_without_ejection(self, stack):
+        # queue-full is an overload signal: the router fails over
+        # and benches the replica for the hinted interval, but never
+        # counts it toward ejection (a burst must not eject a
+        # healthy fleet)
+        from deeplearning4j_tpu.serving.lifecycle import \
+            CircuitBreaker
+        fleet, router = stack(n=2, probe_interval_s=30.0)
+        base = f"http://127.0.0.1:{router.port}"
+        full = fleet.replica(0)
+        real = router._forward
+
+        def forward(view, method, path, body, headers, timeout):
+            if view.rid == full.id:
+                return (429,
+                        json.dumps({"error": "queue full"}).encode(),
+                        {"Retry-After": "30"})
+            return real(view, method, path, body, headers, timeout)
+
+        router._forward = forward
+        for i in range(10):
+            st, _, _ = _post(base, "/v1/predict", _predict_body(i))
+            assert st == 200       # always failed over, never a 429
+        view = router._views[full.id]
+        assert view.unavailable_until > time.monotonic() + 10.0
+        assert view.breaker.state == CircuitBreaker.CLOSED
+        assert _counter(router, "router_ejections_total",
+                        replica=str(full.id)) == 0
+
+
+# ---------------------------------------------------------------------------
+# session affinity
+# ---------------------------------------------------------------------------
+
+class TestSessionAffinity:
+    def test_pin_sticks_until_death_then_rebinds(self, stack):
+        fleet, router = stack(n=3)
+        base = f"http://127.0.0.1:{router.port}"
+        body = {"model": "lm", "prompt": [3], "n_tokens": 3,
+                "session": "user-42"}
+        for _ in range(4):
+            st, out, _ = _post(base, "/v1/generate", body)
+            assert st == 200 and out["ids"] == expected_ids([3], 3)
+        pinned_rid = router._affinity["user-42"]
+        counts = {r.id: r.server.metrics.snapshot()["endpoints"]
+                  .get("generate/lm/v1", {}).get("requests", 0)
+                  for r in fleet.snapshot()}
+        assert counts[pinned_rid] == 4
+        assert all(c == 0 for rid, c in counts.items()
+                   if rid != pinned_rid)
+        # kill the pinned replica; the pin breaks and the session
+        # re-pins to a survivor
+        pos = [i for i, r in enumerate(fleet.snapshot())
+               if r.id == pinned_rid][0]
+        fleet.kill(pos)
+        st, out, _ = _post(base, "/v1/generate", body)
+        assert st == 200 and out["ids"] == expected_ids([3], 3)
+        assert router._affinity["user-42"] != pinned_rid
+        assert _counter(router, "router_affinity_breaks_total") >= 1
+
+    def test_pin_breaks_when_replica_ejected(self, stack):
+        # a session pinned to an EJECTED replica must re-pin, not be
+        # forwarded into a guaranteed admission refusal forever —
+        # ejection between requests is the same "pin loses nothing"
+        # case as death between requests
+        fleet, router = stack(n=3)
+        base = f"http://127.0.0.1:{router.port}"
+        body = {"model": "lm", "prompt": [5], "n_tokens": 3,
+                "session": "user-ej"}
+        st, out, _ = _post(base, "/v1/generate", body)
+        assert st == 200
+        pinned_rid = router._affinity["user-ej"]
+        router._views[pinned_rid].breaker.force_open()
+        st, out, _ = _post(base, "/v1/generate", body)
+        assert st == 200 and out["ids"] == expected_ids([5], 3)
+        assert router._affinity["user-ej"] != pinned_rid
+        assert _counter(router, "router_affinity_breaks_total") >= 1
+
+    def test_midstream_death_is_typed_with_trace_id(self, stack):
+        fleet, router = stack(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        real = router._forward
+        state = {"fired": False}
+
+        def dying_forward(view, method, path, body, headers,
+                          timeout):
+            if path == "/v1/generate" and not state["fired"]:
+                state["fired"] = True
+                raise _NetError("exchange", ConnectionResetError(
+                    "replica died mid-stream"))
+            return real(view, method, path, body, headers, timeout)
+
+        router._forward = dying_forward
+        st, body, hdrs = _post(base, "/v1/generate",
+                               {"model": "lm", "prompt": [1],
+                                "n_tokens": 2, "session": "s9"})
+        assert st == 502
+        assert body["error_type"] == "ReplicaGoneError"
+        assert body["trace_id"]
+        assert body["trace_id"] in body["error"]
+        # the pin broke; the next request re-pins and succeeds
+        st, body, _ = _post(base, "/v1/generate",
+                            {"model": "lm", "prompt": [1],
+                             "n_tokens": 2, "session": "s9"})
+        assert st == 200
+
+
+# ---------------------------------------------------------------------------
+# zero-downtime drain/replace
+# ---------------------------------------------------------------------------
+
+class TestDrainReplace:
+    def test_replace_under_load_drops_nothing(self, stack):
+        fleet, router = stack(n=2, delay=0.004)
+        base = f"http://127.0.0.1:{router.port}"
+        before_ids = {r.id for r in fleet.snapshot()}
+        gen = LoadGen(base, body_fn=_predict_body, concurrency=6,
+                      total=200, timeout_s=10.0, max_retries=3)
+        results = []
+        t = threading.Thread(target=lambda: results.append(gen.run()),
+                             daemon=True)
+        t.start()
+        time.sleep(0.1)
+        successor = fleet.replace(0, drain_timeout=10.0)
+        t.join(60.0)
+        assert results, "loadgen did not finish"
+        rep = results[0]
+        assert rep["failed"] == 0, rep
+        assert rep["errors"] == {}, rep
+        assert rep["ok"] == 200
+        after_ids = {r.id for r in fleet.snapshot()}
+        assert successor.id in after_ids
+        assert len(after_ids) == 2 and after_ids != before_ids
+        # the successor actually serves
+        st, _, _ = _post(base, "/v1/predict", _predict_body())
+        assert st == 200
+
+    def test_inflight_stream_survives_drain(self, stack):
+        fleet, router = stack(n=2, stream_delay=0.02)
+        base = f"http://127.0.0.1:{router.port}"
+        # pin a session, find its replica, then replace that replica
+        # while a long stream is in flight: the drain must let the
+        # stream finish before the old replica leaves
+        st, _, _ = _post(base, "/v1/generate",
+                         {"model": "lm", "prompt": [2], "n_tokens": 1,
+                          "session": "pinme"})
+        assert st == 200
+        rid = router._affinity["pinme"]
+        pos = [i for i, r in enumerate(fleet.snapshot())
+               if r.id == rid][0]
+        stream_result = {}
+
+        def long_stream():
+            stream_result["resp"] = _post(
+                base, "/v1/generate",
+                {"model": "lm", "prompt": [2], "n_tokens": 30,
+                 "session": "pinme"}, timeout=30.0)
+
+        t = threading.Thread(target=long_stream, daemon=True)
+        t.start()
+        # gate on the stream actually being in flight (a blind
+        # sleep races the drain on a loaded host): _pin bumps the
+        # view's inflight before forwarding
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if router._views[rid].inflight >= 1:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("stream never started")
+        time.sleep(0.05)          # let it get mid-decode
+        fleet.replace(pos, drain_timeout=20.0)
+        t.join(30.0)
+        st, body, _ = stream_result["resp"]
+        assert st == 200
+        assert body["ids"] == expected_ids([2], 30)
+
+
+# ---------------------------------------------------------------------------
+# the soak acceptance
+# ---------------------------------------------------------------------------
+
+class TestFleetSoak:
+    def test_sigkill_and_replace_mid_load_soak(self, stack):
+        """4 replicas under loadgen while a seeded ``serving.replica``
+        chaos kill takes one replica down mid-load and another is
+        drain-replaced: zero requests fail (hedged or not — every
+        request gets a 200), and in-flight generate streams on
+        surviving replicas run to completion."""
+        fleet, router = stack(n=4, delay=0.005, stream_delay=0.015)
+        base = f"http://127.0.0.1:{router.port}"
+        inj = chaos.install({"faults": [
+            {"site": "serving.replica", "kind": "kill", "at": [60],
+             "args": {"replica": 0}}]}, seed=1234)
+        victim_id = fleet.replica(0).id
+
+        # pin two streams to SURVIVING replicas (not pool position 0)
+        sessions = []
+        for i in range(12):
+            s = f"soak-{i}"
+            st, _, _ = _post(base, "/v1/generate",
+                             {"model": "lm", "prompt": [1],
+                              "n_tokens": 1, "session": s})
+            assert st == 200
+            if router._affinity[s] != victim_id:
+                sessions.append(s)
+            if len(sessions) == 2:
+                break
+        assert len(sessions) == 2
+        stream_out = {}
+
+        def stream(s):
+            stream_out[s] = _post(
+                base, "/v1/generate",
+                {"model": "lm", "prompt": [5], "n_tokens": 40,
+                 "session": s}, timeout=60.0)
+
+        gen = LoadGen(base, body_fn=_predict_body, concurrency=8,
+                      total=400, timeout_s=15.0, max_retries=3)
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(gen.run()), daemon=True)]
+        threads += [threading.Thread(target=stream, args=(s,),
+                                     daemon=True) for s in sessions]
+        for t in threads:
+            t.start()
+        # while the kill fires at request ordinal 60, drain-replace a
+        # DIFFERENT surviving replica (never the stream pins)
+        time.sleep(0.2)
+        pinned = {router._affinity[s] for s in sessions}
+        pool = fleet.snapshot()
+        candidates = [i for i, r in enumerate(pool)
+                      if r.id not in pinned and r.id != victim_id]
+        fleet.replace(candidates[0], drain_timeout=20.0)
+        for t in threads:
+            t.join(90.0)
+        assert results, "loadgen did not finish"
+        rep = results[0]
+        # zero dropped requests: every request got a 200 (retries
+        # and failovers allowed; unanswered requests are failures)
+        assert rep["failed"] == 0, rep
+        assert rep["errors"] == {}, rep
+        assert rep["ok"] == 400
+        # the seeded kill really fired mid-load
+        assert inj.fired_total == 1
+        assert all(r.id != victim_id for r in fleet.snapshot())
+        # the replace is capacity-neutral (successor boots before the
+        # incumbent leaves); the SIGKILL permanently costs one
+        assert fleet.size() == 3
+        # in-flight streams on surviving replicas completed exactly
+        for s in sessions:
+            st, body, _ = stream_out[s]
+            assert st == 200, stream_out[s]
+            assert body["ids"] == expected_ids([5], 40)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+class TestServeFleetCli:
+    def test_serve_fleet_parser_registered(self):
+        import subprocess
+        import sys
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu",
+             "serve-fleet", "--help"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0
+        for flag in ("--replicas", "--probe-interval",
+                     "--hedge-after-ms", "--chaos"):
+            assert flag in proc.stdout
+
+    def test_parse_model_spec(self, tmp_path):
+        from deeplearning4j_tpu.cli import _parse_model_spec
+        assert _parse_model_spec("m.zip") == ("default", "m.zip")
+        assert _parse_model_spec("lm=m.zip") == ("lm", "m.zip")
+        # an existing file wins outright even when its path holds '='
+        weird = tmp_path / "run=3"
+        weird.mkdir()
+        p = weird / "m.zip"
+        p.write_bytes(b"")
+        assert _parse_model_spec(str(p)) == ("default", str(p))
+
+
+# ---------------------------------------------------------------------------
+# drain-timeout expiry (ModelServer.stop(drain=True, timeout=...))
+# ---------------------------------------------------------------------------
+
+class TestDrainTimeoutExpiry:
+    def test_expired_drain_fails_queued_work_typed(self):
+        """A drain whose timeout expires must (a) return False
+        promptly — never hang the stop call on a backlog it cannot
+        clear — and (b) fail every queued/in-flight request with the
+        typed ServerClosedError (HTTP 503), never leave a caller
+        blocked."""
+        from deeplearning4j_tpu.serving.http import ModelServer
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        registry = ModelRegistry()
+        registry.register("default", EchoModel(delay=0.4))
+        server = ModelServer(registry, port=0, max_batch_size=1,
+                             wait_ms=1.0, queue_limit=64).start()
+        base = f"http://127.0.0.1:{server.port}"
+        out = []
+
+        def fire(i):
+            out.append(_post(base, "/v1/predict", _predict_body(i),
+                             timeout=30.0))
+
+        threads = [threading.Thread(target=fire, args=(i,),
+                                    daemon=True) for i in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)            # a real backlog has formed
+        t0 = time.monotonic()
+        ok = server.stop(drain=True, timeout=0.5)
+        stop_wall = time.monotonic() - t0
+        assert ok is False          # the drain did NOT complete
+        # 8 * 0.4s of queued work, but stop returns on the timeout
+        # (plus one in-flight device step), not on the backlog
+        assert stop_wall < 5.0
+        for t in threads:
+            t.join(10.0)
+        assert not any(t.is_alive() for t in threads), \
+            "a queued request never got an answer"
+        codes = sorted(st for st, _, _ in out)
+        assert len(codes) == 8
+        failed = [(st, body) for st, body, _ in out if st != 200]
+        assert failed, "timeout expired yet nothing was cut off"
+        for st, body in failed:
+            assert st == 503
+            assert "shut down" in body["error"]
+
+    def test_completed_drain_returns_true(self):
+        from deeplearning4j_tpu.serving.http import ModelServer
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        registry = ModelRegistry()
+        registry.register("default", EchoModel())
+        server = ModelServer(registry, port=0, wait_ms=1.0).start()
+        base = f"http://127.0.0.1:{server.port}"
+        assert _post(base, "/v1/predict", _predict_body())[0] == 200
+        assert server.stop(drain=True, timeout=10.0) is True
+
+
+# ---------------------------------------------------------------------------
+# loadgen harness itself
+# ---------------------------------------------------------------------------
+
+class TestLoadGen:
+    def test_open_loop_report(self, stack):
+        fleet, router = stack(n=1)
+        base = f"http://127.0.0.1:{router.port}"
+        rep = LoadGen(base, body_fn=_predict_body, concurrency=4,
+                      qps=150.0, duration_s=1.0,
+                      timeout_s=5.0).run()
+        assert rep["mode"] == "open"
+        # this test pins the REPORT mechanics, not throughput: the
+        # 2-core CI host's ceiling is ~50 q/s, so a bar near it
+        # flakes whenever the host is busy — just prove traffic
+        # flowed
+        assert rep["ok"] > 30
+        assert rep["failed"] == 0
+        assert rep["latency_ms"]["p50"] > 0
+        assert rep["latency_ms"]["p99"] >= rep["latency_ms"]["p50"]
+
+    def test_closed_loop_honors_retry_after(self, stack):
+        fleet, router = stack(n=1)
+        # drain the only replica: the router answers 503 with
+        # Retry-After; the loadgen honors it and reports the failure
+        # (no silent hang, no spin)
+        fleet.replica(0).server._draining.set()
+        time.sleep(0.2)            # let the prober see it
+        base = f"http://127.0.0.1:{router.port}"
+        rep = LoadGen(base, body_fn=_predict_body, concurrency=2,
+                      total=4, timeout_s=2.0, max_retries=1).run()
+        assert rep["ok"] == 0
+        assert rep["failed"] == 4
+        assert rep["retry_after_honored"] >= 1

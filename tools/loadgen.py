@@ -1,0 +1,314 @@
+"""Load generator for the serving fleet (and single servers).
+
+The in-repo harness that turns "the router survives faults" into a
+measured claim: drive ``/v1/predict`` (or ``/v1/generate``) at a
+target rate or at fixed concurrency, record every latency in the
+SAME histogram implementation the serving stack exposes
+(``observability.registry.Histogram`` — percentiles come from the
+metrics registry, not a side array), honor ``Retry-After`` backoff
+on 429/503, and report exactly what the soak acceptance needs:
+how many requests were sent, how many ever failed to get a
+successful response (``failed`` — the "dropped requests" count),
+and the latency distribution.
+
+Two loop disciplines (the classic load-testing split):
+
+- **closed loop** (``qps=None``): N workers fire back-to-back; the
+  system's completion rate gates the arrival rate. Measures peak
+  sustainable throughput, hides queueing delay.
+- **open loop** (``qps=R``): arrivals are scheduled at R/s no matter
+  how slow responses are (coordinated-omission-resistant); a bounded
+  backlog models client impatience — overflow counts as
+  ``not_sent`` rather than silently stretching the schedule.
+
+Usage (library)::
+
+    from tools.loadgen import LoadGen
+    report = LoadGen(url, concurrency=16, total=2000).run()
+
+CLI::
+
+    python -m tools.loadgen --url http://127.0.0.1:8080 \
+        --qps 200 --duration 30 --concurrency 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Optional
+
+__all__ = ["LoadGen"]
+
+
+def _default_body(i: int) -> dict:
+    return {"model": "default", "inputs": [[0.0, 1.0, 2.0, 3.0]]}
+
+
+class LoadGen:
+    """Open/closed-loop HTTP load generator with registry-backed
+    latency percentiles."""
+
+    def __init__(self, url: str, route: str = "/v1/predict",
+                 body_fn: Optional[Callable[[int], dict]] = None,
+                 concurrency: int = 8,
+                 qps: Optional[float] = None,
+                 duration_s: Optional[float] = None,
+                 total: Optional[int] = None,
+                 timeout_s: float = 10.0,
+                 max_retries: int = 2,
+                 honor_retry_after: bool = True,
+                 backlog_limit: Optional[int] = None,
+                 registry=None):
+        if duration_s is None and total is None:
+            raise ValueError("give duration_s or total")
+        from deeplearning4j_tpu.observability.registry import (
+            MetricsRegistry)
+        self.url = url.rstrip("/")
+        self.route = route
+        self.body_fn = body_fn or _default_body
+        self.concurrency = max(1, concurrency)
+        self.qps = qps
+        self.duration_s = duration_s
+        self.total = total
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.honor_retry_after = honor_retry_after
+        self.backlog_limit = (backlog_limit if backlog_limit
+                              is not None else 8 * self.concurrency)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.latency = self.registry.histogram(
+            "loadgen_latency_seconds",
+            help="client-observed request latency (seconds)",
+            labels={"route": route})
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {
+            "sent": 0, "ok": 0, "failed": 0, "retries": 0,
+            "not_sent": 0, "retry_after_honored": 0}
+        self._errors: Dict[str, int] = {}
+        self._stop = threading.Event()
+
+    # ---- one request, with backoff-aware retries ----
+    def _once(self, i: int) -> None:
+        body = json.dumps(self.body_fn(i)).encode()
+        deadline = time.monotonic() + self.timeout_s
+        attempts = 0
+        with self._lock:
+            # one REQUEST sent (retries are counted separately), so
+            # sent == ok + failed holds and a drop rate computed
+            # from sent vs ok is honest under failover
+            self._counts["sent"] += 1
+        t0 = time.perf_counter()
+        while True:
+            attempts += 1
+            status, retry_after = self._fire(body, deadline)
+            if status == 200:
+                self.latency.record(time.perf_counter() - t0)
+                with self._lock:
+                    self._counts["ok"] += 1
+                return
+            retryable = status in ("neterr", 429, 503)
+            with self._lock:
+                if attempts <= self.max_retries and retryable:
+                    self._counts["retries"] += 1
+                else:
+                    self._counts["failed"] += 1
+                    key = str(status)
+                    self._errors[key] = self._errors.get(key, 0) + 1
+            if attempts > self.max_retries or not retryable:
+                self.latency.record(time.perf_counter() - t0)
+                return
+            if retry_after and self.honor_retry_after:
+                wait = min(retry_after,
+                           max(0.0, deadline - time.monotonic()))
+                if wait > 0:
+                    with self._lock:
+                        self._counts["retry_after_honored"] += 1
+                    time.sleep(wait)
+            if time.monotonic() >= deadline:
+                with self._lock:
+                    self._counts["failed"] += 1
+                    self._errors["deadline"] = \
+                        self._errors.get("deadline", 0) + 1
+                self.latency.record(time.perf_counter() - t0)
+                return
+
+    def _fire(self, body: bytes, deadline: float):
+        """(status | "neterr", retry_after_seconds or None)."""
+        timeout = max(0.05, deadline - time.monotonic())
+        req = urllib.request.Request(
+            self.url + self.route, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                r.read()
+                return r.status, None
+        except urllib.error.HTTPError as e:
+            e.read()
+            ra = e.headers.get("Retry-After")
+            try:
+                ra = float(ra) if ra is not None else None
+            except ValueError:
+                ra = None
+            return e.code, ra
+        except (urllib.error.URLError, OSError, TimeoutError):
+            return "neterr", None
+
+    # ---- loop disciplines ----
+    def _closed_loop(self) -> None:
+        seq = threading.Lock()
+        counter = [0]
+        t_end = (time.monotonic() + self.duration_s
+                 if self.duration_s is not None else None)
+
+        def worker():
+            while not self._stop.is_set():
+                with seq:
+                    i = counter[0]
+                    counter[0] += 1
+                if self.total is not None and i >= self.total:
+                    return
+                if t_end is not None and time.monotonic() >= t_end:
+                    return
+                self._once(i)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _open_loop(self) -> None:
+        work: "queue.Queue" = queue.Queue(self.backlog_limit)
+
+        def worker():
+            while True:
+                i = work.get()
+                if i is None:
+                    return
+                self._once(i)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.concurrency)]
+        for t in threads:
+            t.start()
+        interval = 1.0 / float(self.qps)
+        t_start = time.monotonic()
+        t_end = (t_start + self.duration_s
+                 if self.duration_s is not None else None)
+        i = 0
+        next_t = t_start
+        while not self._stop.is_set():
+            if self.total is not None and i >= self.total:
+                break
+            now = time.monotonic()
+            if t_end is not None and now >= t_end:
+                break
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.05))
+                continue
+            # the OPEN-loop contract: this arrival happens NOW
+            # whether or not the system kept up; a full backlog is a
+            # client that gave up, not a schedule that stretched
+            try:
+                work.put_nowait(i)
+            except queue.Full:
+                with self._lock:
+                    self._counts["not_sent"] += 1
+            i += 1
+            next_t += interval
+        for _ in threads:
+            work.put(None)
+        for t in threads:
+            t.join()
+
+    # ---- entry ----
+    def run(self) -> dict:
+        t0 = time.monotonic()
+        if self.qps is None:
+            self._closed_loop()
+        else:
+            self._open_loop()
+        wall = time.monotonic() - t0
+        with self._lock:
+            counts = dict(self._counts)
+            errors = dict(self._errors)
+        snap = self.latency.snapshot()
+        report = {
+            "route": self.route,
+            "mode": "closed" if self.qps is None else "open",
+            "target_qps": self.qps,
+            "concurrency": self.concurrency,
+            "wall_s": round(wall, 3),
+            "achieved_qps": round(counts["ok"] / wall, 1)
+            if wall > 0 else 0.0,
+            "latency_ms": {
+                "p50": round(self.latency.quantile(0.50) * 1e3, 3),
+                "p95": round(self.latency.quantile(0.95) * 1e3, 3),
+                "p99": round(self.latency.quantile(0.99) * 1e3, 3),
+                "mean": round(snap["sum"] / snap["count"] * 1e3, 3)
+                if snap["count"] else 0.0},
+            "errors": errors,
+        }
+        report.update(counts)
+        return report
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="loadgen",
+        description="open/closed-loop load generator for the "
+                    "serving router / ModelServer")
+    p.add_argument("--url", required=True,
+                   help="base URL (router or replica)")
+    p.add_argument("--route", default="/v1/predict")
+    p.add_argument("--model", default="default")
+    p.add_argument("--features", type=int, default=4,
+                   help="input feature count for the default "
+                        "predict body")
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--qps", type=float, default=None,
+                   help="open-loop target rate; omit for closed "
+                        "loop")
+    p.add_argument("--duration", type=float, default=None,
+                   help="seconds to run")
+    p.add_argument("--total", type=int, default=None,
+                   help="total requests (alternative to --duration)")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="per-request budget incl. retries (seconds)")
+    p.add_argument("--retries", type=int, default=2)
+    args = p.parse_args(argv)
+    if args.duration is None and args.total is None:
+        args.duration = 10.0
+
+    def body(i, model=args.model, feat=args.features):
+        return {"model": model,
+                "inputs": [[float((i + j) % 7) for j in range(feat)]]}
+
+    gen = LoadGen(args.url, route=args.route, body_fn=body,
+                  concurrency=args.concurrency, qps=args.qps,
+                  duration_s=args.duration, total=args.total,
+                  timeout_s=args.timeout, max_retries=args.retries)
+    try:
+        report = gen.run()
+    except KeyboardInterrupt:
+        gen.stop()
+        report = {"interrupted": True}
+    json.dump(report, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    return 0 if not report.get("failed") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
