@@ -1734,6 +1734,231 @@ def _leg_transformer_decode(peak):
                  f"is asserted in tests/test_native_and_kernels.py")}
 
 
+PAGED_V, PAGED_D, PAGED_L, PAGED_H = 256, 128, 2, 4
+PAGED_SLOTS = 8
+PAGED_CAP = 160
+PAGED_PS = 16                 # tokens per KV page
+PAGED_POOL = 20               # fixed-memory pool for the slot-count leg
+PAGED_STEPS = 96
+PAGED_PROMPT = 64
+SPEC_K = 8
+SPEC_TOKENS = 96
+
+
+def _paged_lm(seed, width, layers, heads):
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        EmbeddingSequenceLayer, RnnOutputLayer, TransformerEncoderLayer)
+    b = (NeuralNetConfiguration.builder().set_seed(seed)
+         .updater(updaters.adam(1e-3)).list()
+         .layer(EmbeddingSequenceLayer(n_in=PAGED_V, n_out=width)))
+    for _ in range(layers):
+        b = b.layer(TransformerEncoderLayer(n_heads=heads,
+                                            causal=True))
+    conf = (b.layer(RnnOutputLayer(n_out=PAGED_V, loss="mcxent"))
+            .set_input_type(InputType.recurrent(PAGED_V, PAGED_CAP))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _leg_transformer_decode_paged(peak):
+    """The decode fast path end to end: (a) paged-KV slot decode vs
+    the dense per-slot session at batch N (same math, page-table
+    gather — greedy parity is tested in tests/test_decode_paged.py),
+    (b) prefix-cache TTFT on a repeated prompt vs cold prefill
+    through ContinuousBatcher, (c) draft-model speculative decode vs
+    vanilla greedy, and (d) the memory story: concurrent slots at a
+    FIXED KV budget, paged vs the dense bucket limit."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.speculative import (
+        SpeculativeDecoder)
+    from deeplearning4j_tpu.serving.continuous import ContinuousBatcher
+
+    net = _paged_lm(0, PAGED_D, PAGED_L, PAGED_H)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, PAGED_V,
+                       (PAGED_STEPS, PAGED_SLOTS, 1, 1)).astype(
+                           np.float32)
+    active = np.ones((PAGED_SLOTS,), bool)
+
+    # ---- (a) dense vs paged slot-step decode at batch N ----
+    dense = net.slot_streaming_session(capacity=PAGED_CAP,
+                                       slots=PAGED_SLOTS)
+    paged = net.paged_slot_streaming_session(
+        capacity=PAGED_CAP, slots=PAGED_SLOTS, page_size=PAGED_PS)
+
+    def _bind_all(sess):
+        for s in range(PAGED_SLOTS):
+            sess.bind(s, sess.reserve([1], PAGED_STEPS + 2))
+
+    _bind_all(paged)
+    float(jnp.sum(dense.step_slots(ids[0], active)))   # compile
+    float(jnp.sum(paged.step_slots(ids[0], active)))
+    drift = [0]
+
+    def _measure(sess, is_paged):
+        def m():
+            # drift the id stream per burst (tunnel memoization
+            # discipline, same as transformer_decode)
+            drift[0] += 1
+            ids_b = (ids + drift[0]) % PAGED_V
+            if is_paged:
+                sess.release_all()
+                _bind_all(sess)
+            else:
+                sess.reset()
+            t0 = time.perf_counter()
+            for s in range(PAGED_STEPS):
+                h = sess.step_slots(ids_b[s], active)
+            float(jnp.sum(h))
+            return time.perf_counter() - t0
+        return m
+
+    dt_p, dt_d = _interleave(_measure(paged, True),
+                             _measure(dense, False), repeats=3)
+    rate_p = PAGED_STEPS * PAGED_SLOTS / dt_p
+    rate_d = PAGED_STEPS * PAGED_SLOTS / dt_d
+
+    # ---- (b) prefix-cache TTFT through the batcher ----
+    cb = ContinuousBatcher(net, slots=4, capacity=PAGED_CAP,
+                           kv_mode="paged", page_size=PAGED_PS,
+                           name="bench_paged")
+    try:
+        warm = rng.integers(1, PAGED_V, (PAGED_PROMPT,))
+        cb.generate(warm, 1)               # compile + worker warmup
+        prompt = rng.integers(1, PAGED_V, (PAGED_PROMPT,))
+        t0 = time.perf_counter()
+        cb.generate(prompt, 1)
+        ttft_cold = time.perf_counter() - t0
+        ttft_hit = float("inf")
+        for _ in range(3):                 # prefix registered at
+            t0 = time.perf_counter()       # first completion
+            cb.generate(prompt, 1)
+            ttft_hit = min(ttft_hit, time.perf_counter() - t0)
+        prefix_hits = cb.session.prefix_cache.hits_total
+    finally:
+        cb.shutdown(drain=False)
+
+    # ---- (c) speculative decode vs vanilla greedy ----
+    draft = _paged_lm(7, 32, 1, 2)
+    spec_tiny = SpeculativeDecoder(net, draft, k=SPEC_K,
+                                   capacity=PAGED_CAP)
+    spec_self = SpeculativeDecoder(net, net, k=SPEC_K,
+                                   capacity=PAGED_CAP)
+    vanilla = net.streaming_session(capacity=PAGED_CAP, batch=1)
+    sp = rng.integers(1, PAGED_V, (1, 8))
+    spec_tiny.generate(sp, SPEC_TOKENS)    # compile
+    spec_self.generate(sp, SPEC_TOKENS)
+    vanilla.reset()
+    vanilla.generate(sp.astype(np.float32), SPEC_TOKENS)
+    sctr = [0]
+
+    def _m_spec(dec):
+        def m():
+            sctr[0] += 1
+            p = (sp + sctr[0]) % PAGED_V
+            t0 = time.perf_counter()
+            dec.generate(p, SPEC_TOKENS)
+            return time.perf_counter() - t0
+        return m
+
+    def _m_vanilla():
+        sctr[0] += 1
+        p = ((sp + sctr[0]) % PAGED_V).astype(np.float32)
+        vanilla.reset()
+        t0 = time.perf_counter()
+        out = vanilla.generate(p, SPEC_TOKENS)
+        float(jnp.sum(out))
+        return time.perf_counter() - t0
+
+    dt_self, dt_v = _interleave(_m_spec(spec_self), _m_vanilla,
+                                repeats=3)
+    dt_tiny, dt_v2 = _interleave(_m_spec(spec_tiny), _m_vanilla,
+                                 repeats=3)
+    dt_v = min(dt_v, dt_v2)
+    rate_spec_self = SPEC_TOKENS / dt_self
+    rate_spec_tiny = SPEC_TOKENS / dt_tiny
+    rate_vanilla = SPEC_TOKENS / dt_v
+
+    # ---- (d) concurrent slots at a FIXED KV budget ----
+    pool_tokens = PAGED_POOL * PAGED_PS
+    dense_slot_limit = pool_tokens // PAGED_CAP
+    fixed = net.paged_slot_streaming_session(
+        capacity=PAGED_CAP, slots=PAGED_SLOTS, page_size=PAGED_PS,
+        n_pages=PAGED_POOL)
+    from deeplearning4j_tpu.serving.errors import (
+        KVPagePoolExhaustedError)
+    short = rng.integers(1, PAGED_V, (8,))
+    concurrent = 0
+    try:
+        for s in range(PAGED_SLOTS):
+            fixed.bind(s, fixed.reserve(short, 24))   # 2 pages each
+            concurrent += 1
+    except KVPagePoolExhaustedError:
+        pass          # the pool is the bound being measured; any
+        # other exception is a real bug and must fail the leg
+
+    print(f"paged decode: paged {rate_p:.0f} tok/s vs dense "
+          f"{rate_d:.0f} tok/s at B={PAGED_SLOTS}; TTFT cold "
+          f"{ttft_cold * 1e3:.1f} ms vs prefix-hit "
+          f"{ttft_hit * 1e3:.1f} ms ({prefix_hits} hits); spec "
+          f"self-draft {rate_spec_self:.0f} tok/s / tiny-draft "
+          f"{rate_spec_tiny:.0f} (acc "
+          f"{spec_tiny.acceptance_rate:.2f}) vs vanilla "
+          f"{rate_vanilla:.0f}; {concurrent} concurrent slots vs "
+          f"dense limit {dense_slot_limit} at {pool_tokens} tokens "
+          f"KV", file=sys.stderr)
+    return {
+        "metric": (f"transformer_decode_paged: paged-KV continuous "
+                   f"decode (B={PAGED_SLOTS} slots, d={PAGED_D}, "
+                   f"L={PAGED_L}, heads={PAGED_H}, vocab {PAGED_V}, "
+                   f"cap {PAGED_CAP}, page {PAGED_PS})"),
+        "value": round(rate_p, 0), "unit": "tokens/sec/chip",
+        "baseline": round(rate_d, 0),
+        "vs_baseline": round(rate_p / rate_d, 3),
+        "ttft_cold_ms": round(ttft_cold * 1e3, 3),
+        "ttft_prefix_hit_ms": round(ttft_hit * 1e3, 3),
+        "prefix_ttft_speedup": round(ttft_cold / ttft_hit, 3),
+        "prefix_cache_hits": prefix_hits,
+        "spec_self_draft_tokens_per_sec": round(rate_spec_self, 0),
+        "spec_tiny_draft_tokens_per_sec": round(rate_spec_tiny, 0),
+        "spec_vanilla_tokens_per_sec": round(rate_vanilla, 0),
+        "spec_self_vs_vanilla": round(rate_spec_self / rate_vanilla,
+                                      3),
+        "spec_tiny_vs_vanilla": round(rate_spec_tiny / rate_vanilla,
+                                      3),
+        "spec_tiny_acceptance": round(spec_tiny.acceptance_rate, 4),
+        "spec_k": SPEC_K,
+        "kv_pool_tokens_fixed_mem": pool_tokens,
+        "dense_slot_limit_at_fixed_mem": dense_slot_limit,
+        "paged_concurrent_slots_at_fixed_mem": concurrent,
+        "mfu": None,
+        "note": (f"value/baseline: tokens/sec over {PAGED_STEPS} "
+                 f"single-token steps with all {PAGED_SLOTS} slots "
+                 "active — paged gathers each slot's page table, "
+                 "dense indexes a private capacity-row cache (greedy "
+                 "tokens bit-identical; tested). TTFT: "
+                 "ContinuousBatcher n_tokens=1 request wall time; "
+                 f"the prefix-hit path resumes after "
+                 f"{PAGED_PROMPT // PAGED_PS} cached pages instead "
+                 f"of {PAGED_PROMPT} teacher-forced prefill steps. "
+                 "Speculative: self-draft (acceptance 1.0) is the "
+                 "machinery ceiling — 2 draft dispatches (feed + "
+                 f"fused k={SPEC_K} scan) + 1 chunked verify per "
+                 "round replace k single-token dispatches; the "
+                 "tiny-draft row is an UNTRAINED draft, so its "
+                 "acceptance (~1/vocab) makes it a slowdown — a "
+                 "distilled draft lands between the two rows. "
+                 "Slot-count row: at a fixed "
+                 "pool of KV memory the dense session can host only "
+                 "floor(mem/capacity) slots; paged binds pages per "
+                 "request's actual need")}
+
+
 def _leg_flash_attention_masked(peak):
     """Variable-length batch at T=4096 through the kv-mask-aware
     Pallas kernels (fwd+bwd) vs (a) exact masked attention — the
@@ -1928,6 +2153,9 @@ _LEGS = [
     ("flash_attention", _leg_flash_attention, 300),
     ("flash_attention_masked", _leg_flash_attention_masked, 300),
     ("transformer_decode", _leg_transformer_decode, 300),
+    # small config (CPU-feasible): paged vs dense decode, prefix-hit
+    # TTFT, speculative vs vanilla, fixed-memory slot count
+    ("transformer_decode_paged", _leg_transformer_decode_paged, 300),
     ("serving_throughput", _leg_serving_throughput, 180),
     # 480s: its ResNet executable (n_classes=10) is NOT covered by
     # the other ResNet legs' compile cache — cold tunnel compile ~5min

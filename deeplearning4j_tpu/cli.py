@@ -173,7 +173,8 @@ def _cmd_serve(args):
         queue_limit=args.queue_limit, wait_ms=args.wait_ms,
         slots=args.slots, capacity=args.capacity, metrics=metrics,
         sample_rate=args.trace_sample, slow_ms=args.slow_ms,
-        slos=slos).start()
+        slos=slos, kv_mode=args.kv_mode, page_size=args.page_size,
+        kv_pages=args.kv_pages).start()
     print(f"serving on http://{args.host}:{server.port}/ "
           f"(/v1/predict /v1/generate /v1/models /healthz /metrics "
           f"/debug/requests /debug/slots /debug/traces; trace "
@@ -328,6 +329,19 @@ def main(argv=None):
                    help="continuous-batching KV-cache slots")
     v.add_argument("--capacity", type=int, default=256,
                    help="max prompt+generated tokens per request")
+    v.add_argument("--kv-mode", choices=("auto", "paged", "dense"),
+                   default="auto",
+                   help="decode KV cache: 'paged' = refcounted page "
+                        "pool + prefix cache (slot count bounded by "
+                        "memory), 'dense' = per-slot capacity "
+                        "buckets, 'auto' pages transformer models "
+                        "and falls back to dense for recurrent ones")
+    v.add_argument("--page-size", type=int, default=16,
+                   help="tokens per KV page (paged mode)")
+    v.add_argument("--kv-pages", type=int, default=None,
+                   help="total pages in the pool (default: memory "
+                        "parity with the dense session, "
+                        "slots * ceil(capacity/page_size))")
     v.add_argument("--trace-sample", type=float, default=0.01,
                    metavar="RATE",
                    help="head-based request-trace sampling rate in "

@@ -607,6 +607,21 @@ class MultiLayerNetwork:
         return SlotStreamingSession(self, capacity, slots,
                                     dtype or jnp.float32)
 
+    def paged_slot_streaming_session(self, capacity: int, slots: int,
+                                     page_size: int = 16,
+                                     n_pages=None, dtype=None):
+        """Paged-KV continuous-batching session: per-slot page tables
+        into one refcounted page pool, so concurrent slot count is
+        bounded by total KV memory (``n_pages * page_size`` tokens)
+        instead of ``slots x capacity`` — plus prompt-prefix sharing
+        between slots (see ``models/paged_kv.py``). Raises
+        ``ValueError`` for models whose layers carry state with no
+        paged analog (recurrent carries, running statistics)."""
+        from deeplearning4j_tpu.models.paged_kv import PagedSlotSession
+        return PagedSlotSession(self, slots=slots, capacity=capacity,
+                                page_size=page_size, n_pages=n_pages,
+                                dtype=dtype)
+
     # ------------------------------------------------------------------
     # params plumbing (reference flat params view :542-554)
     # ------------------------------------------------------------------

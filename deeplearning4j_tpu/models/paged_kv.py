@@ -1,0 +1,493 @@
+"""Paged (block) KV cache: allocator, prefix cache, slot session.
+
+The dense ``SlotStreamingSession`` reserves ``capacity`` cache rows
+per slot up front, so slot count is bounded by ``slots x capacity``
+KV memory whether or not the streams use it — the shape-bucket
+ceiling the ROADMAP "decode fast path" item names. This module is the
+vLLM-style paged memory model over the same layer math:
+
+- **PagedKVAllocator** — one physical pool of fixed-size pages per
+  model (per attention layer: a ``(n_pages, page_size, H, Dh)``
+  buffer, allocated once). Pages are refcounted; a request reserves
+  only the pages its ``prompt + n_tokens`` worst case needs, so
+  concurrent slot count is bounded by TOTAL KV memory, not by
+  per-slot capacity. Exhaustion is a typed admission error
+  (``KVPagePoolExhaustedError``, HTTP 429 + ``Retry-After``), never
+  an OOM mid-decode: reservation is up-front.
+- **PrefixCache** — prompt-prefix reuse across requests: when a
+  stream completes, the pages FULLY covered by its prompt become
+  immutable and are registered under the rolling hash chain of the
+  prompt's page-aligned prefixes. A later request whose prompt starts
+  with a cached prefix points its page table at the shared pages
+  (refcounted) and resumes prefill AFTER them — repeated-prompt
+  traffic skips prefill. Shared pages are read-only; the one write
+  a resumed stream must make inside a shared page (re-feeding the
+  last prompt token when the whole prompt was covered) triggers
+  copy-on-write. Cache entries are LRU-evicted when the allocator
+  runs dry.
+- **PagedSlotSession** — the continuous-batching substrate over page
+  tables: one jitted (slots, 1) decode step; each attention layer
+  writes new k/v into the slot's current page and attends over the
+  slot's GATHERED virtual cache (``apply_stream_paged``). With
+  ``pages_per_slot * page_size`` equal to the dense capacity the
+  math is position-for-position identical to the dense path —
+  greedy-token parity is tested.
+
+Page id 0 is a reserved scratch page: inactive slots' page-table rows
+are all-zero, so their dummy writes land in scratch and can never
+corrupt a live page. The allocator hands out ids ``1..n_pages``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.errors import KVPagePoolExhaustedError
+
+__all__ = ["PagedKVAllocator", "PrefixCache", "PagedSlotSession"]
+
+
+def _pages_for(tokens: int, page_size: int) -> int:
+    return -(-int(tokens) // int(page_size))
+
+
+class PagedKVAllocator:
+    """Refcounted free-list allocator over page ids ``1..n_pages``
+    (id 0 is the session's scratch page). Thread-safe: admission
+    checks read counts from request threads while the batcher worker
+    allocates/frees."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 1:
+            raise ValueError(f"n_pages must be >= 1, got {n_pages}")
+        if page_size < 1:
+            raise ValueError(
+                f"page_size must be >= 1, got {page_size}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        # LIFO free list: recently-freed pages are re-used first
+        # (their pool rows are warm)
+        self._free: List[int] = list(range(self.n_pages, 0, -1))
+        self._ref = np.zeros(self.n_pages + 1, np.int32)
+
+    # ---- queries ----
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def in_use(self) -> int:
+        return self.n_pages - self.free_count()
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return int(self._ref[page])
+
+    # ---- alloc / refcount ----
+    def alloc(self, n: int, evictor=None) -> List[int]:
+        """Allocate ``n`` pages (refcount 1 each). When the free list
+        is short and an ``evictor`` is given, it is asked to release
+        ``needed`` pages (the prefix cache drops LRU entries there);
+        still short afterwards raises
+        :class:`KVPagePoolExhaustedError` with a backoff hint scaled
+        to the shortfall — allocation is all-or-nothing."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        with self._lock:
+            short = n - len(self._free)
+        if short > 0 and evictor is not None:
+            evictor.evict(short)
+        with self._lock:
+            if n > len(self._free):
+                raise KVPagePoolExhaustedError(
+                    f"KV page pool exhausted: {n} pages needed, "
+                    f"{len(self._free)} free of {self.n_pages} — "
+                    "active decodes free pages as they finish",
+                    retry_after_s=max(0.1, 0.02 * n))
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._ref[p] = 1
+            return pages
+
+    def incref(self, pages) -> None:
+        with self._lock:
+            for p in pages:
+                if self._ref[p] <= 0:
+                    raise ValueError(
+                        f"incref on free page {p} (use-after-free)")
+                self._ref[p] += 1
+
+    def decref(self, pages) -> None:
+        """Drop one reference per page; a page at refcount 0 returns
+        to the free list."""
+        with self._lock:
+            for p in pages:
+                if self._ref[p] <= 0:
+                    raise ValueError(
+                        f"decref on free page {p} (double free)")
+                self._ref[p] -= 1
+                if self._ref[p] == 0:
+                    self._free.append(p)
+
+    def reset(self) -> None:
+        """Forget everything (worker-restart recovery: the pool
+        buffers were rebuilt, so every outstanding reference is
+        dead)."""
+        with self._lock:
+            self._free = list(range(self.n_pages, 0, -1))
+            self._ref[:] = 0
+
+
+class PrefixCache:
+    """Page-granular prompt-prefix index with LRU eviction.
+
+    Keys are the page-aligned token prefixes themselves (exact match,
+    not a lossy hash): a registered prompt of ``m`` full pages adds
+    one entry per prefix length ``1..m``, so a later prompt sharing
+    only the first page still hits. Each entry owns one refcount on
+    each of its pages; eviction (LRU, driven by the allocator running
+    dry) drops entries and their references — a page frees only when
+    no entry AND no live slot references it."""
+
+    def __init__(self, allocator: PagedKVAllocator):
+        self._alloc = allocator
+        self._entries: "OrderedDict[bytes, List[int]]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits_total = 0
+        self.evictions_total = 0
+
+    @staticmethod
+    def _key(tokens: np.ndarray, n_tokens: int) -> bytes:
+        return np.ascontiguousarray(
+            tokens[:n_tokens], dtype=np.int64).tobytes()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def register(self, tokens, pages: List[int]) -> int:
+        """Register the chain of full-prompt pages ``pages`` (page i
+        holds tokens ``[i*ps, (i+1)*ps)``). Returns how many new
+        entries were added."""
+        ps = self._alloc.page_size
+        tokens = np.asarray(tokens).reshape(-1)
+        added = 0
+        with self._lock:
+            for n in range(1, len(pages) + 1):
+                key = self._key(tokens, n * ps)
+                if key in self._entries:
+                    self._entries.move_to_end(key)
+                    continue
+                chain = list(pages[:n])
+                self._alloc.incref(chain)
+                self._entries[key] = chain
+                added += 1
+        return added
+
+    def lookup(self, tokens) -> List[int]:
+        """Longest cached page chain matching the prompt's page-
+        aligned prefix. The returned pages carry one NEW reference
+        each (the caller's — release with ``decref``); empty list on
+        miss. Counts a hit only when at least one page matched."""
+        ps = self._alloc.page_size
+        tokens = np.asarray(tokens).reshape(-1)
+        with self._lock:
+            for n in range(len(tokens) // ps, 0, -1):
+                key = self._key(tokens, n * ps)
+                chain = self._entries.get(key)
+                if chain is not None:
+                    self._entries.move_to_end(key)
+                    self._alloc.incref(chain)
+                    self.hits_total += 1
+                    return list(chain)
+        return []
+
+    def evict(self, n_pages_needed: int) -> None:
+        """Drop LRU entries until ~``n_pages_needed`` page references
+        were released (or the cache is empty). Called by the
+        allocator mid-``alloc``; pages shared with live slots lose
+        the cache's reference but stay resident."""
+        released = 0
+        with self._lock:
+            while self._entries and released < n_pages_needed:
+                _, chain = self._entries.popitem(last=False)
+                self._alloc.decref(chain)
+                released += len(chain)
+                self.evictions_total += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            for chain in self._entries.values():
+                self._alloc.decref(chain)
+            self._entries.clear()
+
+
+class _Lease:
+    """One admitted stream's page reservation."""
+
+    __slots__ = ("pages", "resume_pos", "prefix_hit_tokens",
+                 "prompt_len")
+
+    def __init__(self, pages, resume_pos, prefix_hit_tokens,
+                 prompt_len):
+        self.pages = pages                    # table order
+        self.resume_pos = resume_pos          # first position to feed
+        self.prefix_hit_tokens = prefix_hit_tokens
+        self.prompt_len = prompt_len
+
+
+class PagedSlotSession:
+    """Continuous-batching decode over a paged KV pool: the drop-in
+    sibling of :class:`~deeplearning4j_tpu.models.streaming.
+    SlotStreamingSession` whose per-slot state is a page table into
+    one shared pool instead of a private ``capacity``-row cache.
+
+    ``capacity`` still bounds ONE request's prompt+generation length
+    (it is the page-table width in tokens); memory is bounded by
+    ``n_pages * page_size`` total. Supported layers: paged attention
+    (``apply_stream_paged``) and stateless layers — recurrent
+    carries (``zero_state``) and running statistics have no paged
+    analog; build the dense session for those models.
+    """
+
+    @staticmethod
+    def supports(net) -> bool:
+        """Can this model decode over page tables? False when any
+        layer carries state with no paged analog (recurrent carry or
+        running statistic) — the predicate the batcher's
+        ``kv_mode="auto"`` fallback keys on, so that REAL
+        construction errors (bad page_size/n_pages) are never
+        mistaken for an unsupported model."""
+        return not any(
+            not hasattr(layer, "apply_stream_paged")
+            and (hasattr(layer, "zero_state")
+                 or hasattr(layer, "apply_stream"))
+            for layer in net.layers)
+
+    def __init__(self, net, slots: int, capacity: int,
+                 page_size: int = 16, n_pages: Optional[int] = None,
+                 dtype=None):
+        import jax.numpy as jnp
+        for i, layer in enumerate(net.layers):
+            if hasattr(layer, "apply_stream_paged"):
+                continue
+            if hasattr(layer, "zero_state") or hasattr(
+                    layer, "apply_stream"):
+                raise ValueError(
+                    f"layer {i} ({type(layer).__name__}) carries "
+                    "state with no paged analog (recurrent carry or "
+                    "running statistic); use the dense "
+                    "SlotStreamingSession for this model")
+        self.net = net
+        self.slots = int(slots)
+        self.capacity = int(capacity)
+        self.page_size = int(page_size)
+        self.pages_per_slot = _pages_for(capacity, page_size)
+        if n_pages is None:
+            # memory parity with the dense session by default: the
+            # win then comes from reserving per-request actual need
+            n_pages = self.slots * self.pages_per_slot
+        self._dtype = dtype or jnp.float32
+        self.allocator = PagedKVAllocator(n_pages, self.page_size)
+        self.prefix_cache = PrefixCache(self.allocator)
+        self.slot_pos = np.zeros((self.slots,), np.int32)
+        self._table = np.zeros((self.slots, self.pages_per_slot),
+                               np.int32)
+        self._leases: Dict[int, _Lease] = {}
+        self._pools = self._fresh_pools()
+        self._step = None
+        self._copy_page = None
+
+    # ---- pools ----
+    def _fresh_pools(self):
+        pools = []
+        for layer in self.net.layers:
+            if hasattr(layer, "apply_stream_paged"):
+                # +1 physical row: page id 0 is the scratch page
+                pools.append(layer.zero_page_pool(
+                    self.allocator.n_pages + 1, self.page_size,
+                    self._dtype))
+            else:
+                pools.append(None)
+        return pools
+
+    def pages_total(self) -> int:
+        return self.allocator.n_pages
+
+    def pages_in_use(self) -> int:
+        return self.allocator.in_use()
+
+    def slot_pages(self, slot: int) -> int:
+        lease = self._leases.get(slot)
+        return len(lease.pages) if lease is not None else 0
+
+    def slot_prefix_hit(self, slot: int) -> int:
+        lease = self._leases.get(slot)
+        return lease.prefix_hit_tokens if lease is not None else 0
+
+    # ---- admission-side API (batcher worker thread) ----
+    def can_ever_fit(self, prompt_len: int, n_tokens: int) -> bool:
+        """Could this request EVER be admitted (table width and whole
+        pool permitting)? False means a client error, not transient
+        pressure."""
+        total = int(prompt_len) + int(n_tokens)
+        return (total <= self.capacity
+                and _pages_for(total, self.page_size)
+                <= self.allocator.n_pages)
+
+    def reserve(self, prompt, n_tokens: int) -> _Lease:
+        """Reserve pages for one stream's ``prompt + n_tokens`` worst
+        case, reusing cached prefix pages when the prompt matches.
+        Raises :class:`KVPagePoolExhaustedError` (all-or-nothing)
+        under transient pressure. The lease is not visible to the
+        device until :meth:`bind`."""
+        prompt = np.asarray(prompt).reshape(-1)
+        T0 = prompt.size
+        if T0 < 1:
+            raise ValueError("prompt must contain at least one token")
+        if T0 + int(n_tokens) > self.capacity:
+            raise ValueError(
+                f"prompt ({T0}) + n_tokens ({n_tokens}) exceeds the "
+                f"page-table width (capacity {self.capacity})")
+        total_pages = _pages_for(T0 + int(n_tokens), self.page_size)
+        shared = self.prefix_cache.lookup(prompt)
+        # the LAST prompt token must be re-fed to produce the first
+        # output logits, so a hit can cover at most T0 - 1 positions
+        resume = min(len(shared) * self.page_size, T0 - 1)
+        cow_idx = resume // self.page_size
+        need_cow = cow_idx < len(shared)
+        fresh_needed = total_pages - len(shared) + (
+            1 if need_cow else 0)
+        try:
+            fresh = self.allocator.alloc(fresh_needed,
+                                         evictor=self.prefix_cache)
+        except KVPagePoolExhaustedError:
+            if shared:
+                self.allocator.decref(shared)
+            raise
+        if need_cow:
+            # the resume position sits INSIDE a shared page (whole
+            # prompt was covered): copy-on-write it so the re-fed
+            # token's write cannot touch the shared original
+            cow_page = fresh.pop()
+            self._device_copy_page(cow_page, shared[cow_idx])
+            self.allocator.decref([shared[cow_idx]])
+            shared = shared[:cow_idx] + [cow_page]
+        pages = shared + fresh
+        return _Lease(pages, resume,
+                      prefix_hit_tokens=resume, prompt_len=T0)
+
+    def bind(self, slot: int, lease: _Lease) -> None:
+        self._table[slot, :] = 0
+        self._table[slot, :len(lease.pages)] = lease.pages
+        self.slot_pos[slot] = lease.resume_pos
+        self._leases[slot] = lease
+
+    def release(self, slot: int, register_prompt=None) -> None:
+        """Recycle a slot: drop its page references; when the stream
+        completed cleanly, first register its full-prompt pages in
+        the prefix cache (the cache takes its own references)."""
+        lease = self._leases.pop(slot, None)
+        self._table[slot, :] = 0
+        self.slot_pos[slot] = 0
+        if lease is None:
+            return
+        if register_prompt is not None:
+            prompt = np.asarray(register_prompt).reshape(-1)
+            n_full = prompt.size // self.page_size
+            if n_full > 0:
+                self.prefix_cache.register(prompt,
+                                           lease.pages[:n_full])
+        self.allocator.decref(lease.pages)
+
+    def release_all(self) -> None:
+        for slot in list(self._leases):
+            self.release(slot)
+
+    # ---- device step ----
+    def _device_copy_page(self, dst: int, src: int) -> None:
+        import jax
+        if self._copy_page is None:
+            def copy(pool, dst, src):
+                row = jax.tree_util.tree_map(lambda b: b[src], pool)
+                return jax.tree_util.tree_map(
+                    lambda b, r: b.at[dst].set(r), pool, row)
+
+            self._copy_page = jax.jit(copy, donate_argnums=(0,))
+        import jax.numpy as jnp
+        d, s = jnp.int32(dst), jnp.int32(src)
+        for i, pool in enumerate(self._pools):
+            if pool is not None:
+                self._pools[i] = self._copy_page(pool, d, s)
+
+    def _make_step(self):
+        import jax
+        net = self.net
+        layers = list(net.layers)
+        preprocessors = dict(net.conf.preprocessors)
+
+        def step(params, layer_states, pools, table, pos, x):
+            h = x
+            new_pools = list(pools)
+            for i, layer in enumerate(layers):
+                if i in preprocessors:
+                    h = preprocessors[i](h)
+                if hasattr(layer, "apply_stream_paged"):
+                    h, new_pools[i] = layer.apply_stream_paged(
+                        params[i], pools[i], table, pos, h)
+                else:
+                    h, _ = layer.apply(params[i], layer_states[i], h,
+                                       training=False)
+            return h, new_pools
+
+        return jax.jit(step, donate_argnums=(2,))
+
+    def step_slots(self, x, active):
+        """One decode step for every slot at once — the
+        ``SlotStreamingSession.step_slots`` contract: ``x`` is
+        (slots, 1, C), free slots carry a dummy row (their write
+        lands in the scratch page and their ``pos`` stays put).
+        Returns the (slots, 1, V) output for the new step."""
+        import jax.numpy as jnp
+        x = jnp.asarray(x)
+        active = np.asarray(active, bool)
+        if x.shape[0] != self.slots:
+            raise ValueError(f"x has {x.shape[0]} rows; session has "
+                             f"{self.slots} slots")
+        if active.any() and int(self.slot_pos[active].max()) >= \
+                self.capacity:
+            raise ValueError(
+                f"slot overflow: an active slot is at pos "
+                f"{int(self.slot_pos[active].max())} with capacity "
+                f"{self.capacity} — admit shorter requests or build "
+                "the session with a larger capacity")
+        if self._step is None:
+            self._step = self._make_step()
+        # inactive slots step with pos 0 over their all-zero table
+        # row: the write targets scratch, never a live page
+        pos = np.where(active, self.slot_pos, 0).astype(np.int32)
+        h, self._pools = self._step(
+            self.net.params, self.net.state, self._pools,
+            jnp.asarray(self._table), jnp.asarray(pos), x)
+        self.slot_pos = self.slot_pos + active.astype(
+            self.slot_pos.dtype)
+        return h
+
+    def reinit_states(self) -> None:
+        """Post-crash recovery: the jitted step donates the pools, so
+        after a failed step the buffers may be deleted device arrays.
+        Rebuild them AND forget every page reference — the prefix
+        cache's entries point at contents that no longer exist, so it
+        must flush (its counters survive for the metrics)."""
+        self._leases.clear()
+        self.prefix_cache.clear()
+        self.allocator.reset()
+        self.slot_pos = np.zeros((self.slots,), np.int32)
+        self._table = np.zeros((self.slots, self.pages_per_slot),
+                               np.int32)
+        self._pools = self._fresh_pools()

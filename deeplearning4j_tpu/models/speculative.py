@@ -1,0 +1,172 @@
+"""Draft-model speculative decoding with accept-prefix semantics.
+
+Autoregressive greedy decode pays one target-model dispatch per
+token. Speculative decoding (the Leviathan/Chen draft-verify scheme,
+greedy variant) lets a SMALL draft model propose ``k`` tokens and the
+target model verify all of them in ONE chunked step:
+
+- the draft proposes greedily from its own KV state — here as one
+  fused ``lax.scan`` program, so a whole proposal round is two
+  dispatches (feed the last accepted token, scan k proposals);
+- the target consumes ``[last_accepted] + proposals[:-1]`` as a
+  single (1, k) chunk — one dispatch — giving its next-token argmax
+  at every position;
+- the longest prefix of proposals that matches the target's argmax
+  chain is ACCEPTED; on a mismatch the target's own argmax at the
+  mismatch position is emitted instead (the "bonus" correction).
+
+Because every emitted token is, by construction, exactly the target's
+greedy argmax given the emitted history, the output is IDENTICAL to
+vanilla greedy decode of the target alone (tested) — the draft only
+changes how many dispatches that sequence costs: ``2 + 1`` per round
+of up to ``k`` tokens instead of ``k``. Rejected proposals leave
+stale KV entries behind; rewinding ``session.pos`` is all the
+rollback needed — the bounded sessions mask every cache position
+``>= pos``, and later writes overwrite the stale slots
+(models/streaming.py). That masking trick is also why only models
+whose streaming state is pure KV cache qualify: a recurrent carry
+cannot rewind, so such layers are rejected at construction.
+
+Acceptance telemetry rides the shared metrics registry
+(``spec_tokens_proposed_total`` / ``spec_tokens_accepted_total``;
+the acceptance rate is their ratio) so serving dashboards can see
+when a draft has drifted too far from its target to pay for itself.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["SpeculativeDecoder"]
+
+
+def _reject_unrewindable(net, role: str) -> None:
+    for i, layer in enumerate(net.layers):
+        if hasattr(layer, "apply_stream_bounded"):
+            continue
+        if hasattr(layer, "zero_state") or hasattr(layer,
+                                                   "apply_stream"):
+            raise ValueError(
+                f"{role} model layer {i} ({type(layer).__name__}) "
+                "carries non-KV streaming state (recurrent carry or "
+                "running statistic); speculative decode rolls back "
+                "by rewinding pos, which only KV caches support")
+
+
+class SpeculativeDecoder:
+    """Greedy speculative decoding over two bounded streaming
+    sessions (target + draft). ``generate(prompt, n_tokens)`` returns
+    ids bit-identical to the target's own greedy decode.
+
+    ``capacity`` needs ``prompt + n_tokens + k`` headroom: a verify
+    chunk may overshoot the final length by up to ``k`` rejected
+    positions before the rewind."""
+
+    def __init__(self, target_net, draft_net, k: int = 4,
+                 capacity: int = 256, registry=None,
+                 endpoint: str = "speculative"):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        _reject_unrewindable(target_net, "target")
+        _reject_unrewindable(draft_net, "draft")
+        self.k = int(k)
+        self.capacity = int(capacity)
+        self.target = target_net.streaming_session(capacity=capacity,
+                                                   batch=1)
+        self.draft = draft_net.streaming_session(capacity=capacity,
+                                                 batch=1)
+        # lifetime acceptance accounting (plain ints for tests /
+        # in-process callers, registry counters for dashboards —
+        # instruments created once HERE, never per round)
+        self.tokens_proposed = 0
+        self.tokens_accepted = 0
+        self._proposed_ctr = self._accepted_ctr = None
+        if registry is not None:
+            lbl = {"endpoint": endpoint}
+            self._proposed_ctr = registry.counter(
+                "spec_tokens_proposed_total",
+                help="draft tokens proposed for verification",
+                labels=lbl)
+            self._accepted_ctr = registry.counter(
+                "spec_tokens_accepted_total",
+                help="draft tokens accepted by the target "
+                     "(acceptance rate = accepted / proposed)",
+                labels=lbl)
+
+    @property
+    def acceptance_rate(self) -> float:
+        if not self.tokens_proposed:
+            return 0.0
+        return self.tokens_accepted / self.tokens_proposed
+
+    def _count(self, proposed: int, accepted: int) -> None:
+        self.tokens_proposed += proposed
+        self.tokens_accepted += accepted
+        if self._proposed_ctr is not None:
+            self._proposed_ctr.inc(proposed)
+            self._accepted_ctr.inc(accepted)
+
+    def generate(self, prompt, n_tokens: int) -> np.ndarray:
+        """Greedy-decode ``n_tokens`` ids after ``prompt`` (a 1-d or
+        (1, T0) id sequence). Returns a (n_tokens,) int array equal
+        to the target's vanilla greedy decode."""
+        import jax.numpy as jnp
+        prompt = np.asarray(prompt).reshape(1, -1)
+        T0 = prompt.shape[1]
+        n_tokens = int(n_tokens)
+        if n_tokens < 1:
+            raise ValueError(f"n_tokens must be >= 1, got {n_tokens}")
+        if T0 + n_tokens + self.k > self.capacity:
+            raise ValueError(
+                f"prompt ({T0}) + n_tokens ({n_tokens}) + k "
+                f"({self.k}) verify headroom exceeds capacity "
+                f"{self.capacity}")
+        tgt, drf, k = self.target, self.draft, self.k
+        tgt.reset()
+        drf.reset()
+        feed = lambda toks: np.asarray(toks, np.float32
+                                       ).reshape(1, -1, 1)
+        # prefill both models; the FIRST token comes straight from
+        # the target (no draft involvement, same as vanilla greedy)
+        p_t = np.asarray(tgt.step(feed(prompt[0])))
+        drf.step(feed(prompt[0]))
+        last_tok = int(np.argmax(p_t[0, -1]))
+        emitted = [last_tok]
+        rng = jnp.zeros((2,), jnp.uint32)     # greedy: RNG unused
+        while len(emitted) < n_tokens:
+            # draft round: consume the last accepted token (one
+            # dispatch), then propose k more as ONE fused scan
+            d_pos0 = drf.pos
+            d_probs = np.asarray(drf.step(feed([last_tok])))
+            props = [int(t) for t in np.asarray(
+                drf._generate_fused(jnp.asarray(d_probs[:, 0]), k,
+                                    0.0, rng))[0]]
+            # target verifies the whole round in one chunked step:
+            # probs[j] is the target's next-token distribution after
+            # consuming [last_tok] + props[:j]
+            t_pos0 = tgt.pos
+            chunk = [last_tok] + props[:-1]
+            P = np.asarray(tgt.step(feed(chunk)))[0]      # (k, V)
+            argmax = np.argmax(P, axis=-1)
+            n_acc = 0
+            while n_acc < k and props[n_acc] == int(argmax[n_acc]):
+                n_acc += 1
+            self._count(proposed=k, accepted=n_acc)
+            if n_acc == k:
+                # every proposal matched the target's argmax chain:
+                # all of the chunk's KV entries are valid, and the
+                # last proposal becomes the next round's feed
+                emitted.extend(props)
+                last_tok = props[-1]
+            else:
+                # accept the matching prefix, emit the target's own
+                # argmax at the first mismatch, rewind both sessions
+                # past the garbage KV (masked until overwritten)
+                emitted.extend(props[:n_acc])
+                last_tok = int(argmax[n_acc])
+                emitted.append(last_tok)
+                tgt.pos = t_pos0 + 1 + n_acc
+            drf.pos = d_pos0 + 1 + min(n_acc, k - 1)
+        return np.asarray(emitted[:n_tokens], np.int64)

@@ -42,7 +42,14 @@ class _BoundedSession:
         self.batch = int(batch)
         self.pos = 0
         self._step_cache = {}
-        self._gen_cache = {}      # (n_tokens, temperature) -> program
+        # (n_tokens, greedy?) -> program. Temperature is a TRACED
+        # operand of the fused program, never part of the key: a
+        # float key would compile one executable per distinct
+        # temperature, so per-request jitter (0.7 vs 0.7000001)
+        # churns executables without bound (the GL002 recompile
+        # hazard). Only the greedy/sampled STRUCTURE is static —
+        # greedy has no RNG carry to thread.
+        self._gen_cache = {}
 
     def _fn_for(self, t: int):
         fn = self._step_cache.get(t)
@@ -85,19 +92,29 @@ class _BoundedSession:
         return 1
 
     @staticmethod
-    def _sample(last, temp, key):
-        """(next_ids, new_key). ONE implementation for the unfused
-        loop and the fused scan body — their id-parity contract
-        (tested) depends on bitwise-identical sampling."""
-        if temp > 0:
-            key, sub = jax.random.split(key)
-            # output layers emit probabilities (softmax applied):
-            # sample in log space
-            nxt = jax.random.categorical(
-                sub, jnp.log(last + 1e-9) / temp, axis=-1)
-        else:
-            nxt = jnp.argmax(last, axis=-1)
+    def _sample_greedy(last):
+        return jnp.argmax(last, axis=-1)
+
+    @staticmethod
+    def _sample_temp(last, temp, key):
+        key, sub = jax.random.split(key)
+        # output layers emit probabilities (softmax applied):
+        # sample in log space. ``temp`` may be a traced scalar (the
+        # fused program) or a python float (the unfused loop) — the
+        # math is identical either way, which is what the fused/
+        # unfused id-parity contract (tested) rests on.
+        nxt = jax.random.categorical(
+            sub, jnp.log(last + 1e-9) / temp, axis=-1)
         return nxt, key
+
+    @staticmethod
+    def _sample(last, temp, key):
+        """(next_ids, new_key) for a CONCRETE temperature — the
+        unfused loop's dispatcher over the two shared sampling
+        bodies."""
+        if temp > 0:
+            return _BoundedSession._sample_temp(last, temp, key)
+        return _BoundedSession._sample_greedy(last), key
 
     def generate(self, prompt, n_tokens: int, *,
                  temperature: float = 0.0, rng_key=None,
@@ -115,7 +132,9 @@ class _BoundedSession:
         caches as carries): a single device dispatch replaces
         n_tokens of them — the difference dominates when dispatch
         latency is high (e.g. a tunnel'd chip). One compile per
-        (n_tokens, temperature); identical ids to the unfused path
+        (n_tokens, greedy-vs-sampled) — the temperature itself is a
+        traced operand, so per-request temperature jitter reuses one
+        executable; identical ids to the unfused path
         for the same rng_key (tested). Needs
         ``capacity >= T0 + n_tokens`` fused (the last sampled token
         is written to cache) vs ``T0 + n_tokens - 1`` unfused."""
@@ -157,15 +176,21 @@ class _BoundedSession:
 
     def _generate_fused(self, last, n_tokens, temp, rng_key):
         params, lstates = self._model_params()
-        prog = self._gen_cache.get((n_tokens, temp))
+        greedy = temp <= 0
+        prog = self._gen_cache.get((n_tokens, greedy))
         if prog is None:
             feed = self._fused_ctx()
-            def program(params, lstates, states, pos, last, key):
-                sample = self._sample
+            sample_greedy = self._sample_greedy
+            sample_temp = self._sample_temp
 
+            def program(params, lstates, states, pos, last, key,
+                        temp):
                 def body(carry, _):
                     states, pos, last, key = carry
-                    nxt, key = sample(last, temp, key)
+                    if greedy:       # static: chosen at trace time
+                        nxt = sample_greedy(last)
+                    else:
+                        nxt, key = sample_temp(last, temp, key)
                     x = nxt[:, None, None].astype(jnp.float32)
                     h, states = feed(params, lstates, states, pos, x)
                     return (states, pos + 1, h[:, 0], key), nxt
@@ -175,10 +200,11 @@ class _BoundedSession:
                     length=n_tokens)
                 return jnp.swapaxes(ids, 0, 1), states
 
-            prog = self._gen_cache[(n_tokens, temp)] = jax.jit(
+            prog = self._gen_cache[(n_tokens, greedy)] = jax.jit(
                 program, donate_argnums=(2,))
         ids, self._states = prog(params, lstates, self._states,
-                                 jnp.int32(self.pos), last, rng_key)
+                                 jnp.int32(self.pos), last, rng_key,
+                                 jnp.float32(temp))
         self.pos += n_tokens
         return ids
 

@@ -275,6 +275,74 @@ class SelfAttentionLayer(BaseLayer):
             proj = proj + params["bo"]
         return proj, {"k": k_cache, "v": v_cache}
 
+    # ---- paged (block) KV cache: the vLLM memory model over the
+    #      same math as apply_stream_bounded. The session owns ONE
+    #      physical pool of fixed-size pages per layer; each slot sees
+    #      a VIRTUAL contiguous cache assembled by gathering its page
+    #      table — so KV memory is bounded by the pool, not by
+    #      slots x max-capacity (models/paged_kv.py) ----
+    def zero_page_pool(self, n_pages: int, page_size: int, dtype):
+        """Physical page pool for this layer: ``zero_stream_cache``
+        with (batch, capacity) = (n_pages, page_size) — a page IS a
+        page_size-token cache row."""
+        return self.zero_stream_cache(n_pages, page_size, dtype)
+
+    def apply_stream_paged(self, params, pool, table, pos, x):
+        """One jittable decode step over paged caches for ALL slots at
+        once. ``x`` is the new (S, t, C) chunk (one row per slot),
+        ``pool`` the physical {'k','v'} pages of shape
+        (n_pages, page_size, H, Dh), ``table`` the (S, P) per-slot
+        page table, ``pos`` the (S,) per-slot token positions. Writes
+        each slot's new k/v at its (page, offset) — scatter indices
+        are unique because written pages are slot-exclusive (shared
+        prefix pages are read-only; divergence is copy-on-write at
+        admission, host-side) — then attends each slot's queries over
+        its GATHERED virtual cache of P*page_size positions with the
+        same k_pos <= q_pos mask as the dense step. With
+        P*page_size == dense capacity the math is position-for-
+        position identical to apply_stream_bounded (greedy-token
+        parity is tested). Returns (out, pool)."""
+        if not self.causal:
+            raise ValueError(
+                "apply_stream_paged requires causal=True: streaming "
+                "non-causal attention would need future timesteps")
+        S, t, _ = x.shape
+        ps = pool["k"].shape[1]
+        q, k, v = self._project_qkv(params, x)
+        # write positions for the t new tokens of every slot
+        wpos = pos[:, None] + jnp.arange(t)[None, :]        # (S, t)
+        page_ids = jnp.take_along_axis(table, wpos // ps, axis=1)
+        offs = wpos % ps
+        k_pool = pool["k"].at[page_ids, offs].set(
+            k.astype(pool["k"].dtype))
+        v_pool = pool["v"].at[page_ids, offs].set(
+            v.astype(pool["v"].dtype))
+        # gather each slot's virtual cache: (S, P, ps, H, Dh) ->
+        # (S, P*ps, H, Dh). Stale/unassigned table entries gather
+        # garbage pages, but their virtual positions exceed pos and
+        # the mask zeroes them exactly (exp(_NEG_INF - max) == 0.0)
+        P = table.shape[1]
+        H = self.n_heads
+        Dh = self.n_out // H
+        k_cache = k_pool[table].reshape(S, P * ps, H, Dh)
+        v_cache = v_pool[table].reshape(S, P * ps, H, Dh)
+        scale = q.shape[-1] ** -0.5
+        from deeplearning4j_tpu.ops.attention import _NEG_INF
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q,
+                            k_cache.astype(q.dtype)) * scale
+        k_pos = jnp.arange(P * ps)[None, None, :]           # (1,1,K)
+        q_pos = wpos[:, :, None]                            # (S,t,1)
+        logits = jnp.where((k_pos <= q_pos)[:, None], logits,
+                           _NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         v_cache.astype(q.dtype))
+        out = out.reshape(S, t, self.n_out)
+        proj = out @ params["Wo"]
+        if self.out_bias:
+            proj = proj + params["bo"]
+        return proj, {"k": k_pool, "v": v_pool}
+
 
 @register_layer
 @dataclasses.dataclass
@@ -370,6 +438,20 @@ class TransformerEncoderLayer(BaseLayer):
                                                    cache, h, pos)
         x = x + a
         return x + self._mlp_half(params, x), cache
+
+    def zero_page_pool(self, n_pages: int, page_size: int, dtype):
+        return self._ensure_attn().zero_page_pool(n_pages, page_size,
+                                                  dtype)
+
+    def apply_stream_paged(self, params, pool, table, pos, x):
+        """Paged-cache decode step through the pre-LN block (see
+        SelfAttentionLayer.apply_stream_paged)."""
+        self._ensure_attn()
+        h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        a, pool = self._attn.apply_stream_paged(params["attn"], pool,
+                                                table, pos, h)
+        x = x + a
+        return x + self._mlp_half(params, x), pool
 
 
 def _stream_attention(q, k_full, v_full, n_cached: int):

@@ -21,6 +21,7 @@ _EXPORTS = {
     "CircuitOpenError": "errors",
     "ReplicaGoneError": "errors",
     "NoReplicaAvailableError": "errors",
+    "KVPagePoolExhaustedError": "errors",
     "CircuitBreaker": "lifecycle",
     "LatencyHistogram": "metrics",
     "EndpointMetrics": "metrics",

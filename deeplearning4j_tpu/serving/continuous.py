@@ -19,6 +19,18 @@ temperature with a per-request seeded RNG), which keeps per-request
 sampling parameters out of the compiled program; each slot's logits
 are bitwise independent of its neighbours (vmapped B=1 math —
 slot-reuse parity against a sequential decode is tested).
+
+Since the decode-fast-path PR the KV state behind the slots is PAGED
+by default (``kv_mode="auto"``): transformer-style models get a
+:class:`~deeplearning4j_tpu.models.paged_kv.PagedSlotSession` — a
+refcounted page pool with per-slot page tables, so admission asks the
+ALLOCATOR (pages for this request's ``prompt + n_tokens`` worst
+case) instead of a per-slot capacity bucket, and slot count is
+bounded by total KV memory. Repeated prompts hit the prefix cache
+and skip the cached part of prefill entirely (the phase ledger
+records ``prefix_hit_tokens``). Models with recurrent carries fall
+back to the dense session (``kv_mode="dense"`` forces it; greedy
+tokens are bit-identical either way — tested).
 """
 
 from __future__ import annotations
@@ -31,7 +43,7 @@ import numpy as np
 
 from deeplearning4j_tpu import chaos
 from deeplearning4j_tpu.observability.tracing import RequestContext
-from deeplearning4j_tpu.serving.errors import DeadlineExceededError
+from deeplearning4j_tpu.serving.errors import KVPagePoolExhaustedError
 from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
                                                   CircuitBreaker,
                                                   ServingBackend)
@@ -53,12 +65,17 @@ class _GenRequest(BaseRequest):
 
 class _Slot:
     __slots__ = ("req", "feed", "prompt_left", "out", "rng",
-                 "t_slotted", "t_last_token")
+                 "t_slotted", "t_last_token", "prefix_hit")
 
-    def __init__(self, req: _GenRequest):
+    def __init__(self, req: _GenRequest, resume: int = 0):
+        # ``resume``: prompt positions [0, resume) are already in the
+        # KV cache (a prefix-cache hit) — prefill starts at the
+        # resume token instead of token 0
         self.req = req
-        self.feed = int(req.prompt[0])
-        self.prompt_left = list(int(t) for t in req.prompt[1:])
+        self.feed = int(req.prompt[resume])
+        self.prompt_left = list(int(t)
+                                for t in req.prompt[resume + 1:])
+        self.prefix_hit = int(resume)
         self.out: List[int] = []
         self.rng = (np.random.default_rng(req.seed)
                     if req.temperature > 0 else None)
@@ -80,12 +97,42 @@ class ContinuousBatcher(ServingBackend):
                  metrics: Optional[ServingMetrics] = None,
                  name: str = "generate", dtype=None,
                  breaker: Optional[CircuitBreaker] = None,
-                 version: str = "0"):
+                 version: str = "0", kv_mode: str = "auto",
+                 page_size: int = 16,
+                 kv_pages: Optional[int] = None):
+        if kv_mode not in ("auto", "paged", "dense"):
+            raise ValueError(
+                f"kv_mode must be auto|paged|dense, got {kv_mode!r}")
         super().__init__("contbatch", name, queue_limit, slots,
                          metrics, breaker=breaker)
+        self._paged = False
         try:
-            self.session = net.slot_streaming_session(
-                capacity=capacity, slots=slots, dtype=dtype)
+            session = None
+            if kv_mode in ("auto", "paged") and hasattr(
+                    net, "paged_slot_streaming_session"):
+                from deeplearning4j_tpu.models.paged_kv import (
+                    PagedSlotSession)
+                # auto's dense fallback keys on the SUPPORT predicate
+                # only — a real construction error (bad page_size /
+                # kv_pages) must surface, not silently select dense
+                if PagedSlotSession.supports(net):
+                    session = net.paged_slot_streaming_session(
+                        capacity=capacity, slots=slots,
+                        page_size=page_size, n_pages=kv_pages,
+                        dtype=dtype)
+                    self._paged = True
+                elif kv_mode == "paged":
+                    # build anyway for the layer-naming ValueError
+                    net.paged_slot_streaming_session(
+                        capacity=capacity, slots=slots,
+                        page_size=page_size, n_pages=kv_pages,
+                        dtype=dtype)
+            if session is None:
+                session = net.slot_streaming_session(
+                    capacity=capacity, slots=slots, dtype=dtype)
+            self.session = session
+            if self._paged:
+                self._register_kv_metrics()
         except BaseException:
             # super().__init__ already registered the queue-depth and
             # circuit-state gauges; a failed construction must not
@@ -106,6 +153,65 @@ class ContinuousBatcher(ServingBackend):
         # a queue.Queue cannot be inspected without draining it
         self._pending: List[_GenRequest] = []
         self._start_worker()
+
+    # ---- paged-KV observability ----
+    def _register_kv_metrics(self) -> None:
+        """Pool gauges + prefix-cache counters, Prometheus-named on
+        the shared registry and mirrored into the JSON gauges
+        snapshot (what the fleet router's prober reads)."""
+        reg = self.metrics.registry
+        lbl = {"endpoint": self.name}
+        sess = self.session
+        reg.gauge("kv_pages_in_use",
+                  help="KV cache pages currently referenced",
+                  labels=lbl, fn=sess.pages_in_use)
+        reg.gauge("kv_pages_total",
+                  help="KV cache pages in the pool",
+                  labels=lbl, fn=sess.pages_total)
+        self._prefix_hits = reg.counter(
+            "prefix_cache_hits_total",
+            help="admissions that reused cached prompt-prefix pages",
+            labels=lbl)
+        self._prefix_evictions = reg.counter(
+            "prefix_cache_evictions_total",
+            help="prefix-cache entries LRU-evicted under page "
+                 "pressure", labels=lbl)
+        self._evictions_seen = 0
+        self.metrics.register_gauge(f"{self.name}_kv_pages_in_use",
+                                    sess.pages_in_use)
+        self.metrics.register_gauge(f"{self.name}_kv_pages_total",
+                                    sess.pages_total)
+
+    def _unregister_gauges(self) -> None:
+        super()._unregister_gauges()
+        if self._paged:
+            self.metrics.unregister_gauge(
+                f"{self.name}_kv_pages_in_use")
+            self.metrics.unregister_gauge(
+                f"{self.name}_kv_pages_total")
+            lbl = {"endpoint": self.name}
+            self.metrics.registry.unregister("kv_pages_in_use",
+                                             labels=lbl)
+            self.metrics.registry.unregister("kv_pages_total",
+                                             labels=lbl)
+
+    def _sync_evictions(self) -> None:
+        # evictions happen inside the allocator mid-reserve; bridge
+        # the cache's plain count onto the registry counter
+        ev = self.session.prefix_cache.evictions_total
+        if ev > self._evictions_seen:
+            self._prefix_evictions.inc(ev - self._evictions_seen)
+            self._evictions_seen = ev
+
+    def _release_slot(self, i: int, register: bool = False) -> None:
+        """Recycle slot ``i``: for paged sessions drop its page
+        references — registering its prompt's full pages in the
+        prefix cache first when the stream completed cleanly."""
+        s = self._slots[i]
+        if self._paged and s is not None:
+            self.session.release(
+                i, register_prompt=s.req.prompt if register else None)
+        self._slots[i] = None
 
     # ---- admission ----
     def submit(self, prompt, n_tokens: int, temperature: float = 0.0,
@@ -137,6 +243,18 @@ class ContinuousBatcher(ServingBackend):
             raise ValueError(
                 f"prompt ({prompt.size}) + n_tokens ({n_tokens}) "
                 f"exceeds slot capacity {self.capacity}")
+        if self._paged and not self.session.can_ever_fit(
+                prompt.size, n_tokens):
+            # admission asks the allocator: a request whose worst
+            # case exceeds the WHOLE pool can never be admitted —
+            # that is a client error, not transient pressure (which
+            # keeps the request pending at slotting time, deadline
+            # enforced — see KVPagePoolExhaustedError)
+            raise ValueError(
+                f"prompt ({prompt.size}) + n_tokens ({n_tokens}) "
+                f"needs more KV pages than the whole pool "
+                f"({self.session.pages_total()} pages of "
+                f"{self.session.page_size} tokens)")
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
         if ctx is None:
@@ -184,13 +302,9 @@ class ContinuousBatcher(ServingBackend):
         keep = []
         for r in self._pending:
             if r.deadline is not None and now > r.deadline:
-                self._endpoint.count_expired()
-                r.error = DeadlineExceededError(
-                    "generate request deadline expired while queued "
-                    "(decoding never started)")
-                if r.ctx is not None:
-                    r.ctx.set_error(r.error)
-                r.event.set()
+                self._fail_expired(
+                    r, "generate request deadline expired while "
+                       "queued (decoding never started)")
             else:
                 keep.append(r)
         self._pending = keep
@@ -200,14 +314,43 @@ class ContinuousBatcher(ServingBackend):
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
                 return
-            r = self._pending.pop(0)
-            self.session.reset_slot(free[0])
+            resume = 0
+            if self._paged:
+                # admission asks the allocator: pages for this
+                # request's worst case, reusing cached prefix pages.
+                # Transient exhaustion leaves the request pending
+                # (FIFO — no starvation of big requests); its
+                # deadline keeps being enforced meanwhile
+                try:
+                    lease = self.session.reserve(
+                        self._pending[0].prompt,
+                        self._pending[0].n_tokens)
+                except KVPagePoolExhaustedError:
+                    return
+                r = self._pending.pop(0)
+                self.session.bind(free[0], lease)
+                resume = lease.resume_pos
+                if lease.prefix_hit_tokens:
+                    self._prefix_hits.inc()
+                self._sync_evictions()
+            else:
+                r = self._pending.pop(0)
+                self.session.reset_slot(free[0])
             if r.ctx is not None:
                 # slotted: queue_wait ends, prefill begins (prompt
-                # tokens ride the decode steps teacher-forced)
+                # tokens ride the decode steps teacher-forced; a
+                # prefix-cache hit resumes AFTER the cached tokens —
+                # the ledger records how many were skipped)
+                attrs = {"slot": free[0]}
+                if resume:
+                    attrs["prefix_hit_tokens"] = resume
+                # the ledger attr ALSO lands on the context so the
+                # /debug/requests completion ring can assert a
+                # prefix hit without a sampled span
+                r.ctx.attrs["prefix_hit_tokens"] = resume
                 r.ctx.phase_done("queue_wait", now_in="prefill",
-                                 attrs={"slot": free[0]})
-            self._slots[free[0]] = _Slot(r)
+                                 attrs=attrs)
+            self._slots[free[0]] = _Slot(r, resume)
 
     @staticmethod
     def _sample(probs: np.ndarray, slot: _Slot) -> int:
@@ -253,7 +396,7 @@ class ContinuousBatcher(ServingBackend):
                         self._endpoint.count_error()
                         s.req.error = e
                         s.req.event.set()
-                        self._slots[i] = None
+                        self._release_slot(i)
                 raise
             try:
                 h = np.asarray(self.session.step_slots(x, active))
@@ -269,7 +412,7 @@ class ContinuousBatcher(ServingBackend):
                         self._endpoint.count_error()
                         s.req.error = e
                         s.req.event.set()
-                        self._slots[i] = None
+                        self._release_slot(i)
                 try:
                     self.session.reinit_states()
                 except BaseException:
@@ -295,7 +438,7 @@ class ContinuousBatcher(ServingBackend):
                     self._endpoint.count_error()
                     s.req.error = e
                     s.req.event.set()
-                    self._slots[i] = None
+                    self._release_slot(i)
                     continue
                 s.out.append(nxt)
                 now_t = time.monotonic()
@@ -323,7 +466,10 @@ class ContinuousBatcher(ServingBackend):
                             "decode", now_in="respond",
                             attrs={"tokens": len(s.out)})
                     s.req.event.set()
-                    self._slots[i] = None    # slot recycled next admit
+                    # slot recycled next admit; a cleanly-finished
+                    # stream donates its full-prompt pages to the
+                    # prefix cache
+                    self._release_slot(i, register=True)
                 else:
                     s.feed = nxt
 
@@ -342,20 +488,44 @@ class ContinuousBatcher(ServingBackend):
                      "state": "prefill" if s.prompt_left else "decode",
                      "tokens_out": len(s.out),
                      "prompt_left": len(s.prompt_left),
+                     "prefix_hit_tokens": s.prefix_hit,
                      "age_ms": round((now - s.t_slotted) * 1e3, 3)}
+            if self._paged:
+                entry["kv_pages"] = self.session.slot_pages(i)
             if s.req.ctx is not None:
                 entry["trace_id"] = s.req.ctx.trace_id
                 entry["sampled"] = s.req.ctx.sampled
             out.append(entry)
         return out
 
+    def kv_debug(self) -> Optional[dict]:
+        """Pool + prefix-cache state for ``/debug/slots`` (None on
+        the dense path)."""
+        if not self._paged:
+            return None
+        sess = self.session
+        return {"page_size": sess.page_size,
+                "kv_pages_total": sess.pages_total(),
+                "kv_pages_in_use": sess.pages_in_use(),
+                "pages_per_slot": sess.pages_per_slot,
+                "prefix_cache_entries": len(sess.prefix_cache),
+                "prefix_cache_hits_total":
+                    sess.prefix_cache.hits_total,
+                "prefix_cache_evictions_total":
+                    sess.prefix_cache.evictions_total}
+
     def _crash_casualties(self):
         # only streams mid-decode die with the crash; _pending
         # (admitted, never slotted — _pump drains the queue
         # aggressively, so queued work effectively lives here) is
-        # served by the restarted loop
-        casualties = [s.req for s in self._slots if s is not None]
-        self._slots = [None] * self.slots
+        # served by the restarted loop. Their page leases are
+        # released HERE (host-side bookkeeping, safe in the crash
+        # handler) so refcounts cannot leak across a worker restart
+        casualties = []
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                casualties.append(s.req)
+                self._release_slot(i)
         return casualties
 
     def _abort_inflight(self):

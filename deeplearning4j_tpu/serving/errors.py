@@ -16,7 +16,7 @@ serving stack, and ``serving/__init__`` re-exports lazily.
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
            "ModelNotFoundError", "ServerClosedError",
            "CircuitOpenError", "ReplicaGoneError",
-           "NoReplicaAvailableError"]
+           "NoReplicaAvailableError", "KVPagePoolExhaustedError"]
 
 
 class ServingError(RuntimeError):
@@ -40,6 +40,21 @@ class QueueFullError(ServingError):
     """Admission control rejected the request: the bounded queue is at
     its limit. Load-shedding semantics — the caller should back off
     and retry, not block (HTTP maps this to 429)."""
+
+
+class KVPagePoolExhaustedError(QueueFullError):
+    """The paged KV allocator has no free pages for this reservation
+    (models/paged_kv.py). Raised by ``PagedKVAllocator.alloc`` /
+    ``PagedSlotSession.reserve`` with a ``retry_after_s`` hint scaled
+    to the shortfall; as a ``QueueFullError`` subclass it maps to
+    429 + Retry-After for callers driving sessions directly.
+    ``ContinuousBatcher`` deliberately ABSORBS it at slotting time —
+    transient pool pressure parks the request in the pending list
+    with its deadline still enforced (so the client sees success or
+    a 504, while the bounded queue keeps backlog shed as 429s) —
+    because active decodes free pages on their own. A request whose
+    worst case exceeds the WHOLE pool can never be admitted and is a
+    client error instead (ValueError at submit)."""
 
 
 class DeadlineExceededError(ServingError):

@@ -161,7 +161,9 @@ class ModelServer:
                  metrics: Optional[ServingMetrics] = None,
                  alerts=None, sample_rate: float = 0.01,
                  sample_routes: Optional[Dict[str, float]] = None,
-                 slow_ms: float = 250.0, slos=None, tracer=None):
+                 slow_ms: float = 250.0, slos=None, tracer=None,
+                 kv_mode: str = "auto", page_size: int = 16,
+                 kv_pages: Optional[int] = None):
         self.registry = registry or ModelRegistry()
         self.metrics = metrics or ServingMetrics()
         # optional observability.AlertManager: while any rule fires,
@@ -193,6 +195,12 @@ class ModelServer:
         self.wait_ms = wait_ms
         self.slots = slots
         self.capacity = capacity
+        # paged-KV decode knobs (models/paged_kv.py): "auto" gives
+        # transformer models the paged session + prefix cache and
+        # falls back to dense for recurrent models
+        self.kv_mode = kv_mode
+        self.page_size = page_size
+        self.kv_pages = kv_pages
         self._schedulers: Dict[Tuple[str, int], BatchScheduler] = {}
         self._batchers: Dict[Tuple[str, int], ContinuousBatcher] = {}
         self._lock = threading.Lock()
@@ -271,7 +279,8 @@ class ModelServer:
                 model, slots=self.slots, capacity=self.capacity,
                 queue_limit=self.queue_limit, metrics=self.metrics,
                 name=f"generate/{name}/v{version}",
-                version=str(version)))
+                version=str(version), kv_mode=self.kv_mode,
+                page_size=self.page_size, kv_pages=self.kv_pages))
         return b, version
 
     # ---- HTTP plumbing ----
@@ -519,6 +528,13 @@ class ModelServer:
                  "duration_ms": round(total_s * 1e3, 3),
                  "phases_ms": {k: round(v * 1e3, 3)
                                for k, v in ctx.phases.items()},
+                 # scalar phase attrs (slot, prefix_hit_tokens,
+                 # model_version, ...) make the completion ring
+                 # assertable: "did the second identical prompt skip
+                 # prefill" is attrs["prefix_hit_tokens"], not a
+                 # timing heuristic
+                 "attrs": {k: v for k, v in ctx.attrs.items()
+                           if isinstance(v, (int, float, str, bool))},
                  "sampled": ctx.sampled,
                  "slow": total_s * 1e3 >= self.slow_ms
                  or code >= 400,
@@ -544,14 +560,21 @@ class ModelServer:
                     self.metrics.latency_attribution()}
 
     def debug_slots(self) -> dict:
-        """Continuous-batching slot states per generate backend."""
+        """Continuous-batching slot states per generate backend,
+        with the paged-KV pool and prefix-cache state when the
+        backend decodes over page tables."""
         with self._lock:
             batchers = dict(self._batchers)
-        return {"backends": {
-            b.name: {"active_slots": b.active_slots(),
+        out = {}
+        for b in batchers.values():
+            entry = {"active_slots": b.active_slots(),
                      "pending": len(b._pending),
                      "slots": b.slots_debug()}
-            for b in batchers.values()}}
+            kv = b.kv_debug()
+            if kv is not None:
+                entry["kv"] = kv
+            out[b.name] = entry
+        return {"backends": out}
 
     def debug_traces(self) -> dict:
         """Recent slow/errored traces with their phase breakdown —

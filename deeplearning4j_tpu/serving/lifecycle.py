@@ -34,6 +34,7 @@ import time
 from typing import Callable, List, Optional
 
 from deeplearning4j_tpu.serving.errors import (CircuitOpenError,
+                                               DeadlineExceededError,
                                                QueueFullError,
                                                ServerClosedError)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
@@ -373,6 +374,19 @@ class ServingBackend:
                 "being admitted")
             r.event.set()
         return r
+
+    def _fail_expired(self, r: BaseRequest, detail: str) -> None:
+        """Deadline expiry for work that never started: count it,
+        deliver the typed error, promote the trace, wake the waiter
+        — ONE implementation for both backends (the scheduler's
+        queue sweep and the batcher's pending sweep), so the
+        always-sample-on-expiry and counter semantics cannot
+        drift."""
+        self._endpoint.count_expired()
+        r.error = DeadlineExceededError(detail)
+        if r.ctx is not None:
+            r.ctx.set_error(r.error)
+        r.event.set()
 
     def wait(self, r: BaseRequest):
         r.event.wait()

@@ -106,12 +106,18 @@ class _ReplicaView:
     __slots__ = ("rid", "url", "breaker", "health", "queue_depth",
                  "circuits", "inflight", "consecutive_failures",
                  "unavailable_until", "probe_ok_total", "ejections",
-                 "readmissions")
+                 "readmissions", "kv_pages_in_use", "kv_pages_total")
 
     def __init__(self, rid: int, url: str, breaker: CircuitBreaker):
         self.rid = rid
         self.url = url
         self.breaker = breaker
+        # paged-KV decode pressure (summed over the replica's
+        # generate backends), refreshed by the same /metrics probe
+        # as queue_depth — the /fleet debug surface for "which
+        # replica is out of KV memory"
+        self.kv_pages_in_use = 0.0
+        self.kv_pages_total = 0.0
         # probed: ok|degraded|draining|dead. Starts NOT-eligible:
         # "eligible" must mean probe-confirmed, or a readiness gate
         # polling /healthz right after start() would pass while the
@@ -345,7 +351,7 @@ class Router:
         """One active health check: classify, refresh load signals,
         and spend the half-open probe budget on ejected replicas."""
         ok, health, circuits = self._check_ready(view.url)
-        depth = self._read_queue_depth(view.url) if ok or health \
+        load = self._read_load_signals(view.url) if ok or health \
             else None
         st = view.breaker.state
         if st == CircuitBreaker.HALF_OPEN:
@@ -386,8 +392,10 @@ class Router:
                 self._note_failure(view)
         with self._lock:
             view.health = health if health is not None else "dead"
-            if depth is not None:
-                view.queue_depth = depth
+            if load is not None:
+                view.queue_depth = load["queue_depth"]
+                view.kv_pages_in_use = load["kv_pages_in_use"]
+                view.kv_pages_total = load["kv_pages_total"]
             view.circuits = circuits
             if ok:
                 view.probe_ok_total += 1
@@ -415,7 +423,11 @@ class Router:
             return False, "draining", circuits
         return status == 200, health, circuits
 
-    def _read_queue_depth(self, url: str) -> Optional[float]:
+    def _read_load_signals(self, url: str) -> Optional[dict]:
+        """Queue depth + paged-KV pool pressure from one /metrics
+        snapshot (None when unreachable): the ``*_queue_depth``,
+        ``*_kv_pages_in_use`` and ``*_kv_pages_total`` gauges summed
+        over the replica's backends."""
         try:
             status, body, _ = _http_call(
                 url, "GET", "/metrics", timeout=self.probe_timeout_s)
@@ -425,12 +437,15 @@ class Router:
         except (_NetError, ValueError):
             return None
         gauges = snap.get("gauges") or {}
-        total = 0.0
+        out = {"queue_depth": 0.0, "kv_pages_in_use": 0.0,
+               "kv_pages_total": 0.0}
         for name, value in gauges.items():
-            if name.endswith("_queue_depth") \
-                    and isinstance(value, (int, float)):
-                total += value
-        return total
+            if not isinstance(value, (int, float)):
+                continue
+            for suffix in out:
+                if name.endswith("_" + suffix):
+                    out[suffix] += value
+        return out
 
     def _probe_all(self) -> None:
         """One whole probe pass, replicas probed CONCURRENTLY: a
@@ -1093,6 +1108,8 @@ class Router:
              "health": v.health,
              "breaker": v.breaker.state,
              "queue_depth": v.queue_depth,
+             "kv_pages_in_use": v.kv_pages_in_use,
+             "kv_pages_total": v.kv_pages_total,
              "inflight": v.inflight,
              "consecutive_failures": v.consecutive_failures}
             for v in sorted(views, key=lambda v: v.rid)]}

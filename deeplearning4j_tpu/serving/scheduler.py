@@ -27,7 +27,6 @@ from deeplearning4j_tpu import chaos
 from deeplearning4j_tpu.observability.tracing import RequestContext
 from deeplearning4j_tpu.parallel.inference import (
     pow2_pad_rows, serve_batch_with_retry)
-from deeplearning4j_tpu.serving.errors import DeadlineExceededError
 from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
                                                   CircuitBreaker,
                                                   ServingBackend)
@@ -180,15 +179,10 @@ class BatchScheduler(ServingBackend):
         return leftovers
 
     def _expire(self, r: _Request) -> None:
-        self._endpoint.count_expired()
-        r.error = DeadlineExceededError(
-            f"request deadline expired after "
-            f"{time.monotonic() - r.t_submit:.3f}s in the "
-            f"{self.name!r} queue (work was never started)")
-        if r.ctx is not None:
-            # always-sample on deadline-exceeded
-            r.ctx.set_error(r.error)
-        r.event.set()
+        self._fail_expired(
+            r, f"request deadline expired after "
+               f"{time.monotonic() - r.t_submit:.3f}s in the "
+               f"{self.name!r} queue (work was never started)")
 
     def _serve(self, items: List[_Request]) -> None:
         now = time.monotonic()

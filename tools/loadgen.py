@@ -21,6 +21,16 @@ Two loop disciplines (the classic load-testing split):
   backlog models client impatience — overflow counts as
   ``not_sent`` rather than silently stretching the schedule.
 
+Streaming mode (``--mode generate``) drives ``/v1/generate`` with a
+configurable **duplicate-prompt ratio**: that fraction of requests
+reuses one shared prompt, the rest get unique prompts — the traffic
+shape that makes prefix-cache wins measurable through the router.
+After the run the report includes TTFT / inter-token percentiles
+scraped from the server's own ``serving_ttft_seconds`` /
+``serving_itl_seconds`` histograms (``--metrics-url``, defaulting to
+the target), so the latency attribution comes from the serving
+stack's instruments, not a client-side proxy.
+
 Usage (library)::
 
     from tools.loadgen import LoadGen
@@ -30,6 +40,8 @@ CLI::
 
     python -m tools.loadgen --url http://127.0.0.1:8080 \
         --qps 200 --duration 30 --concurrency 32
+    python -m tools.loadgen --url http://127.0.0.1:8080 \
+        --mode generate --dup-ratio 0.5 --total 200 --n-tokens 16
 """
 
 from __future__ import annotations
@@ -44,11 +56,100 @@ import urllib.error
 import urllib.request
 from typing import Callable, Dict, Optional
 
-__all__ = ["LoadGen"]
+__all__ = ["LoadGen", "generate_body_fn", "scrape_streaming_latency"]
 
 
 def _default_body(i: int) -> dict:
     return {"model": "default", "inputs": [[0.0, 1.0, 2.0, 3.0]]}
+
+
+def generate_body_fn(model: str = "default", prompt_len: int = 16,
+                     n_tokens: int = 16, vocab: int = 64,
+                     dup_ratio: float = 0.0) -> Callable[[int], dict]:
+    """Body factory for ``/v1/generate`` streaming load:
+    deterministically, ``dup_ratio`` of requests (by ordinal) send
+    ONE shared prompt — prefix-cache hits after the first completes
+    — and the rest send unique prompts (cold prefill). Prompt ids
+    stay in ``[1, vocab)``."""
+    dup_per_100 = int(round(max(0.0, min(1.0, dup_ratio)) * 100))
+    span = max(1, vocab - 1)
+    shared = [1 + (7 * j) % span for j in range(prompt_len)]
+
+    def body(i: int) -> dict:
+        if (i * 37) % 100 < dup_per_100:     # deterministic spread
+            prompt = shared
+        else:
+            prompt = [1 + (i + 3 * j) % span
+                      for j in range(prompt_len)]
+        return {"model": model, "prompt": prompt,
+                "n_tokens": n_tokens}
+
+    return body
+
+
+def _histogram_quantiles(buckets: Dict[float, float], count: float):
+    """p50/p95/p99 from cumulative Prometheus buckets (upper-edge
+    estimate, matching how coarse scrape-side quantiles are always
+    read)."""
+    out = {}
+    edges = sorted(buckets)
+    finite = [e for e in edges if e != float("inf")]
+    for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+        target = q * count
+        val = None
+        for e in edges:
+            if buckets[e] >= target:
+                val = e
+                break
+        if val is None or val == float("inf"):
+            # an observation above every finite bucket: report the
+            # highest finite edge (the standard scrape-side clamp)
+            val = finite[-1] if finite else 0.0
+        out[name] = round(val * 1e3, 3)
+    return out
+
+
+def scrape_streaming_latency(url: str,
+                             timeout_s: float = 5.0) -> dict:
+    """TTFT / inter-token latency percentiles from a server's OWN
+    metrics: parses the Prometheus exposition's
+    ``serving_ttft_seconds`` / ``serving_itl_seconds`` histograms
+    (buckets summed across model versions). Returns
+    ``{metric: {count, p50, p95, p99}}`` in milliseconds."""
+    req = urllib.request.Request(
+        url.rstrip("/") + "/metrics?format=prometheus")
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        text = r.read().decode()
+    out = {}
+    for metric in ("serving_ttft_seconds", "serving_itl_seconds"):
+        buckets: Dict[float, float] = {}
+        count = 0.0
+        for line in text.splitlines():
+            if not line.startswith(metric):
+                continue
+            rest = line[len(metric):]
+            try:
+                value = float(line.rsplit(" ", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if rest.startswith("_bucket"):
+                marker = 'le="'
+                at = line.find(marker)
+                if at < 0:
+                    continue
+                le = line[at + len(marker):line.index('"', at
+                                                      + len(marker))]
+                edge = float("inf") if le in ("+Inf", "inf") \
+                    else float(le)
+                buckets[edge] = buckets.get(edge, 0.0) + value
+            elif rest.startswith("_count"):
+                count += value
+        entry = {"count": int(count)}
+        entry.update(_histogram_quantiles(buckets, count)
+                     if count else {"p50": 0.0, "p95": 0.0,
+                                    "p99": 0.0})
+        out[metric] = entry
+    return out
 
 
 class LoadGen:
@@ -272,11 +373,33 @@ def main(argv=None):
                     "serving router / ModelServer")
     p.add_argument("--url", required=True,
                    help="base URL (router or replica)")
-    p.add_argument("--route", default="/v1/predict")
+    p.add_argument("--route", default=None,
+                   help="override the request path (default: by "
+                        "--mode)")
+    p.add_argument("--mode", choices=("predict", "generate"),
+                   default="predict",
+                   help="predict = one-shot /v1/predict bodies; "
+                        "generate = streaming /v1/generate bodies "
+                        "with a duplicate-prompt mix")
     p.add_argument("--model", default="default")
     p.add_argument("--features", type=int, default=4,
                    help="input feature count for the default "
                         "predict body")
+    p.add_argument("--prompt-len", type=int, default=16,
+                   help="generate mode: prompt tokens per request")
+    p.add_argument("--n-tokens", type=int, default=16,
+                   help="generate mode: tokens to decode per request")
+    p.add_argument("--vocab", type=int, default=64,
+                   help="generate mode: prompt ids drawn from "
+                        "[1, vocab)")
+    p.add_argument("--dup-ratio", type=float, default=0.0,
+                   help="generate mode: fraction of requests reusing "
+                        "ONE shared prompt (prefix-cache hits after "
+                        "the first completes)")
+    p.add_argument("--metrics-url", default=None,
+                   help="generate mode: scrape TTFT/ITL histogram "
+                        "percentiles from this server after the run "
+                        "(default: --url; 'off' disables)")
     p.add_argument("--concurrency", type=int, default=8)
     p.add_argument("--qps", type=float, default=None,
                    help="open-loop target rate; omit for closed "
@@ -292,11 +415,22 @@ def main(argv=None):
     if args.duration is None and args.total is None:
         args.duration = 10.0
 
-    def body(i, model=args.model, feat=args.features):
-        return {"model": model,
-                "inputs": [[float((i + j) % 7) for j in range(feat)]]}
+    if args.mode == "generate":
+        route = args.route or "/v1/generate"
+        body = generate_body_fn(model=args.model,
+                                prompt_len=args.prompt_len,
+                                n_tokens=args.n_tokens,
+                                vocab=args.vocab,
+                                dup_ratio=args.dup_ratio)
+    else:
+        route = args.route or "/v1/predict"
 
-    gen = LoadGen(args.url, route=args.route, body_fn=body,
+        def body(i, model=args.model, feat=args.features):
+            return {"model": model,
+                    "inputs": [[float((i + j) % 7)
+                                for j in range(feat)]]}
+
+    gen = LoadGen(args.url, route=route, body_fn=body,
                   concurrency=args.concurrency, qps=args.qps,
                   duration_s=args.duration, total=args.total,
                   timeout_s=args.timeout, max_retries=args.retries)
@@ -305,6 +439,15 @@ def main(argv=None):
     except KeyboardInterrupt:
         gen.stop()
         report = {"interrupted": True}
+    if args.mode == "generate" and args.metrics_url != "off":
+        # the serving stack's OWN streaming histograms: TTFT / ITL
+        # percentiles as the server measured them, not a client proxy
+        try:
+            report["streaming"] = scrape_streaming_latency(
+                args.metrics_url or args.url)
+            report["dup_ratio"] = args.dup_ratio
+        except Exception as e:        # scrape is best-effort
+            report["streaming_error"] = str(e)
     json.dump(report, sys.stdout, indent=2)
     sys.stdout.write("\n")
     return 0 if not report.get("failed") else 1
