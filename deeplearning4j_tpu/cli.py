@@ -48,6 +48,22 @@ def _cmd_train(args):
                  "--workers >1 (rollback needs the single-worker "
                  "ElasticTrainer loop); use --health warn/raise or "
                  "drop --workers")
+    if args.k_step < 1:
+        sys.exit("train: --k-step must be >= 1")
+    if args.k_step > 1 and args.workers and args.workers > 1:
+        # the mesh step is per-batch: silently ignoring the fused
+        # cadence the operator asked for would be worse than refusing
+        sys.exit("train: --k-step >1 is not supported with "
+                 "--workers >1 (the data-parallel mesh step is "
+                 "per-batch); drop one of the two flags")
+    if args.aot_warmup and args.workers and args.workers > 1:
+        # warmup() compiles the SINGLE-worker train programs; the
+        # ParallelWrapper path dispatches a different (mesh) program,
+        # so the flag would burn startup time on dead executables and
+        # still compile cold at the first mesh step
+        sys.exit("train: --aot-warmup is not supported with "
+                 "--workers >1 (warmup builds the single-worker "
+                 "programs; the mesh step compiles its own)")
     model = restore_model(args.model)
     rr = CSVRecordReader().initialize(args.data)
     it = RecordReaderDataSetIterator(
@@ -62,6 +78,22 @@ def _cmd_train(args):
             HealthMonitor)
         model.add_listeners(HealthMonitor(policy=args.health,
                                           recorder=get_recorder()))
+    if args.aot_warmup:
+        # AOT warmup AFTER listeners are attached (the health toggle
+        # changes the train-step program signature): peek one batch
+        # for its shape, lower+compile the k-step and k=1 programs,
+        # rewind the iterator — steady-state training then never
+        # traces or compiles (compile_watch can prove it)
+        ds0 = next(iter(it), None)
+        if ds0 is None:
+            sys.exit("train: --aot-warmup found no data to derive "
+                     "the batch shape from")
+        it.reset()
+        rep = model.warmup(ds0, steps_per_device_call=args.k_step)
+        print("aot warmup: "
+              + (", ".join(f"{n} compiled in {s:.2f}s"
+                           for n, s in rep.items())
+                 or "all programs already warm"))
     use_elastic = args.health == "rollback" or args.async_checkpoint
     if args.workers and args.workers > 1:
         # under ElasticTrainer the trainer owns the batch loop and
@@ -94,10 +126,12 @@ def _cmd_train(args):
             ElasticTrainer)
         ckpt_dir = (args.output or args.model) + ".ckpts"
         ElasticTrainer(model, ckpt_dir, save_every=10,
-                       async_checkpoint=args.async_checkpoint).fit(
+                       async_checkpoint=args.async_checkpoint,
+                       steps_per_device_call=args.k_step).fit(
             it, epochs=args.epochs)
     else:
-        model.fit(it, epochs=args.epochs)
+        model.fit(it, epochs=args.epochs,
+                  steps_per_device_call=args.k_step)
     out = args.output or args.model
     write_model(model, out)
     print(f"trained {args.epochs} epochs; saved to {out}")
@@ -174,7 +208,20 @@ def _cmd_serve(args):
         slots=args.slots, capacity=args.capacity, metrics=metrics,
         sample_rate=args.trace_sample, slow_ms=args.slow_ms,
         slos=slos, kv_mode=args.kv_mode, page_size=args.page_size,
-        kv_pages=args.kv_pages).start()
+        kv_pages=args.kv_pages)
+    if args.aot_warmup:
+        # pre-compile every hosted model's serving executables (pow2
+        # predict buckets + generate prefill/decode) BEFORE the
+        # listener takes traffic: the first real request never pays
+        # an XLA compile
+        rep = server.warmup()
+        for name, r in rep.items():
+            print(f"aot warmup: {name} v{r['version']} — predict "
+                  f"buckets {r['predict_buckets']}, generate="
+                  f"{r['generate']} ({r['seconds']:.1f}s"
+                  + (f"; skipped: {'; '.join(r['skipped'])}"
+                     if r["skipped"] else "") + ")")
+    server.start()
     print(f"serving on http://{args.host}:{server.port}/ "
           f"(/v1/predict /v1/generate /v1/models /healthz /metrics "
           f"/debug/requests /debug/slots /debug/traces; trace "
@@ -246,6 +293,13 @@ def main(argv=None):
                    help="record structured spans for this run and "
                         "write a Chrome trace-event file (open in "
                         "Perfetto / chrome://tracing) to PATH on exit")
+    p.add_argument("--xla-cache", metavar="DIR", default=None,
+                   help="enable JAX's persistent compilation cache "
+                        "rooted at DIR: compiled executables survive "
+                        "process restarts, so a restarted trainer or "
+                        "a fresh serving replica warms from disk "
+                        "instead of cold-compiling (pairs with "
+                        "--aot-warmup)")
     p.add_argument("--flight-record", metavar="DIR", default=None,
                    help="install a flight recorder: spans/stats/"
                         "anomalies ride a bounded ring and a "
@@ -274,6 +328,26 @@ def main(argv=None):
                         "divergence/plateau/gradient detectors); "
                         "POLICY = warn | raise | rollback "
                         "(default warn)")
+    t.add_argument("--k-step", type=int, default=1, metavar="N",
+                   help="fuse N train steps into one device program "
+                        "(lax.scan over a stacked batch window): the "
+                        "dispatch-bound regime pays one host "
+                        "round-trip per N steps; listeners/health "
+                        "still see every step, checkpoints land on "
+                        "N-step boundaries (preemption resume stays "
+                        "bit-identical); an epoch tail of "
+                        "n_batches %% N runs through the "
+                        "pre-compiled single-step program")
+    t.add_argument("--aot-warmup", action="store_true",
+                   help="pre-compile the train-step programs "
+                        "(jit().lower(shapes).compile()) from the "
+                        "first batch's shape before training: the "
+                        "steady state then compiles zero times for "
+                        "batches of that shape (a partial FINAL "
+                        "batch — dataset size not divisible by "
+                        "--batch-size — still compiles once on "
+                        "first use; --xla-cache makes that one-time "
+                        "across runs)")
     t.add_argument("--async-checkpoint", action="store_true",
                    help="train under ElasticTrainer with background "
                         "checkpoint writes: saves cost the train "
@@ -351,6 +425,12 @@ def main(argv=None):
     v.add_argument("--slow-ms", type=float, default=250.0,
                    help="requests at or above this duration land in "
                         "the /debug/traces slow ring")
+    v.add_argument("--aot-warmup", action="store_true",
+                   help="pre-compile every hosted model's serving "
+                        "executables at boot (predict pow2 batch "
+                        "buckets up to --max-batch-size + a generate "
+                        "prefill/decode pass): the first real "
+                        "request never pays an XLA compile")
     v.add_argument("--slo", metavar="RULES", default=None,
                    help="declarative SLOs: inline JSON or a JSON "
                         "file (see README 'Request tracing & SLOs' "
@@ -401,6 +481,16 @@ def main(argv=None):
     s.set_defaults(fn=_cmd_summary)
 
     args = p.parse_args(argv)
+    if args.xla_cache:
+        # must land before first backend use: the persistent cache is
+        # consulted at compile time, AOT warmup included
+        import jax
+        os.makedirs(args.xla_cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", args.xla_cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
     recorder = None
     if args.flight_record:
         from deeplearning4j_tpu.observability.flight_recorder import (

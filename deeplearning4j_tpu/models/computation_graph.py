@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import functools
 import logging
-from typing import Dict, List, Optional, Sequence, Union
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +25,7 @@ import optax
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.nn.conf import updaters as updaters_mod
+from deeplearning4j_tpu.models.kstep import KStepExecutorMixin
 from deeplearning4j_tpu.nn.conf.graph_conf import (
     ComputationGraphConfiguration,
 )
@@ -36,7 +38,7 @@ logger = logging.getLogger("deeplearning4j_tpu")
 __all__ = ["ComputationGraph"]
 
 
-class ComputationGraph:
+class ComputationGraph(KStepExecutorMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.params: Optional[Dict[str, dict]] = None
@@ -50,6 +52,12 @@ class ComputationGraph:
         self._optimizer = None
         self._jit_train_step = None
         self._jit_tbptt_step = None
+        # k-step fused programs (models/kstep.py): dict k -> jitted
+        # scan program, plus AOT-compiled executables keyed by batch
+        # signature (warmup() fills; the fit loop dispatches them
+        # directly so the steady state never traces or compiles)
+        self._jit_kstep: Dict[int, Any] = {}
+        self._aot: Dict[tuple, Any] = {}
         self._jit_output = {}
         self._rnn_state: Optional[Dict[str, object]] = None
         # (data_wait_s, dispatch_s) of the latest fit iteration —
@@ -109,6 +117,8 @@ class ComputationGraph:
         self.opt_state = self._optimizer.init(self.params)
         self._jit_train_step = None
         self._jit_tbptt_step = None
+        self._jit_kstep = {}
+        self._aot = {}
         self._jit_output = {}
 
     # ------------------------------------------------------------------
@@ -233,38 +243,45 @@ class ComputationGraph:
             return total, (new_state, new_carries)
         return total, new_state
 
-    def _make_train_step(self):
+    def _train_core(self, params, state, opt_state, batch, rng):
+        """Traced single-step training math over the whole DAG —
+        shared verbatim by the k=1 jitted step and the k-step
+        ``lax.scan`` body (models/kstep.py), so the fused and
+        per-step programs compute bit-identical updates."""
         optimizer = self._optimizer
-        health_enabled = self._health_enabled
+
+        def loss_fn(p):
+            return self._loss(p, state, batch, rng, training=True)
+
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        from deeplearning4j_tpu.train.gradnorm import (
+            apply_gradient_normalization)
+        layer_cfgs = {n: v[0] for n, v in self.conf.vertices.items()
+                      if n in params}
+        grads = apply_gradient_normalization(layer_cfgs, grads)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        constrained = {}
+        for name, p in new_params.items():
+            obj, _ = self.conf.vertices[name]
+            constrained[name] = apply_layer_constraints(obj, p)
+        if self._health_enabled:
+            # fused finite check + global norms, computed inside
+            # this same XLA program (observability/health.py)
+            from deeplearning4j_tpu.observability.health import (
+                fused_health)
+            health = fused_health(loss, grads, updates, constrained)
+            return constrained, new_state, new_opt, loss, health
+        return constrained, new_state, new_opt, loss
+
+    def _make_train_step(self):
+        core = self._train_core
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, state, opt_state, batch, base_rng, step):
             rng = jax.random.fold_in(base_rng, step)
-
-            def loss_fn(p):
-                return self._loss(p, state, batch, rng, training=True)
-
-            (loss, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            from deeplearning4j_tpu.train.gradnorm import (
-                apply_gradient_normalization)
-            layer_cfgs = {n: v[0] for n, v in self.conf.vertices.items()
-                          if n in params}
-            grads = apply_gradient_normalization(layer_cfgs, grads)
-            updates, new_opt = optimizer.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            constrained = {}
-            for name, p in new_params.items():
-                obj, _ = self.conf.vertices[name]
-                constrained[name] = apply_layer_constraints(obj, p)
-            if health_enabled:
-                # fused finite check + global norms, computed inside
-                # this same XLA program (observability/health.py)
-                from deeplearning4j_tpu.observability.health import (
-                    fused_health)
-                health = fused_health(loss, grads, updates, constrained)
-                return constrained, new_state, new_opt, loss, health
-            return constrained, new_state, new_opt, loss
+            return core(params, state, opt_state, batch, rng)
 
         return train_step
 
@@ -277,6 +294,10 @@ class ComputationGraph:
             self._health_enabled = want
             self._jit_train_step = None
             self._jit_tbptt_step = None
+            # the k-step programs' output structure includes the
+            # stacked health block iff enabled — rebuild them too
+            self._jit_kstep = {}
+            self._aot = {}
             if not want:
                 self._last_health = None
 
@@ -337,8 +358,33 @@ class ComputationGraph:
               if mds.labels_masks is not None else None)
         return (inputs, labels, fm, lm)
 
-    def fit(self, data, *, epochs: int = 1):
-        """data: iterable of DataSet/MultiDataSet, or a single one."""
+    def _batch_tuple_np(self, mds: MultiDataSet):
+        """Host-side batch tuple (numpy, no device transfer, dtypes
+        JAX-canonicalized so AOT cache keys match what the program
+        actually receives): the unit the k-step window stacker works
+        on."""
+        from deeplearning4j_tpu.models.kstep import canonical_np
+        inputs = tuple(canonical_np(f) for f in mds.features)
+        labels = tuple(canonical_np(l) for l in mds.labels)
+        fm = (tuple(None if m is None else canonical_np(m)
+                    for m in mds.features_masks)
+              if mds.features_masks is not None else None)
+        lm = (tuple(None if m is None else canonical_np(m)
+                    for m in mds.labels_masks)
+              if mds.labels_masks is not None else None)
+        return (inputs, labels, fm, lm)
+
+    def fit(self, data, *, epochs: int = 1,
+            steps_per_device_call: int = 1):
+        """data: iterable of DataSet/MultiDataSet, or a single one.
+        ``steps_per_device_call=k`` fuses k train steps into one
+        ``lax.scan`` device program (see
+        :meth:`MultiLayerNetwork.fit`); the epoch tail runs through
+        the pre-compiled k=1 program."""
+        from deeplearning4j_tpu.observability.tracing import trace
+        k = int(steps_per_device_call)
+        if k < 1:
+            raise ValueError("steps_per_device_call must be >= 1")
         if self.params is None:
             self.init()
         if isinstance(data, (DataSet, MultiDataSet)):
@@ -351,54 +397,13 @@ class ComputationGraph:
         self._sync_health_mode()
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
-        step_fn = self._jit_train_step
         tbptt = self.conf.conf.tbptt
-        import time
-
-        from deeplearning4j_tpu.observability.tracing import trace
         try:
             for _ in range(epochs):
                 with trace.span("epoch"):
                     for lst in self.listeners:
                         lst.on_epoch_start(self)
-                    data_iter = iter(data)
-                    while True:
-                        t0 = time.perf_counter()
-                        with trace.span("data_wait"):
-                            ds = next(data_iter, None)
-                        if ds is None:
-                            break
-                        t1 = time.perf_counter()
-                        mds = self._as_multi(ds)
-                        if tbptt is not None and any(
-                                np.ndim(f) == 3 for f in mds.features):
-                            with trace.span("train_step_tbptt"):
-                                self._fit_tbptt(mds, tbptt,
-                                                data_wait_s=t1 - t0)
-                            continue
-                        with trace.span("train_step"):
-                            batch = self._batch_tuple(mds)
-                            out = step_fn(
-                                self.params, self.state, self.opt_state,
-                                batch, self._rng_key,
-                                np.int32(self.iteration_count))
-                        if self._health_enabled:
-                            (self.params, self.state, self.opt_state,
-                             loss, self._last_health) = out
-                        else:
-                            (self.params, self.state, self.opt_state,
-                             loss) = out
-                        self._last_batch = batch
-                        self.score_value = loss
-                        # (data_wait_s, dispatch_s) for ProfilerListener
-                        self._step_timing = (t1 - t0,
-                                             time.perf_counter() - t1)
-                        with trace.span("listeners"):
-                            for lst in self.listeners:
-                                lst.iteration_done(
-                                    self, self.iteration_count, loss,
-                                    mds.num_examples())
-                        self.iteration_count += 1
+                    self._fit_epoch(iter(data), k, tbptt)
                     for lst in self.listeners:
                         lst.on_epoch_end(self)
                 self.epoch_count += 1
@@ -410,6 +415,33 @@ class ComputationGraph:
             on_fit_exception(self, e)
             raise
         return self
+
+    # KStepExecutorMixin adapters (fit_batches/_fit_one live there)
+    def _coerce_fit_batch(self, ds) -> MultiDataSet:
+        return self._as_multi(ds)
+
+    def _batch_is_tbptt(self, mds: MultiDataSet, tbptt) -> bool:
+        return tbptt is not None and any(np.ndim(f) == 3
+                                         for f in mds.features)
+
+    def _run_tbptt(self, mds: MultiDataSet, tbptt,
+                   data_wait_s: float = 0.0) -> None:
+        self._fit_tbptt(mds, tbptt, data_wait_s=data_wait_s)
+
+    def warmup(self, example, *, steps_per_device_call: int = 1):
+        """AOT warmup: ``jit(...).lower(shapes).compile()`` the
+        k-step and k=1 train programs for this batch signature (see
+        :meth:`MultiLayerNetwork.warmup`). Attach listeners before
+        warming. Returns ``{program: compile_seconds}``."""
+        from deeplearning4j_tpu.models import kstep as _kstep
+        if self.params is None:
+            self.init()
+        self._sync_health_mode()
+        if self._jit_train_step is None:
+            self._jit_train_step = self._make_train_step()
+        batch_np = self._batch_tuple_np(self._as_multi(example))
+        return _kstep.warmup_train_programs(
+            self, batch_np, int(steps_per_device_call))
 
     def _fit_tbptt(self, mds: MultiDataSet, tbptt,
                    data_wait_s: float = 0.0):

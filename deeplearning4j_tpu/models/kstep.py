@@ -1,0 +1,395 @@
+"""k-step fused on-device training + AOT train-program warmup.
+
+BENCH_DETAIL's MFU analysis shows small models are dispatch-bound:
+LeNet spends ~1 ms/step in device compute but pays a full host
+round-trip per step, with ±20% jitter. The classic fix is the
+in-graph training loop of the TensorFlow papers (arXiv:1605.08695
+§3.3, arXiv:1603.04467): keep the device busy across many steps per
+host interaction, and pre-compile the executables so the steady state
+never traces.
+
+Two pieces, shared by both executors
+(``models/multi_layer_network.py``, ``models/computation_graph.py``;
+the executor supplies its traced single-step core ``_train_core`` and
+this module supplies the window plumbing):
+
+- :func:`make_kstep_fn` fuses k training steps into ONE device
+  program — a ``lax.scan`` over a host-stacked ``[k, ...]`` batch
+  window with the ``(params, state, opt_state)`` carry donated,
+  emitting
+  stacked per-step ``loss`` — and, when the health monitor is
+  attached, the fused ``[k, 5]`` health block — so the host still
+  observes EVERY step from a single device→host fetch per window:
+  detection/rollback lag is bounded by k, never lost. k is a
+  PYTHON-static loop bound (the scan length is the window's leading
+  dim, fixed at trace time), never a traced value — no GL002
+  recompile hazard.
+
+- :func:`aot_compile` / :func:`warmup_train_programs` pre-build the
+  k-step program AND the k=1 tail-remainder program via
+  ``jit(...).lower(shapes).compile()`` at startup — compilation from
+  abstract shapes only, no execution (training warmup must not
+  advance params) and no real buffers. The executors then dispatch
+  the AOT-compiled executable directly whenever the incoming batch
+  signature matches, so the steady state neither traces nor compiles
+  (``observability.compile_watch.zero_compile_scope`` proves it).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["signature", "stack_batches", "make_kstep_fn",
+           "aot_compile", "warmup_train_programs", "canonical_np",
+           "KStepExecutorMixin"]
+
+
+def signature(tree) -> Tuple:
+    """Hashable shape/dtype signature of an argument pytree.
+
+    The treedef is part of the key, so mask-presence (a ``None`` slot
+    vs an array) distinguishes signatures. Used both as the AOT
+    program-cache key and as the uniformity check that decides
+    whether a window of batches may be fused into one scan."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (treedef,
+            tuple((tuple(np.shape(l)), np.dtype(_dtype_of(l)).str)
+                  for l in leaves))
+
+
+def _dtype_of(x):
+    dt = getattr(x, "dtype", None)
+    return dt if dt is not None else np.asarray(x).dtype
+
+
+def canonical_np(x):
+    """Host array in JAX's CANONICAL dtype (f64→f32, i64→i32 unless
+    x64 is enabled). The executors' host batch tuples go through
+    this so an AOT cache key computed from host arrays matches what
+    ``jnp.asarray`` will actually hand the program at dispatch — a
+    float64 label array (``np.eye`` defaults to f64) must not make
+    the warmed k=1 executable unreachable."""
+    import jax
+    a = np.asarray(x)
+    dt = jax.dtypes.canonicalize_dtype(a.dtype)
+    return a if a.dtype == dt else a.astype(dt)
+
+
+def stack_batches(batch_tuples: Sequence):
+    """Host-stack k same-signature batch tuples into one ``[k, ...]``
+    window (``np.stack`` per leaf; ``None`` mask slots must be
+    ``None`` in every batch — enforced upstream by comparing
+    :func:`signature`). Stacking on HOST means the window reaches the
+    device as one transfer and the per-batch device arrays of the
+    per-step path are never materialized."""
+    if len(batch_tuples) < 2:
+        raise ValueError("a window needs at least 2 batches")
+    import jax
+    return jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]),
+        *batch_tuples)
+
+
+def make_kstep_fn(step_core, k: int, health_enabled: bool):
+    """Build the fused k-step train program.
+
+    ``step_core(params, state, opt_state, batch, rng)`` is the
+    executor's traced single-step math — the SAME function the k=1
+    jitted step wraps, so the two programs compute identical updates
+    (bit-identical params across k, regression-tested).
+
+    Donation (GL003-audited): the ``(params, state, opt_state)``
+    carry is consumed by the scan — argnums 0-2 donate and the caller
+    rebinds from the outputs. The stacked window is deliberately NOT
+    donated even though its buffer is dead after the call: scan xs
+    are consumed by slicing and no output shares their shape, so XLA
+    can never alias them — donation would be a no-op that warns
+    "donated buffers were not usable" on every trace. ``base_rng`` is
+    reused across calls and must not donate either.
+    """
+    if k < 2:
+        raise ValueError("k-step fusion needs k >= 2; the k=1 path "
+                         "is the executor's single-step program")
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def kstep_train(params, state, opt_state, window, base_rng, step0):
+        def body(carry, xs):
+            p, s, o = carry
+            batch_i, i = xs
+            # per-step rng identical to the per-step loop's
+            # fold_in(base_rng, iteration_count): step0 + i
+            rng = jax.random.fold_in(base_rng, step0 + i)
+            out = step_core(p, s, o, batch_i, rng)
+            if health_enabled:
+                p2, s2, o2, loss, health = out
+                return (p2, s2, o2), (loss, health)
+            p2, s2, o2, loss = out
+            return (p2, s2, o2), loss
+
+        (p, s, o), ys = jax.lax.scan(
+            body, (params, state, opt_state),
+            (window, jnp.arange(k, dtype=jnp.int32)))
+        if health_enabled:
+            losses, healths = ys
+            return p, s, o, losses, healths
+        return p, s, o, ys
+
+    return kstep_train
+
+
+def aot_compile(jit_fn, example_args) -> Tuple[Any, float]:
+    """``jit(...).lower(shapes).compile()``: build the executable from
+    abstract shapes WITHOUT executing (a training warmup must not
+    advance params) and WITHOUT allocating real buffers. Returns
+    ``(compiled, seconds)``; the compiled object is directly callable
+    with concrete arguments of exactly this signature (donation
+    preserved)."""
+    import jax
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), _dtype_of(x)),
+        example_args)
+    t0 = time.perf_counter()
+    compiled = jit_fn.lower(*abstract).compile()
+    return compiled, time.perf_counter() - t0
+
+
+class KStepExecutorMixin:
+    """The executor-side window plumbing both executors share — one
+    copy, so a fix to program selection, AOT dispatch, the per-step
+    listener fan-out or the window entry point cannot drift between
+    them. The host executor supplies ``_train_core``,
+    ``_batch_tuple``/``_batch_tuple_np``, the
+    ``_jit_train_step``/``_jit_kstep``/``_aot`` caches, and three
+    small adapters — ``_coerce_fit_batch`` (DataSet → its native
+    batch object), ``_batch_is_tbptt`` and ``_run_tbptt``; batches
+    only need ``num_examples()``."""
+
+    def _fit_epoch(self, data_iter, k: int, tbptt) -> None:
+        """One epoch's batch loop (shared by both executors' ``fit``):
+        time the data wait, collect k-batch windows (k > 1), flush on
+        tBPTT entries so step order is preserved, and flush the tail
+        at exhaustion. Epoch hooks stay with the caller."""
+        from deeplearning4j_tpu.observability.tracing import trace
+        pending = []          # k-step window under collection
+        while True:
+            # data wait timed apart from the step so the profiler/
+            # tracer can tell an input-starved chip from a
+            # dispatch-bound host
+            t0 = time.perf_counter()
+            with trace.span("data_wait"):
+                ds = next(data_iter, None)
+            if ds is None:
+                break
+            wait = time.perf_counter() - t0
+            m = self._coerce_fit_batch(ds)
+            if self._batch_is_tbptt(m, tbptt):
+                # tBPTT chunks its own loop — flush the window first
+                # so step order is preserved
+                self._flush_window(pending, k)
+                with trace.span("train_step_tbptt"):
+                    self._run_tbptt(m, tbptt, data_wait_s=wait)
+                continue
+            if k == 1:
+                self._fit_one(m, wait)
+                continue
+            pending.append((m, wait))
+            if len(pending) == k:
+                self._flush_window(pending, k)
+        self._flush_window(pending, k)
+
+    def _fit_one(self, ds, data_wait_s: float = 0.0):
+        """One single-step device call + listener pass (the k=1 path,
+        byte-for-byte the pre-k-step fit-loop body)."""
+        from deeplearning4j_tpu.observability.tracing import trace
+        t1 = time.perf_counter()
+        with trace.span("train_step"):
+            batch = self._batch_tuple(ds)
+            out = self._step_fn_for(batch)(
+                self.params, self.state, self.opt_state, batch,
+                self._rng_key, np.int32(self.iteration_count))
+        if self._health_enabled:
+            (self.params, self.state, self.opt_state,
+             loss, self._last_health) = out
+        else:
+            (self.params, self.state, self.opt_state, loss) = out
+        self._last_batch = batch
+        self.score_value = loss
+        # (data_wait_s, dispatch_s) — ProfilerListener
+        self._step_timing = (data_wait_s, time.perf_counter() - t1)
+        with trace.span("listeners"):
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count, loss,
+                                   ds.num_examples())
+        self.iteration_count += 1
+
+    def fit_batches(self, batches, *, steps_per_device_call=1):
+        """Train on a list of batches in one listener-visible pass
+        with NO epoch bookkeeping (ElasticTrainer's window entry
+        point, the k-step analog of ``ParallelWrapper.fit_batch``).
+        When ``len(batches) == steps_per_device_call > 1`` and all
+        batches share one shape signature, the whole window runs as
+        a single fused device program; otherwise batches run through
+        the (pre-compiled) single-step program. The default is the
+        per-step path — fusing is OPT-IN via ``steps_per_device_call``
+        because a fused program's compile cost grows with k (a
+        convenience caller passing 200 batches must not silently
+        compile a 200-step scan). Returns the per-step losses as a
+        host numpy array."""
+        from deeplearning4j_tpu.observability.tracing import trace
+        if self.params is None:
+            self.init()
+        self._sync_health_mode()
+        if self._jit_train_step is None:
+            self._jit_train_step = self._make_train_step()
+        items = [self._coerce_fit_batch(d) for d in batches]
+        k = int(steps_per_device_call)
+        tbptt = self.conf.conf.tbptt
+        if k > 1 and len(items) == k and not any(
+                self._batch_is_tbptt(m, tbptt) for m in items):
+            tups = [self._batch_tuple_np(m) for m in items]
+            if len({signature(t) for t in tups}) == 1:
+                return self._dispatch_window(tups, items, [0.0] * k, k)
+        out = []
+        for i, m in enumerate(items):
+            # which window entry is live (a tBPTT entry spans several
+            # iterations — ElasticTrainer must not map a mid-entry
+            # rollback to a neighbouring batch's ordinal)
+            self._window_batch_index = i
+            if self._batch_is_tbptt(m, tbptt):
+                with trace.span("train_step_tbptt"):
+                    self._run_tbptt(m, tbptt)
+                out.append(float(self.score_value))
+                continue
+            self._fit_one(m)
+            out.append(float(self.score_value))
+        return np.asarray(out, dtype=np.float64)
+
+    def _step_fn_for(self, batch):
+        """The k=1 program for this batch signature: the AOT-compiled
+        executable when :meth:`warmup` built one (zero trace, zero
+        compile), else the jit wrapper."""
+        if self._aot:
+            fn = self._aot.get(("train1", signature(batch)))
+            if fn is not None:
+                return fn
+        return self._jit_train_step
+
+    def _kstep_fn_for(self, window, k: int):
+        if self._aot:
+            fn = self._aot.get(("kstep", k, signature(window)))
+            if fn is not None:
+                return fn
+        fn = self._jit_kstep.get(k)
+        if fn is None:
+            fn = self._jit_kstep[k] = make_kstep_fn(
+                self._train_core, k, self._health_enabled)
+        return fn
+
+    def _flush_window(self, pending, k: int):
+        """Dispatch the collected window: one fused program when the
+        window is FULL (len == k) and every batch shares one shape
+        signature; anything else (the epoch tail, a shape-churn
+        batch) runs per-batch through the pre-compiled k=1 program —
+        never a fresh mid-epoch trace of an odd-length scan."""
+        if not pending:
+            return
+        batches = [d for d, _ in pending]
+        waits = [w for _, w in pending]
+        del pending[:]
+        if len(batches) == k and k > 1:
+            tups = [self._batch_tuple_np(d) for d in batches]
+            if len({signature(t) for t in tups}) == 1:
+                self._dispatch_window(tups, batches, waits, k)
+                return
+        for d, w in zip(batches, waits):
+            self._fit_one(d, w)
+
+    def _dispatch_window(self, tups, batches, waits, k: int):
+        """One fused k-step device call, then the per-step listener
+        pass over the stacked outputs. The loss vector (and, with a
+        health listener, the [k, 5] health block) is fetched ONCE per
+        window — every step is still observed, detection lag is
+        bounded by k."""
+        from deeplearning4j_tpu.observability.tracing import trace
+        window = stack_batches(tups)
+        fn = self._kstep_fn_for(window, k)
+        t1 = time.perf_counter()
+        with trace.span("train_step_fused"):
+            out = fn(self.params, self.state, self.opt_state, window,
+                     self._rng_key, np.int32(self.iteration_count))
+        health_host = None
+        if self._health_enabled:
+            (self.params, self.state, self.opt_state,
+             losses, healths) = out
+            health_host = np.asarray(healths)     # ONE fetch, [k, 5]
+        else:
+            (self.params, self.state, self.opt_state, losses) = out
+        loss_host = np.asarray(losses)            # ONE fetch, [k]
+        dispatch_s = time.perf_counter() - t1
+        # the last sub-batch (host arrays — the stacked window's
+        # device buffer was consumed by the scan) for the
+        # dead-activation checker
+        self._last_batch = tups[-1]
+        per_step_s = dispatch_s / k
+        with trace.span("listeners"):
+            for i in range(k):
+                # which window entry is live — ElasticTrainer maps a
+                # listener-raised rollback back to its batch ordinal
+                # through this (robust to multi-iteration tBPTT
+                # entries on the non-fused path)
+                self._window_batch_index = i
+                self._last_health = (None if health_host is None
+                                     else health_host[i])
+                self.score_value = loss_host[i]
+                self._step_timing = (waits[i], per_step_s)
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count,
+                                       loss_host[i],
+                                       batches[i].num_examples())
+                self.iteration_count += 1
+        return loss_host
+
+
+def warmup_train_programs(model, batch_np, k: int) -> Dict[str, float]:
+    """AOT-compile a model's train-step programs for one batch
+    signature: the k=1 single-step program (also the tail-remainder
+    program when ``n_batches % k != 0``) and, for ``k > 1``, the
+    fused k-step scan program. Installs the executables in
+    ``model._aot`` (keyed by signature, consulted by the fit loop
+    before falling back to the jit wrapper) and returns
+    ``{program_name: compile_seconds}`` for what was actually built
+    (already-warm signatures are skipped).
+
+    Works on both executors — needs ``_train_core`` /
+    ``_jit_train_step`` / ``_jit_kstep`` / ``_aot`` /
+    ``_health_enabled`` and live ``params/state/opt_state/_rng_key``
+    (call after ``init()``; the executor's ``warmup()`` method
+    handles that)."""
+    out: Dict[str, float] = {}
+    args1 = (model.params, model.state, model.opt_state, batch_np,
+             model._rng_key, np.int32(0))
+    key1 = ("train1", signature(batch_np))
+    if key1 not in model._aot:
+        compiled, secs = aot_compile(model._jit_train_step, args1)
+        model._aot[key1] = compiled
+        out["train_step"] = secs
+    if k > 1:
+        window = stack_batches([batch_np] * k)
+        keyk = ("kstep", k, signature(window))
+        if keyk not in model._aot:
+            # the SAME get-or-create the fit loop uses — warmup and
+            # dispatch can never build different programs for one k
+            fn = model._kstep_fn_for(window, k)
+            argsk = (model.params, model.state, model.opt_state,
+                     window, model._rng_key, np.int32(0))
+            compiled, secs = aot_compile(fn, argsk)
+            model._aot[keyk] = compiled
+            out[f"kstep_{k}"] = secs
+    return out

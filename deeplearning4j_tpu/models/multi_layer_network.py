@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -41,6 +42,7 @@ from deeplearning4j_tpu.nn.conf.layers.output import (
     CenterLossOutputLayer, OutputLayer,
 )
 from deeplearning4j_tpu.nn.conf.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.models.kstep import KStepExecutorMixin
 from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
 from deeplearning4j_tpu.train.constraints import apply_layer_constraints
 
@@ -62,7 +64,7 @@ def _as_iterator(data, labels=None, batch_size=None) -> DataSetIterator:
     raise TypeError(f"Cannot build iterator from {type(data)}")
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(KStepExecutorMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[Layer] = conf.layers
@@ -77,6 +79,12 @@ class MultiLayerNetwork:
         self._rnn_state: Optional[List[Any]] = None    # rnnTimeStep stateMap
         self._jit_train_step = None
         self._jit_tbptt_step = None
+        # k-step fused programs (models/kstep.py): dict k -> jitted
+        # scan program, plus AOT-compiled executables keyed by batch
+        # signature (warmup() fills; the fit loop dispatches them
+        # directly so the steady state never traces or compiles)
+        self._jit_kstep: Dict[int, Any] = {}
+        self._aot: Dict[tuple, Any] = {}
         self._jit_output = {}
         self._optimizer = None
         # (data_wait_s, dispatch_s) of the latest fit iteration —
@@ -147,6 +155,8 @@ class MultiLayerNetwork:
         self.opt_state = self._optimizer.init(self.params)
         self._jit_train_step = None    # invalidate
         self._jit_tbptt_step = None
+        self._jit_kstep = {}
+        self._aot = {}
 
     # ------------------------------------------------------------------
     # forward (reference feedForward :863-975)
@@ -227,42 +237,49 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # jitted train step (replaces Solver.optimize + SGD.optimize)
     # ------------------------------------------------------------------
-    def _make_train_step(self):
-        optimizer = self._optimizer
-        health_enabled = self._health_enabled
+    def _train_core(self, params, state, opt_state, batch, rng):
+        """Traced single-step training math: loss → grads → updates →
+        constraints (+ the fused health vector when a health listener
+        is attached). Shared verbatim by the k=1 jitted step and the
+        k-step ``lax.scan`` body (models/kstep.py), so the fused and
+        per-step programs compute bit-identical updates."""
         from deeplearning4j_tpu.train.gradnorm import (
             apply_gradient_normalization)
+        optimizer = self._optimizer
+
+        def loss_fn(p):
+            loss, new_states = self._loss(p, state, batch, rng,
+                                          training=True)
+            return loss, new_states
+
+        (loss, new_states), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = apply_gradient_normalization(self.layers, grads)
+        updates, new_opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+        new_params = optax.apply_updates(params, updates)
+        new_params = [
+            apply_layer_constraints(l, p)
+            for l, p in zip(self.layers, new_params)
+        ]
+        if self._health_enabled:
+            # fused finite check + global norms, computed inside
+            # this same XLA program (observability/health.py)
+            from deeplearning4j_tpu.observability.health import (
+                fused_health)
+            health = fused_health(loss, grads, updates, new_params)
+            return new_params, new_states, new_opt_state, loss, health
+        return new_params, new_states, new_opt_state, loss
+
+    def _make_train_step(self):
+        core = self._train_core
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, state, opt_state, batch, base_rng, step):
             # step arrives as a traced scalar; folding inside the jit
             # avoids a host-side dispatch per iteration
             rng = jax.random.fold_in(base_rng, step)
-
-            def loss_fn(p):
-                loss, new_states = self._loss(p, state, batch, rng,
-                                              training=True)
-                return loss, new_states
-
-            (loss, new_states), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            grads = apply_gradient_normalization(self.layers, grads)
-            updates, new_opt_state = optimizer.update(grads, opt_state,
-                                                      params)
-            new_params = optax.apply_updates(params, updates)
-            new_params = [
-                apply_layer_constraints(l, p)
-                for l, p in zip(self.layers, new_params)
-            ]
-            if health_enabled:
-                # fused finite check + global norms, computed inside
-                # this same XLA program (observability/health.py)
-                from deeplearning4j_tpu.observability.health import (
-                    fused_health)
-                health = fused_health(loss, grads, updates, new_params)
-                return new_params, new_states, new_opt_state, loss, \
-                    health
-            return new_params, new_states, new_opt_state, loss
+            return core(params, state, opt_state, batch, rng)
 
         return train_step
 
@@ -276,6 +293,10 @@ class MultiLayerNetwork:
             self._health_enabled = want
             self._jit_train_step = None
             self._jit_tbptt_step = None
+            # the k-step programs' output structure includes the
+            # stacked health block iff enabled — rebuild them too
+            self._jit_kstep = {}
+            self._aot = {}
             if not want:
                 self._last_health = None
 
@@ -319,66 +340,53 @@ class MultiLayerNetwork:
         lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
         return (f, l, fm, lm)
 
+    def _batch_tuple_np(self, ds: DataSet):
+        """Host-side batch tuple (numpy, no device transfer, dtypes
+        JAX-canonicalized): the unit the k-step window stacker works
+        on — stacking k batches on host means ONE host→device
+        transfer per window instead of k, and canonical dtypes keep
+        AOT cache keys consistent with what the program actually
+        receives."""
+        from deeplearning4j_tpu.models.kstep import canonical_np
+        f = canonical_np(ds.features)
+        l = None if ds.labels is None else canonical_np(ds.labels)
+        fm = None if ds.features_mask is None else canonical_np(
+            ds.features_mask)
+        lm = (None if ds.labels_mask is None
+              else canonical_np(ds.labels_mask))
+        return (f, l, fm, lm)
+
     # ------------------------------------------------------------------
     # fit (reference fit(DataSetIterator) :1167)
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, *, epochs: int = 1,
-            batch_size: Optional[int] = None):
-        import time
-
+            batch_size: Optional[int] = None,
+            steps_per_device_call: int = 1):
+        """``steps_per_device_call=k`` fuses k train steps into ONE
+        device program (a ``lax.scan`` over a stacked batch window —
+        models/kstep.py): the dispatch-bound regime pays one host
+        round-trip per k steps instead of per step. Listeners still
+        fire per step (losses and the fused health vector come back
+        stacked, one fetch per window); a tail of ``n_batches % k``
+        runs through the k=1 program — pre-compile both with
+        :meth:`warmup` and the steady state never compiles."""
         from deeplearning4j_tpu.observability.tracing import trace
+        k = int(steps_per_device_call)
+        if k < 1:
+            raise ValueError("steps_per_device_call must be >= 1")
         if self.params is None:
             self.init()
         it = _as_iterator(data, labels, batch_size)
         self._sync_health_mode()
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
-        step_fn = self._jit_train_step
         tbptt = self.conf.conf.tbptt
         try:
             for _ in range(epochs):
                 with trace.span("epoch"):
                     for lst in self.listeners:
                         lst.on_epoch_start(self)
-                    data_iter = iter(it)
-                    while True:
-                        # data wait timed apart from the step so the
-                        # profiler/tracer can tell an input-starved chip
-                        # from a dispatch-bound host
-                        t0 = time.perf_counter()
-                        with trace.span("data_wait"):
-                            ds = next(data_iter, None)
-                        if ds is None:
-                            break
-                        t1 = time.perf_counter()
-                        if tbptt is not None and ds.features.ndim == 3:
-                            with trace.span("train_step_tbptt"):
-                                self._fit_tbptt(ds, step_fn, tbptt,
-                                                data_wait_s=t1 - t0)
-                            continue
-                        with trace.span("train_step"):
-                            batch = self._batch_tuple(ds)
-                            out = step_fn(
-                                self.params, self.state, self.opt_state,
-                                batch, self._rng_key,
-                                np.int32(self.iteration_count))
-                        if self._health_enabled:
-                            (self.params, self.state, self.opt_state,
-                             loss, self._last_health) = out
-                        else:
-                            (self.params, self.state, self.opt_state,
-                             loss) = out
-                        self._last_batch = batch
-                        self.score_value = loss
-                        # (data_wait_s, dispatch_s) — ProfilerListener
-                        self._step_timing = (t1 - t0,
-                                             time.perf_counter() - t1)
-                        with trace.span("listeners"):
-                            for lst in self.listeners:
-                                lst.iteration_done(
-                                    self, self.iteration_count, loss,
-                                    ds.num_examples())
-                        self.iteration_count += 1
+                    self._fit_epoch(iter(it), k, tbptt)
                     for lst in self.listeners:
                         lst.on_epoch_end(self)
                 self.epoch_count += 1
@@ -391,6 +399,44 @@ class MultiLayerNetwork:
             on_fit_exception(self, e)
             raise
         return self
+
+    # KStepExecutorMixin adapters (fit_batches/_fit_one live there)
+    def _coerce_fit_batch(self, ds: DataSet) -> DataSet:
+        return ds
+
+    def _batch_is_tbptt(self, ds: DataSet, tbptt) -> bool:
+        return tbptt is not None and ds.features.ndim == 3
+
+    def _run_tbptt(self, ds: DataSet, tbptt,
+                   data_wait_s: float = 0.0) -> None:
+        self._fit_tbptt(ds, None, tbptt, data_wait_s=data_wait_s)
+
+    def warmup(self, example: DataSet, *,
+               steps_per_device_call: int = 1):
+        """AOT warmup: ``jit(...).lower(shapes).compile()`` the train
+        programs this batch signature will need — the k-step fused
+        program (``steps_per_device_call > 1``) and the k=1
+        single-step/tail-remainder program — so a subsequent
+        ``fit``/``fit_batches`` steady state compiles ZERO times
+        (``compile_watch.zero_compile_scope`` can assert it). Attach
+        listeners (HealthMonitor in particular) BEFORE warming: the
+        health toggle changes the program signature and flushes the
+        AOT cache. Only the example's signature is warmed — a shape
+        not seen here (e.g. a partial final batch when the dataset
+        size isn't divisible by the batch size) still compiles once
+        on first use; warm it with a second ``warmup`` call, or rely
+        on the persistent cache (``--xla-cache``) to make it
+        one-time across runs. Returns
+        ``{program: compile_seconds}``."""
+        from deeplearning4j_tpu.models import kstep as _kstep
+        if self.params is None:
+            self.init()
+        self._sync_health_mode()
+        if self._jit_train_step is None:
+            self._jit_train_step = self._make_train_step()
+        batch_np = self._batch_tuple_np(example)
+        return _kstep.warmup_train_programs(
+            self, batch_np, int(steps_per_device_call))
 
     def _fit_tbptt(self, ds: DataSet, step_fn_unused, tbptt,
                    data_wait_s: float = 0.0):

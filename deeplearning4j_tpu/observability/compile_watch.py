@@ -26,6 +26,7 @@ the Perfetto timeline.
 from __future__ import annotations
 
 import collections
+import contextlib
 import logging
 import threading
 import time
@@ -34,8 +35,9 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["RecompileStormError", "CompileEvent", "CompileWatcher",
-           "watch", "install_global_watch", "GlobalCompileStats"]
+__all__ = ["RecompileStormError", "SteadyStateCompileError",
+           "CompileEvent", "CompileWatcher", "watch",
+           "install_global_watch", "GlobalCompileStats"]
 
 
 class RecompileStormError(RuntimeError):
@@ -47,6 +49,18 @@ class RecompileStormError(RuntimeError):
     def __init__(self, msg: str, events: List["CompileEvent"]):
         super().__init__(msg)
         self.events = events
+
+
+class SteadyStateCompileError(RuntimeError):
+    """Raised by :meth:`GlobalCompileStats.zero_compile_scope` when a
+    scope that promised zero compiles (the post-AOT-warmup steady
+    state) compiled anyway — a shape escaped the warmup set, or a
+    program was invalidated after warming (listener/health toggle,
+    optimizer rebuild)."""
+
+    def __init__(self, msg: str, stats: dict):
+        super().__init__(msg)
+        self.stats = stats
 
 
 def _describe(x) -> str:
@@ -283,6 +297,26 @@ class GlobalCompileStats:
     @property
     def cache_hit(self) -> Optional[bool]:
         return self._cache_hit(self.mark())
+
+    @contextlib.contextmanager
+    def zero_compile_scope(self, what: str = "steady state"):
+        """Assert that NOTHING in the scope triggers an XLA backend
+        compile — the post-AOT-warmup contract: after
+        ``model.warmup()`` / ``ModelServer.warmup()`` pre-built every
+        expected program, the fit loop or a serving request burst
+        must run entirely on compiled executables. Raises
+        :class:`SteadyStateCompileError` with the compile deltas
+        otherwise."""
+        mark = self.mark()
+        yield self
+        s = self.summary(mark)
+        if s["backend_compiles"]:
+            raise SteadyStateCompileError(
+                f"{what}: {s['backend_compiles']} XLA backend "
+                f"compile(s) ({s['compile_secs']:.2f}s) inside a "
+                "scope that promised zero after AOT warmup — a shape "
+                "escaped the warmup set or a warmed program was "
+                "invalidated", s)
 
     # ---- listeners ----
     def _on_event(self, event: str, **kw) -> None:

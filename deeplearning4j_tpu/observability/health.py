@@ -22,6 +22,13 @@ Two detection planes, matched to what each can afford:
    never walks leaves with ``block_until_ready``). ``finite_bits`` is
    a bitmask (BIT_LOSS | BIT_GRADS | BIT_UPDATES | BIT_PARAMS), so a
    trip tells you *which* stage went non-finite within one step.
+   Under k-step fused training (``fit(steps_per_device_call=k)``,
+   models/kstep.py) the executor fetches the stacked ``[k, 5]``
+   health block once per device call and hands this listener one row
+   per step — EVERY step is still inspected and a trip fires at the
+   exact poisoned sub-step; only the device→host cadence changes
+   (one fetch per k steps), so detection/rollback lag is bounded by
+   k, never lost to fusion.
 
 2. **Host plane — sliding-window detectors** over the scalar stream
    and the existing ``StatsReport`` pipe (chain the monitor as a

@@ -283,6 +283,15 @@ class ModelServer:
                 page_size=self.page_size, kv_pages=self.kv_pages))
         return b, version
 
+    def warmup(self, **kwargs) -> Dict[str, dict]:
+        """AOT warmup for every hosted model: pre-compile the predict
+        pow2 batch buckets and (optionally) the generate prefill +
+        decode programs, so the first real request — and every later
+        one landing in a warmed bucket — never pays an XLA compile
+        (see serving/warmup.py). Call before serving traffic."""
+        from deeplearning4j_tpu.serving.warmup import warmup_server
+        return warmup_server(self, **kwargs)
+
     # ---- HTTP plumbing ----
     def start(self) -> "ModelServer":
         server = self

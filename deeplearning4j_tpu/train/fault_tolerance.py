@@ -250,7 +250,8 @@ class ElasticTrainer:
                  max_rollbacks: int = 5, heal_after: Optional[int] = None,
                  handle_sigterm: bool = True, wrapper=None,
                  lr_drop_on_rollback: Optional[float] = None,
-                 async_checkpoint: bool = False):
+                 async_checkpoint: bool = False,
+                 steps_per_device_call: int = 1):
         # async_checkpoint: take checkpoints OFF the train thread —
         # save_checkpoint snapshots params/opt-state device→host at
         # the step boundary (cheap) and hands serialization + zip +
@@ -270,8 +271,40 @@ class ElasticTrainer:
         # then train data-parallel while checkpoint/restore still talks
         # to the underlying model (ParallelWrapper.java analog: the
         # wrapper composes with, not replaces, the model's lifecycle)
+        # steps_per_device_call: k-step fused training (the
+        # dispatch-bound fix, models/kstep.py) — the trainer collects
+        # k batches per window (fingerprint / skip-set / chaos still
+        # run PER LOGICAL STEP at collection time), dispatches them
+        # as one fused device program via ``model.fit_batches``, and
+        # checkpoints only at window boundaries so the iterator
+        # cursor always lands on a k-step boundary — preemption
+        # resume stays bit-identical. Non-finite/rollback detection
+        # lag is bounded by k (every step's loss still comes back).
+        # NOTE on listener semantics: the k>1 path drives
+        # ``model.fit_batches`` (no epoch hooks, ``epoch_count``
+        # untouched), while the legacy k=1 path calls
+        # ``model.fit(ds)`` per batch, which fires
+        # on_epoch_start/on_epoch_end and bumps ``epoch_count`` once
+        # PER BATCH — a historical quirk kept for checkpoint/test
+        # compatibility. Params are unaffected either way; listeners
+        # keying off epoch hooks see the (saner) windowed cadence
+        # under k>1.
         self.model = model
         self.wrapper = wrapper
+        self.k = int(steps_per_device_call)
+        if self.k < 1:
+            # same contract as the executors' fit(): an invalid k
+            # fails loudly everywhere instead of silently clamping
+            # in one mode and crashing in another
+            raise ValueError("steps_per_device_call must be >= 1")
+        if wrapper is not None and self.k > 1:
+            # the mesh step has no fused k-step program — failing
+            # loudly beats silently training with a different cadence
+            # than the operator asked for
+            raise ValueError(
+                "steps_per_device_call > 1 is not supported with a "
+                "ParallelWrapper (the mesh step is per-batch); drop "
+                "the wrapper or use steps_per_device_call=1")
         self.dir = checkpoint_dir
         os.makedirs(checkpoint_dir, exist_ok=True)
         self.save_every = max(1, save_every)
@@ -702,6 +735,14 @@ class ElasticTrainer:
                         f"before the restart — the replay "
                         f"fast-forward requires a same-order iterator "
                         f"(disable shuffling or seed it per-epoch)")
+                if self.k > 1:
+                    rolled_back = self._run_epoch_kstep(it)
+                    if rolled_back or self._stop_requested:
+                        continue
+                    self._epoch += 1
+                    self._batch = 0
+                    self._fp_chain = ""
+                    continue
                 rolled_back = False
                 while True:
                     # check BEFORE pulling: a batch fetched after the
@@ -795,6 +836,101 @@ class ElasticTrainer:
                                      "during fit-exception unwind")
         return self
 
+    def _run_epoch_kstep(self, it) -> bool:
+        """Window-at-a-time epoch body for ``steps_per_device_call=k``:
+        collect up to k batches (fingerprint chain, skip set and the
+        ``train.step`` chaos site all run PER LOGICAL STEP, exactly as
+        in the per-step loop), dispatch them as ONE fused device call
+        via ``model.fit_batches``, then inspect every step's loss.
+        Checkpoints happen only between windows — the iterator cursor
+        always agrees with ``self._batch`` there. A SIGTERM closes
+        the window under collection early (the partial window trains
+        through the pre-compiled k=1 program), so the grace
+        checkpoint lands within about one step of the signal, same as
+        the per-step loop. Returns True when a rollback was taken
+        (the caller restarts the epoch from the restored
+        position)."""
+        model = self.model
+        k = self.k
+        while True:
+            if self._stop_requested:
+                return False
+            window = []                      # [(ordinal, ds)]
+            exhausted = False
+            while len(window) < k:
+                # honor a SIGTERM mid-collection: close the window
+                # early (a partial window trains through the k=1
+                # program) so the grace checkpoint lands within ~one
+                # step, like the per-step loop — the cursor still
+                # equals the trained count and fused vs single-step
+                # are bit-identical, so resume is unaffected
+                if self._stop_requested:
+                    break
+                ds = next(it, None)
+                if ds is None:
+                    exhausted = True
+                    break
+                self._fp_chain = _chain(self._fp_chain,
+                                        _fingerprint(ds))
+                ordinal = self._batch
+                self._batch += 1
+                if (self._epoch, ordinal) in self._skip:
+                    continue                 # the poisoned batch
+                ds = self._chaos_step(ds)
+                window.append((ordinal, ds))
+            if window:
+                it_before = model.iteration_count
+                try:
+                    # full windows fuse into one scan program; the
+                    # epoch tail (len < k) runs through the
+                    # pre-compiled k=1 program — no mid-epoch trace
+                    losses = model.fit_batches(
+                        [d for _, d in window],
+                        steps_per_device_call=k)
+                except Exception as e:
+                    if not getattr(e, "rollback", False):
+                        raise
+                    # HealthMonitor raised from the listener pass at
+                    # some sub-step: the executor stamps the live
+                    # window entry on _window_batch_index (NOT
+                    # derivable from iteration deltas — a tBPTT entry
+                    # advances the iteration counter once per chunk)
+                    try:
+                        idx = int(getattr(model, "_window_batch_index",
+                                          0))
+                    except (TypeError, ValueError):
+                        idx = 0
+                    idx = min(max(idx, 0), len(window) - 1)
+                    logger.warning(
+                        "health monitor requested rollback: %s", e)
+                    self._rollback(
+                        skip_ordinal=(self._epoch, window[idx][0]))
+                    return True
+                bad = np.flatnonzero(~np.isfinite(
+                    np.asarray(losses, dtype=np.float64)))
+                if bad.size:
+                    # first non-finite step in the window: skip THAT
+                    # ordinal on replay (later window steps trained on
+                    # garbage params, but the rollback recomputes them
+                    # from the restored checkpoint — same trajectory
+                    # the per-step loop produces)
+                    self._rollback(skip_ordinal=(
+                        self._epoch, window[int(bad[0])][0]))
+                    return True
+                self._healthy_streak += len(window)
+                if (self.rollbacks
+                        and self._healthy_streak >= self.heal_after):
+                    self.rollbacks = 0       # incident over
+                if (it_before // self.save_every
+                        != model.iteration_count // self.save_every):
+                    # the save cadence was crossed inside the window:
+                    # checkpoint at the boundary, where the iterator
+                    # cursor equals self._batch and iterator state
+                    # rides the zip
+                    self.save_checkpoint()
+            if exhausted:
+                return False
+
     @staticmethod
     def _chaos_step(ds):
         f = chaos.step_fault("train.step")
@@ -823,7 +959,7 @@ class ElasticTrainer:
                 ds.features = arr
         return ds
 
-    def _rollback(self):
+    def _rollback(self, skip_ordinal=None):
         self.rollbacks += 1
         self.total_rollbacks += 1
         self._healthy_streak = 0
@@ -836,9 +972,13 @@ class ElasticTrainer:
                        "(rollback %d/%d)",
                        self.model.iteration_count, self.rollbacks,
                        self.max_rollbacks)
-        # the batch just consumed (ordinal _batch - 1) produced the
-        # non-finite loss: skip it on replay, replay everything else
-        self._skip.add((self._epoch, self._batch - 1))
+        # the batch that produced the non-finite loss: skip it on
+        # replay, replay everything else. Per-step callers leave the
+        # default (the batch just consumed, ordinal _batch - 1); the
+        # k-step window path passes the exact in-window ordinal.
+        if skip_ordinal is None:
+            skip_ordinal = (self._epoch, self._batch - 1)
+        self._skip.add(skip_ordinal)
         # an async save may still be in flight — it IS the newest
         # generation; restoring before it lands would silently roll
         # back further than necessary

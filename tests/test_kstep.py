@@ -1,0 +1,428 @@
+"""k-step fused on-device training + AOT warmup (ISSUE 10).
+
+Covers: bit-identical params across the seed per-step loop, k=1 and
+k=8 on both executors (the fused ``lax.scan`` program computes the
+same math as the single-step program); the tail-remainder contract
+(``n_batches % k`` runs through the pre-compiled k=1 program — zero
+mid-epoch traces, proven by the global compile watch); HealthMonitor
+trip latency bounded by k in fused mode; ElasticTrainer k-step
+integration (window-boundary checkpoints, SIGTERM-preemption soak
+resuming bit-identically with the iterator cursor on a k-step
+boundary, rollback skip-ordinal mapped back into the window); AOT
+warmup on both the training and the serving path (zero post-warmup
+compiles under ``zero_compile_scope``); and the CLI surface
+(``train --k-step/--aot-warmup``, ``serve --aot-warmup``,
+``--xla-cache``).
+"""
+
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (ArrayDataSetIterator,
+                                               ListDataSetIterator)
+from deeplearning4j_tpu.observability.compile_watch import (
+    SteadyStateCompileError, install_global_watch)
+from deeplearning4j_tpu.observability.health import (
+    HealthMonitor, TrainingDivergedError)
+from deeplearning4j_tpu.train.fault_tolerance import ElasticTrainer
+
+from fixtures import make_batches, poison_batch, tiny_classifier
+
+pytestmark = pytest.mark.kstep
+
+
+def _flat_params(model):
+    import jax
+    return [np.asarray(l)
+            for l in jax.tree_util.tree_leaves(model.params)]
+
+
+def _assert_bit_identical(a, b):
+    la, lb = _flat_params(a), _flat_params(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+def tiny_graph(seed: int = 0):
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.models.computation_graph import (
+        ComputationGraph)
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    g = (NeuralNetConfiguration.builder().set_seed(seed)
+         .updater(updaters.adam(0.01)).graph_builder()
+         .add_inputs("in")
+         .add_layer("h", DenseLayer(n_out=8, activation="relu"), "in")
+         .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "h")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4)).build())
+    return ComputationGraph(g).init()
+
+
+# ---------------------------------------------------------------------------
+# parity: the fused scan computes the per-step math bit-for-bit
+# ---------------------------------------------------------------------------
+
+class TestKStepParity:
+    def test_mln_bit_identical_seed_vs_k1_vs_k8(self):
+        """11 batches with k=8 = one fused window + a 3-batch tail
+        through the k=1 program; params must match the seed per-step
+        loop bit-for-bit."""
+        batches = make_batches(11, seed=3)
+        seed_loop = tiny_classifier(seed=1)
+        seed_loop.fit(ListDataSetIterator(list(batches)), epochs=2)
+        k1 = tiny_classifier(seed=1)
+        k1.fit(ListDataSetIterator(list(batches)), epochs=2,
+               steps_per_device_call=1)
+        k8 = tiny_classifier(seed=1)
+        k8.fit(ListDataSetIterator(list(batches)), epochs=2,
+               steps_per_device_call=8)
+        _assert_bit_identical(seed_loop, k1)
+        _assert_bit_identical(seed_loop, k8)
+        assert (seed_loop.iteration_count == k1.iteration_count
+                == k8.iteration_count == 22)
+
+    def test_graph_bit_identical_k1_vs_k4(self):
+        batches = make_batches(10, seed=4)
+        a = tiny_graph(seed=2)
+        a.fit(list(batches), epochs=1)
+        b = tiny_graph(seed=2)
+        b.fit(list(batches), epochs=1, steps_per_device_call=4)
+        _assert_bit_identical(a, b)
+        assert a.iteration_count == b.iteration_count == 10
+
+    def test_fit_batches_returns_every_steps_loss(self):
+        batches = make_batches(8, seed=5)
+        net = tiny_classifier(seed=3)
+        losses = net.fit_batches(batches, steps_per_device_call=8)
+        assert losses.shape == (8,)
+        assert np.isfinite(losses).all()
+        # the last step's loss is the model's score
+        assert float(net.score_value) == pytest.approx(
+            float(losses[-1]))
+
+    def test_shape_churn_window_falls_back_to_single_step(self):
+        """A window whose batches disagree on shape must not fuse
+        (and must not crash): every batch trains through the k=1
+        program, params identical to a per-step run."""
+        batches = make_batches(4, seed=6)
+        odd = make_batches(4, batch=5, seed=6)   # different B
+        mixed = [batches[0], odd[0], batches[1], odd[1]]
+        a = tiny_classifier(seed=4)
+        a.fit(ListDataSetIterator(list(mixed)), epochs=1)
+        b = tiny_classifier(seed=4)
+        b.fit(ListDataSetIterator(list(mixed)), epochs=1,
+              steps_per_device_call=4)
+        _assert_bit_identical(a, b)
+
+    def test_invalid_k_rejected(self):
+        net = tiny_classifier()
+        with pytest.raises(ValueError, match="steps_per_device_call"):
+            net.fit(ListDataSetIterator(make_batches(2)),
+                    steps_per_device_call=0)
+
+
+# ---------------------------------------------------------------------------
+# health: every fused step is observed; trip lag bounded by k
+# ---------------------------------------------------------------------------
+
+class TestKStepHealth:
+    def test_monitor_trips_at_poisoned_step_in_fused_window(self):
+        """Poison batch 5 of a k=8 window: the stacked health block
+        carries every step, so the monitor trips AT step 5 — within
+        <= k steps of the poison, never lost to fusion."""
+        batches = poison_batch(make_batches(8, seed=7), 5)
+        net = tiny_classifier(seed=5)
+        mon = HealthMonitor(policy="raise")
+        net.add_listeners(mon)
+        with pytest.raises(TrainingDivergedError) as ei:
+            net.fit(ListDataSetIterator(list(batches)), epochs=1,
+                    steps_per_device_call=8)
+        assert ei.value.anomaly["iteration"] == 5
+        assert mon.last["finite_bits"]          # device-plane trip
+        # params advanced through the window on device, but the trip
+        # fired during listener pass 5 (its counter un-incremented,
+        # same as the per-step path) — detection lag < k
+        assert net.iteration_count == 5
+
+    def test_fused_window_feeds_monitor_per_step_norms(self):
+        batches = make_batches(8, seed=8)
+        net = tiny_classifier(seed=6)
+        mon = HealthMonitor(policy="warn")
+        net.add_listeners(mon)
+        net.fit(ListDataSetIterator(list(batches)), epochs=1,
+                steps_per_device_call=8)
+        assert mon.last["iteration"] == 7
+        assert mon.last["grad_norm"] is not None
+        assert mon.last["param_norm"] is not None
+
+
+# ---------------------------------------------------------------------------
+# ElasticTrainer integration
+# ---------------------------------------------------------------------------
+
+class TestKStepElastic:
+    def test_wrapper_plus_kstep_fails_loudly(self, tmp_path):
+        with pytest.raises(ValueError, match="steps_per_device_call"):
+            ElasticTrainer(tiny_classifier(), str(tmp_path),
+                           wrapper=object(), steps_per_device_call=2)
+
+    def test_nan_rollback_skips_exact_window_ordinal(self, tmp_path):
+        """A poisoned batch inside a fused window rolls back and
+        records THAT ordinal in the skip set (not the window
+        boundary); the run completes with finite params."""
+        batches = poison_batch(make_batches(16, seed=9), 10)
+        net = tiny_classifier(seed=7)
+        tr = ElasticTrainer(net, str(tmp_path / "ck"), save_every=4,
+                            handle_sigterm=False,
+                            steps_per_device_call=8)
+        tr.fit(ListDataSetIterator(list(batches)), epochs=1)
+        assert tr.total_rollbacks == 1
+        assert (0, 10) in tr._skip
+        assert net.iteration_count == 15         # 16 - 1 skipped
+        for leaf in _flat_params(net):
+            assert np.isfinite(leaf).all()
+
+    def test_checkpoints_land_on_window_boundaries(self, tmp_path):
+        """save_every=10 with k=8: the cadence crossing inside a
+        window defers the save to the window boundary, so the
+        persisted batch cursor is always a multiple of k (or the
+        epoch end) and iterator state rides the zip."""
+        batches = make_batches(20, seed=10)
+        net = tiny_classifier(seed=8)
+        tr = ElasticTrainer(net, str(tmp_path / "ck"), save_every=10,
+                            handle_sigterm=False,
+                            steps_per_device_call=8)
+        tr.fit(ListDataSetIterator(list(batches)), epochs=1)
+        cursors = []
+        for f in sorted(os.listdir(tr.dir)):
+            if not f.endswith(".zip"):
+                continue
+            with zipfile.ZipFile(os.path.join(tr.dir, f)) as z:
+                pos = json.loads(z.read("data_position.json"))
+            cursors.append((f, pos["epoch"], pos["batch"]))
+        assert cursors
+        for f, _, batch in cursors:
+            assert batch % 8 == 0 or batch in (0, 20), (f, batch)
+
+    def test_sigterm_soak_k8_resumes_bit_identical(self, tmp_path):
+        """ACCEPTANCE: seeded-plan SIGTERM at logical step 14 (inside
+        window [8..16)) under k=8 — collection closes the window
+        early, the partial window [8..14] trains through the k=1
+        program (fused and single-step are bit-identical, so the
+        grouping change is invisible to the math), the grace
+        checkpoint lands within one step of the signal (cursor 15),
+        and the restart converges bit-identically to the
+        uninterrupted k=8 run, resuming via iterator state."""
+        rng = np.random.default_rng(12)
+        x = rng.normal(size=(160, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 160)]
+
+        def make_it():
+            return ArrayDataSetIterator(x, y, batch_size=8,
+                                        shuffle=True, seed=5)
+
+        ref = tiny_classifier(seed=2)
+        ElasticTrainer(ref, str(tmp_path / "free"), save_every=8,
+                       handle_sigterm=False,
+                       steps_per_device_call=8).fit(
+            make_it(), until_epoch=2)
+
+        chaos.install({"faults": [
+            {"site": "train.step", "kind": "sigterm", "at": [14]},
+        ]}, seed=9)
+        try:
+            cdir = str(tmp_path / "preempted")
+            net = tiny_classifier(seed=2)
+            tr = ElasticTrainer(net, cdir, save_every=8,
+                                handle_sigterm=True,
+                                steps_per_device_call=8)
+            tr.fit(make_it(), until_epoch=2)     # clean grace stop
+        finally:
+            chaos.uninstall()
+        assert tr._stop_requested
+        # grace stop within one step of the signal — the partial
+        # window trained and the cursor matches what the PER-STEP
+        # loop stops at for the same seeded plan (cursor 14)
+        assert net.iteration_count == 14
+        assert tr._batch == 14
+        newest = tr.latest_checkpoint()
+        assert os.path.basename(newest) == "ckpt_14.zip"
+
+        net2 = tiny_classifier(seed=2)
+        tr2 = ElasticTrainer(net2, cdir, save_every=8,
+                             handle_sigterm=True,
+                             steps_per_device_call=8)
+        assert net2.iteration_count == 14
+        tr2.fit(make_it(), until_epoch=2)
+        assert net2.iteration_count == ref.iteration_count == 40
+        _assert_bit_identical(ref, net2)
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup: zero compiles after startup
+# ---------------------------------------------------------------------------
+
+class TestAOTWarmup:
+    def test_fit_steady_state_zero_compiles_with_tail(self):
+        """Warm the k=8 and k=1 programs, then fit 11 batches x 2
+        epochs (fused windows + tail remainder): ZERO backend
+        compiles — the tail runs the pre-compiled k=1 executable,
+        never a fresh mid-epoch trace."""
+        batches = make_batches(11, seed=13)
+        net = tiny_classifier(seed=9)
+        rep = net.warmup(batches[0], steps_per_device_call=8)
+        assert set(rep) == {"train_step", "kstep_8"}
+        stats = install_global_watch()
+        with stats.zero_compile_scope("k-step fit steady state"):
+            net.fit(ListDataSetIterator(list(batches)), epochs=2,
+                    steps_per_device_call=8)
+        assert net.iteration_count == 22
+
+    def test_warmup_from_float64_batch_stays_warm(self):
+        """np.eye defaults to float64: the warmup key must be
+        computed in JAX-canonical dtypes, or the warmed k=1
+        executable is unreachable at dispatch (jnp.asarray hands the
+        program f32) and the steady state compiles anyway."""
+        rng = np.random.default_rng(20)
+        batches = [DataSet(rng.normal(size=(8, 4)),          # f64
+                           np.eye(3)[rng.integers(0, 3, 8)])  # f64
+                   for _ in range(6)]
+        net = tiny_classifier(seed=16)
+        rep = net.warmup(batches[0], steps_per_device_call=2)
+        assert set(rep) == {"train_step", "kstep_2"}
+        stats = install_global_watch()
+        with stats.zero_compile_scope("f64-input steady state"):
+            net.fit(ListDataSetIterator(list(batches)), epochs=1,
+                    steps_per_device_call=2)
+
+    def test_warmup_is_idempotent_per_signature(self):
+        net = tiny_classifier(seed=10)
+        ds = make_batches(1, seed=14)[0]
+        assert net.warmup(ds, steps_per_device_call=4)
+        assert net.warmup(ds, steps_per_device_call=4) == {}
+
+    def test_warmup_with_health_listener_stays_warm(self):
+        """Listeners attach BEFORE warmup: the health-enabled program
+        (stacked [k, 5] health block) is what gets AOT-compiled, and
+        the fit steady state still compiles zero times."""
+        batches = make_batches(8, seed=15)
+        net = tiny_classifier(seed=11)
+        net.add_listeners(HealthMonitor(policy="warn"))
+        net.warmup(batches[0], steps_per_device_call=8)
+        stats = install_global_watch()
+        with stats.zero_compile_scope("health-enabled steady state"):
+            net.fit(ListDataSetIterator(list(batches)), epochs=1,
+                    steps_per_device_call=8)
+
+    def test_zero_compile_scope_raises_on_cold_program(self):
+        stats = install_global_watch()
+        net = tiny_classifier(seed=12)
+        with pytest.raises(SteadyStateCompileError):
+            with stats.zero_compile_scope("cold fit"):
+                net.fit(ListDataSetIterator(make_batches(2, seed=16)),
+                        epochs=1)
+
+    def test_serve_warmup_then_burst_zero_compiles(self):
+        """ModelServer.warmup() pre-builds every pow2 predict bucket;
+        a mixed-batch-size request burst through the scheduler then
+        compiles zero times."""
+        from deeplearning4j_tpu.serving.http import ModelServer
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        reg = ModelRegistry()
+        reg.register("default", tiny_classifier(seed=13))
+        server = ModelServer(reg, max_batch_size=8)
+        try:
+            rep = server.warmup()
+            assert rep["default"]["predict_buckets"] == [1, 2, 4, 8]
+            stats = install_global_watch()
+            sched, _ = server.scheduler_for("default")
+            with stats.zero_compile_scope("serve burst"):
+                for n in (1, 2, 3, 5, 8, 7, 1):
+                    out = sched.predict(
+                        np.zeros((n, 4), np.float32), timeout=30)
+                    assert out.shape == (n, 3)
+        finally:
+            server.stop(drain=False)
+
+    def test_serve_warmup_skips_underivable_shapes(self):
+        """A model whose config pins no concrete input shape skips
+        predict warmup with the reason on record instead of dying."""
+        from deeplearning4j_tpu.serving.http import ModelServer
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        net = tiny_classifier(seed=14)
+        net.conf.input_type = None
+        reg = ModelRegistry()
+        reg.register("noshape", net)
+        server = ModelServer(reg, max_batch_size=4)
+        try:
+            rep = server.warmup(generate=False)
+            assert rep["noshape"]["predict_buckets"] == []
+            assert rep["noshape"]["skipped"]
+        finally:
+            server.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+class TestKStepCLI:
+    def test_help_mentions_new_flags(self, capsys):
+        from deeplearning4j_tpu.cli import main
+        with pytest.raises(SystemExit) as ei:
+            main(["train", "--help"])
+        assert ei.value.code == 0
+        out = capsys.readouterr().out
+        assert "--k-step" in out and "--aot-warmup" in out
+        with pytest.raises(SystemExit) as ei:
+            main(["serve", "--help"])
+        assert ei.value.code == 0
+        assert "--aot-warmup" in capsys.readouterr().out
+        with pytest.raises(SystemExit) as ei:
+            main(["--help"])
+        assert ei.value.code == 0
+        assert "--xla-cache" in capsys.readouterr().out
+
+    def test_kstep_with_workers_fails_loudly(self):
+        from deeplearning4j_tpu.cli import main
+        with pytest.raises(SystemExit) as ei:
+            main(["train", "--model", "nope.zip", "--data", "n.csv",
+                  "--label-index", "4", "--k-step", "4",
+                  "--workers", "2"])
+        assert "--k-step" in str(ei.value)
+
+    def test_cli_train_kstep_aot_e2e(self, tmp_path, capsys):
+        """End-to-end: train --k-step 4 --aot-warmup over a CSV runs,
+        prints the warmup report, and saves a model."""
+        from deeplearning4j_tpu.cli import main
+        from deeplearning4j_tpu.util.model_serializer import (
+            write_model)
+        mpath = str(tmp_path / "m.zip")
+        write_model(tiny_classifier(seed=15), mpath)
+        rng = np.random.default_rng(17)
+        rows = []
+        for _ in range(24):
+            feats = rng.normal(size=4)
+            rows.append(",".join(f"{v:.5f}" for v in feats)
+                        + f",{rng.integers(0, 3)}")
+        data = str(tmp_path / "d.csv")
+        with open(data, "w") as f:
+            f.write("\n".join(rows) + "\n")
+        out = str(tmp_path / "trained.zip")
+        main(["train", "--model", mpath, "--data", data,
+              "--label-index", "4", "--classes", "3",
+              "--batch-size", "8", "--epochs", "1",
+              "--k-step", "2", "--aot-warmup", "--output", out])
+        printed = capsys.readouterr().out
+        assert "aot warmup:" in printed
+        assert os.path.exists(out)
