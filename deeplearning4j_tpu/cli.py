@@ -50,12 +50,19 @@ def _cmd_train(args):
                  "drop --workers")
     if args.k_step < 1:
         sys.exit("train: --k-step must be >= 1")
+    if args.mesh and args.workers and args.workers > 1:
+        # two ways to state the same parallelism — refuse the
+        # ambiguity (--mesh "dp=N" is the --workers N successor)
+        sys.exit("train: pass either --mesh (declarative sharded "
+                 "fit) or --workers (legacy data-parallel wrapper), "
+                 "not both")
     if args.k_step > 1 and args.workers and args.workers > 1:
-        # the mesh step is per-batch: silently ignoring the fused
-        # cadence the operator asked for would be worse than refusing
+        # the wrapper's per-batch path has no fused program on this
+        # CLI route; the declarative spec composes with fusion
         sys.exit("train: --k-step >1 is not supported with "
-                 "--workers >1 (the data-parallel mesh step is "
-                 "per-batch); drop one of the two flags")
+                 "--workers >1 (the legacy wrapper steps per-batch); "
+                 "use --mesh \"dp=N\" — the sharded fit path fuses "
+                 "k-step windows")
     if args.aot_warmup and args.workers and args.workers > 1:
         # warmup() compiles the SINGLE-worker train programs; the
         # ParallelWrapper path dispatches a different (mesh) program,
@@ -63,8 +70,16 @@ def _cmd_train(args):
         # still compile cold at the first mesh step
         sys.exit("train: --aot-warmup is not supported with "
                  "--workers >1 (warmup builds the single-worker "
-                 "programs; the mesh step compiles its own)")
+                 "programs; the mesh step compiles its own — with "
+                 "--mesh the warmed programs ARE the sharded ones)")
     model = restore_model(args.model)
+    if args.mesh:
+        # install the mesh BEFORE warmup/elastic construction: the
+        # warmed programs and any checkpoint restore must be the
+        # sharded, output-pinned ones
+        model.use_mesh(args.mesh)
+        print(f"mesh: {model._mesh_ctx.plan} over "
+              f"{model._mesh_ctx.plan.n_devices()} device(s)")
     rr = CSVRecordReader().initialize(args.data)
     it = RecordReaderDataSetIterator(
         rr, args.batch_size, label_index=args.label_index,
@@ -208,7 +223,11 @@ def _cmd_serve(args):
         slots=args.slots, capacity=args.capacity, metrics=metrics,
         sample_rate=args.trace_sample, slow_ms=args.slow_ms,
         slos=slos, kv_mode=args.kv_mode, page_size=args.page_size,
-        kv_pages=args.kv_pages)
+        kv_pages=args.kv_pages, mesh=args.mesh)
+    if args.mesh:
+        print(f"serving mesh: {server.mesh_plan} "
+              f"({server.mesh_plan.n_devices()} device(s); predict "
+              f"tensor-parallel, generate unsharded-replica only)")
     if args.aot_warmup:
         # pre-compile every hosted model's serving executables (pow2
         # predict buckets + generate prefill/decode) BEFORE the
@@ -257,7 +276,8 @@ def _cmd_serve_fleet(args):
         server_kwargs=dict(max_batch_size=args.max_batch_size,
                            queue_limit=args.queue_limit,
                            wait_ms=args.wait_ms, slots=args.slots,
-                           capacity=args.capacity)).start()
+                           capacity=args.capacity,
+                           mesh=args.mesh)).start()
     router = Router(
         fleet, port=args.port, host=args.host,
         probe_interval_s=args.probe_interval,
@@ -317,7 +337,20 @@ def main(argv=None):
     t.add_argument("--batch-size", type=int, default=64)
     t.add_argument("--epochs", type=int, default=1)
     t.add_argument("--workers", type=int, default=0,
-                   help=">1 = data-parallel over that many devices")
+                   help=">1 = data-parallel over that many devices "
+                        "(legacy wrapper; prefer --mesh)")
+    t.add_argument("--mesh", metavar="SPEC", default=None,
+                   help="declarative sharded training: 'dp=4' | "
+                        "'dp=2,tp=2' | JSON (axes dp/tp; sp trains "
+                        "via ParallelWrapper, pp via the SPMD "
+                        "pipeline module). Params are placed per "
+                        "the spec, batches split over dp, and the "
+                        "train step runs as ONE sharded device "
+                        "program — composing with --k-step (fused "
+                        "sharded windows) and --aot-warmup. On a "
+                        "CPU host export XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N "
+                        "first")
     t.add_argument("--prefetch", type=int, default=2)
     t.add_argument("--output", default=None)
     t.add_argument("--health", nargs="?", const="warn", default=None,
@@ -436,6 +469,14 @@ def main(argv=None):
                         "file (see README 'Request tracing & SLOs' "
                         "for the rule schema); multi-window burn-rate "
                         "breaches flip /healthz to degraded")
+    v.add_argument("--mesh", metavar="SPEC", default=None,
+                   help="serve predict tensor-parallel over a "
+                        "declarative mesh ('tp=2' | 'dp=2,tp=2'): "
+                        "params sharded per the Megatron rule "
+                        "table, one AOT-warmable executable per "
+                        "pow2 batch bucket; the mesh shape is "
+                        "surfaced on /healthz and the "
+                        "serving_mesh_devices gauge")
     v.set_defaults(fn=_cmd_serve)
 
     f = sub.add_parser(
@@ -468,6 +509,11 @@ def main(argv=None):
                         "disables hedging")
     f.add_argument("--trace-sample", type=float, default=0.01,
                    metavar="RATE")
+    f.add_argument("--mesh", metavar="SPEC", default=None,
+                   help="every replica serves predict tensor-"
+                        "parallel over this mesh spec (see serve "
+                        "--mesh); replica meshes surface on each "
+                        "/healthz the router scrapes")
     f.add_argument("--chaos", metavar="PLAN", default=None,
                    help="deterministic fault plan (the "
                         "serving.replica site kills/hangs whole "

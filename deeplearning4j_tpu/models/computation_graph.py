@@ -278,7 +278,11 @@ class ComputationGraph(KStepExecutorMixin):
     def _make_train_step(self):
         core = self._train_core
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        # under a mesh context the program's output layout is pinned
+        # to the placed model's (kstep._train_jit_kwargs) — GSPMD
+        # must not drift a carry sharding and recompile every step
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                           **self._train_jit_kwargs())
         def train_step(params, state, opt_state, batch, base_rng, step):
             rng = jax.random.fold_in(base_rng, step)
             return core(params, state, opt_state, batch, rng)
@@ -375,16 +379,21 @@ class ComputationGraph(KStepExecutorMixin):
         return (inputs, labels, fm, lm)
 
     def fit(self, data, *, epochs: int = 1,
-            steps_per_device_call: int = 1):
+            steps_per_device_call: int = 1, mesh_spec=None):
         """data: iterable of DataSet/MultiDataSet, or a single one.
         ``steps_per_device_call=k`` fuses k train steps into one
         ``lax.scan`` device program (see
         :meth:`MultiLayerNetwork.fit`); the epoch tail runs through
-        the pre-compiled k=1 program."""
+        the pre-compiled k=1 program. ``mesh_spec`` trains sharded
+        over a declarative device mesh and composes with the fused
+        windows (see :meth:`MultiLayerNetwork.fit` /
+        ``parallel/mesh_spec.py``)."""
         from deeplearning4j_tpu.observability.tracing import trace
         k = int(steps_per_device_call)
         if k < 1:
             raise ValueError("steps_per_device_call must be >= 1")
+        if mesh_spec is not None:
+            self.use_mesh(mesh_spec)
         if self.params is None:
             self.init()
         if isinstance(data, (DataSet, MultiDataSet)):
@@ -428,12 +437,15 @@ class ComputationGraph(KStepExecutorMixin):
                    data_wait_s: float = 0.0) -> None:
         self._fit_tbptt(mds, tbptt, data_wait_s=data_wait_s)
 
-    def warmup(self, example, *, steps_per_device_call: int = 1):
+    def warmup(self, example, *, steps_per_device_call: int = 1,
+               mesh_spec=None):
         """AOT warmup: ``jit(...).lower(shapes).compile()`` the
         k-step and k=1 train programs for this batch signature (see
         :meth:`MultiLayerNetwork.warmup`). Attach listeners before
         warming. Returns ``{program: compile_seconds}``."""
         from deeplearning4j_tpu.models import kstep as _kstep
+        if mesh_spec is not None:
+            self.use_mesh(mesh_spec)
         if self.params is None:
             self.init()
         self._sync_health_mode()

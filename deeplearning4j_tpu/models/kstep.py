@@ -95,7 +95,8 @@ def stack_batches(batch_tuples: Sequence):
         *batch_tuples)
 
 
-def make_kstep_fn(step_core, k: int, health_enabled: bool):
+def make_kstep_fn(step_core, k: int, health_enabled: bool,
+                  out_shardings=None):
     """Build the fused k-step train program.
 
     ``step_core(params, state, opt_state, batch, rng)`` is the
@@ -111,6 +112,13 @@ def make_kstep_fn(step_core, k: int, health_enabled: bool):
     can never alias them — donation would be a no-op that warns
     "donated buffers were not usable" on every trace. ``base_rng`` is
     reused across calls and must not donate either.
+
+    ``out_shardings`` (the mesh-spec fit path,
+    ``parallel/mesh_spec.py``) pins the program's output layout to
+    the input layout: without the pin GSPMD may emit a different
+    sharding for a carry leaf than the one it arrived with, and the
+    NEXT window's changed input shardings silently recompile every
+    call.
     """
     if k < 2:
         raise ValueError("k-step fusion needs k >= 2; the k=1 path "
@@ -118,7 +126,12 @@ def make_kstep_fn(step_core, k: int, health_enabled: bool):
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    jit_kwargs = {}
+    if out_shardings is not None:
+        jit_kwargs["out_shardings"] = out_shardings
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                       **jit_kwargs)
     def kstep_train(params, state, opt_state, window, base_rng, step0):
         def body(carry, xs):
             p, s, o = carry
@@ -150,11 +163,27 @@ def aot_compile(jit_fn, example_args) -> Tuple[Any, float]:
     advance params) and WITHOUT allocating real buffers. Returns
     ``(compiled, seconds)``; the compiled object is directly callable
     with concrete arguments of exactly this signature (donation
-    preserved)."""
+    preserved).
+
+    Example leaves that are mesh-placed ``jax.Array``s (or
+    ``ShapeDtypeStruct``s already carrying a sharding — the
+    mesh-spec fit path's abstract batches) keep their sharding in
+    the lowered signature, so the compiled executable accepts
+    exactly the sharded arguments dispatch will feed it; a
+    sharding-less lowering would compile an executable the sharded
+    steady state can never hit."""
     import jax
-    abstract = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(np.shape(x), _dtype_of(x)),
-        example_args)
+    from jax.sharding import NamedSharding
+
+    def _abstract(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+        return jax.ShapeDtypeStruct(np.shape(x), _dtype_of(x))
+
+    abstract = jax.tree_util.tree_map(_abstract, example_args)
     t0 = time.perf_counter()
     compiled = jit_fn.lower(*abstract).compile()
     return compiled, time.perf_counter() - t0
@@ -169,7 +198,88 @@ class KStepExecutorMixin:
     ``_jit_train_step``/``_jit_kstep``/``_aot`` caches, and three
     small adapters — ``_coerce_fit_batch`` (DataSet → its native
     batch object), ``_batch_is_tbptt`` and ``_run_tbptt``; batches
-    only need ``num_examples()``."""
+    only need ``num_examples()``.
+
+    MESH-SPEC SHARDING (``parallel/mesh_spec.py``): :meth:`use_mesh`
+    installs a :class:`~deeplearning4j_tpu.parallel.mesh_spec.MeshContext`
+    — params/opt-state placed per the spec (tensor-parallel rules
+    over 'model', replication over 'data'), every batch/window
+    transfer sharded over 'data', and every train program (k=1 AND
+    the fused k-step scan) built with pinned ``out_shardings`` so
+    the sharded steady state never recompiles. The k-step window
+    machinery below is mesh-agnostic: a fused window over a dp x tp
+    mesh is the same ``lax.scan`` program, GSPMD-partitioned —
+    fused multichip steps in ONE device program."""
+
+    # the installed MeshContext (None = single-device semantics);
+    # a class default so both executors inherit it without touching
+    # their __init__s
+    _mesh_ctx = None
+
+    def use_mesh(self, mesh_spec, devices=None, *,
+                 respect_existing: bool = False):
+        """Install a declarative mesh spec (``"dp=4,tp=2"`` | dict |
+        JSON | a prebuilt ``MeshContext``) on this executor: place
+        the model, and invalidate every compiled train program so
+        the next fit builds sharded, output-pinned executables.
+        ``respect_existing`` keeps param leaves a caller already
+        placed on an equal mesh (the ParallelWrapper contract)."""
+        from deeplearning4j_tpu.parallel.mesh_spec import (
+            MeshContext, build_mesh_context)
+        if mesh_spec is None:
+            return self
+        tbptt = self.conf.conf.tbptt
+        if tbptt is not None:
+            raise NotImplementedError(
+                "tBPTT does not compose with mesh_spec yet (the "
+                "chunked step threads recurrent carries the sharded "
+                "program does not pin); drop tbptt or the mesh spec")
+        if self.params is None:
+            self.init()
+        ctx = (mesh_spec if isinstance(mesh_spec, MeshContext)
+               else build_mesh_context(mesh_spec, self, devices))
+        cur = self._mesh_ctx
+        if (cur is not None and cur.plan == ctx.plan
+                and tuple(cur.mesh.devices.flat)
+                == tuple(ctx.mesh.devices.flat)):
+            # same spec over the same devices: keep the installed
+            # context AND its compiled programs — warmup(mesh_spec=X)
+            # followed by fit(mesh_spec=X) must not flush the
+            # AOT-warmed executables and recompile on the first step
+            cur.place_model(self, respect_existing=True)
+            return self
+        self._mesh_ctx = ctx
+        ctx.place_model(self, respect_existing=respect_existing)
+        # every compiled program pins shardings — rebuild them all
+        self._flush_compiled_programs()
+        return self
+
+    def _flush_compiled_programs(self) -> None:
+        """Drop every compiled/AOT train program — the ONE flush
+        both mesh installers use (``use_mesh`` here, the wrapper's
+        shrink/regrow rebuild), so a future executor cache cannot be
+        missed at one site and serve stale-mesh executables."""
+        self._jit_train_step = None
+        self._jit_tbptt_step = None
+        self._jit_kstep = {}
+        self._aot = {}
+
+    def _mesh_out_shardings(self):
+        """Pinned ``out_shardings`` for the train programs under the
+        installed mesh context (None otherwise) — the single place
+        that knows how many trailing scalar/stacked outputs the step
+        tuple carries (loss, plus the health block when enabled)."""
+        if self._mesh_ctx is None:
+            return None
+        n_out = 2 if self._health_enabled else 1
+        return self._mesh_ctx.step_out_shardings(self, n_out)
+
+    def _train_jit_kwargs(self) -> dict:
+        """Extra ``jax.jit`` kwargs for the executor's k=1 train
+        step: pinned ``out_shardings`` under a mesh context (see
+        module docstring), nothing otherwise."""
+        sh = self._mesh_out_shardings()
+        return {} if sh is None else {"out_shardings": sh}
 
     def _fit_epoch(self, data_iter, k: int, tbptt) -> None:
         """One epoch's batch loop (shared by both executors' ``fit``):
@@ -210,7 +320,17 @@ class KStepExecutorMixin:
         from deeplearning4j_tpu.observability.tracing import trace
         t1 = time.perf_counter()
         with trace.span("train_step"):
-            batch = self._batch_tuple(ds)
+            if self._mesh_ctx is not None:
+                # shard from HOST arrays: host→mesh device_put is a
+                # plain per-shard copy, while resharding an already-
+                # committed device array onto a multi-axis mesh
+                # compiles a _multi_slice program per shape — a stray
+                # compile the warmed zero-compile steady state must
+                # not pay
+                batch = self._mesh_ctx.shard_batch(
+                    self._batch_tuple_np(ds))
+            else:
+                batch = self._batch_tuple(ds)
             out = self._step_fn_for(batch)(
                 self.params, self.state, self.opt_state, batch,
                 self._rng_key, np.int32(self.iteration_count))
@@ -289,7 +409,8 @@ class KStepExecutorMixin:
         fn = self._jit_kstep.get(k)
         if fn is None:
             fn = self._jit_kstep[k] = make_kstep_fn(
-                self._train_core, k, self._health_enabled)
+                self._train_core, k, self._health_enabled,
+                out_shardings=self._mesh_out_shardings())
         return fn
 
     def _flush_window(self, pending, k: int):
@@ -319,6 +440,8 @@ class KStepExecutorMixin:
         bounded by k."""
         from deeplearning4j_tpu.observability.tracing import trace
         window = stack_batches(tups)
+        if self._mesh_ctx is not None:
+            window = self._mesh_ctx.shard_window(window)
         fn = self._kstep_fn_for(window, k)
         t1 = time.perf_counter()
         with trace.span("train_step_fused"):
@@ -373,7 +496,12 @@ def warmup_train_programs(model, batch_np, k: int) -> Dict[str, float]:
     (call after ``init()``; the executor's ``warmup()`` method
     handles that)."""
     out: Dict[str, float] = {}
-    args1 = (model.params, model.state, model.opt_state, batch_np,
+    # under a mesh context the lowered batch/window signatures carry
+    # the data shardings dispatch will use — a sharding-less lowering
+    # would build executables the sharded fit loop can never hit
+    ctx = getattr(model, "_mesh_ctx", None)
+    batch_ex = ctx.abstract_batch(batch_np) if ctx else batch_np
+    args1 = (model.params, model.state, model.opt_state, batch_ex,
              model._rng_key, np.int32(0))
     key1 = ("train1", signature(batch_np))
     if key1 not in model._aot:
@@ -387,8 +515,9 @@ def warmup_train_programs(model, batch_np, k: int) -> Dict[str, float]:
             # the SAME get-or-create the fit loop uses — warmup and
             # dispatch can never build different programs for one k
             fn = model._kstep_fn_for(window, k)
+            window_ex = ctx.abstract_window(window) if ctx else window
             argsk = (model.params, model.state, model.opt_state,
-                     window, model._rng_key, np.int32(0))
+                     window_ex, model._rng_key, np.int32(0))
             compiled, secs = aot_compile(fn, argsk)
             model._aot[keyk] = compiled
             out[f"kstep_{k}"] = secs

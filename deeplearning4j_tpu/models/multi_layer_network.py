@@ -274,7 +274,11 @@ class MultiLayerNetwork(KStepExecutorMixin):
     def _make_train_step(self):
         core = self._train_core
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        # under a mesh context the program's output layout is pinned
+        # to the placed model's (kstep._train_jit_kwargs) — GSPMD
+        # must not drift a carry sharding and recompile every step
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                           **self._train_jit_kwargs())
         def train_step(params, state, opt_state, batch, base_rng, step):
             # step arrives as a traced scalar; folding inside the jit
             # avoids a host-side dispatch per iteration
@@ -361,7 +365,7 @@ class MultiLayerNetwork(KStepExecutorMixin):
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: Optional[int] = None,
-            steps_per_device_call: int = 1):
+            steps_per_device_call: int = 1, mesh_spec=None):
         """``steps_per_device_call=k`` fuses k train steps into ONE
         device program (a ``lax.scan`` over a stacked batch window —
         models/kstep.py): the dispatch-bound regime pays one host
@@ -369,11 +373,21 @@ class MultiLayerNetwork(KStepExecutorMixin):
         fire per step (losses and the fused health vector come back
         stacked, one fetch per window); a tail of ``n_batches % k``
         runs through the k=1 program — pre-compile both with
-        :meth:`warmup` and the steady state never compiles."""
+        :meth:`warmup` and the steady state never compiles.
+
+        ``mesh_spec`` ("dp=4,tp=2" | dict | JSON — see
+        ``parallel/mesh_spec.py``) trains SHARDED: params placed per
+        the spec, batches split over the mesh's data axis, and the
+        train programs (fused k-step windows included) run as single
+        SPMD device programs with pinned output shardings. Composes
+        with ``steps_per_device_call`` — k sharded steps per host
+        round-trip."""
         from deeplearning4j_tpu.observability.tracing import trace
         k = int(steps_per_device_call)
         if k < 1:
             raise ValueError("steps_per_device_call must be >= 1")
+        if mesh_spec is not None:
+            self.use_mesh(mesh_spec)
         if self.params is None:
             self.init()
         it = _as_iterator(data, labels, batch_size)
@@ -412,7 +426,7 @@ class MultiLayerNetwork(KStepExecutorMixin):
         self._fit_tbptt(ds, None, tbptt, data_wait_s=data_wait_s)
 
     def warmup(self, example: DataSet, *,
-               steps_per_device_call: int = 1):
+               steps_per_device_call: int = 1, mesh_spec=None):
         """AOT warmup: ``jit(...).lower(shapes).compile()`` the train
         programs this batch signature will need — the k-step fused
         program (``steps_per_device_call > 1``) and the k=1
@@ -429,6 +443,8 @@ class MultiLayerNetwork(KStepExecutorMixin):
         one-time across runs. Returns
         ``{program: compile_seconds}``."""
         from deeplearning4j_tpu.models import kstep as _kstep
+        if mesh_spec is not None:
+            self.use_mesh(mesh_spec)
         if self.params is None:
             self.init()
         self._sync_health_mode()

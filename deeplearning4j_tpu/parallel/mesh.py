@@ -74,22 +74,45 @@ def largest_pow2(n: int) -> int:
 
 
 def shrink_data_mesh(mesh: Mesh, lost) -> Mesh:
-    """Rebuild a pure data-parallel mesh over the devices surviving
+    """Shrink the DATA axis of a mesh over the devices surviving
     ``lost`` (an iterable of device objects), at the largest
     power-of-two dp that fits — dp=8 with one device lost becomes
-    dp=4. Only data-parallel meshes shrink: params are REPLICATED
-    over 'data', so any survivor holds a complete copy to re-shard
-    from; a mesh that also shards 'model'/'pipe'/'seq' has state that
-    lived only on the lost device and must recover via checkpoint
+    dp=4.
+
+    Two shapes shrink:
+
+    - pure data-parallel: params are REPLICATED over 'data', so any
+      survivor holds a complete copy to re-shard from;
+    - data x model (dp x tp): params are sharded over 'model' but
+      replicated over 'data' — every dp ROW holds one complete copy
+      of every tp shard, so losing a device costs its whole row
+      (that row is missing a tp shard) and the mesh rebuilds over
+      the largest power-of-two count of INTACT rows, tp axis kept.
+
+    Meshes sharding 'pipe'/'seq' do not shrink: pipeline/sequence
+    state lived only on the lost device — recover via checkpoint
     restart instead."""
-    for ax in ("model", "pipe", "seq"):
+    for ax in ("pipe", "seq"):
         if mesh.shape.get(ax, 1) > 1:
             raise NotImplementedError(
-                f"elastic shrink supports pure data-parallel meshes; "
-                f"axis {ax!r} has size {mesh.shape[ax]} — sharded "
-                "state died with the device, restart from a "
+                f"elastic shrink supports data / data x model "
+                f"meshes; axis {ax!r} has size {mesh.shape[ax]} — "
+                "sharded state died with the device, restart from a "
                 "checkpoint instead")
     lost = set(lost)
+    tp = mesh.shape.get("model", 1)
+    if tp > 1:
+        # rows of the (data, model) grid with no lost device keep a
+        # complete set of tp shards; rows touched by the loss are
+        # unusable as a unit
+        grid = mesh.devices.reshape(mesh.shape.get("data", 1), tp)
+        rows = [list(r) for r in grid
+                if not any(d in lost for d in r)]
+        if not rows:
+            raise RuntimeError("no intact dp row survives the loss")
+        dp = largest_pow2(len(rows))
+        devs = [d for r in rows[:dp] for d in r]
+        return build_mesh(MeshSpec(data=dp, model=tp), devs)
     survivors = [d for d in mesh.devices.flat if d not in lost]
     if not survivors:
         raise RuntimeError("no surviving devices to shrink onto")

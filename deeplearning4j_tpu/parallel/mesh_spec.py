@@ -1,0 +1,360 @@
+"""Declarative mesh specs → first-class sharded fit/serve paths.
+
+MULTICHIP_r05 proves every parallel regime as a dryrun; this module
+is what promotes them into the REAL executors: a tiny declarative
+spec (``"dp=4,tp=2"``, a ``{"dp": 4, "tp": 2}`` dict, or JSON)
+validated against the visible devices and resolved into
+
+- a ``jax.sharding.Mesh`` over the standard axes
+  (``parallel/mesh.py``: data/model/pipe/seq),
+- param placements (tensor-parallel rules from
+  ``parallel/tensor_parallel.py`` when ``tp > 1``, replication
+  otherwise),
+- batch/window shardings (batch dim over ``data``; the k-step
+  window's leading ``[k]`` axis replicated so the fused
+  ``lax.scan`` slices per-step batches that stay data-sharded),
+- pinned program output shardings (``jit(...,
+  out_shardings=...)``) — without the pin GSPMD is free to pick a
+  different output layout than the inputs carried, and the NEXT
+  step's changed input shardings silently recompile every call
+  (observed: the adam ``nu`` tree re-sharded after one window). The
+  pin is what makes the sharded steady state zero-compile.
+
+This is the TF device-placement/dataflow-partitioning story
+(PAPERS.md 1603.04467 §3, 1605.08695) done JAX-natively: the user
+states the parallelism, one SPMD device program runs it, and the
+k-step fused window (``models/kstep.py``) multiplies it — k sharded
+steps per host round-trip.
+
+Scope (documented, enforced loudly):
+
+- ``dp``/``tp`` compose freely and fuse with k-step windows — both
+  executors' ``fit(..., mesh_spec=...)`` take them.
+- ``sp`` (sequence parallel) trains through
+  ``ParallelWrapper``'s manual shard_map step (per-batch; ring
+  attention islands do not currently compose with the scanned
+  window) — ``fit(mesh_spec="sp=8")`` says so instead of guessing.
+- ``pp`` (pipeline) remains the ``parallel/pipeline_spmd.py``
+  dryrun/staged path: the executors' single-program fit cannot
+  express a ppermute pipeline schedule; spelling ``pp`` here raises
+  with that pointer.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, build_mesh
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["MeshPlan", "parse_mesh_spec", "MeshContext",
+           "build_mesh_context"]
+
+_KEYS = ("dp", "tp", "pp", "sp")
+# spec key → parallel/mesh.py axis name
+_AXIS_OF = {"dp": "data", "tp": "model", "pp": "pipe", "sp": "seq"}
+
+
+class MeshPlan:
+    """A parsed, validated mesh spec: one int per axis, product
+    checked against the visible device count at resolve time."""
+
+    __slots__ = ("dp", "tp", "pp", "sp")
+
+    def __init__(self, dp: int = 1, tp: int = 1, pp: int = 1,
+                 sp: int = 1):
+        for k, v in (("dp", dp), ("tp", tp), ("pp", pp), ("sp", sp)):
+            if not isinstance(v, (int, np.integer)) or v < 1:
+                raise ValueError(
+                    f"mesh spec axis {k!r} must be a positive int; "
+                    f"got {v!r}")
+        self.dp, self.tp, self.pp, self.sp = (int(dp), int(tp),
+                                              int(pp), int(sp))
+
+    def n_devices(self) -> int:
+        return self.dp * self.tp * self.pp * self.sp
+
+    def to_mesh_spec(self) -> MeshSpec:
+        return MeshSpec(data=self.dp, model=self.tp, pipe=self.pp,
+                        seq=self.sp)
+
+    def describe(self) -> dict:
+        """JSON-able shape summary (the /healthz + /metrics form)."""
+        return {"spec": str(self),
+                "axes": {"dp": self.dp, "tp": self.tp,
+                         "pp": self.pp, "sp": self.sp},
+                "devices": self.n_devices()}
+
+    def __str__(self) -> str:
+        parts = [f"{k}={getattr(self, k)}" for k in _KEYS
+                 if getattr(self, k) > 1]
+        return ",".join(parts) or "dp=1"
+
+    def __repr__(self) -> str:
+        return f"MeshPlan({str(self)})"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, MeshPlan)
+                and all(getattr(self, k) == getattr(other, k)
+                        for k in _KEYS))
+
+
+def parse_mesh_spec(spec) -> MeshPlan:
+    """``"dp=4,tp=2"`` | ``{"dp": 4, "tp": 2}`` | JSON text |
+    :class:`MeshPlan` → validated :class:`MeshPlan`. Unknown keys
+    and non-positive sizes fail loudly — a typo'd axis silently
+    training single-device would be the worst outcome."""
+    if isinstance(spec, MeshPlan):
+        return spec
+    if isinstance(spec, str):
+        text = spec.strip()
+        if text.startswith("{"):
+            try:
+                spec = json.loads(text)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"mesh spec is not valid JSON: {e}")
+        else:
+            spec = {}
+            for part in filter(None,
+                               (p.strip() for p in text.split(","))):
+                key, sep, val = part.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"mesh spec entry {part!r} is not KEY=N "
+                        f"(expected e.g. 'dp=4,tp=2')")
+                try:
+                    spec[key.strip()] = int(val)
+                except ValueError:
+                    raise ValueError(
+                        f"mesh spec axis {key.strip()!r} has "
+                        f"non-integer size {val!r}")
+    if not isinstance(spec, dict):
+        raise TypeError(
+            f"mesh spec must be a 'dp=4,tp=2' string, a dict, or "
+            f"JSON; got {type(spec).__name__}")
+    unknown = sorted(set(spec) - set(_KEYS))
+    if unknown:
+        raise ValueError(
+            f"unknown mesh spec axis(es) {unknown}; valid axes are "
+            f"{list(_KEYS)} (dp=data, tp=tensor, pp=pipeline, "
+            f"sp=sequence)")
+    return MeshPlan(**{k: spec.get(k, 1) for k in _KEYS})
+
+
+class MeshContext:
+    """A resolved mesh + the placement/sharding policy for one model.
+
+    Built once per ``fit(mesh_spec=...)`` / serving backend; the
+    executors consult it at three points — model placement, batch /
+    window transfer, and program ``out_shardings`` — so every
+    compiled artifact agrees on one layout and the steady state
+    never recompiles (GL002: all executable caches stay keyed by
+    shape signature; the layout is a constant of the context)."""
+
+    def __init__(self, plan: MeshPlan, mesh: Mesh):
+        self.plan = plan
+        self.mesh = mesh
+        self._repl = NamedSharding(mesh, P())
+
+    # ---- construction ----------------------------------------------------
+    @staticmethod
+    def from_mesh(mesh: Mesh) -> "MeshContext":
+        shape = dict(mesh.shape)
+        plan = MeshPlan(dp=shape.get("data", 1),
+                        tp=shape.get("model", 1),
+                        pp=shape.get("pipe", 1),
+                        sp=shape.get("seq", 1))
+        return MeshContext(plan, mesh)
+
+    def describe(self) -> dict:
+        return self.plan.describe()
+
+    # ---- placement -------------------------------------------------------
+    def _on_this_mesh(self, a) -> bool:
+        sh = getattr(a, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            return False
+        if sh.mesh is self.mesh:
+            return True
+        # equal shape + axis names is NOT enough: an equal-shaped
+        # mesh over a DIFFERENT device subset would leave this leaf
+        # stranded on the old devices while batches go to the new
+        return (sh.mesh.shape == self.mesh.shape
+                and tuple(sh.mesh.axis_names)
+                == tuple(self.mesh.axis_names)
+                and tuple(sh.mesh.devices.flat)
+                == tuple(self.mesh.devices.flat))
+
+    def _replicate(self, tree):
+        return jax.tree_util.tree_map(
+            lambda a: a if self._on_this_mesh(a)
+            else jax.device_put(a, self._repl), tree)
+
+    def place_model(self, model, *, respect_existing: bool = False):
+        """Put ``params/state/opt_state`` on this mesh: params take
+        the tensor-parallel rule table when ``tp > 1`` (else
+        replicate); state replicates; opt-state leaves follow their
+        matching param's placement by unique-shape lookup (adam
+        ``mu``/``nu`` mirror param shapes) and replicate otherwise —
+        a wrong lookup costs layout, never correctness (GSPMD
+        reshards). ``respect_existing=True`` keeps leaves already
+        placed on an equal mesh (the ParallelWrapper contract: a
+        user's hand-sharded params survive). Idempotent — re-placing
+        an already-placed model is a handful of no-op device_puts."""
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
+        if model.params is None:
+            model.init()
+        if self.plan.tp > 1 and not (
+                respect_existing
+                and all(self._on_this_mesh(a) for a in
+                        jax.tree_util.tree_leaves(model.params))):
+            from deeplearning4j_tpu.parallel.tensor_parallel import (
+                shard_graph_params, shard_params)
+            if isinstance(model, ComputationGraph):
+                model.params = shard_graph_params(model.params, model,
+                                                  self.mesh)
+            else:
+                model.params = shard_params(model.params, model,
+                                            self.mesh)
+        else:
+            model.params = self._replicate(model.params)
+        model.state = self._replicate(model.state)
+        # param shape → sharding, kept only when unambiguous
+        by_shape: dict = {}
+        for p in jax.tree_util.tree_leaves(model.params):
+            prev = by_shape.get(p.shape)
+            if prev is not None and prev != p.sharding:
+                by_shape[p.shape] = self._repl       # ambiguous
+            else:
+                by_shape[p.shape] = p.sharding
+
+        def place_opt(a):
+            if self._on_this_mesh(a):
+                return a
+            sh = by_shape.get(np.shape(a), self._repl)
+            return jax.device_put(a, sh)
+
+        model.opt_state = jax.tree_util.tree_map(place_opt,
+                                                 model.opt_state)
+        return model
+
+    # ---- batch / window transfer ----------------------------------------
+    def _data_spec(self, ndim: int, lead_axes=()) -> NamedSharding:
+        axes = tuple(lead_axes) + ("data",)
+        pad = ndim - len(axes)
+        if pad < 0:
+            raise ValueError(
+                f"batch leaf with {ndim} dim(s) cannot carry the "
+                f"window + batch axes {axes}")
+        return NamedSharding(self.mesh, P(*axes, *([None] * pad)))
+
+    def _check_divisible(self, n: int, what: str) -> None:
+        dp = self.plan.dp
+        if n % dp:
+            raise ValueError(
+                f"{what} of {n} example(s) is not divisible by the "
+                f"mesh data axis (dp={dp}); size batches as a "
+                f"multiple of dp (the sharded fit path never "
+                f"truncates — that would change the math vs the "
+                f"single-device run)")
+
+    def shard_batch(self, batch):
+        """Device-put every batch leaf with its batch dim over
+        ``data`` (masks and per-input lists included; ``None`` slots
+        pass through the treedef)."""
+        def put(a):
+            self._check_divisible(np.shape(a)[0], "batch")
+            return jax.device_put(a, self._data_spec(np.ndim(a)))
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def shard_window(self, window):
+        """A host-stacked ``[k, B, ...]`` k-step window: the leading
+        step axis replicated (the scan consumes it), the batch axis
+        sharded over ``data``."""
+        def put(a):
+            self._check_divisible(np.shape(a)[1], "window batch")
+            return jax.device_put(
+                a, self._data_spec(np.ndim(a), lead_axes=(None,)))
+
+        return jax.tree_util.tree_map(put, window)
+
+    def abstract_batch(self, batch_np):
+        """ShapeDtypeStructs carrying the batch shardings — what AOT
+        warmup lowers against so the compiled executable accepts
+        exactly what :meth:`shard_batch` will feed it."""
+        def abs_(a):
+            a = np.asarray(a)
+            return jax.ShapeDtypeStruct(
+                a.shape, jax.dtypes.canonicalize_dtype(a.dtype),
+                sharding=self._data_spec(a.ndim))
+
+        return jax.tree_util.tree_map(abs_, batch_np)
+
+    def abstract_window(self, window_np):
+        def abs_(a):
+            a = np.asarray(a)
+            return jax.ShapeDtypeStruct(
+                a.shape, jax.dtypes.canonicalize_dtype(a.dtype),
+                sharding=self._data_spec(a.ndim, lead_axes=(None,)))
+
+        return jax.tree_util.tree_map(abs_, window_np)
+
+    # ---- program output pinning ------------------------------------------
+    def step_out_shardings(self, model, n_scalar_outputs: int = 1):
+        """``out_shardings`` for a train program emitting
+        ``(params, state, opt_state, loss[, health])``: the carry
+        keeps exactly the layout the placed model holds (re-placing
+        first, so a rebuilt optimizer's stray default-device scalars
+        can never leak into a pinned program), scalars/stacks
+        replicate."""
+        self.place_model(model, respect_existing=True)
+        sh = jax.tree_util.tree_map(
+            lambda a: a.sharding,
+            (model.params, model.state, model.opt_state))
+        return sh + (self._repl,) * n_scalar_outputs
+
+
+def build_mesh_context(mesh_spec, model=None,
+                       devices: Optional[Sequence] = None,
+                       *, allow_sp: bool = False) -> MeshContext:
+    """Parse + validate ``mesh_spec`` against the visible devices and
+    build the :class:`MeshContext` (the model, when given, is only
+    used for error messages here — placement happens in
+    :meth:`MeshContext.place_model`)."""
+    plan = parse_mesh_spec(mesh_spec)
+    if plan.pp > 1:
+        raise NotImplementedError(
+            "pp (pipeline) meshes do not run through the "
+            "single-program fit path — a ppermute pipeline schedule "
+            "needs the staged executor in parallel/pipeline_spmd.py "
+            "(dryrun-proven); drop pp from the spec or use that "
+            "module directly")
+    if plan.sp > 1 and not allow_sp:
+        raise NotImplementedError(
+            "sp (sequence-parallel) meshes train through "
+            "ParallelWrapper's manual shard_map step (per-batch; "
+            "ring-attention islands do not compose with the fused "
+            "k-step scan): build the mesh with "
+            "parallel.mesh.build_mesh(MeshSpec(seq=...)) and wrap "
+            "the model in ParallelWrapper, or drop sp from the spec")
+    devs = list(devices) if devices is not None else jax.devices()
+    need = plan.n_devices()
+    if need > len(devs):
+        raise ValueError(
+            f"mesh spec {plan} needs {need} device(s) but only "
+            f"{len(devs)} are visible — on a CPU host export "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{need} (the README 'Sharded training & serving' "
+            f"recipe)")
+    mesh = build_mesh(plan.to_mesh_spec(), devs[:need])
+    logger.info("mesh spec %s resolved over %d device(s)", plan, need)
+    return MeshContext(plan, mesh)

@@ -43,10 +43,9 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:                      # older jax
-    from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.parallel.compat import (HAS_PCAST,
+                                                pcast_varying,
+                                                shard_map_compat)
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -162,10 +161,9 @@ class SpmdPipeline:
                 # the scan carry is device-varying (each device holds a
                 # different in-flight activation) — mark it so the
                 # carry types line up under jax's varying-axes checking
-                h0 = lax.pcast(jnp.zeros_like(hs[0]), axis, to="varying")
-                st0 = jax.tree_util.tree_map(
-                    lambda a: lax.pcast(a, axis, to="varying"),
-                    local_state)
+                # (identity on 0.4.x, which has no varying-axes types)
+                h0 = pcast_varying(jnp.zeros_like(hs[0]), axis)
+                st0 = pcast_varying(local_state, axis)
 
                 def tick(carry, t):
                     state, aux = carry
@@ -202,9 +200,7 @@ class SpmdPipeline:
                     # its state carry must start varying too (psum
                     # below restores invariance from the last device's
                     # copy)
-                    hs0 = jax.tree_util.tree_map(
-                        lambda a: lax.pcast(a, axis, to="varying"),
-                        head_state)
+                    hs0 = pcast_varying(head_state, axis)
                     new_head_state, losses = lax.scan(
                         hd, hs0, (jnp.arange(M), final, ys))
                 else:
@@ -237,6 +233,15 @@ class SpmdPipeline:
                 local, embed_params, head_params)
             new_local_state, new_embed_state, new_head_state = aux_states
             g_stage, g_embed, g_head = grads
+            if not HAS_PCAST:
+                # 0.4.x fallback (check_rep=False): no varying-axes
+                # AD, so the replicated embed/head cotangents come
+                # back as per-device partials — sum them explicitly
+                # (same full-precision reduce new jax inserts)
+                g_embed = jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, axis), g_embed)
+                g_head = jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, axis), g_head)
             # opt state for the stage carries the same (1, ...) local
             # stage axis as the params — strip it for the update, put
             # it back for the sharded output
@@ -258,12 +263,13 @@ class SpmdPipeline:
                     new_embed_state, new_head, new_head_state,
                     opt_s2, opt_e2, opt_h2, loss)
 
-        smapped = shard_map(
+        smapped = shard_map_compat(
             per_device, mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis), P(), P(), P(), P(),
                       P(self.axis), P(), P(), P(), P(), P()),
             out_specs=(P(self.axis), P(self.axis), P(), P(), P(), P(),
-                       P(self.axis), P(), P(), P()))
+                       P(self.axis), P(), P(), P()),
+            varying_params=True)
         full = jax.jit(smapped,
                        donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
         if self.stateful:

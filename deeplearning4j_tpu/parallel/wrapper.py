@@ -194,12 +194,10 @@ class ParallelWrapper:
         leading mesh axis."""
         from deeplearning4j_tpu.models.computation_graph import (
             ComputationGraph)
+        from deeplearning4j_tpu.parallel.compat import (pcast_varying,
+                                                        shard_map_compat)
         from deeplearning4j_tpu.parallel.compression import (
             make_compressed_psum_ef)
-        try:
-            from jax import shard_map
-        except ImportError:       # older jax
-            from jax.experimental.shard_map import shard_map
 
         model = self.model
         mesh = self.mesh
@@ -219,9 +217,10 @@ class ParallelWrapper:
             residual = jax.tree_util.tree_map(lambda r: r[0], residual)
             # mark params device-varying: otherwise jax's varying-axes
             # AD auto-psums the cotangent (full-precision!) before we
-            # get to intercept it with the compressed reduce
-            params_v = jax.tree_util.tree_map(
-                lambda p: jax.lax.pcast(p, "data", to="varying"), params)
+            # get to intercept it with the compressed reduce (0.4.x:
+            # identity — check_rep=False already leaves the cotangent
+            # per-device, see parallel/compat.py)
+            params_v = pcast_varying(params, "data")
 
             def loss_fn(p):
                 return model._loss(p, state, batch, rng, training=True)
@@ -239,10 +238,11 @@ class ParallelWrapper:
                                                   new_residual)
             return new_params, new_state, new_opt, new_residual, loss
 
-        smapped = shard_map(
+        smapped = shard_map_compat(
             per_device, mesh=mesh,
             in_specs=(P(), P(), P(), P("data"), P("data"), P(), P()),
-            out_specs=(P(), P(), P(), P("data"), P()))
+            out_specs=(P(), P(), P(), P("data"), P()),
+            varying_params=True)
         return jax.jit(smapped, donate_argnums=(0, 1, 2, 3))
 
     # ---- sequence-parallel train step ----
@@ -383,12 +383,11 @@ class ParallelWrapper:
         compression exists for."""
         from deeplearning4j_tpu.models.computation_graph import (
             ComputationGraph)
+        from deeplearning4j_tpu.parallel.compat import (HAS_PCAST,
+                                                        pcast_varying,
+                                                        shard_map_compat)
         from deeplearning4j_tpu.parallel.seq_context import (
             sequence_parallel)
-        try:
-            from jax import shard_map
-        except ImportError:       # older jax
-            from jax.experimental.shard_map import shard_map
 
         model = self.model
         mesh = self.mesh
@@ -418,9 +417,7 @@ class ParallelWrapper:
                 # varying over 'data' only: the seq cotangent still
                 # auto-psums (full precision, ICI); the data-axis
                 # reduction is ours to compress
-                params_in = jax.tree_util.tree_map(
-                    lambda p: jax.lax.pcast(p, "data", to="varying"),
-                    params)
+                params_in = pcast_varying(params, "data")
             else:
                 params_in = params
             with sequence_parallel("seq", loss_axes=axes):
@@ -430,6 +427,18 @@ class ParallelWrapper:
 
                 (loss, new_state), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params_in)
+            if not HAS_PCAST:
+                # 0.4.x fallback (check_rep=False): NO cotangent
+                # auto-psum happened — reduce explicitly, in full
+                # precision, over exactly the axes new jax's AD
+                # covers (every axis uncompressed; 'seq' only when
+                # the data-axis reduction belongs to the compressed
+                # psum below)
+                red = (tuple(a for a in axes if a != "data")
+                       if compressed else axes)
+                if red:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: jax.lax.psum(g, red), grads)
             # grads on each data shard: Σ over seq shards of ∂(local
             # mean loss); the global loss is the MEAN of the uniform
             # local means — normalize by the full shard count
@@ -452,10 +461,11 @@ class ParallelWrapper:
         bspec_l = P(daxis) if self._seq_collapses else bspec_t
         bspec = (bspec_t, bspec_l, bspec_t, bspec_l)
         if compressed:
-            smapped = shard_map(
+            smapped = shard_map_compat(
                 per_device, mesh=mesh,
                 in_specs=(P(), P(), P(), P("data"), bspec, P(), P()),
-                out_specs=(P(), P(), P(), P("data"), P()))
+                out_specs=(P(), P(), P(), P("data"), P()),
+                varying_params=True)
             return jax.jit(smapped, donate_argnums=(0, 1, 2, 3))
 
         def no_residual(params, state, opt_state, batch, base_rng,
@@ -463,9 +473,11 @@ class ParallelWrapper:
             return per_device(params, state, opt_state, None, batch,
                               base_rng, step)
 
-        smapped = shard_map(no_residual, mesh=mesh,
-                            in_specs=(P(), P(), P(), bspec, P(), P()),
-                            out_specs=(P(), P(), P(), P()))
+        smapped = shard_map_compat(
+            no_residual, mesh=mesh,
+            in_specs=(P(), P(), P(), bspec, P(), P()),
+            out_specs=(P(), P(), P(), P()),
+            varying_params=True)
         return jax.jit(smapped, donate_argnums=(0, 1, 2))
 
     def _make_seq_gspmd_step(self):
@@ -605,20 +617,36 @@ class ParallelWrapper:
 
     def _rebuild_on(self, new_mesh) -> None:
         """Move the model onto ``new_mesh``: host snapshot from the
-        current (replicated) placement, mesh swap, re-place, reset
-        every mesh-shaped compiled artifact (steps retrace; the
+        current placement (``device_get`` gathers tensor-parallel
+        shards into full arrays), mesh swap, re-place, reset every
+        mesh-shaped compiled artifact (steps retrace; the
         compression error-feedback residual is per-device state and
         re-zeroes — the one thing a topology change does NOT
-        preserve)."""
+        preserve). A mesh with a 'model' axis re-places params
+        through the DEFAULT tensor-parallel rule table
+        (``tensor_parallel.default_tp_rules``) — hand-written rules
+        do not survive a shrink."""
+        from deeplearning4j_tpu.parallel.mesh_spec import MeshContext
         m = self.model
         host = jax.device_get((m.params, m.state, m.opt_state))
         self.mesh = new_mesh
         self._compressed_step = None
         self._seq_step = None
         self._residual = None
-        m.params = self._on_mesh(host[0])
-        m.state = self._on_mesh(host[1])
-        m.opt_state = self._on_mesh(host[2])
+        m.params, m.state, m.opt_state = host
+        if (new_mesh.shape.get("model", 1) > 1
+                or getattr(m, "_mesh_ctx", None) is not None):
+            ctx = MeshContext.from_mesh(new_mesh)
+            ctx.place_model(m)
+            if getattr(m, "_mesh_ctx", None) is not None:
+                # the model's own programs pin the OLD mesh's output
+                # shardings — swap the context and flush them
+                m._mesh_ctx = ctx
+                m._flush_compiled_programs()
+        else:
+            m.params = self._on_mesh(m.params)
+            m.state = self._on_mesh(m.state)
+            m.opt_state = self._on_mesh(m.opt_state)
         if self.dcn_compression is not None:
             self._residual = self._init_residual()
 
@@ -646,9 +674,10 @@ class ParallelWrapper:
     def regrow(self, devices=None):
         """Explicitly rebuild the mesh after capacity returns:
         ``devices`` (default ``jax.devices()``) at the original dp
-        (or the largest power of two that fits). Params/opt-state are
-        re-placed from the current host copy; compiled steps retrace.
-        Returns the new mesh."""
+        (or the largest power of two that fits), keeping any
+        tensor-parallel 'model' axis intact. Params/opt-state are
+        re-placed from the current host copy; compiled steps
+        retrace. Returns the new mesh."""
         if devices is not None:
             # an explicit device list is the operator vouching for
             # every device in it — including ones previously
@@ -662,8 +691,10 @@ class ParallelWrapper:
             devices = [d for d in jax.devices()
                        if d not in self._lost_devices]
         old_dp = self.mesh.shape.get("data", 1)
-        dp = min(self._initial_dp, largest_pow2(len(devices)))
-        self._rebuild_on(build_mesh(MeshSpec(data=dp), devices[:dp]))
+        tp = self.mesh.shape.get("model", 1)
+        dp = min(self._initial_dp, largest_pow2(len(devices) // tp))
+        self._rebuild_on(build_mesh(MeshSpec(data=dp, model=tp),
+                                    devices[:dp * tp]))
         logger.warning("mesh regrown dp=%d -> dp=%d", old_dp, dp)
         self._account_elastic("elastic_mesh_regrows_total",
                               "explicit mesh regrows after a shrink",
@@ -789,6 +820,53 @@ class ParallelWrapper:
         self._place_model()
         self._train_batch(ds)
         return self.model
+
+    # ---- fused k-step windows on the mesh ----
+    def supports_fused_windows(self) -> bool:
+        """Whether this wrapper's mesh can run k-step fused windows
+        as ONE sharded device program: data / data x model meshes
+        with full-precision reduce. The seq step is a manual
+        shard_map (ring islands don't compose with the scanned
+        window) and the compressed reduce threads per-device
+        residual state the scan carry does not hold — both stay
+        per-batch."""
+        return (self._seq_axis_size() == 1
+                and self.mesh.shape.get("pipe", 1) == 1
+                and self.dcn_compression is None)
+
+    def _ensure_model_ctx(self) -> None:
+        """Install (or refresh after a shrink/regrow) a
+        ``MeshContext`` over THIS mesh on the model, preserving any
+        hand-applied tensor-parallel placement already on it."""
+        from deeplearning4j_tpu.parallel.mesh_spec import MeshContext
+        ctx = getattr(self.model, "_mesh_ctx", None)
+        if ctx is None or ctx.mesh is not self.mesh:
+            self.model.use_mesh(MeshContext.from_mesh(self.mesh),
+                                respect_existing=True)
+
+    def fit_batches(self, batches, *, steps_per_device_call: int = 1):
+        """Train a window of batches with the model's k-step fused
+        machinery running ON this wrapper's mesh — window fusion +
+        mesh step in ONE device program (the ElasticTrainer k>1
+        entry point; the per-batch twin is :meth:`fit_batch`). The
+        ``parallel.device`` chaos site is consulted once per window:
+        a device loss shrinks the mesh first and the whole window
+        trains on the survivors. Returns per-step losses."""
+        if not self.supports_fused_windows():
+            raise ValueError(
+                "fused k-step windows need a data / data x model "
+                "mesh with full-precision reduce; this wrapper's "
+                "mesh/config (seq/pipe axis or dcn_compression) "
+                "trains per-batch — use fit_batch or "
+                "steps_per_device_call=1")
+        if self.model.params is None:
+            self.model.init()
+        f = chaos.step_fault("parallel.device")
+        if f is not None and f.kind == "loss":
+            self._on_device_loss(f)
+        self._ensure_model_ctx()
+        return self.model.fit_batches(
+            batches, steps_per_device_call=steps_per_device_call)
 
     def fit(self, iterator: DataSetIterator, *, epochs: int = 1):
         model = self.model

@@ -33,6 +33,7 @@ _EXPORTS = {
     "pow2_pad_rows": "scheduler",
     "ContinuousBatcher": "continuous",
     "ModelServer": "http",
+    "TensorParallelModel": "tp_backend",
     "ReplicaFleet": "fleet",
     "InProcessReplica": "fleet",
     "SubprocessReplica": "fleet",

@@ -163,9 +163,55 @@ class ModelServer:
                  sample_routes: Optional[Dict[str, float]] = None,
                  slow_ms: float = 250.0, slos=None, tracer=None,
                  kv_mode: str = "auto", page_size: int = 16,
-                 kv_pages: Optional[int] = None):
+                 kv_pages: Optional[int] = None, mesh=None):
         self.registry = registry or ModelRegistry()
         self.metrics = metrics or ServingMetrics()
+        # mesh: a declarative serving mesh spec ("tp=2" |
+        # "dp=2,tp=2" | dict — parallel/mesh_spec.py). Predict
+        # backends then run TENSOR-PARALLEL: each hosted model is
+        # wrapped in serving/tp_backend.TensorParallelModel (params
+        # sharded over the 'model' axis, request batches over
+        # 'data'), with one AOT-compilable executable per pow2
+        # bucket. Parsed NOW so a typo'd spec kills boot, not the
+        # first request; surfaced on /healthz ("mesh") and the
+        # serving_mesh_devices gauge. Generate/streaming stays on
+        # the unsharded model (the paged-KV decode path has its own
+        # device story) — the proxy refuses to advertise streaming.
+        self.mesh_plan = None
+        self._tp_models: Dict[Tuple[str, int], object] = {}
+        if mesh is not None:
+            from deeplearning4j_tpu.parallel.mesh_spec import (
+                build_mesh_context, parse_mesh_spec)
+            self.mesh_plan = parse_mesh_spec(mesh)
+            if self.mesh_plan.sp > 1:
+                raise ServingError(
+                    "serving meshes take dp/tp axes only; sp "
+                    "belongs to training")
+            # full validation at boot, not first traffic: device
+            # count and pp rejection (build_mesh_context raises with
+            # the fix in the message; the context itself is rebuilt
+            # per model by the tp proxy), plus executor
+            # compatibility for every model ALREADY registered — a
+            # graph model would otherwise boot healthy and 500 every
+            # predict (models registered later still fail lazily)
+            build_mesh_context(self.mesh_plan)
+            for entry in self.registry.models():
+                mdl, _ = self.registry.resolve(entry["name"])
+                if not hasattr(mdl, "_forward"):
+                    raise ServingError(
+                        f"model {entry['name']!r} "
+                        f"({type(mdl).__name__}) cannot serve "
+                        "tensor-parallel (sequential executors "
+                        "only); drop --mesh or host it on an "
+                        "unsharded server")
+            _help = ("serving mesh shape per axis (absent = "
+                     "unsharded serving)")
+            axes = self.mesh_plan.describe()["axes"]
+            reg = self.metrics.registry
+            reg.gauge("serving_mesh_devices", help=_help,
+                      labels={"axis": "dp"}).set(axes["dp"])
+            reg.gauge("serving_mesh_devices", help=_help,
+                      labels={"axis": "tp"}).set(axes["tp"])
         # optional observability.AlertManager: while any rule fires,
         # /healthz reports "degraded" + the firing alerts instead of
         # an unconditional "ok" (load balancers and pagers see the
@@ -218,13 +264,16 @@ class ModelServer:
         self.chaos_delay_s = 0.0
 
     # ---- backend resolution ----
-    def _get_or_create(self, cache: dict, key: tuple, factory):
+    def _get_or_create(self, cache: dict, key: tuple, factory,
+                       kind: Optional[str] = None):
         """Resolve-or-build a backend WITHOUT holding the global lock
         through construction (building allocates device buffers and
         must not stall unrelated models), serialized per key so a
         thundering first-request herd builds exactly one backend.
         Draining is re-checked after the build: a backend created
         behind stop()'s back would leak its worker thread + gauge."""
+        if kind is None:
+            kind = "sched" if cache is self._schedulers else "batch"
         with self._lock:
             b = cache.get(key)
             if b is not None:
@@ -233,8 +282,7 @@ class ModelServer:
                 raise ServerClosedError(
                     "server is draining; not creating new backends")
             create_lock = self._create_locks.setdefault(
-                ("sched",) + key if cache is self._schedulers
-                else ("batch",) + key, threading.Lock())
+                (kind,) + key, threading.Lock())
         with create_lock:
             with self._lock:
                 b = cache.get(key)
@@ -249,12 +297,36 @@ class ModelServer:
         raise ServerClosedError(
             "server is draining; not creating new backends")
 
+    def resolve_serving_model(self, name: str,
+                              version: Optional[int] = None):
+        """(model, version) as the predict path serves it: the
+        registry's model, wrapped tensor-parallel per the server's
+        mesh spec when one is configured (wrap cached per
+        name/version — the proxy owns the sharded placement and the
+        per-bucket executables)."""
+        model, version = self.registry.resolve(name, version)
+        if self.mesh_plan is None:
+            return model, version
+
+        def build():
+            from deeplearning4j_tpu.serving.tp_backend import (
+                TensorParallelModel)
+            return TensorParallelModel(model, self.mesh_plan)
+
+        # the shared double-checked-locking helper: one proxy per
+        # name/version even under a first-request herd (construction
+        # re-places the registry model's params — two concurrent
+        # builds would race that), and draining refuses cleanly
+        tp = self._get_or_create(self._tp_models, (name, version),
+                                 build, kind="tp")
+        return tp, version
+
     def scheduler_for(
             self, name: str, version: Optional[int] = None
     ) -> Tuple[BatchScheduler, int]:
         """(scheduler, served version) — the single resolution point
         for a predict request."""
-        model, version = self.registry.resolve(name, version)
+        model, version = self.resolve_serving_model(name, version)
         s = self._get_or_create(
             self._schedulers, (name, version),
             lambda: BatchScheduler(
@@ -268,6 +340,12 @@ class ModelServer:
             self, name: str, version: Optional[int] = None
     ) -> Tuple[ContinuousBatcher, int]:
         """(batcher, served version)."""
+        if self.mesh_plan is not None:
+            raise ServingError(
+                "generate is not supported on a mesh-sharded server "
+                "yet (the tp proxy re-places params; the decode KV "
+                "path is single-device) — serve streaming models "
+                "from an unsharded replica")
         model, version = self.registry.resolve(name, version)
         if not hasattr(model, "slot_streaming_session"):
             raise ServingError(
@@ -637,6 +715,10 @@ class ModelServer:
             payload = {"status": "ok"}
         if slo_status is not None:
             payload["slos"] = slo_status
+        if self.mesh_plan is not None:
+            # operators (and the fleet router's prober) see the
+            # serving mesh shape next to health, not buried in logs
+            payload["mesh"] = self.mesh_plan.describe()
         return payload
 
     def _unready_retry_after_s(self, payload: dict) -> float:
@@ -685,6 +767,13 @@ class ModelServer:
                          if k in self._schedulers]
                         + [self._batchers.pop(k) for k in keys
                            if k in self._batchers])
+            # drop the tensor-parallel wraps too: a re-registered
+            # version must re-place and re-compile, not serve a
+            # stale proxy's executables
+            for k in [k for k in self._tp_models
+                      if k[0] == name and (version is None
+                                           or k[1] == version)]:
+                self._tp_models.pop(k, None)
         for b in backends:
             ok = b.shutdown(drain=drain, timeout=timeout) and ok
         return ok
@@ -700,6 +789,7 @@ class ModelServer:
                         + list(self._batchers.values()))
             self._schedulers.clear()
             self._batchers.clear()
+            self._tp_models.clear()
         oks = {}
         threads = [threading.Thread(
             target=lambda b=b: oks.__setitem__(

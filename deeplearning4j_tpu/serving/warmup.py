@@ -84,7 +84,10 @@ def warmup_server(server, *, generate: bool = True,
     report: Dict[str, dict] = {}
     for entry in server.registry.models():
         name = entry["name"]
-        model, version = server.registry.resolve(name)
+        # resolve THROUGH the server: a mesh-sharded server serves
+        # the tensor-parallel proxy, so warmup compiles the sharded
+        # per-bucket executables the real traffic will hit
+        model, version = server.resolve_serving_model(name)
         r = {"version": version, "predict_buckets": [],
              "generate": False, "seconds": 0.0, "skipped": []}
         t0 = time.perf_counter()

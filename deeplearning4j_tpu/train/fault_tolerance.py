@@ -251,7 +251,8 @@ class ElasticTrainer:
                  handle_sigterm: bool = True, wrapper=None,
                  lr_drop_on_rollback: Optional[float] = None,
                  async_checkpoint: bool = False,
-                 steps_per_device_call: int = 1):
+                 steps_per_device_call: int = 1,
+                 mesh_spec=None):
         # async_checkpoint: take checkpoints OFF the train thread —
         # save_checkpoint snapshots params/opt-state device→host at
         # the step boundary (cheap) and hands serialization + zip +
@@ -289,6 +290,13 @@ class ElasticTrainer:
         # compatibility. Params are unaffected either way; listeners
         # keying off epoch hooks see the (saner) windowed cadence
         # under k>1.
+        # mesh_spec: train SHARDED over a declarative device mesh
+        # ("dp=4,tp=2" | dict | JSON — parallel/mesh_spec.py): the
+        # spec is installed on the model up front (so a checkpoint
+        # restore re-places onto the mesh too) and composes with
+        # steps_per_device_call — k sharded steps fused into one
+        # device program per window. Mutually exclusive with
+        # ``wrapper`` (two ways to state the same parallelism).
         self.model = model
         self.wrapper = wrapper
         self.k = int(steps_per_device_call)
@@ -297,14 +305,27 @@ class ElasticTrainer:
             # fails loudly everywhere instead of silently clamping
             # in one mode and crashing in another
             raise ValueError("steps_per_device_call must be >= 1")
-        if wrapper is not None and self.k > 1:
-            # the mesh step has no fused k-step program — failing
-            # loudly beats silently training with a different cadence
-            # than the operator asked for
+        if mesh_spec is not None:
+            if wrapper is not None:
+                raise ValueError(
+                    "pass either mesh_spec (the executor's sharded "
+                    "fit path) or wrapper (an explicit "
+                    "ParallelWrapper), not both")
+            model.use_mesh(mesh_spec)
+        if wrapper is not None and self.k > 1 and not (
+                getattr(wrapper, "supports_fused_windows",
+                        lambda: False)()):
+            # seq / compressed meshes have no fused k-step program —
+            # failing loudly beats silently training with a
+            # different cadence than the operator asked for. Pure-dp
+            # and dp x tp wrappers DO fuse (wrapper.fit_batches runs
+            # the window as one sharded device program).
             raise ValueError(
-                "steps_per_device_call > 1 is not supported with a "
-                "ParallelWrapper (the mesh step is per-batch); drop "
-                "the wrapper or use steps_per_device_call=1")
+                "steps_per_device_call > 1 needs a wrapper mesh "
+                "that fuses (data / data x model, no "
+                "dcn_compression); this wrapper's mesh step is "
+                "per-batch — drop the wrapper or use "
+                "steps_per_device_call=1")
         self.dir = checkpoint_dir
         os.makedirs(checkpoint_dir, exist_ok=True)
         self.save_every = max(1, save_every)
@@ -550,6 +571,13 @@ class ElasticTrainer:
         m.opt_state = loaded.opt_state
         m.iteration_count = loaded.iteration_count
         m.epoch_count = loaded.epoch_count
+        # a mesh-sharded model restores HOST arrays — re-place them
+        # per the installed context, or the next (output-pinned)
+        # step would see default-device inputs and die on a device
+        # mismatch instead of resuming
+        ctx = getattr(m, "_mesh_ctx", None)
+        if ctx is not None:
+            ctx.place_model(m)
         self._it_state = None
         try:
             with zipfile.ZipFile(path, "r") as z:
@@ -883,8 +911,14 @@ class ElasticTrainer:
                 try:
                     # full windows fuse into one scan program; the
                     # epoch tail (len < k) runs through the
-                    # pre-compiled k=1 program — no mid-epoch trace
-                    losses = model.fit_batches(
+                    # pre-compiled k=1 program — no mid-epoch trace.
+                    # With a wrapper the SAME window machinery runs
+                    # on its mesh (wrapper.fit_batches: window
+                    # fusion + mesh step in one sharded program)
+                    fit_batches = (self.wrapper.fit_batches
+                                   if self.wrapper is not None
+                                   else model.fit_batches)
+                    losses = fit_batches(
                         [d for _, d in window],
                         steps_per_device_call=k)
                 except Exception as e:

@@ -1,0 +1,467 @@
+"""Mesh-spec sharded fit/serve paths (ISSUE 11).
+
+Covers: declarative spec parsing/validation; ``fit(mesh_spec=...)``
+on both executors (dp parity with the single-device run; the fused
+k-step window on a mesh bit-identical to the per-step mesh run —
+fused multichip steps are ONE device program); dp x tp composed with
+k-step windows at zero steady-state compiles after AOT warmup (the
+acceptance path); the lifted ElasticTrainer/ParallelWrapper
+``steps_per_device_call>1`` restriction (fused windows on dp meshes,
+still refused for compressed/seq meshes); dp x tp elastic
+shrink-resume; the tensor-parallel serving backend behind the
+existing scheduler (pow2-bucket executables, /healthz mesh shape,
+zero-compile burst); and the CLI surface (``train --mesh``,
+``serve --mesh``).
+
+A note on "bit-identical" for dp-vs-single-device: splitting one
+batch over dp devices changes the ORDER of the cross-example
+gradient reduction (per-shard partial sums + a psum tree vs one
+device-local reduce), so parity there is exact-to-float-tolerance —
+the same contract every dryrun and ParallelWrapper parity test in
+this repo pins. What IS bit-identical is everything that runs the
+same math on the same mesh: fused k-step windows vs per-step on one
+mesh, wrapper-vs-executor dp paths, and preemption resume.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.data.iterators import (ArrayDataSetIterator,
+                                               ListDataSetIterator)
+from deeplearning4j_tpu.observability.compile_watch import (
+    install_global_watch)
+from deeplearning4j_tpu.parallel.mesh_spec import (MeshPlan,
+                                                   build_mesh_context,
+                                                   parse_mesh_spec)
+from deeplearning4j_tpu.train.fault_tolerance import ElasticTrainer
+
+from fixtures import make_batches, tiny_classifier
+
+pytestmark = pytest.mark.mesh
+
+
+def _leaves(model):
+    return [np.asarray(l)
+            for l in jax.tree_util.tree_leaves(model.params)]
+
+
+def _assert_bit_identical(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+def _assert_parity(a, b):
+    """Exact-to-float-tolerance parity (cross-shard reduce order —
+    see module docstring)."""
+    for x, y in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# spec parsing / validation
+# ---------------------------------------------------------------------------
+
+class TestMeshSpecParsing:
+    def test_string_dict_json_and_plan_forms_agree(self):
+        want = MeshPlan(dp=4, tp=2)
+        assert parse_mesh_spec("dp=4,tp=2") == want
+        assert parse_mesh_spec(" dp=4 , tp=2 ") == want
+        assert parse_mesh_spec({"dp": 4, "tp": 2}) == want
+        assert parse_mesh_spec('{"dp": 4, "tp": 2}') == want
+        assert parse_mesh_spec(want) is want
+        assert str(want) == "dp=4,tp=2"
+        d = want.describe()
+        assert d["devices"] == 8 and d["axes"]["tp"] == 2
+
+    def test_bad_specs_fail_loudly(self):
+        with pytest.raises(ValueError, match="unknown mesh spec"):
+            parse_mesh_spec("dp=4,zz=2")
+        with pytest.raises(ValueError, match="positive int"):
+            parse_mesh_spec("dp=0")
+        with pytest.raises(ValueError, match="non-integer"):
+            parse_mesh_spec("dp=four")
+        with pytest.raises(ValueError, match="KEY=N"):
+            parse_mesh_spec("dp:4")
+        with pytest.raises(TypeError):
+            parse_mesh_spec(4)
+
+    def test_too_many_devices_names_the_recipe(self):
+        with pytest.raises(ValueError,
+                           match="xla_force_host_platform"):
+            build_mesh_context(f"dp={2 * jax.device_count()}", None)
+
+    def test_pp_and_sp_route_to_their_own_paths(self):
+        with pytest.raises(NotImplementedError, match="pipeline"):
+            build_mesh_context("pp=4", None)
+        with pytest.raises(NotImplementedError,
+                           match="ParallelWrapper"):
+            build_mesh_context("sp=8", None)
+        net = tiny_classifier()
+        with pytest.raises(NotImplementedError,
+                           match="ParallelWrapper"):
+            net.fit(ListDataSetIterator(make_batches(2)),
+                    mesh_spec="sp=8")
+
+
+# ---------------------------------------------------------------------------
+# sharded fit: parity + fused windows + zero compiles
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(jax.device_count() < 4,
+                    reason="needs 4 virtual devices")
+class TestShardedFit:
+    def test_dp4_fit_parity_with_single_device(self):
+        batches = make_batches(8, seed=3)
+        ref = tiny_classifier(seed=1)
+        ref.fit(ListDataSetIterator(list(batches)), epochs=2)
+        dp = tiny_classifier(seed=1)
+        dp.fit(ListDataSetIterator(list(batches)), epochs=2,
+               mesh_spec="dp=4")
+        _assert_parity(ref, dp)
+        assert ref.iteration_count == dp.iteration_count == 16
+
+    def test_dp4_fused_k8_bit_identical_to_per_step_mesh_run(self):
+        """The k-step window on a mesh is the SAME math as the
+        per-step mesh run — one scanned device program, bit-equal
+        params (incl. the 3-batch tail through the k=1 program)."""
+        batches = make_batches(11, seed=4)
+        k1 = tiny_classifier(seed=2)
+        k1.fit(ListDataSetIterator(list(batches)), epochs=2,
+               mesh_spec="dp=4", steps_per_device_call=1)
+        k8 = tiny_classifier(seed=2)
+        k8.fit(ListDataSetIterator(list(batches)), epochs=2,
+               mesh_spec="dp=4", steps_per_device_call=8)
+        _assert_bit_identical(k1, k8)
+        assert k1.iteration_count == k8.iteration_count == 22
+
+    def test_graph_executor_dp2_parity(self):
+        from test_kstep import tiny_graph
+        batches = make_batches(6, seed=5)
+        ref = tiny_graph(seed=2)
+        ref.fit(list(batches), epochs=1)
+        dp = tiny_graph(seed=2)
+        dp.fit(list(batches), epochs=1, mesh_spec="dp=2",
+               steps_per_device_call=3)
+        _assert_parity(ref, dp)
+
+    def test_dp2_tp2_k8_fused_zero_compiles(self):
+        """ACCEPTANCE: fit(mesh_spec="dp=2,tp=2",
+        steps_per_device_call=8) runs fused sharded windows with
+        ZERO steady-state compiles after AOT warmup, params at
+        float-tolerance parity with the single-device run, tp
+        placement actually applied."""
+        batches = make_batches(11, seed=6)
+        ref = tiny_classifier(seed=3)
+        ref.fit(ListDataSetIterator(list(batches)), epochs=2)
+        net = tiny_classifier(seed=3)
+        net.use_mesh("dp=2,tp=2")
+        rep = net.warmup(batches[0], steps_per_device_call=8)
+        assert set(rep) == {"train_step", "kstep_8"}
+        stats = install_global_watch()
+        with stats.zero_compile_scope("sharded k-step steady state"):
+            net.fit(ListDataSetIterator(list(batches)), epochs=2,
+                    steps_per_device_call=8)
+        _assert_parity(ref, net)
+        specs = [str(p.sharding.spec)
+                 for p in jax.tree_util.tree_leaves(net.params)]
+        assert any("model" in s for s in specs), specs
+
+    def test_use_mesh_same_spec_keeps_warmed_programs(self):
+        """Re-stating the SAME spec (warmup(mesh_spec=X) then
+        fit(mesh_spec=X)) must not flush the AOT-warmed executables —
+        the advertised zero-compile steady state would silently
+        recompile on the first step otherwise."""
+        batches = make_batches(8, seed=17)
+        net = tiny_classifier(seed=17)
+        rep = net.warmup(batches[0], steps_per_device_call=8,
+                         mesh_spec="dp=2")
+        assert set(rep) == {"train_step", "kstep_8"}
+        stats = install_global_watch()
+        with stats.zero_compile_scope("re-stated mesh spec"):
+            net.fit(ListDataSetIterator(list(batches)), epochs=1,
+                    mesh_spec="dp=2", steps_per_device_call=8)
+
+    def test_indivisible_batch_fails_loudly(self):
+        net = tiny_classifier(seed=4)
+        bad = make_batches(1, batch=6, seed=7)       # 6 % 4 != 0
+        with pytest.raises(ValueError, match="not divisible"):
+            net.fit(ListDataSetIterator(list(bad)), mesh_spec="dp=4")
+
+    def test_mesh_refused_with_tbptt(self):
+        net = tiny_classifier(seed=5)
+        net.conf.conf.tbptt = {"fwd_length": 4, "bwd_length": 4}
+        with pytest.raises(NotImplementedError, match="tBPTT"):
+            net.use_mesh("dp=2")
+
+
+# ---------------------------------------------------------------------------
+# elastic training on a mesh
+# ---------------------------------------------------------------------------
+
+@pytest.mark.preempt
+@pytest.mark.skipif(jax.device_count() < 8,
+                    reason="needs 8 virtual devices")
+class TestMeshElastic:
+    def test_wrapper_fused_windows_bit_identical_to_per_step(
+            self, tmp_path):
+        """The lifted restriction: ElasticTrainer + a pure-dp
+        ParallelWrapper now take steps_per_device_call>1 — the
+        window runs as ONE sharded device program, bit-identical to
+        the per-step wrapper run."""
+        from deeplearning4j_tpu.parallel.mesh import (MeshSpec,
+                                                      build_mesh)
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        batches = make_batches(16, seed=8)
+
+        def run(k, sub):
+            net = tiny_classifier(seed=6)
+            pw = ParallelWrapper(
+                net, build_mesh(MeshSpec(data=4), jax.devices()[:4]),
+                prefetch_buffer=0)
+            ElasticTrainer(net, str(tmp_path / sub), save_every=8,
+                           handle_sigterm=False, wrapper=pw,
+                           steps_per_device_call=k).fit(
+                ListDataSetIterator(list(batches)), epochs=1)
+            return net
+
+        a, b = run(1, "k1"), run(8, "k8")
+        assert a.iteration_count == b.iteration_count == 16
+        _assert_bit_identical(a, b)
+
+    def test_compressed_wrapper_still_refuses_fusion(self, tmp_path):
+        from deeplearning4j_tpu.parallel.mesh import (MeshSpec,
+                                                      build_mesh)
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        net = tiny_classifier(seed=7)
+        pw = ParallelWrapper(
+            net, build_mesh(MeshSpec(data=4), jax.devices()[:4]),
+            dcn_compression={"threshold": 0.0})
+        assert not pw.supports_fused_windows()
+        with pytest.raises(ValueError, match="steps_per_device_call"):
+            ElasticTrainer(net, str(tmp_path), wrapper=pw,
+                           steps_per_device_call=2)
+        with pytest.raises(ValueError, match="fused"):
+            pw.fit_batches(make_batches(2), steps_per_device_call=2)
+
+    def test_mesh_trainer_sigterm_resume_bit_identical(self,
+                                                       tmp_path):
+        """Preemption semantics survive the sharded path: SIGTERM
+        inside a fused window closes it early, the grace checkpoint
+        lands within one step, and the restart (which re-places the
+        restored host params onto the mesh) converges bit-identically
+        to the uninterrupted sharded run."""
+        rng = np.random.default_rng(21)
+        x = rng.normal(size=(96, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 96)]
+
+        def make_it():
+            return ArrayDataSetIterator(x, y, batch_size=8,
+                                        shuffle=True, seed=5)
+
+        ref = tiny_classifier(seed=8)
+        ElasticTrainer(ref, str(tmp_path / "free"), save_every=4,
+                       handle_sigterm=False, mesh_spec="dp=2",
+                       steps_per_device_call=4).fit(
+            make_it(), until_epoch=2)
+
+        chaos.install({"faults": [
+            {"site": "train.step", "kind": "sigterm", "at": [9]},
+        ]}, seed=3)
+        try:
+            cdir = str(tmp_path / "preempted")
+            net = tiny_classifier(seed=8)
+            tr = ElasticTrainer(net, cdir, save_every=4,
+                                handle_sigterm=True, mesh_spec="dp=2",
+                                steps_per_device_call=4)
+            tr.fit(make_it(), until_epoch=2)
+        finally:
+            chaos.uninstall()
+        assert tr._stop_requested
+        net2 = tiny_classifier(seed=8)
+        tr2 = ElasticTrainer(net2, cdir, save_every=4,
+                             handle_sigterm=True, mesh_spec="dp=2",
+                             steps_per_device_call=4)
+        tr2.fit(make_it(), until_epoch=2)
+        assert net2.iteration_count == ref.iteration_count == 24
+        _assert_bit_identical(ref, net2)
+
+    def test_dp_tp_shrink_resume_e2e(self, tmp_path):
+        """dp=4 x tp=2 over 8 devices: a device loss mid-epoch
+        shrinks the dp axis (tp kept intact, params re-placed
+        through the rule table), the run completes on the survivors,
+        and a from-checkpoint restart resumes onto the full mesh and
+        finishes with finite params."""
+        from deeplearning4j_tpu.parallel.mesh import (MeshSpec,
+                                                      build_mesh)
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            shard_params)
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        batches = make_batches(8, seed=9)
+        cdir = str(tmp_path / "ck")
+
+        def build():
+            net = tiny_classifier(seed=9)
+            mesh = build_mesh(MeshSpec(data=4, model=2),
+                              jax.devices()[:8])
+            net.params = shard_params(net.params, net, mesh)
+            net.opt_state = net._optimizer.init(net.params)
+            return net, ParallelWrapper(net, mesh, prefetch_buffer=0)
+
+        net, pw = build()
+        tr = ElasticTrainer(net, cdir, save_every=4,
+                            handle_sigterm=False, wrapper=pw)
+        chaos.install({"faults": [{"site": "parallel.device",
+                                   "kind": "loss", "at": [5]}]},
+                      seed=0)
+        try:
+            tr.fit(ListDataSetIterator(list(batches)), epochs=1)
+        finally:
+            chaos.uninstall()
+        assert pw.mesh.shape["data"] == 2        # shrunk
+        assert pw.mesh.shape["model"] == 2       # tp intact
+        assert net.iteration_count == 8
+        specs = [str(p.sharding.spec)
+                 for p in jax.tree_util.tree_leaves(net.params)]
+        assert any("model" in s for s in specs), specs
+        for leaf in _leaves(net):
+            assert np.isfinite(leaf).all()
+
+        # restart: a fresh trainer restores the checkpoint into a
+        # full dp=4 x tp=2 mesh and trains another epoch
+        net2, pw2 = build()
+        tr2 = ElasticTrainer(net2, cdir, save_every=4,
+                             handle_sigterm=False, wrapper=pw2)
+        assert net2.iteration_count > 0          # resumed
+        tr2.fit(ListDataSetIterator(list(batches)), epochs=1)
+        assert pw2.mesh.shape["data"] == 4
+        for leaf in _leaves(net2):
+            assert np.isfinite(leaf).all()
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel serving
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(jax.device_count() < 4,
+                    reason="needs 4 virtual devices")
+class TestTPServing:
+    def test_tp_predict_matches_unsharded_with_zero_compiles(self):
+        """serve --mesh end to end: warmup builds one executable per
+        pow2 bucket, a mixed-size burst then compiles ZERO times,
+        outputs match the unsharded model, and the mesh shape rides
+        /healthz + the serving_mesh_devices gauge."""
+        from deeplearning4j_tpu.serving.http import ModelServer
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        ref = tiny_classifier(seed=13)
+        x5 = np.ones((5, 4), np.float32)
+        want = np.asarray(ref.output(x5))
+        reg = ModelRegistry()
+        reg.register("default", tiny_classifier(seed=13))
+        server = ModelServer(reg, max_batch_size=8, mesh="dp=2,tp=2")
+        try:
+            rep = server.warmup(generate=False)
+            assert rep["default"]["predict_buckets"] == [1, 2, 4, 8]
+            stats = install_global_watch()
+            sched, _ = server.scheduler_for("default")
+            with stats.zero_compile_scope("tp serve burst"):
+                for n in (1, 2, 3, 5, 8, 7, 1):
+                    out = sched.predict(np.zeros((n, 4), np.float32),
+                                        timeout=30)
+                    assert out.shape == (n, 3)
+            model, _ = server.resolve_serving_model("default")
+            np.testing.assert_allclose(model.output(x5), want,
+                                       rtol=1e-5, atol=1e-6)
+            assert model.mesh_desc()["axes"]["tp"] == 2
+            payload = server.health_payload()
+            assert payload["mesh"]["spec"] == "dp=2,tp=2"
+            assert "serving_mesh_devices" in \
+                server.metrics.prometheus_text()
+        finally:
+            server.stop(drain=False)
+
+    def test_generate_refused_on_mesh_server(self):
+        from deeplearning4j_tpu.serving.errors import ServingError
+        from deeplearning4j_tpu.serving.http import ModelServer
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        reg = ModelRegistry()
+        reg.register("default", tiny_classifier(seed=14))
+        server = ModelServer(reg, mesh="tp=2")
+        try:
+            with pytest.raises(ServingError, match="unsharded"):
+                server.batcher_for("default")
+        finally:
+            server.stop(drain=False)
+
+    def test_bad_mesh_spec_fails_at_boot(self):
+        """Unservable specs kill BOOT, not the first request: typos,
+        sp/pp axes, and oversubscribed device counts."""
+        from deeplearning4j_tpu.serving.errors import ServingError
+        from deeplearning4j_tpu.serving.http import ModelServer
+        with pytest.raises(ValueError, match="unknown mesh spec"):
+            ModelServer(mesh="tp=2,bogus=1")
+        with pytest.raises(ServingError, match="dp/tp axes only"):
+            ModelServer(mesh="sp=2")
+        with pytest.raises(NotImplementedError, match="pipeline"):
+            ModelServer(mesh="pp=2")
+        with pytest.raises(ValueError,
+                           match="xla_force_host_platform"):
+            ModelServer(mesh=f"tp={2 * jax.device_count()}")
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+class TestMeshCLI:
+    def test_help_mentions_mesh(self, capsys):
+        from deeplearning4j_tpu.cli import main
+        with pytest.raises(SystemExit) as ei:
+            main(["train", "--help"])
+        assert ei.value.code == 0
+        assert "--mesh" in capsys.readouterr().out
+        with pytest.raises(SystemExit) as ei:
+            main(["serve", "--help"])
+        assert ei.value.code == 0
+        assert "--mesh" in capsys.readouterr().out
+
+    def test_mesh_with_workers_fails_loudly(self):
+        from deeplearning4j_tpu.cli import main
+        with pytest.raises(SystemExit) as ei:
+            main(["train", "--model", "nope.zip", "--data", "n.csv",
+                  "--label-index", "4", "--mesh", "dp=2",
+                  "--workers", "2"])
+        assert "--mesh" in str(ei.value)
+
+    @pytest.mark.skipif(jax.device_count() < 2,
+                        reason="needs 2 virtual devices")
+    def test_cli_train_mesh_kstep_aot_e2e(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import main
+        from deeplearning4j_tpu.util.model_serializer import (
+            write_model)
+        mpath = str(tmp_path / "m.zip")
+        write_model(tiny_classifier(seed=15), mpath)
+        rng = np.random.default_rng(17)
+        rows = []
+        for _ in range(24):
+            feats = rng.normal(size=4)
+            rows.append(",".join(f"{v:.5f}" for v in feats)
+                        + f",{rng.integers(0, 3)}")
+        data = str(tmp_path / "d.csv")
+        with open(data, "w") as f:
+            f.write("\n".join(rows) + "\n")
+        out = str(tmp_path / "trained.zip")
+        main(["train", "--model", mpath, "--data", data,
+              "--label-index", "4", "--classes", "3",
+              "--batch-size", "8", "--epochs", "1",
+              "--mesh", "dp=2", "--k-step", "2", "--aot-warmup",
+              "--output", out])
+        printed = capsys.readouterr().out
+        assert "mesh: dp=2" in printed
+        assert "aot warmup:" in printed
+        assert os.path.exists(out)

@@ -646,9 +646,19 @@ class TestElasticShrink:
         assert largest_pow2(7) == 4 and largest_pow2(8) == 8
 
     def test_sharded_axes_refuse_to_shrink(self):
+        # pipe/seq state dies with the device — those meshes still
+        # refuse; data x model shrinks the dp axis keeping tp intact
+        # (tests/test_mesh_spec.py covers that path e2e)
         mesh = build_mesh(MeshSpec(data=1, seq=8), jax.devices()[:8])
-        with pytest.raises(NotImplementedError, match="data-parallel"):
+        with pytest.raises(NotImplementedError, match="data"):
             shrink_data_mesh(mesh, {jax.devices()[0]})
+        devs = jax.devices()[:8]
+        dptp = build_mesh(MeshSpec(data=4, model=2), devs)
+        shrunk = shrink_data_mesh(dptp, {devs[5]})    # kills dp row 2
+        assert shrunk.shape["data"] == 2
+        assert shrunk.shape["model"] == 2
+        assert devs[5] not in set(shrunk.devices.flat)
+        assert devs[4] not in set(shrunk.devices.flat)   # same row
 
     def test_device_loss_shrinks_and_matches_checkpoint_restart(
             self, tmp_path):
