@@ -1599,6 +1599,179 @@ def _leg_router_fleet(peak):
                  "router+fleet stack, not multi-host scale-out")}
 
 
+def _leg_autoscaler_soak(peak):
+    """The self-healing-fleet drill as a measured claim: a ~6x QPS
+    step over a 1-replica fleet with a seeded whole-replica kill
+    mid-spike, tiered traffic (gold/standard/best_effort). Headline:
+    seconds from SLO breach to SLO recovery with the autoscaler
+    closing the loop (bounds 1..3), vs the same spike on a FIXED
+    1-replica fleet (no autoscaler, no kill) where the SLO only
+    recovers when the spike ends. Also records per-tier outcomes:
+    zero gold-tier drops, best-effort shed first.
+
+    Replica capacity is an explicit per-request service time (a
+    sleep-based model), NOT device compute: on this 2-core host the
+    router stack itself is host-bound at ~50 q/s (see router_fleet),
+    so real-model replicas could not show capacity scaling. The leg
+    measures the CONTROL LOOP — detection, boot-first scale-up,
+    recovery — and the admission tiering, with loadgen in-process."""
+    import threading as _th
+
+    from deeplearning4j_tpu import chaos
+    from deeplearning4j_tpu.observability.slo import (BurnWindow, SLO,
+                                                      SLOMonitor)
+    from deeplearning4j_tpu.serving.autoscaler import Autoscaler
+    from deeplearning4j_tpu.serving.fleet import ReplicaFleet
+    from deeplearning4j_tpu.serving.router import Router
+    from tools.loadgen import (LoadGen, parse_profile,
+                               parse_tier_mix, tiered_body_fn)
+
+    class DelayModel:
+        def __init__(self, delay_s):
+            self.delay_s = delay_s
+
+        def output(self, x):
+            time.sleep(self.delay_s)
+            return np.asarray(x)
+
+    MIX = "gold=0.2,standard=0.5,best_effort=0.3"
+    PROFILE = "step:8:48:2"
+    DURATION = 14.0
+
+    def run(autoscale, kill_at=None):
+        fleet = ReplicaFleet(
+            lambda: {"default": DelayModel(0.04)}, n=1,
+            server_kwargs=dict(wait_ms=1.0, max_batch_size=1,
+                               queue_limit=6)).start()
+        router = Router(fleet, probe_interval_s=0.1,
+                        probe_timeout_s=0.5, attempt_timeout_s=3.0,
+                        request_timeout_s=8.0, hedge_after_s=None,
+                        sample_rate=0.0).start()
+        slos = SLOMonitor(router.registry, [SLO(
+            name="router_p_latency", objective=0.8, threshold_s=0.1,
+            metric="router_latency_seconds",
+            labels={"route": "/v1/predict"}, window_s=30.0,
+            windows=[BurnWindow(short_s=1.5, long_s=4.0,
+                                factor=1.5)])],
+            min_eval_interval_s=0.2)
+        scaler = None
+        if autoscale:
+            scaler = Autoscaler(
+                fleet, router, slos=slos, registry=router.registry,
+                min_replicas=1, max_replicas=3,
+                tick_interval_s=0.25, queue_high=3.0,
+                queue_low=0.25, up_consecutive=2,
+                down_consecutive=10_000, up_cooldown_s=1.5,
+                down_cooldown_s=60.0).start()
+        if kill_at is not None:
+            chaos.install({"faults": [
+                {"site": "serving.replica", "kind": "kill",
+                 "at": [kill_at], "args": {"replica": 0}}]},
+                seed=99)
+        body = tiered_body_fn(
+            lambda i: {"model": "default",
+                       "inputs": [[float(i % 7), 1.0]]},
+            parse_tier_mix(MIX))
+        gen = LoadGen(f"http://127.0.0.1:{router.port}",
+                      body_fn=body, concurrency=24,
+                      profile=parse_profile(PROFILE),
+                      duration_s=DURATION, timeout_s=6.0,
+                      max_retries=6, backlog_limit=512)
+        marks = {"breach": None, "recover": None}
+        t0 = time.monotonic()
+        out = {}
+
+        def load():
+            out["report"] = gen.run()
+
+        lt = _th.Thread(target=load, daemon=True)
+        lt.start()
+        try:
+            deadline = t0 + DURATION + 30.0
+            while time.monotonic() < deadline:
+                b = slos.any_breached()
+                now = time.monotonic() - t0
+                if b and marks["breach"] is None:
+                    marks["breach"] = now
+                if not b and marks["breach"] is not None:
+                    marks["recover"] = now
+                    break
+                time.sleep(0.1)
+            lt.join(timeout=30.0)
+            final_replicas = fleet.size()
+        finally:
+            chaos.uninstall()
+            if scaler is not None:
+                scaler.stop(wait_retires=False)
+            router.stop()
+            fleet.stop(drain=False, timeout=2.0)
+        rep = out.get("report", {})
+        ups = router.registry.get(
+            "autoscaler_scale_events_total",
+            labels={"direction": "up"})
+        return {"breach_s": marks["breach"],
+                "recover_s": marks["recover"],
+                "recovery_s": (None if None in marks.values()
+                               else round(marks["recover"]
+                                          - marks["breach"], 2)),
+                "scale_ups": 0 if ups is None else int(ups.value),
+                "final_replicas": final_replicas,
+                "tiers": rep.get("tiers", {}),
+                "failed": rep.get("failed"), "ok": rep.get("ok")}
+
+    scaled = run(autoscale=True, kill_at=150)
+    fixed = run(autoscale=False)
+    if scaled["recovery_s"] is None:
+        raise RuntimeError(
+            f"autoscaled run never breached+recovered: {scaled}")
+    gold = scaled["tiers"].get("gold", {})
+    if gold.get("failed", 1) != 0:
+        raise RuntimeError(
+            f"gold-tier drops under the autoscaled drill: {gold}")
+    fixed_rec = fixed["recovery_s"]
+    print(f"autoscaler_soak: breach @{scaled['breach_s']:.1f}s, "
+          f"recovered in {scaled['recovery_s']:.1f}s "
+          f"({scaled['scale_ups']} scale-ups, kill absorbed, gold "
+          f"0 dropped); fixed fleet recovery "
+          f"{fixed_rec if fixed_rec is not None else '>30'}s",
+          file=sys.stderr)
+    return {
+        "metric": ("autoscaler SLO-recovery time: ~6x QPS step + "
+                   "replica SIGKILL mid-spike, fleet bounds 1..3 "
+                   "(in-process replicas, 40ms service time, "
+                   "tiered load)"),
+        "value": scaled["recovery_s"], "unit": "seconds",
+        "baseline": fixed_rec,
+        "vs_baseline": (None if not fixed_rec else round(
+            fixed_rec / scaled["recovery_s"], 3)),
+        "scale_ups": scaled["scale_ups"],
+        "final_replicas": scaled["final_replicas"],
+        "gold_outcomes": scaled["tiers"].get("gold"),
+        "standard_outcomes": scaled["tiers"].get("standard"),
+        "best_effort_outcomes": scaled["tiers"].get("best_effort"),
+        "fixed_fleet_tiers": fixed["tiers"],
+        "host_cpus": os.cpu_count(),
+        "mfu": None,
+        "note": ("value: breach->recovery seconds with the "
+                 "autoscaler closing the loop (step:8:48:2 q/s at "
+                 "t=2s, seeded serving.replica kill at request "
+                 "ordinal 150 mid-spike; SLO = 80% of "
+                 "/v1/predict under 100ms, 1.5s/4s burn windows). "
+                 "baseline: the same step on a FIXED 1-replica "
+                 "fleet (no kill) — it exits breach too, but only "
+                 "by mass-shedding (fast 429s dilute the latency "
+                 "objective): see fixed_fleet_tiers — dozens of "
+                 "standard/best_effort requests dropped outright "
+                 "and even gold pays sheds+retries, vs zero gold "
+                 "and zero standard drops with the autoscaler. "
+                 "Replicas are sleep-based 40ms-service-time "
+                 "models behind real ModelServer/Router HTTP: the "
+                 "2-core host is router-bound (router_fleet), so "
+                 "the leg measures the control loop + tier "
+                 "admission, not hardware scale-out. The drill "
+                 "requires ZERO gold failures")}
+
+
 DECODE_STEPS = 128
 DECODE_CAP = 256
 MASKED_ATTN_SHAPE = (4, 4096, 8, 64)     # B, T, H, D
@@ -2459,6 +2632,9 @@ _LEGS = [
     ("tracing_overhead", _leg_tracing_overhead, 180),
     # CPU-dominated (loopback HTTP, tiny MLP replicas): cheap
     ("router_fleet", _leg_router_fleet, 240),
+    # CPU-dominated (sleep-based replicas, control-loop timing):
+    # cheap, runs last
+    ("autoscaler_soak", _leg_autoscaler_soak, 240),
 ]
 
 # every runnable --leg (the burst headline rides outside the ordered

@@ -49,6 +49,12 @@ the docs lint checks the README table against these):
                      seed-replayable SIGKILL; ``hang``/``slow``: stall
                      every handler on it by ``args.delay_s``, auto-
                      recovering after ``args.for_s`` when given)
+``serving.replica.boot`` one replica BOOT in ``serving/fleet.py``
+                     (``boot_fail``: the boot raises before the
+                     listener opens — ``fleet.grow()`` retries with
+                     bounded exponential backoff so the autoscaler's
+                     control loop never wedges; ``boot_slow``: the
+                     boot stalls ``args.delay_s`` first)
 ``parallel.device``  ``parallel/wrapper.ParallelWrapper`` right before
                      each data-parallel mesh step (``crash``, and
                      ``loss`` — simulate losing one mesh device; the
@@ -127,6 +133,8 @@ SITES: Dict[str, str] = {
     "train.step": "one ElasticTrainer train step",
     "serving.worker.step": "one serving-backend device step",
     "serving.replica": "one request routed to a fleet replica",
+    "serving.replica.boot": "one fleet replica boot (scale-up / "
+                            "replace successor)",
     "parallel.device": "one ParallelWrapper data-parallel mesh step",
 }
 
@@ -148,6 +156,12 @@ SITE_KINDS: Dict[str, frozenset] = {
     # handlers (the generic kinds would fault the ROUTER's own
     # dispatch thread, which is not what a replica fault means)
     "serving.replica": frozenset({"kill", "hang", "slow"}),
+    # boot faults are interpreted by ReplicaFleet._boot_replica:
+    # boot_fail raises ReplicaBootError BEFORE the replica starts
+    # (the autoscaler's grow() retries with bounded exponential
+    # backoff instead of wedging the control loop), boot_slow
+    # sleeps args.delay_s first (a replica importing jax forever)
+    "serving.replica.boot": frozenset({"boot_fail", "boot_slow"}),
     "parallel.device": _GENERIC_KINDS | {"loss"},
 }
 

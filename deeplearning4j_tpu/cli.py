@@ -258,6 +258,40 @@ def _cmd_serve_fleet(args):
     from deeplearning4j_tpu.serving.fleet import ReplicaFleet
     from deeplearning4j_tpu.serving.router import Router
     from deeplearning4j_tpu.util.model_serializer import restore_model
+    bounds = None
+    if args.autoscale:
+        # validate EVERY autoscaler input BEFORE booting anything: a
+        # typo'd bound, watermark band, or SLO rule must exit here,
+        # not crash after N replicas started (and leak them)
+        try:
+            lo, _, hi = args.autoscale.partition(":")
+            bounds = (int(lo), int(hi))
+        except ValueError:
+            raise SystemExit(
+                f"--autoscale wants MIN:MAX, got {args.autoscale!r}")
+        if bounds[0] < 1 or bounds[1] < bounds[0]:
+            raise SystemExit(
+                f"--autoscale bounds must satisfy 1 <= MIN <= MAX, "
+                f"got {args.autoscale!r}")
+        if not args.queue_low < args.queue_high:
+            raise SystemExit(
+                f"--queue-low ({args.queue_low:g}) must sit below "
+                f"--queue-high ({args.queue_high:g}) — the band "
+                "between them is the anti-flap dead zone")
+    if args.slo:
+        # --slo stands on its own (burn rates + slo_breach on the
+        # router's /metrics, autoscaler or not) and must also fail
+        # fast: validate the rules before any replica boots
+        from deeplearning4j_tpu.observability.registry import (
+            MetricsRegistry)
+        from deeplearning4j_tpu.observability.slo import SLOMonitor
+        try:
+            # throwaway registry: this pass only validates the
+            # rules; the real monitor binds to the router's
+            # registry once the router exists
+            SLOMonitor.from_config(MetricsRegistry(), args.slo)
+        except Exception as e:
+            raise SystemExit(f"bad --slo rules: {e}")
     if args.chaos:
         from deeplearning4j_tpu import chaos
         inj = chaos.install(args.chaos, seed=args.chaos_seed)
@@ -284,6 +318,31 @@ def _cmd_serve_fleet(args):
         hedge_after_s=None if args.hedge_after_ms <= 0
         else args.hedge_after_ms / 1e3,
         sample_rate=args.trace_sample).start()
+    slos = None
+    if args.slo:
+        from deeplearning4j_tpu.observability.slo import SLOMonitor
+        # objectives over the ROUTER's own latency family: the burn
+        # rate then measures what CLIENTS experienced through
+        # failover/hedging — and the slo_breach/slo_burn_rate
+        # gauges live on the router's /metrics whether or not the
+        # autoscaler consumes them
+        slos = SLOMonitor.from_config(router.registry, args.slo)
+        print(f"slo: {len(slos.status())} objective(s) over the "
+              "router registry (slo_breach on /metrics)")
+    scaler = None
+    if bounds is not None:
+        from deeplearning4j_tpu.serving.autoscaler import Autoscaler
+        lo, hi = bounds
+        scaler = Autoscaler(
+            fleet, router, slos=slos,
+            min_replicas=lo, max_replicas=hi,
+            tick_interval_s=args.autoscale_tick,
+            queue_high=args.queue_high,
+            queue_low=args.queue_low).start()
+        print(f"autoscaler: bounds {lo}..{hi}, tick "
+              f"{args.autoscale_tick:g}s, queue watermarks "
+              f"{args.queue_low:g}/{args.queue_high:g}"
+              + (f", {len(slos.status())} SLO(s)" if slos else ""))
     print(f"fleet router on http://{args.host}:{router.port}/ over "
           f"{fleet.size()} replica(s) "
           f"(/v1/predict /v1/generate /v1/models /healthz /readyz "
@@ -293,6 +352,8 @@ def _cmd_serve_fleet(args):
             time.sleep(3600)
     except KeyboardInterrupt:
         print("draining fleet...")
+        if scaler is not None:
+            scaler.stop(wait_retires=False)
         router.stop()
         fleet.stop(drain=True)
 
@@ -517,9 +578,38 @@ def main(argv=None):
     f.add_argument("--chaos", metavar="PLAN", default=None,
                    help="deterministic fault plan (the "
                         "serving.replica site kills/hangs whole "
-                        "replicas mid-load)")
+                        "replicas mid-load; serving.replica.boot "
+                        "fails/stalls scale-up boots)")
     f.add_argument("--chaos-seed", type=int, default=None,
                    metavar="N")
+    f.add_argument("--autoscale", metavar="MIN:MAX", default=None,
+                   help="run the SLO-driven autoscaler over the "
+                        "fleet: replica count moves inside "
+                        "[MIN, MAX] from SLO burn rate + queue "
+                        "depth + KV pressure (boot-first scale-up, "
+                        "drain-based scale-down of the replica "
+                        "with the fewest pinned streams)")
+    f.add_argument("--autoscale-tick", type=float, default=1.0,
+                   metavar="S",
+                   help="autoscaler control-loop period (seconds)")
+    f.add_argument("--queue-high", type=float, default=8.0,
+                   help="mean OUTSTANDING work per replica (probed "
+                        "backend queue depth + router in-flight — "
+                        "a queued request appears in both) above "
+                        "which the autoscaler votes scale-up")
+    f.add_argument("--queue-low", type=float, default=1.0,
+                   help="mean outstanding work per replica below "
+                        "which the autoscaler votes scale-down")
+    f.add_argument("--slo", metavar="RULES", default=None,
+                   help="declarative SLOs evaluated over the "
+                        "ROUTER's latency/availability metrics "
+                        "(inline JSON or @file; see README "
+                        "'Request tracing & SLOs'); burn-rate "
+                        "breaches are the autoscaler's primary "
+                        "scale-up trigger. Use metric "
+                        "'router_latency_seconds' with labels "
+                        "{'route': '/v1/predict'} for latency "
+                        "objectives at the router")
     f.set_defaults(fn=_cmd_serve_fleet)
 
     s = sub.add_parser("summary", help="inspect a model file")

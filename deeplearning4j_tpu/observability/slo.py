@@ -389,6 +389,16 @@ class SLOMonitor:
                 out.append(tid)
         return out[-limit:]
 
+    def any_breached(self, evaluate: bool = True) -> bool:
+        """True while ANY registered SLO's multi-window burn-rate
+        condition holds — the autoscaler's scale-up trigger (one
+        rate-limited evaluation per call by default, so a fast
+        control loop cannot stack samples)."""
+        if evaluate:
+            self.evaluate()
+        with self._lock:
+            return any(st.breached for st in self._state.values())
+
     # ------------------------------------------------------------------
     def status(self) -> List[dict]:
         """Per-SLO verdict for /healthz and the UI."""

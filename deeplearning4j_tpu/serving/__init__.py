@@ -22,7 +22,11 @@ _EXPORTS = {
     "ReplicaGoneError": "errors",
     "NoReplicaAvailableError": "errors",
     "KVPagePoolExhaustedError": "errors",
+    "ReplicaBootError": "errors",
     "CircuitBreaker": "lifecycle",
+    "TierQueue": "lifecycle",
+    "parse_tier": "tiers",
+    "priced_retry_after_s": "tiers",
     "LatencyHistogram": "metrics",
     "EndpointMetrics": "metrics",
     "BatchOccupancy": "metrics",
@@ -38,6 +42,7 @@ _EXPORTS = {
     "InProcessReplica": "fleet",
     "SubprocessReplica": "fleet",
     "Router": "router",
+    "Autoscaler": "autoscaler",
 }
 
 __all__ = list(_EXPORTS)

@@ -43,6 +43,7 @@ import numpy as np
 
 from deeplearning4j_tpu import chaos
 from deeplearning4j_tpu.observability.tracing import RequestContext
+from deeplearning4j_tpu.serving import tiers
 from deeplearning4j_tpu.serving.errors import KVPagePoolExhaustedError
 from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
                                                   CircuitBreaker,
@@ -152,6 +153,15 @@ class ContinuousBatcher(ServingBackend):
         # deadlines must be enforceable while every slot is busy, and
         # a queue.Queue cannot be inspected without draining it
         self._pending: List[_GenRequest] = []
+        # weighted-fair slot granting across the tiers pending
+        # (worker-thread only — see _next_pending)
+        self._picker = tiers.WeightedFairPicker()
+        # the request whose KV reservation last failed: admissions
+        # HOLD until it fits (or it leaves the pending list), so a
+        # big request cannot be starved by a stream of small
+        # higher-tier ones each grabbing the pages it was waiting
+        # for — the pre-tier FIFO no-starvation contract, kept
+        self._kv_blocked: Optional[_GenRequest] = None
         self._start_worker()
 
     # ---- paged-KV observability ----
@@ -217,13 +227,17 @@ class ContinuousBatcher(ServingBackend):
     def submit(self, prompt, n_tokens: int, temperature: float = 0.0,
                seed: int = 0,
                timeout: Optional[float] = None,
-               ctx=None) -> _GenRequest:
+               ctx=None, tier: Optional[str] = None) -> _GenRequest:
         """Enqueue one generate request. ``prompt`` is a 1-d (or
         (1, T0)) sequence of token ids; returns a waitable handle.
         ``ctx`` is the request's trace context (minted at HTTP
         admission); a fresh unsampled one is created for in-process
-        callers so phase attribution covers them too."""
+        callers so phase attribution covers them too. ``tier`` is
+        the priority-admission tier (gold/standard/best_effort):
+        under queue pressure the cheapest backlogged tier is evicted
+        first and slots are granted weighted-fair."""
         probe = self._admit_guard()
+        tier = tiers.parse_tier(tier)
         prompt = np.asarray(prompt)
         if prompt.ndim > 1 and prompt.shape[0] != 1:
             # a (B, T) batch of prompts is NOT one request: silently
@@ -259,19 +273,22 @@ class ContinuousBatcher(ServingBackend):
                     if timeout is not None else None)
         if ctx is None:
             ctx = RequestContext(route=self.name, deadline=deadline)
+        ctx.attrs["tier"] = tier
         ctx.phase_done("admission", now_in="queue_wait")
         r = _GenRequest(prompt, int(n_tokens), float(temperature),
                         int(seed), deadline)
         r.ctx = ctx
         r.probe = probe
+        r.tier = tier
         return self._enqueue(r)
 
     def generate(self, prompt, n_tokens: int, temperature: float = 0.0,
                  seed: int = 0,
                  timeout: Optional[float] = None,
-                 ctx=None) -> np.ndarray:
+                 ctx=None, tier: Optional[str] = None) -> np.ndarray:
         return self.wait(self.submit(prompt, n_tokens, temperature,
-                                     seed, timeout=timeout, ctx=ctx))
+                                     seed, timeout=timeout, ctx=ctx,
+                                     tier=tier))
 
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s is not None)
@@ -309,32 +326,65 @@ class ContinuousBatcher(ServingBackend):
                 keep.append(r)
         self._pending = keep
 
+    def _next_pending(self) -> int:
+        """Index of the next request to slot: WEIGHTED-FAIR across
+        the tiers present in the pending list, FIFO within a tier —
+        the same smooth-WRR contract the TierQueue enforces on
+        dequeue, re-applied here because ``_pump`` drains the queue
+        into ``_pending`` wholesale (slots, not dequeues, are this
+        backend's scarce resource). Strict priority would let a
+        sustained gold stream starve an admitted best-effort
+        request forever; the picker gives it the documented ~1/12
+        share instead."""
+        present = sorted({r.tier for r in self._pending},
+                         key=lambda t: tiers.PRIORITY.get(t, 1))
+        chosen = self._picker.pick(present)
+        return next(i for i, r in enumerate(self._pending)
+                    if r.tier == chosen)
+
     def _admit(self) -> None:
         while self._pending:
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
                 return
+            if (self._kv_blocked is not None
+                    and self._kv_blocked not in self._pending):
+                # the blocked request expired / was swept: release
+                # the hold
+                self._kv_blocked = None
+            if self._kv_blocked is not None:
+                # pool head-of-line: retry the SAME request until
+                # completing slots free enough pages for it —
+                # bypassing it would let smaller (or higher-tier)
+                # requests eat every freed page and starve it
+                nxt = self._pending.index(self._kv_blocked)
+            else:
+                nxt = self._next_pending()
             resume = 0
             if self._paged:
                 # admission asks the allocator: pages for this
                 # request's worst case, reusing cached prefix pages.
-                # Transient exhaustion leaves the request pending
-                # (FIFO — no starvation of big requests); its
-                # deadline keeps being enforced meanwhile
+                # Transient exhaustion parks the request as the
+                # sticky pool head (no starvation of big requests —
+                # see _kv_blocked); its deadline keeps being
+                # enforced meanwhile
                 try:
                     lease = self.session.reserve(
-                        self._pending[0].prompt,
-                        self._pending[0].n_tokens)
+                        self._pending[nxt].prompt,
+                        self._pending[nxt].n_tokens)
                 except KVPagePoolExhaustedError:
+                    self._kv_blocked = self._pending[nxt]
                     return
-                r = self._pending.pop(0)
+                r = self._pending.pop(nxt)
+                if r is self._kv_blocked:
+                    self._kv_blocked = None
                 self.session.bind(free[0], lease)
                 resume = lease.resume_pos
                 if lease.prefix_hit_tokens:
                     self._prefix_hits.inc()
                 self._sync_evictions()
             else:
-                r = self._pending.pop(0)
+                r = self._pending.pop(nxt)
                 self.session.reset_slot(free[0])
             if r.ctx is not None:
                 # slotted: queue_wait ends, prefill begins (prompt

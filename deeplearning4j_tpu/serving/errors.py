@@ -16,7 +16,8 @@ serving stack, and ``serving/__init__`` re-exports lazily.
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
            "ModelNotFoundError", "ServerClosedError",
            "CircuitOpenError", "ReplicaGoneError",
-           "NoReplicaAvailableError", "KVPagePoolExhaustedError"]
+           "NoReplicaAvailableError", "KVPagePoolExhaustedError",
+           "ReplicaBootError"]
 
 
 class ServingError(RuntimeError):
@@ -94,3 +95,13 @@ class NoReplicaAvailableError(ServingError):
     """Every replica in the fleet is dead, ejected, or draining: the
     router has nowhere to send the request (HTTP maps this to 503;
     ``retry_after_s`` is the soonest a replica may be readmitted)."""
+
+
+class ReplicaBootError(ServingError):
+    """A fleet replica failed to boot (scale-up or replace
+    successor): the process died / raised before its listener
+    opened, or the chaos ``serving.replica.boot`` site fired
+    ``boot_fail``. ``fleet.grow()`` retries boots with bounded
+    exponential backoff and raises this only once the retry budget
+    is spent — the autoscaler logs it and tries again next tick
+    instead of wedging."""

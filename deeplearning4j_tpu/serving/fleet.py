@@ -29,6 +29,16 @@ Fleet operations:
   FIRST (capacity never dips), the old replica flips to
   ``draining`` (the router stops new sends at the next pick, its
   in-flight streams finish), then drains and leaves the pool.
+- ``grow()`` — boot-first scale-up (the autoscaler's up verb): a
+  fresh replica boots and joins the pool only once its listener is
+  up, with failed boots retried under bounded exponential backoff
+  (chaos site ``serving.replica.boot``, kinds ``boot_fail`` /
+  ``boot_slow``; retries counted as ``replica_boot_retries_total``
+  and recorded by the flight recorder).
+- ``retire(rid)`` — drain-based scale-down (the autoscaler's down
+  verb): the replica flips to ``draining`` (the router stops new
+  sends at the very next pick), its in-flight and pinned streams
+  finish, then it leaves the pool.
 - ``apply_fault(fault)`` — the ``serving.replica`` chaos-site
   interpreter: ``kill`` / ``hang`` / ``slow`` faults from a seeded
   plan, so a SIGKILL-mid-load soak is replayable bit-for-bit.
@@ -252,6 +262,72 @@ class ReplicaFleet:
         return SubprocessReplica(rid, self._model_specs,
                                  self._base_port + rid)
 
+    def _boot_replica(self) -> _BaseReplica:
+        """Boot ONE new replica through the ``serving.replica.boot``
+        chaos site: ``boot_fail`` raises a typed
+        :class:`~.errors.ReplicaBootError` before the listener opens
+        (a crashed child, an OOM-killed import), ``boot_slow``
+        stalls the boot by ``args.delay_s`` first (jax importing
+        forever on a cold node). A real ``start()`` failure is
+        wrapped in the same typed error so every caller retries one
+        failure shape."""
+        from deeplearning4j_tpu import chaos
+        from deeplearning4j_tpu.serving.errors import ReplicaBootError
+        fault = chaos.hit("serving.replica.boot")
+        if fault is not None:
+            if fault.kind == "boot_fail":
+                raise ReplicaBootError(
+                    f"[chaos] replica boot failed at ordinal "
+                    f"#{fault.ordinal}")
+            if fault.kind == "boot_slow":
+                time.sleep(float(fault.args.get("delay_s", 0.25)))
+        r = self._new_replica()
+        try:
+            return r.start()
+        except Exception as e:
+            raise ReplicaBootError(
+                f"replica {r.id} failed to boot: {e!r}") from e
+
+    def _boot_retrying(self, max_boot_retries: int = 3
+                       ) -> _BaseReplica:
+        """Boot with bounded exponential backoff between failed
+        attempts — a flaky boot path must not wedge the autoscaler's
+        control loop, and a persistently failing one must fail TYPED
+        after the budget, not spin forever."""
+        from deeplearning4j_tpu.serving.errors import ReplicaBootError
+        attempt = 0
+        while True:
+            try:
+                return self._boot_replica()
+            except ReplicaBootError as e:
+                if attempt >= max_boot_retries:
+                    raise
+                delay = min(2.0, 0.05 * (2.0 ** attempt))
+                attempt += 1
+                try:
+                    from deeplearning4j_tpu.observability.registry \
+                        import safe_inc
+                    safe_inc("replica_boot_retries_total",
+                             help="failed fleet replica boots "
+                                  "retried with backoff")
+                except Exception:
+                    pass
+                try:
+                    from deeplearning4j_tpu.observability import (
+                        flight_recorder)
+                    rec = flight_recorder.get_recorder()
+                    if rec is not None:
+                        rec.record("replica_boot_retry",
+                                   attempt=attempt,
+                                   backoff_s=delay, error=repr(e))
+                except Exception:
+                    pass
+                logger.warning(
+                    "fleet: replica boot failed (attempt %d/%d, "
+                    "retrying in %.2fs): %r", attempt,
+                    max_boot_retries + 1, delay, e)
+                time.sleep(delay)
+
     def start(self) -> "ReplicaFleet":
         fresh = [self._new_replica().start() for _ in range(self.n)]
         with self._lock:
@@ -334,6 +410,64 @@ class ReplicaFleet:
             self.hang(pos, float(fault.args.get("delay_s", default)),
                       for_s=fault.args.get("for_s"))
 
+    # ---- elasticity (the autoscaler's verbs) ----
+    def grow(self, max_boot_retries: int = 3) -> _BaseReplica:
+        """Boot-first scale-up: a fresh replica joins the pool only
+        once its listener is actually up — booting capacity is never
+        counted as serving capacity. Failed boots retry under
+        bounded exponential backoff (``replica_boot_retries_total``);
+        a spent retry budget raises :class:`~.errors.ReplicaBootError`
+        for the caller to log and re-attempt next tick."""
+        successor = self._boot_retrying(max_boot_retries)
+        with self._lock:
+            self._replicas.append(successor)
+        logger.info("fleet: grew to %d replicas (replica %d up)",
+                    self.size(), successor.id)
+        self._notify()     # routable the moment it answers a probe
+        return successor
+
+    def retire(self, rid: int, drain_timeout: float = 30.0) -> bool:
+        """Drain-based scale-down of replica id ``rid``: flip it to
+        ``draining`` (the router stops new sends at the very next
+        pick — before the drain even starts), let its in-flight and
+        pinned streams finish, then drop it from the pool. Returns
+        True when the drain completed inside ``drain_timeout``
+        (stragglers past it fail typed, exactly like ``replace``'s
+        incumbent)."""
+        with self._lock:
+            target = next((r for r in self._replicas
+                           if r.id == rid), None)
+            if target is None:
+                logger.warning("fleet: retire(%d) — no such replica "
+                               "in the pool; ignored", rid)
+                return False
+            target.fleet_state = DRAINING
+        self._notify()
+        logger.info("fleet: retiring replica %d (drain-based "
+                    "scale-down)", rid)
+        ok = target.stop(drain=True, timeout=drain_timeout)
+        if not ok:
+            logger.warning("fleet: replica %d drain timed out after "
+                           "%.1fs during scale-down; stragglers "
+                           "failed typed", rid, drain_timeout)
+        with self._lock:
+            if target in self._replicas:
+                self._replicas.remove(target)
+        self._notify()
+        return ok
+
+    def draining_count(self) -> int:
+        """Members already on their way out (scale-down / replace
+        drain in flight): the autoscaler subtracts them from serving
+        capacity. Counts every pooled member NOT ``up`` — a
+        replica's ``stop()`` flips it ``draining``→``dead`` at the
+        start of its drain while it stays in the pool until the
+        drain completes, and a dead-but-pooled member is exactly as
+        much non-capacity as a draining one."""
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if r.fleet_state != UP)
+
     # ---- rotation ----
     def replace(self, pos: int, drain_timeout: float = 30.0
                 ) -> _BaseReplica:
@@ -346,8 +480,11 @@ class ReplicaFleet:
         router (which reads ``snapshot()`` per pick and skips
         ``draining`` members) stops new sends the moment the flag
         flips, while the old replica's in-flight streams run to
-        completion."""
-        successor = self._new_replica().start()
+        completion. The successor boots through the
+        ``serving.replica.boot`` chaos site like any scale-up (one
+        attempt — a failed replace boot raises before the incumbent
+        is touched, so the pool is left intact)."""
+        successor = self._boot_replica()
         with self._lock:
             if not self._replicas:
                 # the pool was emptied (seeded kills can outpace a

@@ -4,10 +4,16 @@ The same ``ThreadingHTTPServer`` idiom as ``ui/server.py`` (the
 reference's Play-based servers become stdlib http.server + JSON), in
 front of the registry + schedulers:
 
-- ``POST /v1/predict``  {"model", "version"?, "inputs", "timeout_ms"?}
-  → {"outputs", "model_version"}
+- ``POST /v1/predict``  {"model", "version"?, "inputs", "timeout_ms"?,
+  "tier"?} → {"outputs", "model_version"}
 - ``POST /v1/generate`` {"model", "version"?, "prompt", "n_tokens",
-  "temperature"?, "seed"?, "timeout_ms"?} → {"ids", "model_version"}
+  "temperature"?, "seed"?, "timeout_ms"?, "tier"?} →
+  {"ids", "model_version"}
+
+``tier`` is the priority-admission tier (``gold`` / ``standard`` /
+``best_effort``, default standard — see ``serving/tiers.py``): under
+queue pressure the cheapest backlogged tier is shed first and 429/503
+``Retry-After`` hints are priced by tier.
 - ``GET  /v1/models``   → registry listing
 - ``GET  /healthz``     → {"status": "ok" | "degraded" | "draining"}
   — always 200 for humans; the STATUS field carries the judgement
@@ -561,7 +567,8 @@ class ModelServer:
             x = x[None, :]
         if ctx is not None:
             ctx.attrs["model_version"] = version
-        out = sched.predict(x, timeout=self._timeout_s(body), ctx=ctx)
+        out = sched.predict(x, timeout=self._timeout_s(body), ctx=ctx,
+                            tier=body.get("tier"))
         return {"outputs": np.asarray(out).tolist(),
                 "model_version": version}
 
@@ -577,7 +584,8 @@ class ModelServer:
             body["prompt"], int(body.get("n_tokens", 16)),
             temperature=float(body.get("temperature", 0.0)),
             seed=int(body.get("seed", 0)),
-            timeout=self._timeout_s(body), ctx=ctx)
+            timeout=self._timeout_s(body), ctx=ctx,
+            tier=body.get("tier"))
         return {"ids": np.asarray(ids).tolist(),
                 "model_version": version}
 
@@ -634,15 +642,24 @@ class ModelServer:
     # ---- /debug payloads ----
     def debug_requests(self) -> dict:
         """In-flight requests (current phase + age + deadline), the
-        most recent completions, and the latency-attribution report
-        — the first page an operator opens for a slow server."""
+        most recent completions, per-backend queue depth by
+        priority tier, and the latency-attribution report — the
+        first page an operator opens for a slow server."""
         with self._inflight_lock:
             inflight = [dict(v["ctx"].to_debug(), model=v["model"])
                         for v in self._inflight.values()]
             recent = list(self._recent)[-20:]
+        with self._lock:
+            backends = (list(self._schedulers.values())
+                        + list(self._batchers.values()))
+        # which tiers are backlogged where: the page that answers
+        # "is the spike degrading best-effort first" directly
+        by_tier = {b.name: d for b in backends
+                   for d in [b._queue.depth_by_tier()] if d}
         return {"in_flight": inflight,
                 "in_flight_count": len(inflight),
                 "recent": recent,
+                "queue_by_tier": by_tier,
                 "latency_attribution":
                     self.metrics.latency_attribution()}
 

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from deeplearning4j_tpu.serving import tiers
 from deeplearning4j_tpu.serving.errors import (CircuitOpenError,
                                                DeadlineExceededError,
                                                QueueFullError,
@@ -41,7 +42,94 @@ from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["BaseRequest", "ServingBackend", "CircuitBreaker"]
+__all__ = ["BaseRequest", "ServingBackend", "CircuitBreaker",
+           "TierQueue"]
+
+
+class TierQueue:
+    """Bounded request queue with weighted-fair service across
+    priority tiers and shed-cheapest-first admission.
+
+    The drop-in replacement for the backends' ``queue.Queue``
+    (``put_nowait`` / ``get`` / ``get_nowait`` / ``qsize`` /
+    ``empty`` / ``maxsize``), with two tier behaviours layered on:
+
+    - **dequeue** is smooth weighted round-robin over the non-empty
+      tiers (``tiers.WEIGHTS``): under full backlog gold drains ~8x
+      as fast as best-effort, but best-effort is never starved.
+    - **overflow** sheds the cheapest traffic first: ``put_nowait``
+      at capacity evicts the NEWEST queued request of the lowest
+      backlogged tier strictly below the arrival's (returned to the
+      caller to fail typed — its waiter has invested the least
+      queue time of its tier); an arrival that outranks nothing
+      queued raises ``queue.Full`` and is shed itself.
+    """
+
+    def __init__(self, maxsize: int):
+        self.maxsize = int(maxsize)
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._q = {t: collections.deque() for t in tiers.TIERS}
+        self._picker = tiers.WeightedFairPicker()
+
+    def qsize(self) -> int:
+        with self._lock:
+            return sum(len(d) for d in self._q.values())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def depth_by_tier(self) -> dict:
+        with self._lock:
+            return {t: len(d) for t, d in self._q.items() if d}
+
+    def put_nowait(self, r: "BaseRequest"
+                   ) -> Optional["BaseRequest"]:
+        """Admit ``r``; returns the evicted lower-tier request when
+        admission had to make room (the caller owns failing it), or
+        None on a plain admit. Raises ``queue.Full`` when ``r``
+        itself must shed."""
+        tier = getattr(r, "tier", tiers.DEFAULT_TIER)
+        with self._not_empty:
+            total = sum(len(d) for d in self._q.values())
+            if self.maxsize <= 0 or total < self.maxsize:
+                self._q[tier].append(r)
+                self._not_empty.notify()
+                return None
+            for victim_tier in reversed(tiers.TIERS):
+                if (tiers.PRIORITY[victim_tier]
+                        <= tiers.PRIORITY[tier]):
+                    break       # nothing queued outranks the arrival
+                if self._q[victim_tier]:
+                    victim = self._q[victim_tier].pop()
+                    self._q[tier].append(r)
+                    return victim
+            raise queue.Full
+
+    def _pop_locked(self) -> "BaseRequest":
+        avail = [t for t in tiers.TIERS if self._q[t]]
+        return self._q[self._picker.pick(avail)].popleft()
+
+    def get(self, timeout: Optional[float] = None) -> "BaseRequest":
+        with self._not_empty:
+            if timeout is None:
+                while not any(self._q.values()):
+                    self._not_empty.wait()
+            else:
+                deadline = time.monotonic() + max(0.0, timeout)
+                while not any(self._q.values()):
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._not_empty.wait(left):
+                        if not any(self._q.values()):
+                            raise queue.Empty
+                        break
+            return self._pop_locked()
+
+    def get_nowait(self) -> "BaseRequest":
+        with self._lock:
+            if not any(self._q.values()):
+                raise queue.Empty
+            return self._pop_locked()
 
 
 class CircuitBreaker:
@@ -193,7 +281,7 @@ class BaseRequest:
     """A waitable unit of admitted work."""
 
     __slots__ = ("event", "result", "error", "deadline", "t_submit",
-                 "probe", "ctx")
+                 "probe", "ctx", "tier")
 
     def __init__(self, deadline: Optional[float], ctx=None):
         self.event = threading.Event()
@@ -201,6 +289,11 @@ class BaseRequest:
         self.error: Optional[BaseException] = None
         self.deadline = deadline
         self.t_submit = time.monotonic()
+        # priority-admission tier (tiers.py): decides weighted-fair
+        # service order, who is evicted first under queue pressure,
+        # and how the Retry-After backoff is priced. Stamped by the
+        # backend's submit() from the request body.
+        self.tier = tiers.DEFAULT_TIER
         # True when this request was admitted as a half-open circuit
         # probe: ONLY its success may close the circuit (a stale
         # pre-crash success must not vouch for a worker it never
@@ -238,7 +331,17 @@ class ServingBackend:
             help="per-backend circuit breaker state "
                  "(0=closed, 1=half-open, 2=open)",
             labels={"endpoint": name}, fn=self.breaker.state_code)
-        self._queue: "queue.Queue[BaseRequest]" = queue.Queue(queue_limit)
+        # per-tier shed accounting, instruments created ONCE here
+        # (GL006): the soak's "best-effort degraded first" claim is
+        # asserted on these counters
+        self._shed_by_tier = {
+            t: self.metrics.registry.counter(
+                "admission_shed_total",
+                help="requests shed at admission (queue overflow "
+                     "eviction or refusal), by priority tier",
+                labels={"endpoint": name, "tier": t})
+            for t in tiers.TIERS}
+        self._queue = TierQueue(queue_limit)
         self._draining = threading.Event()
         self._drained = threading.Event()
         self._stop = threading.Event()
@@ -307,13 +410,9 @@ class ServingBackend:
         except Exception:
             pass
         for r in self._crash_casualties():
-            if not r.event.is_set():
-                r.error = exc
-                if r.ctx is not None:
-                    # promote to sampled: a request killed by a
-                    # worker crash must leave a trace
-                    r.ctx.set_error(exc)
-                r.event.set()
+            # promote to sampled: a request killed by a worker crash
+            # must leave a trace
+            self._deliver_failure(r, exc)
 
     def _loop(self) -> None:
         raise NotImplementedError
@@ -349,44 +448,72 @@ class ServingBackend:
                 retry_after_s=self.breaker.cooldown_remaining())
         return kind == "probe"
 
+    def _shed_error(self, r: BaseRequest,
+                    detail: str) -> QueueFullError:
+        """Build the typed shed error and do its accounting: the
+        endpoint shed counter, the per-tier ``admission_shed_total``
+        family, and a Retry-After priced by the request's tier (the
+        base hint — 10 ms/queued item, floor 100 ms — is roughly the
+        time the backlog needs to clear; cheap tiers are told to
+        stay away for a multiple of it)."""
+        self._endpoint.count_shed()
+        counter = self._shed_by_tier.get(r.tier)
+        if counter is not None:
+            counter.inc()
+        base = max(0.1, 0.01 * self._queue.maxsize)
+        return QueueFullError(
+            f"{self.name!r} queue is at its limit "
+            f"({self._queue.maxsize}); {r.tier} request {detail} — "
+            "retry with backoff",
+            retry_after_s=tiers.priced_retry_after_s(base, r.tier))
+
     def _enqueue(self, r: BaseRequest) -> BaseRequest:
-        """Fail-fast put: shed at the limit, and guard the race where
-        shutdown's final sweep already ran — nothing would ever
-        complete a request admitted after it."""
+        """Fail-fast put: shed at the limit — evicting the newest
+        queued request of a cheaper tier first, so a spike degrades
+        best-effort traffic before paid traffic — and guard the race
+        where shutdown's final sweep already ran (nothing would ever
+        complete a request admitted after it)."""
         try:
-            self._queue.put_nowait(r)
+            victim = self._queue.put_nowait(r)
         except queue.Full:
-            self._endpoint.count_shed()
-            # backoff hint derived from queue depth: the time the
-            # backlog roughly needs to clear before a retry can even
-            # be admitted (10 ms/queued item, floor 100 ms) — crude,
-            # but proportional to the actual congestion instead of a
-            # constant the caller would have to guess
-            raise QueueFullError(
-                f"{self.name!r} queue is at its limit "
-                f"({self._queue.maxsize}); request shed — retry with "
-                "backoff",
-                retry_after_s=max(0.1, 0.01 * self._queue.maxsize)
-            ) from None
-        if self._stop.is_set() and not r.event.is_set():
-            r.error = ServerClosedError(
+            raise self._shed_error(r, "refused") from None
+        if victim is not None:
+            # a higher-tier arrival took the evicted request's queue
+            # slot: the victim is shed exactly as if admission had
+            # refused it — typed error, tier-priced Retry-After,
+            # counted against ITS tier
+            self._deliver_failure(victim,
+                                  self._shed_error(victim,
+                                                   "evicted"))
+        if self._stop.is_set():
+            self._deliver_failure(r, ServerClosedError(
                 f"{self.name!r} shut down while the request was "
-                "being admitted")
-            r.event.set()
+                "being admitted"))
         return r
+
+    @staticmethod
+    def _deliver_failure(r: BaseRequest, err: BaseException) -> None:
+        """The one fail-and-wake implementation: set the typed
+        error, promote the trace (always-sample on failure), wake
+        the waiter — idempotent on an already-completed request.
+        Every failure path (expiry, eviction, crash casualties, the
+        shutdown sweep) goes through here so the semantics cannot
+        drift between copies."""
+        if r.event.is_set():
+            return
+        r.error = err
+        if r.ctx is not None:
+            r.ctx.set_error(err)
+        r.event.set()
 
     def _fail_expired(self, r: BaseRequest, detail: str) -> None:
         """Deadline expiry for work that never started: count it,
-        deliver the typed error, promote the trace, wake the waiter
-        — ONE implementation for both backends (the scheduler's
-        queue sweep and the batcher's pending sweep), so the
-        always-sample-on-expiry and counter semantics cannot
-        drift."""
+        then the shared fail-and-wake — ONE implementation for both
+        backends (the scheduler's queue sweep and the batcher's
+        pending sweep), so the always-sample-on-expiry and counter
+        semantics cannot drift."""
         self._endpoint.count_expired()
-        r.error = DeadlineExceededError(detail)
-        if r.ctx is not None:
-            r.ctx.set_error(r.error)
-        r.event.set()
+        self._deliver_failure(r, DeadlineExceededError(detail))
 
     def wait(self, r: BaseRequest):
         r.event.wait()
@@ -444,11 +571,7 @@ class ServingBackend:
             except queue.Empty:
                 break
         for r in leftovers:
-            if not r.event.is_set():
-                r.error = err
-                if r.ctx is not None:
-                    r.ctx.set_error(err)
-                r.event.set()
+            self._deliver_failure(r, err)
 
     def _unregister_gauges(self) -> None:
         self.metrics.unregister_gauge(f"{self.name}_queue_depth")

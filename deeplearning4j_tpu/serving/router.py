@@ -67,6 +67,7 @@ from deeplearning4j_tpu.observability.registry import MetricsRegistry
 from deeplearning4j_tpu.observability.tracing import (RequestContext,
                                                       Sampler,
                                                       get_tracer)
+from deeplearning4j_tpu.serving import tiers
 from deeplearning4j_tpu.serving.errors import (NoReplicaAvailableError,
                                                ReplicaGoneError,
                                                ServerClosedError)
@@ -225,6 +226,17 @@ class Router:
         self._affinity_breaks = self.registry.counter(
             "router_affinity_breaks_total",
             help="session pins broken by replica death")
+        # router-level shed accounting by priority tier: a request
+        # the router turns away with no replica to try (the fleet is
+        # dead/ejected/benched) is a shed too, and the soak's
+        # per-tier evidence must cover it
+        self._shed_by_tier = {
+            t: self.registry.counter(
+                "admission_shed_total",
+                help="requests shed at admission (queue overflow "
+                     "eviction or refusal), by priority tier",
+                labels={"endpoint": "router", "tier": t})
+            for t in tiers.TIERS}
         self._sync_views()
         # pool-mutation hook: a replace()'s successor becomes
         # routable the moment it answers a probe, not a probe
@@ -874,6 +886,46 @@ class Router:
         if gone is not None:
             self._affinity_breaks.inc()
 
+    def pinned_sessions(self) -> Dict[int, int]:
+        """Replica id -> number of generate sessions currently
+        pinned to it. The autoscaler's scale-down victim selection
+        reads this: draining the replica with the FEWEST pins breaks
+        the fewest streams (zero, usually — pins on the drained
+        replica still finish, but new requests of those sessions
+        must re-pin)."""
+        with self._lock:
+            counts: Dict[int, int] = {}
+            for rid in self._affinity.values():
+                counts[rid] = counts.get(rid, 0) + 1
+        return counts
+
+    # ------------------------------------------------------------------
+    # autoscaler read surface
+    # ------------------------------------------------------------------
+    def load_signals(self) -> List[dict]:
+        """Per-replica load as the prober last saw it (the
+        autoscaler's sensor bundle): probed queue depth, router-side
+        in-flight, paged-KV pool pressure, health, and whether the
+        replica is currently eligible for traffic. Fleet-draining
+        members are excluded — a replica on its way out is not
+        capacity."""
+        eligible = {v.rid for v in self._eligible()}
+        fleet_states = {r.id: r.fleet_state
+                        for r in self.fleet.snapshot()}
+        with self._lock:
+            views = list(self._views.values())
+        out = []
+        for v in views:
+            if fleet_states.get(v.rid) != UP:
+                continue
+            out.append({"rid": v.rid, "health": v.health,
+                        "queue_depth": float(v.queue_depth),
+                        "inflight": int(v.inflight),
+                        "kv_pages_in_use": float(v.kv_pages_in_use),
+                        "kv_pages_total": float(v.kv_pages_total),
+                        "eligible": v.rid in eligible})
+        return out
+
     # ------------------------------------------------------------------
     # HTTP front end
     # ------------------------------------------------------------------
@@ -990,6 +1042,11 @@ class Router:
                     self._send(400, {"error":
                                      f"bad timeout_ms: {e}"})
                     return
+                try:
+                    tier = tiers.parse_tier(body.get("tier"))
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                    return
                 ctx = RequestContext.from_traceparent(
                     self.headers.get("traceparent"), route,
                     router.sampler, deadline=deadline,
@@ -998,6 +1055,7 @@ class Router:
                     ctx = RequestContext.new(
                         route, router.sampler, deadline=deadline,
                         tracer=router.tracer)
+                ctx.attrs["tier"] = tier
                 ctx.open_root()
                 code = 500
                 try:
@@ -1015,14 +1073,21 @@ class Router:
                 except NoReplicaAvailableError as e:
                     ctx.set_error(e)
                     code = 503
+                    # the router's own shed: counted by tier, and the
+                    # backoff hint priced by tier — cheap traffic is
+                    # told to stay away longest after a fleet-wide
+                    # outage, so the retry storm is tier-ordered too
+                    router._shed_by_tier[tier].inc()
                     self._send(503, {
                         "error": str(e),
                         "error_type": "NoReplicaAvailableError",
+                        "tier": tier,
                         "trace_id": ctx.trace_id},
                         headers={
                             "traceparent": ctx.traceparent(),
                             "Retry-After": _retry_after_header(
-                                e.retry_after_s or 1.0)})
+                                tiers.priced_retry_after_s(
+                                    e.retry_after_s or 1.0, tier))})
                 except ReplicaGoneError as e:
                     ctx.set_error(e)
                     code = 502

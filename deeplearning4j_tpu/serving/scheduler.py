@@ -25,6 +25,7 @@ import numpy as np
 
 from deeplearning4j_tpu import chaos
 from deeplearning4j_tpu.observability.tracing import RequestContext
+from deeplearning4j_tpu.serving import tiers
 from deeplearning4j_tpu.parallel.inference import (
     pow2_pad_rows, serve_batch_with_retry)
 from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
@@ -75,15 +76,20 @@ class BatchScheduler(ServingBackend):
 
     # ---- admission ----
     def submit(self, x, timeout: Optional[float] = None,
-               ctx=None) -> _Request:
+               ctx=None, tier: Optional[str] = None) -> _Request:
         """Enqueue one request of shape (n, ...features). Fail-fast
-        admission: raises QueueFullError at the queue limit and
-        ServerClosedError once draining. ``ctx`` is an optional
+        admission: raises QueueFullError at the queue limit (the
+        lowest backlogged tier is evicted first — see
+        ``serving/tiers.py``) and ServerClosedError once draining.
+        ``ctx`` is an optional
         :class:`~deeplearning4j_tpu.observability.tracing.RequestContext`
         (the HTTP front end mints one at admission); without one a
         fresh unsampled context is created so phase attribution
-        covers in-process callers too."""
+        covers in-process callers too. ``tier`` is the request's
+        priority tier (gold/standard/best_effort; default
+        standard)."""
         probe = self._admit_guard()
+        tier = tiers.parse_tier(tier)
         x = np.asarray(x)
         if x.ndim == 0:
             raise ValueError("request must have a leading batch axis")
@@ -91,16 +97,19 @@ class BatchScheduler(ServingBackend):
                     if timeout is not None else None)
         if ctx is None:
             ctx = RequestContext(route=self.name, deadline=deadline)
+        ctx.attrs["tier"] = tier
         # close the admission segment (parse/resolve/validate) as the
         # queue_wait segment opens — the enqueue below is the boundary
         ctx.phase_done("admission", now_in="queue_wait")
         r = _Request(x, deadline, ctx=ctx)
         r.probe = probe
+        r.tier = tier
         return self._enqueue(r)
 
     def predict(self, x, timeout: Optional[float] = None,
-                ctx=None) -> np.ndarray:
-        return self.wait(self.submit(x, timeout=timeout, ctx=ctx))
+                ctx=None, tier: Optional[str] = None) -> np.ndarray:
+        return self.wait(self.submit(x, timeout=timeout, ctx=ctx,
+                                     tier=tier))
 
     def _extra_depth(self) -> int:
         # list() snapshots the dict in one GIL-held C call — the

@@ -1,0 +1,1119 @@
+"""SLO-driven autoscaler + priority admission (ISSUE 13).
+
+- TierQueue: weighted-fair service, shed-cheapest-first eviction
+- tier admission through BatchScheduler + HTTP (tier-priced
+  Retry-After, ``admission_shed_total{tier}``)
+- burn-rate evaluation on zero-traffic / empty windows (no
+  div-by-zero, no vacuous breach)
+- autoscaler decision logic under a fake clock (hysteresis,
+  per-direction cooldowns, bounds, boot-failure backoff — no sleeps)
+- fleet boot retry through the ``serving.replica.boot`` chaos site
+- drain-based scale-down under active pinned streams drops nothing
+- ACCEPTANCE SOAK: ~4x QPS step + seeded SIGKILL mid-spike; the
+  autoscaler scales up and the latency SLO recovers within a bounded
+  window, zero gold-tier requests dropped, best-effort shed with
+  tier-priced Retry-After — asserted via ``slo_breach``,
+  ``autoscaler_scale_events_total{direction}`` and
+  ``admission_shed_total{tier}``.
+"""
+
+import json
+import queue as _queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.observability import registry as obs_registry
+from deeplearning4j_tpu.observability.registry import MetricsRegistry
+from deeplearning4j_tpu.observability.slo import (SLO, BurnWindow,
+                                                  SLOMonitor)
+from deeplearning4j_tpu.serving import tiers
+from deeplearning4j_tpu.serving.autoscaler import Autoscaler
+from deeplearning4j_tpu.serving.continuous import ContinuousBatcher
+from deeplearning4j_tpu.serving.errors import (QueueFullError,
+                                               ReplicaBootError)
+from deeplearning4j_tpu.serving.fleet import ReplicaFleet
+from deeplearning4j_tpu.serving.http import ModelServer
+from deeplearning4j_tpu.serving.lifecycle import TierQueue
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.router import Router
+from deeplearning4j_tpu.serving.scheduler import BatchScheduler
+from tools.loadgen import (LoadGen, parse_profile, parse_tier_mix,
+                           tiered_body_fn)
+
+pytestmark = pytest.mark.autoscale
+
+
+# ---------------------------------------------------------------------------
+# cheap models (the test_fleet idiom)
+# ---------------------------------------------------------------------------
+
+class EchoModel:
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def output(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(x) * 2.0
+
+
+class _FakeSession:
+    def __init__(self, slots, vocab, step_delay):
+        self.slots = slots
+        self.vocab = vocab
+        self.step_delay = step_delay
+
+    def reset_slot(self, i):
+        pass
+
+    def reinit_states(self):
+        pass
+
+    def step_slots(self, x, active):
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        h = np.zeros((self.slots, 1, self.vocab), np.float32)
+        for i in range(self.slots):
+            nxt = (int(x[i, 0, 0]) + 1) % self.vocab
+            h[i, 0, nxt] = 1.0
+        return h
+
+
+class FakeStreamModel:
+    VOCAB = 16
+
+    def __init__(self, step_delay=0.0):
+        self.step_delay = step_delay
+
+    def slot_streaming_session(self, capacity=64, slots=2,
+                               dtype=None):
+        return _FakeSession(slots, self.VOCAB, self.step_delay)
+
+
+def _post(base, path, body, timeout=10.0):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), \
+                dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def _sum_counter(registry, name, **label_filter):
+    """Sum a counter family over all label sets matching the
+    filter."""
+    total = 0.0
+    for m in registry.collect():
+        if m.name != name or m.kind != "counter":
+            continue
+        lbl = m.labels or {}
+        if all(lbl.get(k) == v for k, v in label_filter.items()):
+            total += m.value
+    return total
+
+
+class _Req:
+    def __init__(self, tier):
+        self.tier = tier
+        self.event = threading.Event()
+        self.error = None
+        self.ctx = None
+
+
+# ---------------------------------------------------------------------------
+# TierQueue
+# ---------------------------------------------------------------------------
+
+class TestTierQueue:
+    def test_weighted_fair_service_ratio(self):
+        q = TierQueue(0)
+        for _ in range(120):
+            for t in tiers.TIERS:
+                q.put_nowait(_Req(t))
+        got = [q.get_nowait().tier for _ in range(120)]
+        counts = {t: got.count(t) for t in tiers.TIERS}
+        # smooth WRR at weights 8:3:1 over a full backlog
+        assert counts[tiers.GOLD] == 80
+        assert counts[tiers.STANDARD] == 30
+        assert counts[tiers.BEST_EFFORT] == 10
+
+    def test_single_tier_is_fifo(self):
+        q = TierQueue(0)
+        reqs = [_Req(tiers.STANDARD) for _ in range(5)]
+        for r in reqs:
+            q.put_nowait(r)
+        assert [q.get_nowait() for _ in range(5)] == reqs
+
+    def test_overflow_evicts_newest_of_cheapest_tier(self):
+        q = TierQueue(4)
+        be = [_Req(tiers.BEST_EFFORT) for _ in range(2)]
+        for r in be:
+            q.put_nowait(r)
+        q.put_nowait(_Req(tiers.STANDARD))
+        q.put_nowait(_Req(tiers.GOLD))
+        victim = q.put_nowait(_Req(tiers.GOLD))
+        # the NEWEST queued best-effort goes, not the oldest
+        assert victim is be[1]
+        assert q.qsize() == 4
+
+    def test_overflow_refuses_arrival_that_outranks_nothing(self):
+        q = TierQueue(2)
+        q.put_nowait(_Req(tiers.GOLD))
+        q.put_nowait(_Req(tiers.GOLD))
+        with pytest.raises(_queue.Full):
+            q.put_nowait(_Req(tiers.GOLD))
+        with pytest.raises(_queue.Full):
+            q.put_nowait(_Req(tiers.BEST_EFFORT))
+
+    def test_weighted_fair_picker_shares_and_solo_fast_path(self):
+        p = tiers.WeightedFairPicker()
+        picks = [p.pick(list(tiers.TIERS)) for _ in range(120)]
+        assert picks.count(tiers.GOLD) == 80
+        assert picks.count(tiers.STANDARD) == 30
+        assert picks.count(tiers.BEST_EFFORT) == 10
+        # a lone tier is served directly, accumulating no credit
+        # against absent rivals
+        for _ in range(50):
+            assert p.pick([tiers.BEST_EFFORT]) == tiers.BEST_EFFORT
+        follow = [p.pick(list(tiers.TIERS)) for _ in range(12)]
+        assert follow.count(tiers.BEST_EFFORT) == 1
+
+    def test_batcher_slot_grant_cannot_starve_best_effort(self):
+        """ContinuousBatcher grants freed slots weighted-fair over
+        the PENDING list (not strict priority): with gold always
+        pending, an admitted best-effort request still gets its
+        ~1/12 share of slot grants instead of waiting forever."""
+        cb = ContinuousBatcher(FakeStreamModel(), slots=1,
+                               capacity=64, queue_limit=64)
+        cb.shutdown(drain=False)        # drive _next_pending by hand
+        cb._pending = [_Req(tiers.GOLD) for _ in range(30)] \
+            + [_Req(tiers.BEST_EFFORT)]
+        grants = []
+        for _ in range(20):
+            i = cb._next_pending()
+            grants.append(cb._pending.pop(i).tier)
+            # gold never dries up
+            cb._pending.append(_Req(tiers.GOLD))
+        assert tiers.BEST_EFFORT in grants, grants
+
+    def test_kv_blocked_head_is_sticky_across_tiers(self):
+        """A request whose KV reservation failed becomes the sticky
+        pool head: smaller HIGHER-tier requests cannot keep eating
+        the freed pages it is waiting for (the pre-tier FIFO
+        no-starvation contract, kept under weighted-fair
+        picking)."""
+        from deeplearning4j_tpu.serving.continuous import _GenRequest
+        from deeplearning4j_tpu.serving.errors import (
+            KVPagePoolExhaustedError)
+
+        class FakePagedSession:
+            def __init__(self):
+                self.allow = set()
+                self.bound = []
+                self.prefix_cache = type(
+                    "PC", (), {"evictions_total": 0})()
+
+            def reserve(self, prompt, n_tokens):
+                if tuple(int(t) for t in prompt) not in self.allow:
+                    raise KVPagePoolExhaustedError("pool full")
+                return type("L", (), {"resume_pos": 0,
+                                      "prefix_hit_tokens": 0})()
+
+            def bind(self, slot, lease):
+                self.bound.append(slot)
+
+            def reset_slot(self, i):
+                pass
+
+        cb = ContinuousBatcher(FakeStreamModel(), slots=2,
+                               capacity=64, queue_limit=8)
+        cb.shutdown(drain=False)        # drive _admit by hand
+        sess = FakePagedSession()
+        cb._paged = True
+        cb.session = sess
+        cb._evictions_seen = 0
+        cb._slots = [None, None]
+
+        def gen(prompt, tier):
+            r = _GenRequest(np.asarray(prompt), 2, 0.0, 0, None)
+            r.tier = tier
+            return r
+
+        big = gen([5, 5, 5], tiers.STANDARD)
+        cb._pending = [big]
+        cb._admit()                     # pool full: big parks as head
+        assert cb._kv_blocked is big and cb._pending == [big]
+        smalls = [gen([i + 1], tiers.GOLD) for i in range(3)]
+        sess.allow.update((i + 1,) for i in range(3))
+        cb._pending.extend(smalls)
+        cb._admit()
+        # gold fits, but the blocked head HOLDS admissions entirely
+        assert sess.bound == [] and big in cb._pending
+        sess.allow.add((5, 5, 5))       # pages freed: big fits now
+        cb._admit()
+        assert cb._kv_blocked is None
+        assert big not in cb._pending   # big slotted first
+        assert len(sess.bound) == 2     # then a gold took slot 2
+
+    def test_get_timeout_raises_empty(self):
+        q = TierQueue(4)
+        t0 = time.monotonic()
+        with pytest.raises(_queue.Empty):
+            q.get(timeout=0.05)
+        assert time.monotonic() - t0 < 1.0
+        with pytest.raises(_queue.Empty):
+            q.get_nowait()
+
+
+# ---------------------------------------------------------------------------
+# tier admission through the backends and HTTP
+# ---------------------------------------------------------------------------
+
+class TestTierAdmission:
+    def _stalled_scheduler(self, queue_limit=4):
+        """A scheduler whose worker is busy in a slow device call,
+        so submissions stay QUEUED (max_batch_size=1: one request
+        per device call)."""
+        sched = BatchScheduler(EchoModel(delay=0.5),
+                               max_batch_size=1,
+                               queue_limit=queue_limit, wait_ms=1.0,
+                               name="predict")
+        sched.submit([[1.0]])          # occupies the worker
+        time.sleep(0.15)               # worker now inside the model
+        return sched
+
+    def test_gold_evicts_best_effort_with_priced_retry_after(self):
+        sched = self._stalled_scheduler(queue_limit=4)
+        try:
+            be = [sched.submit([[1.0]], tier="best_effort")
+                  for _ in range(4)]
+            gold = sched.submit([[2.0]], tier="gold")
+            # the newest best-effort was evicted, typed + priced
+            assert be[-1].event.is_set()
+            assert isinstance(be[-1].error, QueueFullError)
+            base = max(0.1, 0.01 * 4)
+            assert be[-1].error.retry_after_s == pytest.approx(
+                tiers.priced_retry_after_s(base, "best_effort"))
+            assert not gold.event.is_set() or gold.error is None
+            reg = sched.metrics.registry
+            assert _sum_counter(reg, "admission_shed_total",
+                                tier="best_effort") == 1.0
+            assert _sum_counter(reg, "admission_shed_total",
+                                tier="gold") == 0.0
+        finally:
+            sched.shutdown(drain=False)
+
+    def test_arrival_outranked_is_shed_with_its_own_price(self):
+        sched = self._stalled_scheduler(queue_limit=2)
+        try:
+            for _ in range(2):
+                sched.submit([[1.0]], tier="gold")
+            with pytest.raises(QueueFullError) as ei:
+                sched.submit([[9.0]], tier="best_effort")
+            base = max(0.1, 0.01 * 2)
+            assert ei.value.retry_after_s == pytest.approx(
+                tiers.priced_retry_after_s(base, "best_effort"))
+            gold_price = tiers.priced_retry_after_s(base, "gold")
+            assert ei.value.retry_after_s > gold_price
+            assert _sum_counter(sched.metrics.registry,
+                                "admission_shed_total",
+                                tier="best_effort") == 1.0
+        finally:
+            sched.shutdown(drain=False)
+
+    def test_unknown_tier_is_a_client_error(self):
+        sched = BatchScheduler(EchoModel(), name="predict")
+        try:
+            with pytest.raises(ValueError):
+                sched.submit([[1.0]], tier="platinum")
+        finally:
+            sched.shutdown(drain=False)
+
+    def test_http_tier_threading_and_400(self):
+        models = ModelRegistry()
+        models.register("m", EchoModel())
+        server = ModelServer(models, wait_ms=1.0).start()
+        base = f"http://{server.host}:{server.port}"
+        try:
+            st, body, _ = _post(base, "/v1/predict",
+                                {"model": "m", "inputs": [[1.0]],
+                                 "tier": "gold"})
+            assert st == 200 and body["outputs"] == [[2.0]]
+            st, body, _ = _post(base, "/v1/predict",
+                                {"model": "m", "inputs": [[1.0]],
+                                 "tier": "platinum"})
+            assert st == 400
+            assert "tier" in body["error"]
+            # best-effort spelled with a dash is accepted
+            st, _, _ = _post(base, "/v1/predict",
+                             {"model": "m", "inputs": [[1.0]],
+                              "tier": "best-effort"})
+            assert st == 200
+        finally:
+            server.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate edges: zero traffic, empty windows
+# ---------------------------------------------------------------------------
+
+class TestBurnRateEdges:
+    def test_unregistered_metric_never_breaches(self):
+        reg = MetricsRegistry()
+        clk = FakeClock()
+        mon = SLOMonitor(reg, [SLO(name="lat", objective=0.99,
+                                   threshold_s=0.1)],
+                         clock=clk, min_eval_interval_s=0.0)
+        for _ in range(5):
+            clk.advance(10.0)
+            assert mon.evaluate(force=True) == []
+        assert mon.any_breached(evaluate=False) is False
+        g = reg.get("slo_breach", labels={"slo": "lat"})
+        assert g is not None and g.value() == 0.0
+
+    def test_zero_observation_histogram_no_div_by_zero(self):
+        reg = MetricsRegistry()
+        reg.histogram("serving_latency_seconds",
+                      labels={"endpoint": "predict"})
+        clk = FakeClock()
+        mon = SLOMonitor(reg, [SLO(name="lat", objective=0.99,
+                                   threshold_s=0.1,
+                                   labels={"endpoint": "predict"})],
+                         clock=clk, min_eval_interval_s=0.0)
+        for _ in range(5):
+            clk.advance(30.0)
+            assert mon.evaluate(force=True) == []
+        st = mon.status()[0]
+        assert st["breached"] is False
+        assert all(b == 0.0 for b in st["burn_rates"].values())
+
+    def test_zero_traffic_availability_slo_is_quiet(self):
+        reg = MetricsRegistry()
+        reg.counter("serving_requests_total",
+                    labels={"endpoint": "predict"})
+        reg.counter("serving_errors_total",
+                    labels={"endpoint": "predict"})
+        clk = FakeClock()
+        mon = SLOMonitor(reg, [SLO(name="avail", objective=0.999,
+                                   labels={"endpoint": "predict"})],
+                         clock=clk, min_eval_interval_s=0.0)
+        for _ in range(5):
+            clk.advance(30.0)
+            assert mon.evaluate(force=True) == []
+        assert mon.any_breached(evaluate=False) is False
+
+    def test_breach_then_empty_window_recovers(self):
+        """Bad traffic breaches; traffic STOPPING entirely must
+        recover the SLO (empty window deltas burn nothing), not
+        page forever on stale counts."""
+        reg = MetricsRegistry()
+        h = reg.histogram("serving_latency_seconds",
+                          labels={"endpoint": "predict"})
+        clk = FakeClock()
+        win = [BurnWindow(short_s=10.0, long_s=30.0, factor=2.0)]
+        mon = SLOMonitor(reg, [SLO(name="lat", objective=0.9,
+                                   threshold_s=0.05,
+                                   labels={"endpoint": "predict"},
+                                   window_s=30.0, windows=win)],
+                         clock=clk, min_eval_interval_s=0.0)
+        mon.evaluate(force=True)            # baseline sample
+        for _ in range(6):
+            clk.advance(5.0)
+            for _ in range(20):
+                h.record(0.5)               # all bad
+            mon.evaluate(force=True)
+        assert mon.any_breached(evaluate=False) is True
+        # traffic stops; windows slide past the incident
+        changes = []
+        for _ in range(10):
+            clk.advance(10.0)
+            changes += mon.evaluate(force=True)
+        assert mon.any_breached(evaluate=False) is False
+        assert any(c["event"] == "recover" for c in changes)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler decisions under a fake clock (no sleeps, no threads)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class StubReplica:
+    def __init__(self, rid):
+        self.id = rid
+        self.fleet_state = "up"
+
+
+class StubFleet:
+    def __init__(self, n=1, boot_failures=0):
+        self._next = n
+        self.replicas = [StubReplica(i) for i in range(n)]
+        self.boot_failures = boot_failures
+        self.boot_attempts = 0
+        self.retired = []
+
+    def size(self):
+        return len(self.replicas)
+
+    def draining_count(self):
+        return sum(1 for r in self.replicas
+                   if r.fleet_state == "draining")
+
+    def snapshot(self):
+        return list(self.replicas)
+
+    def grow(self, max_boot_retries=3):
+        self.boot_attempts += 1
+        if self.boot_failures > 0:
+            self.boot_failures -= 1
+            raise ReplicaBootError("stub boot failure")
+        r = StubReplica(self._next)
+        self._next += 1
+        self.replicas.append(r)
+        return r
+
+    def retire(self, rid, drain_timeout=30.0):
+        self.retired.append(rid)
+        self.replicas = [r for r in self.replicas if r.id != rid]
+        return True
+
+
+class StubRouter:
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.queue_depth = 0.0
+        self.pins = {}
+        self.fleet = None
+
+    def load_signals(self):
+        return [{"rid": r.id, "health": "ok",
+                 "queue_depth": self.queue_depth, "inflight": 0,
+                 "kv_pages_in_use": 0.0, "kv_pages_total": 0.0,
+                 "eligible": True}
+                for r in self.fleet.snapshot()
+                if r.fleet_state == "up"]
+
+    def pinned_sessions(self):
+        return dict(self.pins)
+
+
+class StubSLOs:
+    def __init__(self):
+        self.breached = False
+
+    def any_breached(self):
+        return self.breached
+
+
+def _make(clock, n=1, **kw):
+    fleet = StubFleet(n=n)
+    router = StubRouter()
+    router.fleet = fleet
+    slos = StubSLOs()
+    cfg = dict(min_replicas=1, max_replicas=4, queue_high=8.0,
+               queue_low=1.0, up_consecutive=2, down_consecutive=3,
+               up_cooldown_s=5.0, down_cooldown_s=30.0, clock=clock)
+    cfg.update(kw)
+    return fleet, router, slos, Autoscaler(fleet, router, slos=slos,
+                                           **cfg)
+
+
+class TestAutoscalerDecisions:
+    def test_hysteresis_needs_consecutive_ticks(self):
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk)
+        router.queue_depth = 20.0
+        assert sc.tick() is None            # 1 high tick: not yet
+        clk.advance(1.0)
+        assert sc.tick() == "up"            # 2nd consecutive: scale
+        assert fleet.size() == 2
+        assert sc.registry.get(
+            "autoscaler_scale_events_total",
+            labels={"direction": "up"}).value == 1.0
+
+    def test_noisy_signal_cannot_flap(self):
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk, n=2)
+        # alternate high/low every tick: neither direction ever
+        # accumulates its consecutive count
+        for i in range(20):
+            router.queue_depth = 20.0 if i % 2 == 0 else 0.0
+            assert sc.tick() is None
+            clk.advance(1.0)
+        assert fleet.size() == 2 and fleet.retired == []
+
+    def test_up_cooldown_blocks_immediate_second_up(self):
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk)
+        router.queue_depth = 20.0
+        sc.tick()
+        clk.advance(1.0)
+        assert sc.tick() == "up"
+        for _ in range(4):                  # inside the 5s cooldown
+            clk.advance(1.0)
+            assert sc.tick() is None
+        clk.advance(2.0)                    # past it
+        assert sc.tick() == "up"
+        assert fleet.size() == 3
+
+    def test_slo_breach_triggers_scale_up(self):
+        clk = FakeClock()
+        fleet, router, slos, sc = _make(clk)
+        slos.breached = True
+        sc.tick()
+        clk.advance(1.0)
+        assert sc.tick() == "up"
+
+    def test_bounds_are_hard(self):
+        clk = FakeClock()
+        fleet, router, slos, sc = _make(clk, n=4, max_replicas=4)
+        router.queue_depth = 50.0
+        for _ in range(10):
+            assert sc.tick() is None        # at max: never up
+            clk.advance(1.0)
+        assert fleet.size() == 4
+        fleet2, router2, _, sc2 = _make(clk, n=1)
+        router2.queue_depth = 0.0
+        for _ in range(10):
+            assert sc2.tick() is None       # at min: never down
+            clk.advance(1.0)
+        assert fleet2.size() == 1
+
+    def test_scale_down_waits_then_picks_fewest_pinned(self):
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk, n=3, down_consecutive=3,
+                                     down_cooldown_s=0.0)
+        router.queue_depth = 0.0
+        router.pins = {0: 2, 1: 0, 2: 1}
+        assert sc.tick() is None
+        clk.advance(1.0)
+        assert sc.tick() is None
+        clk.advance(1.0)
+        assert sc.tick() == "down"
+        # retire runs on a worker thread; StubFleet.retire is instant
+        deadline = time.monotonic() + 5.0
+        while not fleet.retired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fleet.retired == [1]         # zero pins wins
+
+    def test_scale_up_after_down_resets_quickly_but_down_cools(self):
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk, n=2, down_consecutive=2,
+                                     down_cooldown_s=30.0)
+        router.queue_depth = 0.0
+        sc.tick()
+        clk.advance(1.0)
+        assert sc.tick() == "down"
+        # further low ticks inside down_cooldown: no second down
+        for _ in range(5):
+            clk.advance(1.0)
+            assert sc.tick() is None
+        assert fleet.size() + len(fleet.retired) >= 2
+
+    def test_boot_failure_backs_off_instead_of_wedging(self):
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk)
+        fleet.boot_failures = 1             # first grow() raises
+        router.queue_depth = 20.0
+        sc.tick()
+        clk.advance(1.0)
+        assert sc.tick() is None            # boot failed, counted
+        assert sc.registry.get(
+            "autoscaler_boot_failures_total").value == 1.0
+        attempts = fleet.boot_attempts
+        clk.advance(0.5)                    # inside the boot backoff
+        sc.tick()
+        assert fleet.boot_attempts == attempts   # no hot retry loop
+        clk.advance(5.0)                    # past the backoff
+        assert sc.tick() == "up"
+        assert fleet.size() == 2
+
+    def test_unprobed_pool_is_not_starved(self):
+        """A pool whose views are all still 'unprobed' is BOOTING:
+        no spurious scale-up (it is not starved) and no scale-down
+        (zero queue depth there is absence of data, not idleness) —
+        regression for probe_interval > hysteresis window."""
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk, n=2, down_consecutive=2,
+                                     down_cooldown_s=0.0)
+        orig = router.load_signals
+        router.load_signals = lambda: [
+            dict(v, health="unprobed", eligible=False)
+            for v in orig()]
+        for _ in range(10):
+            assert sc.tick() is None
+            clk.advance(1.0)
+        assert fleet.size() == 2 and fleet.retired == []
+
+    def test_probed_dead_fleet_is_starved_and_scales_up(self):
+        """Once the prober has CLASSIFIED the views and none is
+        eligible (mass unannounced death), that IS starvation."""
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk)
+        orig = router.load_signals
+        router.load_signals = lambda: [
+            dict(v, health="dead", eligible=False)
+            for v in orig()]
+        sc.tick()
+        clk.advance(1.0)
+        assert sc.tick() == "up"
+
+    def test_sensor_failure_holds_the_pool(self):
+        """A failing router read is MISSING data, not a starved
+        fleet: the loop must hold (no runaway to max_replicas on a
+        dead prober)."""
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk)
+        router.queue_depth = 20.0
+        sc.tick()                            # one genuine high tick
+
+        def boom():
+            raise RuntimeError("prober dead")
+
+        router.load_signals = boom
+        for _ in range(10):
+            clk.advance(1.0)
+            assert sc.tick() is None
+        assert fleet.size() == 1
+
+    def test_slo_sensor_failure_blocks_scale_down(self):
+        """A raising SLO monitor is a broken sensor, not a healthy
+        SLO: it must not read as 'no breach' and green-light a
+        scale-down mid-incident."""
+        clk = FakeClock()
+        fleet, router, slos, sc = _make(clk, n=2,
+                                        down_consecutive=2,
+                                        down_cooldown_s=0.0)
+        router.queue_depth = 0.0             # shallow queues
+
+        def boom():
+            raise RuntimeError("bad SLO rule")
+
+        slos.any_breached = boom
+        for _ in range(10):
+            assert sc.tick() is None
+            clk.advance(1.0)
+        assert fleet.size() == 2 and fleet.retired == []
+
+    def test_below_min_repairs_without_hysteresis(self):
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk, n=2, min_replicas=2)
+        fleet.replicas.pop()                # a SIGKILL took one
+        assert sc.tick() == "up"            # repaired on tick ONE
+        assert fleet.size() == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet boot retry through the chaos site
+# ---------------------------------------------------------------------------
+
+class TestBootRetry:
+    @pytest.fixture(autouse=True)
+    def _clean_chaos(self):
+        yield
+        chaos.uninstall()
+
+    def _fleet(self, n=1):
+        return ReplicaFleet(
+            lambda: {"default": EchoModel()}, n=n,
+            server_kwargs=dict(wait_ms=1.0)).start()
+
+    def test_grow_retries_seeded_boot_failures(self):
+        fleet = self._fleet()
+        try:
+            chaos.install({"faults": [
+                {"site": "serving.replica.boot", "kind": "boot_fail",
+                 "at": [1, 2]}]}, seed=7)
+            before = obs_registry.REGISTRY.counter(
+                "replica_boot_retries_total").value
+            r = fleet.grow(max_boot_retries=3)
+            assert fleet.size() == 2 and r.port > 0
+            assert chaos.current().hits("serving.replica.boot") == 3
+            after = obs_registry.REGISTRY.counter(
+                "replica_boot_retries_total").value
+            assert after - before == 2
+        finally:
+            fleet.stop(drain=False, timeout=2.0)
+
+    def test_grow_raises_typed_after_budget(self):
+        fleet = self._fleet()
+        try:
+            chaos.install({"faults": [
+                {"site": "serving.replica.boot", "kind": "boot_fail",
+                 "p": 1.0}]}, seed=7)
+            with pytest.raises(ReplicaBootError):
+                fleet.grow(max_boot_retries=1)
+            assert fleet.size() == 1        # pool untouched
+        finally:
+            fleet.stop(drain=False, timeout=2.0)
+
+    def test_boot_slow_stalls_but_succeeds(self):
+        fleet = self._fleet()
+        try:
+            chaos.install({"faults": [
+                {"site": "serving.replica.boot", "kind": "boot_slow",
+                 "at": [1], "args": {"delay_s": 0.3}}]}, seed=7)
+            t0 = time.monotonic()
+            fleet.grow()
+            assert time.monotonic() - t0 >= 0.3
+            assert fleet.size() == 2
+        finally:
+            fleet.stop(drain=False, timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# drain-based scale-down under active streams (satellite regression)
+# ---------------------------------------------------------------------------
+
+class TestScaleDownUnderStreams:
+    def test_scale_down_spares_pinned_replica_and_drops_nothing(self):
+        fleet = ReplicaFleet(
+            lambda: {"default": EchoModel(),
+                     "lm": FakeStreamModel(step_delay=0.03)},
+            n=2, server_kwargs=dict(wait_ms=1.0, slots=2,
+                                    capacity=64)).start()
+        router = Router(fleet, probe_interval_s=0.05,
+                        hedge_after_s=None, sample_rate=0.0).start()
+        base = f"http://127.0.0.1:{router.port}"
+        result = {}
+
+        def stream():
+            result["resp"] = _post(
+                base, "/v1/generate",
+                {"model": "lm", "prompt": [1, 2, 3], "n_tokens": 40,
+                 "session": "s1"}, timeout=30.0)
+
+        t = threading.Thread(target=stream, daemon=True)
+        t.start()
+        try:
+            # wait until the stream is provably pinned + in flight
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline \
+                    and not router.pinned_sessions():
+                time.sleep(0.02)
+            pins = router.pinned_sessions()
+            assert pins, "stream never pinned"
+            pinned_rid = next(iter(pins))
+            sc = Autoscaler(fleet, router, min_replicas=1,
+                            max_replicas=4, down_consecutive=1,
+                            drain_timeout_s=20.0)
+            victim = sc._pick_scale_down_victim()
+            assert victim is not None and victim != pinned_rid
+            ok = fleet.retire(victim, drain_timeout=20.0)
+            assert ok
+            t.join(timeout=20.0)
+            assert not t.is_alive()
+            st, body, _ = result["resp"]
+            assert st == 200 and len(body["ids"]) == 40
+            assert fleet.size() == 1
+            # the surviving replica is the pinned one
+            assert fleet.snapshot()[0].id == pinned_rid
+        finally:
+            t.join(timeout=1.0)
+            router.stop()
+            fleet.stop(drain=False, timeout=2.0)
+
+    def test_retiring_the_pinned_replica_lets_streams_finish(self):
+        fleet = ReplicaFleet(
+            lambda: {"lm": FakeStreamModel(step_delay=0.03)},
+            n=2, server_kwargs=dict(wait_ms=1.0, slots=2,
+                                    capacity=64)).start()
+        router = Router(fleet, probe_interval_s=0.05,
+                        hedge_after_s=None, sample_rate=0.0).start()
+        base = f"http://127.0.0.1:{router.port}"
+        result = {}
+
+        def stream():
+            result["resp"] = _post(
+                base, "/v1/generate",
+                {"model": "lm", "prompt": [1, 2], "n_tokens": 30,
+                 "session": "s2"}, timeout=30.0)
+
+        t = threading.Thread(target=stream, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline \
+                    and not router.pinned_sessions():
+                time.sleep(0.02)
+            pins = router.pinned_sessions()
+            assert pins
+            pinned_rid = next(iter(pins))
+            # gate on the stream being provably IN FLIGHT on the
+            # replica (an active decode slot), not merely pinned at
+            # the router — retiring in the gap between pin and
+            # admission would 503 the request instead of draining it
+            rep = next(r for r in fleet.snapshot()
+                       if r.id == pinned_rid)
+            while time.monotonic() < deadline:
+                slots = rep.server.debug_slots()["backends"]
+                if any(b["active_slots"] > 0
+                       for b in slots.values()):
+                    break
+                time.sleep(0.02)
+            # retire the replica the stream LIVES on: drain must let
+            # it finish (the worst case for drain-based scale-down)
+            rt = threading.Thread(
+                target=lambda: result.__setitem__(
+                    "ok", fleet.retire(pinned_rid,
+                                       drain_timeout=20.0)),
+                daemon=True)
+            rt.start()
+            # DURING the drain the member still pools but must not
+            # count as capacity, whatever its draining/dead state —
+            # the autoscaler's serving-count contract
+            saw_draining = False
+            while rt.is_alive():
+                if fleet.size() == 2 and fleet.draining_count() == 1:
+                    saw_draining = True
+                time.sleep(0.01)
+            rt.join(timeout=25.0)
+            t.join(timeout=20.0)
+            assert saw_draining
+            assert result["ok"] and not t.is_alive()
+            st, body, _ = result["resp"]
+            assert st == 200 and len(body["ids"]) == 30
+            assert fleet.size() == 1
+        finally:
+            t.join(timeout=1.0)
+            router.stop()
+            fleet.stop(drain=False, timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE SOAK: step load + SIGKILL, the fleet heals itself
+# ---------------------------------------------------------------------------
+
+class TestStepLoadKillSoak:
+    def test_autoscaler_restores_slo_with_zero_gold_drops(self):
+        """~4x QPS step over a 1-replica fleet (min 1, max 3) with a
+        seeded whole-replica kill mid-spike: the autoscaler scales
+        up (boot-first), the latency SLO breaches under the spike
+        and RECOVERS within a bounded window, zero gold-tier
+        requests are dropped, and best-effort requests are shed
+        with a tier-priced Retry-After."""
+        fleet = ReplicaFleet(
+            lambda: {"default": EchoModel(delay=0.04)}, n=1,
+            server_kwargs=dict(wait_ms=1.0, max_batch_size=1,
+                               queue_limit=6)).start()
+        router = Router(fleet, probe_interval_s=0.1,
+                        probe_timeout_s=0.5, eject_consecutive=3,
+                        eject_cooldown_s=0.5, attempt_timeout_s=3.0,
+                        request_timeout_s=8.0, hedge_after_s=None,
+                        sample_rate=0.0).start()
+        slo = SLO(name="router_p_latency", objective=0.8,
+                  threshold_s=0.1, metric="router_latency_seconds",
+                  labels={"route": "/v1/predict"}, window_s=30.0,
+                  windows=[BurnWindow(short_s=1.5, long_s=4.0,
+                                      factor=1.5)])
+        slos = SLOMonitor(router.registry, [slo],
+                          min_eval_interval_s=0.2)
+        scaler = Autoscaler(
+            fleet, router, slos=slos, registry=router.registry,
+            min_replicas=1, max_replicas=3, tick_interval_s=0.25,
+            queue_high=3.0, queue_low=0.25, up_consecutive=2,
+            down_consecutive=10_000, up_cooldown_s=1.5,
+            down_cooldown_s=60.0, boot_retries=3).start()
+        # seeded SIGKILL of one replica mid-spike (the
+        # serving.replica site fires on the router's request
+        # ordinal: ~16 requests of low phase + ~3.5s into the spike)
+        chaos.install({"faults": [
+            {"site": "serving.replica", "kind": "kill", "at": [150],
+             "args": {"replica": 0}}]}, seed=99)
+        base = f"http://127.0.0.1:{router.port}"
+        mix = parse_tier_mix("gold=0.2,standard=0.5,best_effort=0.3")
+        body_fn = tiered_body_fn(
+            lambda i: {"model": "default",
+                       "inputs": [[float(i % 7), 1.0]]}, mix)
+        gen = LoadGen(base, body_fn=body_fn, concurrency=24,
+                      profile=parse_profile("step:8:48:2"),
+                      duration_s=14.0, timeout_s=6.0, max_retries=6,
+                      backlog_limit=512)
+        breach = {"t": None, "recovered_t": None}
+        # every replica EVER in the pool, by id: the killed one's
+        # shed counters must still count as evidence after the kill
+        # removes it from the snapshot
+        all_replicas = {}
+        t_start = time.monotonic()
+
+        def run_load():
+            breach["report"] = gen.run()
+
+        lt = threading.Thread(target=run_load, daemon=True)
+        lt.start()
+        try:
+            # watch the SLO from outside the control loop: record
+            # first breach and (after it) first recovery
+            while lt.is_alive():
+                for r in fleet.snapshot():
+                    all_replicas[r.id] = r
+                b = slos.any_breached()
+                now = time.monotonic() - t_start
+                if b and breach["t"] is None:
+                    breach["t"] = now
+                if not b and breach["t"] is not None \
+                        and breach["recovered_t"] is None:
+                    breach["recovered_t"] = now
+                time.sleep(0.1)
+            lt.join(timeout=30.0)
+            # the spike breached the SLO...
+            assert breach["t"] is not None, \
+                "the 4x step never breached the latency SLO"
+            # ...and it recovered within a bounded window of the
+            # breach (scale-up capacity landing; 25s bound covers
+            # boot + burn-window slide on a loaded 2-core host)
+            deadline = time.monotonic() + 25.0
+            while breach["recovered_t"] is None \
+                    and time.monotonic() < deadline:
+                if not slos.any_breached():
+                    breach["recovered_t"] = \
+                        time.monotonic() - t_start
+                time.sleep(0.2)
+            assert breach["recovered_t"] is not None, \
+                "SLO never recovered after the spike"
+            assert breach["recovered_t"] - breach["t"] < 25.0
+            # slo_breach gauge is back to 0
+            g = router.registry.get(
+                "slo_breach", labels={"slo": "router_p_latency"})
+            assert g is not None and g.value() == 0.0
+            # the autoscaler actually scaled up (and repaired the
+            # kill: the fleet ends bigger than it started)
+            ups = router.registry.get(
+                "autoscaler_scale_events_total",
+                labels={"direction": "up"}).value
+            assert ups >= 1
+            assert fleet.size() >= 2
+            # the seeded kill really fired
+            assert chaos.current().hits("serving.replica") >= 150
+            # zero gold-tier requests dropped, end to end
+            rep = breach["report"]
+            assert rep["tiers"]["gold"]["failed"] == 0, rep["tiers"]
+            assert rep["tiers"]["gold"]["ok"] \
+                == rep["tiers"]["gold"]["sent"]
+            # best-effort was degraded first: sheds landed on it
+            shed_be = sum(_sum_counter(
+                r.server.metrics.registry, "admission_shed_total",
+                tier="best_effort")
+                for r in all_replicas.values())
+            shed_be += _sum_counter(router.registry,
+                                    "admission_shed_total",
+                                    tier="best_effort")
+            assert shed_be > 0 \
+                or rep["tiers"]["best_effort"]["shed"] > 0
+            # and the clients saw those sheds (tier-priced
+            # Retry-After honored by the loadgen's backoff)
+            assert rep["tiers"]["best_effort"]["shed"] >= \
+                rep["tiers"]["gold"]["shed"]
+        finally:
+            chaos.uninstall()
+            scaler.stop(wait_retires=False)
+            router.stop()
+            fleet.stop(drain=False, timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+# ---------------------------------------------------------------------------
+
+class TestServeFleetAutoscaleCli:
+    def test_autoscale_flags_registered(self):
+        import subprocess
+        import sys
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu",
+             "serve-fleet", "--help"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0
+        for flag in ("--autoscale", "--autoscale-tick",
+                     "--queue-high", "--queue-low", "--slo"):
+            assert flag in proc.stdout
+
+    def test_bad_autoscaler_inputs_exit_before_boot(self):
+        import argparse
+        from deeplearning4j_tpu.cli import _cmd_serve_fleet
+
+        def args(**over):
+            base = dict(
+                autoscale="1:3", chaos=None, chaos_seed=None,
+                model=["missing.zip"], replicas=1, host="127.0.0.1",
+                port=0, max_batch_size=32, queue_limit=256,
+                wait_ms=2.0, slots=4, capacity=256,
+                probe_interval=1.0, hedge_after_ms=0.0,
+                trace_sample=0.0, mesh=None, autoscale_tick=1.0,
+                queue_high=8.0, queue_low=1.0, slo=None)
+            base.update(over)
+            return argparse.Namespace(**base)
+
+        # every malformed autoscaler input must exit BEFORE any
+        # replica boots (no fleet leaked behind a SystemExit): typo'd
+        # bounds, inverted bounds, zero min, inverted watermark band,
+        # unparseable SLO rules
+        for bad in (args(autoscale="nope"), args(autoscale="4:2"),
+                    args(autoscale="0:3"),
+                    args(queue_low=8.0, queue_high=8.0),
+                    args(slo='[{"objective": 2.0}]')):
+            with pytest.raises(SystemExit):
+                _cmd_serve_fleet(bad)
+
+
+# ---------------------------------------------------------------------------
+# loadgen profile / tier-mix units
+# ---------------------------------------------------------------------------
+
+class TestLoadgenProfiles:
+    def test_step_and_ramp_schedules(self):
+        p = parse_profile("step:10:40:5")
+        assert p(0.0, 20.0) == 10 and p(5.0, 20.0) == 40
+        p2 = parse_profile("step:10:40:5:10")
+        assert p2(12.0, 20.0) == 10
+        r = parse_profile("ramp:0:100")
+        assert r(10.0, 20.0) == pytest.approx(50.0)
+        assert parse_profile(None) is None
+        with pytest.raises(ValueError):
+            parse_profile("sawtooth:1:2")
+        with pytest.raises(ValueError):
+            parse_profile("step:1:2")
+
+    def test_zero_rate_profile_phase_idles_then_fires(self):
+        """An idle-then-spike schedule (step:0:...) must not divide
+        by zero or replay a backlog of never-scheduled arrivals —
+        the zero phase owes nothing, the spike starts on time."""
+        gen = LoadGen("http://127.0.0.1:1", concurrency=1,
+                      profile=parse_profile("step:0:20:0.3"),
+                      duration_s=0.7, timeout_s=0.2, max_retries=0)
+        rep = gen.run()
+        assert rep["mode"] == "open"
+        # ~0.4s at 20 q/s, nothing from the zero phase
+        assert 0 < rep["sent"] <= 12
+
+    def test_tier_mix_is_deterministic_and_normalised(self):
+        mix = parse_tier_mix("gold=1,standard=2,best_effort=1")
+        assert sum(mix.values()) == pytest.approx(1.0)
+        f = tiered_body_fn(lambda i: {"model": "m"}, mix)
+        first = [f(i)["tier"] for i in range(200)]
+        again = [f(i)["tier"] for i in range(200)]
+        assert first == again
+        counts = {t: first.count(t) for t in set(first)}
+        assert counts["standard"] == 100
+        with pytest.raises(ValueError):
+            parse_tier_mix("platinum=1")
+        assert parse_tier_mix(None) is None

@@ -426,7 +426,12 @@ class TestContinuousBatching:
         cb = ContinuousBatcher(net, slots=1, capacity=LM_CAP)
         cb.generate(np.array([1, 2]), 2)          # warm the compile
         long = cb.submit(np.array([1, 2]), LM_CAP - 2)
-        doomed = cb.submit(np.array([1, 2]), 4, timeout=0.02)
+        # already-lapsed deadline: on a fast host the warm LM can
+        # finish `long`'s whole decode inside any small positive
+        # timeout, racing the slot free against the expiry — the
+        # invariant under test (expired while queued => never
+        # served) must not depend on decode speed
+        doomed = cb.submit(np.array([1, 2]), 4, timeout=-0.001)
         with pytest.raises(DeadlineExceededError):
             cb.wait(doomed)
         assert len(cb.wait(long)) == LM_CAP - 2
@@ -507,7 +512,10 @@ class TestDeadlineNeverServedLate:
                                name="generate")
         cb.generate(np.array([1, 2]), 2)          # warm the compile
         long = cb.submit(np.array([1, 2]), LM_CAP - 2)
-        doomed = cb.submit(np.array([3, 4]), 4, timeout=0.02)
+        # lapsed-at-submit deadline (see
+        # test_deadline_expires_while_slots_busy: the expiry must
+        # not race the warm decode freeing the slot)
+        doomed = cb.submit(np.array([3, 4]), 4, timeout=-0.001)
         with pytest.raises(DeadlineExceededError):
             cb.wait(doomed)
         assert len(cb.wait(long)) == LM_CAP - 2

@@ -36,12 +36,23 @@ Usage (library)::
     from tools.loadgen import LoadGen
     report = LoadGen(url, concurrency=16, total=2000).run()
 
+Autoscaler-soak extensions: ``--profile step:LOW:HIGH:AT`` /
+``ramp:LOW:HIGH`` schedule the open-loop QPS over the run (the
+traffic spike the autoscaler must absorb), and ``--tier-mix
+gold=0.2,standard=0.5,best_effort=0.3`` stamps each request with a
+deterministic priority tier — the report then carries per-tier
+latency and outcome percentiles (sent/ok/failed/shed per tier), the
+evidence for "zero gold dropped, best-effort degraded first".
+
 CLI::
 
     python -m tools.loadgen --url http://127.0.0.1:8080 \
         --qps 200 --duration 30 --concurrency 32
     python -m tools.loadgen --url http://127.0.0.1:8080 \
         --mode generate --dup-ratio 0.5 --total 200 --n-tokens 16
+    python -m tools.loadgen --url http://127.0.0.1:8080 \
+        --profile step:20:80:5 --duration 20 \
+        --tier-mix gold=0.2,standard=0.5,best_effort=0.3
 """
 
 from __future__ import annotations
@@ -56,11 +67,108 @@ import urllib.error
 import urllib.request
 from typing import Callable, Dict, Optional
 
-__all__ = ["LoadGen", "generate_body_fn", "scrape_streaming_latency"]
+# the serving stack's tier literals, from their one authoritative
+# home (a stdlib-only leaf module — loadgen already depends on the
+# package for the registry histogram, so mirroring them here would
+# only add drift risk)
+from deeplearning4j_tpu.serving.tiers import TIERS as _TIERS
+
+__all__ = ["LoadGen", "generate_body_fn", "scrape_streaming_latency",
+           "parse_profile", "parse_tier_mix", "tiered_body_fn"]
 
 
 def _default_body(i: int) -> dict:
     return {"model": "default", "inputs": [[0.0, 1.0, 2.0, 3.0]]}
+
+
+def parse_profile(spec):
+    """Open-loop QPS schedule from a compact spec — the soak
+    driver's traffic shape:
+
+    - ``step:LOW:HIGH:AT`` (or ``...:AT:UNTIL``) — LOW q/s until
+      ``AT`` seconds into the run, then HIGH (until ``UNTIL``, then
+      back to LOW): the spike the autoscaler must absorb.
+    - ``ramp:LOW:HIGH`` — linear from LOW to HIGH over the run.
+
+    Returns ``qps_at(t_seconds, duration_s) -> float``; None for no
+    profile (constant ``--qps``)."""
+    if spec is None:
+        return None
+    parts = str(spec).split(":")
+    kind = parts[0]
+    try:
+        nums = [float(x) for x in parts[1:]]
+    except ValueError:
+        raise ValueError(f"bad profile numbers in {spec!r}") from None
+    if kind == "step":
+        if len(nums) not in (3, 4):
+            raise ValueError(
+                f"step profile wants step:LOW:HIGH:AT[:UNTIL], got "
+                f"{spec!r}")
+        low, high, at = nums[:3]
+        until = nums[3] if len(nums) == 4 else float("inf")
+
+        def qps_at(t, duration_s=None):
+            return high if at <= t < until else low
+    elif kind == "ramp":
+        if len(nums) != 2:
+            raise ValueError(
+                f"ramp profile wants ramp:LOW:HIGH, got {spec!r}")
+        low, high = nums
+
+        def qps_at(t, duration_s=None):
+            if not duration_s:
+                return high
+            frac = min(1.0, max(0.0, t / duration_s))
+            return low + (high - low) * frac
+    else:
+        raise ValueError(
+            f"unknown profile kind {kind!r}; known: step, ramp")
+    return qps_at
+
+
+def parse_tier_mix(spec):
+    """``gold=0.2,standard=0.5,best_effort=0.3`` -> dict (fractions
+    normalised to sum 1). None/empty -> None (untiered traffic)."""
+    if not spec:
+        return None
+    mix = {}
+    for part in str(spec).split(","):
+        name, _, frac = part.partition("=")
+        name = name.strip().replace("-", "_")
+        if name not in _TIERS:
+            raise ValueError(
+                f"unknown tier {name!r} in mix; known: {_TIERS}")
+        mix[name] = float(frac)
+    total = sum(mix.values())
+    if total <= 0:
+        raise ValueError(f"tier mix {spec!r} sums to zero")
+    return {t: v / total for t, v in mix.items()}
+
+
+def tiered_body_fn(base_fn, mix):
+    """Wrap a body factory to stamp a deterministic per-ordinal
+    ``tier`` drawn from ``mix`` (same spread idiom as the
+    duplicate-prompt mix: replayable, no rng)."""
+    tiers_sorted = [t for t in _TIERS if t in mix]
+    edges = []
+    acc = 0.0
+    for t in tiers_sorted:
+        acc += mix[t]
+        edges.append((acc * 100.0, t))
+
+    def body(i: int) -> dict:
+        b = dict(base_fn(i))
+        spread = (i * 37) % 100
+        for edge, t in edges:
+            if spread < edge:
+                b["tier"] = t
+                break
+        else:
+            b["tier"] = tiers_sorted[-1]
+        return b
+
+    return body
 
 
 def generate_body_fn(model: str = "default", prompt_len: int = 16,
@@ -166,6 +274,7 @@ class LoadGen:
                  max_retries: int = 2,
                  honor_retry_after: bool = True,
                  backlog_limit: Optional[int] = None,
+                 profile: Optional[Callable] = None,
                  registry=None):
         if duration_s is None and total is None:
             raise ValueError("give duration_s or total")
@@ -176,6 +285,7 @@ class LoadGen:
         self.body_fn = body_fn or _default_body
         self.concurrency = max(1, concurrency)
         self.qps = qps
+        self.profile = profile
         self.duration_s = duration_s
         self.total = total
         self.timeout_s = timeout_s
@@ -194,11 +304,35 @@ class LoadGen:
             "sent": 0, "ok": 0, "failed": 0, "retries": 0,
             "not_sent": 0, "retry_after_honored": 0}
         self._errors: Dict[str, int] = {}
+        # per-tier outcome + latency accounting (created lazily on
+        # the first tiered body; untiered runs pay nothing)
+        self._tier_counts: Dict[str, Dict[str, int]] = {}
+        self._tier_errors: Dict[str, Dict[str, int]] = {}
+        self._tier_latency: Dict[str, object] = {}
         self._stop = threading.Event()
+
+    def _tier_state(self, tier: str):
+        with self._lock:
+            if tier not in self._tier_counts:
+                self._tier_counts[tier] = {
+                    "sent": 0, "ok": 0, "failed": 0, "retries": 0,
+                    "shed": 0}
+                self._tier_errors[tier] = {}
+                self._tier_latency[tier] = self.registry.histogram(
+                    "loadgen_latency_seconds",
+                    help="client-observed request latency (seconds)",
+                    labels={"route": self.route, "tier": tier})
+            return (self._tier_counts[tier], self._tier_errors[tier],
+                    self._tier_latency[tier])
 
     # ---- one request, with backoff-aware retries ----
     def _once(self, i: int) -> None:
-        body = json.dumps(self.body_fn(i)).encode()
+        body_obj = self.body_fn(i)
+        tier = body_obj.get("tier")
+        tc = te = th = None
+        if tier is not None:
+            tc, te, th = self._tier_state(str(tier))
+        body = json.dumps(body_obj).encode()
         deadline = time.monotonic() + self.timeout_s
         attempts = 0
         with self._lock:
@@ -206,25 +340,50 @@ class LoadGen:
             # sent == ok + failed holds and a drop rate computed
             # from sent vs ok is honest under failover
             self._counts["sent"] += 1
+            if tc is not None:
+                tc["sent"] += 1
         t0 = time.perf_counter()
+
+        def record():
+            # the ONE terminal latency record (success, retries
+            # exhausted, deadline): whole-request wall time into the
+            # route histogram and, when tiered, the tier's
+            dt = time.perf_counter() - t0
+            self.latency.record(dt)
+            if th is not None:
+                th.record(dt)
+
         while True:
             attempts += 1
             status, retry_after = self._fire(body, deadline)
+            if status in (429, 503) and tc is not None:
+                with self._lock:
+                    # every shed response the tier absorbed, retried
+                    # or not — the "best-effort degraded first"
+                    # evidence
+                    tc["shed"] += 1
             if status == 200:
-                self.latency.record(time.perf_counter() - t0)
+                record()
                 with self._lock:
                     self._counts["ok"] += 1
+                    if tc is not None:
+                        tc["ok"] += 1
                 return
             retryable = status in ("neterr", 429, 503)
             with self._lock:
                 if attempts <= self.max_retries and retryable:
                     self._counts["retries"] += 1
+                    if tc is not None:
+                        tc["retries"] += 1
                 else:
                     self._counts["failed"] += 1
                     key = str(status)
                     self._errors[key] = self._errors.get(key, 0) + 1
+                    if tc is not None:
+                        tc["failed"] += 1
+                        te[key] = te.get(key, 0) + 1
             if attempts > self.max_retries or not retryable:
-                self.latency.record(time.perf_counter() - t0)
+                record()
                 return
             if retry_after and self.honor_retry_after:
                 wait = min(retry_after,
@@ -238,7 +397,10 @@ class LoadGen:
                     self._counts["failed"] += 1
                     self._errors["deadline"] = \
                         self._errors.get("deadline", 0) + 1
-                self.latency.record(time.perf_counter() - t0)
+                    if tc is not None:
+                        tc["failed"] += 1
+                        te["deadline"] = te.get("deadline", 0) + 1
+                record()
                 return
 
     def _fire(self, body: bytes, deadline: float):
@@ -301,7 +463,8 @@ class LoadGen:
                    for _ in range(self.concurrency)]
         for t in threads:
             t.start()
-        interval = 1.0 / float(self.qps)
+        interval = (1.0 / float(self.qps)
+                    if self.profile is None else None)
         t_start = time.monotonic()
         t_end = (t_start + self.duration_s
                  if self.duration_s is not None else None)
@@ -313,6 +476,22 @@ class LoadGen:
             now = time.monotonic()
             if t_end is not None and now >= t_end:
                 break
+            if self.profile is not None:
+                # time-varying schedule (step / ramp): re-read the
+                # target rate every pass so a QPS step lands at its
+                # scheduled second, not an arrival later
+                rate = float(self.profile(now - t_start,
+                                          self.duration_s))
+                if rate <= 0:
+                    # a zero-rate phase owes no arrivals: idle, and
+                    # re-anchor the schedule so the next nonzero
+                    # phase starts from NOW instead of replaying a
+                    # backlog of arrivals the schedule never asked
+                    # for
+                    next_t = now + 0.05
+                    time.sleep(0.05)
+                    continue
+                interval = 1.0 / rate
             if now < next_t:
                 time.sleep(min(next_t - now, 0.05))
                 continue
@@ -334,7 +513,7 @@ class LoadGen:
     # ---- entry ----
     def run(self) -> dict:
         t0 = time.monotonic()
-        if self.qps is None:
+        if self.qps is None and self.profile is None:
             self._closed_loop()
         else:
             self._open_loop()
@@ -345,7 +524,8 @@ class LoadGen:
         snap = self.latency.snapshot()
         report = {
             "route": self.route,
-            "mode": "closed" if self.qps is None else "open",
+            "mode": ("closed" if self.qps is None
+                     and self.profile is None else "open"),
             "target_qps": self.qps,
             "concurrency": self.concurrency,
             "wall_s": round(wall, 3),
@@ -360,6 +540,24 @@ class LoadGen:
             "errors": errors,
         }
         report.update(counts)
+        with self._lock:
+            tier_counts = {t: dict(c)
+                           for t, c in self._tier_counts.items()}
+            tier_errors = {t: dict(e)
+                           for t, e in self._tier_errors.items()}
+            tier_hists = dict(self._tier_latency)
+        if tier_counts:
+            tiers_rep = {}
+            for t, c in tier_counts.items():
+                h = tier_hists[t]
+                entry = dict(c)
+                entry["errors"] = tier_errors.get(t, {})
+                entry["latency_ms"] = {
+                    "p50": round(h.quantile(0.50) * 1e3, 3),
+                    "p95": round(h.quantile(0.95) * 1e3, 3),
+                    "p99": round(h.quantile(0.99) * 1e3, 3)}
+                tiers_rep[t] = entry
+            report["tiers"] = tiers_rep
         return report
 
     def stop(self) -> None:
@@ -404,6 +602,18 @@ def main(argv=None):
     p.add_argument("--qps", type=float, default=None,
                    help="open-loop target rate; omit for closed "
                         "loop")
+    p.add_argument("--profile", default=None, metavar="SPEC",
+                   help="open-loop QPS schedule: 'step:LOW:HIGH:AT"
+                        "[:UNTIL]' (LOW q/s, stepping to HIGH at AT "
+                        "seconds) or 'ramp:LOW:HIGH' (linear over "
+                        "the run) — the autoscaler soak's traffic "
+                        "shape; overrides --qps")
+    p.add_argument("--tier-mix", default=None, metavar="MIX",
+                   help="per-tier request mix, e.g. "
+                        "'gold=0.2,standard=0.5,best_effort=0.3': "
+                        "each request carries a deterministically "
+                        "assigned tier and the report adds per-tier "
+                        "latency/outcome percentiles")
     p.add_argument("--duration", type=float, default=None,
                    help="seconds to run")
     p.add_argument("--total", type=int, default=None,
@@ -430,8 +640,19 @@ def main(argv=None):
                     "inputs": [[float((i + j) % 7)
                                 for j in range(feat)]]}
 
+    try:
+        mix = parse_tier_mix(args.tier_mix)
+        profile = parse_profile(args.profile)
+    except ValueError as e:
+        p.error(str(e))
+    if mix is not None:
+        body = tiered_body_fn(body, mix)
+    if profile is not None and args.duration is None:
+        p.error("--profile needs --duration (the schedule is "
+                "expressed in run seconds)")
     gen = LoadGen(args.url, route=route, body_fn=body,
                   concurrency=args.concurrency, qps=args.qps,
+                  profile=profile,
                   duration_s=args.duration, total=args.total,
                   timeout_s=args.timeout, max_retries=args.retries)
     try:
