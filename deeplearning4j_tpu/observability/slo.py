@@ -215,6 +215,32 @@ class SLOMonitor:
                     labels={"slo": slo.name, "window": wname})
         return slo
 
+    def remove(self, name: str) -> None:
+        """Drop one SLO and unregister its verdict gauges — the
+        pairing half of ``add`` (GL009): a monitor whose SLO set is
+        reconfigured (or a discarded monitor, via :meth:`close`)
+        must not leave breach/burn gauges whose callbacks pin it on
+        the shared registry."""
+        with self._lock:
+            slo = self._slos.pop(name, None)
+            self._state.pop(name, None)
+        if slo is None:
+            return
+        self.registry.unregister("slo_breach",
+                                 labels={"slo": slo.name})
+        for w in slo.windows:
+            for wname in (f"{int(w.long_s)}s", f"{int(w.short_s)}s"):
+                self.registry.unregister(
+                    "slo_burn_rate",
+                    labels={"slo": slo.name, "window": wname})
+
+    def close(self) -> None:
+        """Unregister every SLO's gauges (see :meth:`remove`)."""
+        with self._lock:
+            names = list(self._slos)
+        for name in names:
+            self.remove(name)
+
     # ------------------------------------------------------------------
     # readings
     # ------------------------------------------------------------------

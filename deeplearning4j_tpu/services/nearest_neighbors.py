@@ -39,6 +39,7 @@ class NearestNeighborsServer:
         self.tree = VPTree(self.points, distance=distance)
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "NearestNeighborsServer":
         tree = self.tree
@@ -94,15 +95,22 @@ class NearestNeighborsServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                           Handler)
         self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
+        # stored, not anonymous (GL007): stop() joins it
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
         logger.info("NearestNeighborsServer on port %d", self.port)
         return self
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd = None
+        httpd, self._httpd = self._httpd, None
+        thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            # release the bound port now, not at GC (GL009)
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
 
 
 class NearestNeighborsClient:

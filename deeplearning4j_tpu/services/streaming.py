@@ -60,6 +60,10 @@ class SocketBrokerServer:
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
         self._srv.listen(16)
+        # deadline discipline (GL008): accept() and per-connection
+        # recv() run on heartbeats, so close() reclaims every broker
+        # thread instead of leaving them wedged in blocking reads
+        self._srv.settimeout(0.5)
         self.host, self.port = self._srv.getsockname()
         self._subs: Dict[str, List] = {}
         self._lock = threading.Lock()
@@ -69,22 +73,33 @@ class SocketBrokerServer:
         self._thread.start()
 
     @staticmethod
-    def _recv_frame(conn) -> Optional[bytes]:
+    def _recv_frame(conn, stop=None) -> Optional[bytes]:
+        """One length-prefixed frame, or None at EOF (or once
+        ``stop`` is set, for connections carrying a recv timeout —
+        the heartbeat that lets a closing server reclaim its
+        connection threads)."""
+        import socket
         import struct
-        head = b""
-        while len(head) < 4:
-            chunk = conn.recv(4 - len(head))
-            if not chunk:
-                return None
-            head += chunk
+
+        def read_n(n: int) -> Optional[bytes]:
+            buf = b""
+            while len(buf) < n:
+                try:
+                    chunk = conn.recv(n - len(buf))
+                except socket.timeout:
+                    if stop is not None and stop.is_set():
+                        return None
+                    continue
+                if not chunk:
+                    return None
+                buf += chunk
+            return buf
+
+        head = read_n(4)
+        if head is None:
+            return None
         (n,) = struct.unpack(">I", head)
-        body = b""
-        while len(body) < n:
-            chunk = conn.recv(n - len(body))
-            if not chunk:
-                return None
-            body += chunk
-        return body
+        return read_n(n)
 
     @staticmethod
     def _send_frame(conn, payload: bytes):
@@ -92,22 +107,33 @@ class SocketBrokerServer:
         conn.sendall(struct.pack(">I", len(payload)) + payload)
 
     def _accept_loop(self):
+        import socket
         while not self._stop.is_set():
             try:
                 conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue              # heartbeat: re-check stop
             except OSError:
                 return
+            conn.settimeout(0.5)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
     def _serve_conn(self, conn):
         import base64
-        while True:
-            frame = self._recv_frame(conn)
+        while not self._stop.is_set():
+            frame = self._recv_frame(conn, stop=self._stop)
             if frame is None:
                 return
             msg = json.loads(frame.decode())
             if msg["op"] == "subscribe":
+                # the connection is WRITE-only from here on: drop the
+                # read heartbeat so a merely-slow subscriber (its TCP
+                # send buffer filling mid-burst) blocks the publisher
+                # briefly instead of raising socket.timeout — an
+                # OSError the publish fan-out would misread as a dead
+                # peer and silently unsubscribe
+                conn.settimeout(None)
                 # each subscriber gets a dedicated send lock:
                 # concurrent publishers would otherwise interleave
                 # partial sendall() writes and corrupt the framing
@@ -146,6 +172,10 @@ class SocketBrokerServer:
             self._srv.close()
         except OSError:
             pass
+        # the accept loop exits within one heartbeat; joining it
+        # (GL007) makes close() mean "the broker is gone", not
+        # "the broker will eventually be gone"
+        self._thread.join(timeout=5.0)
 
 
 class SocketBroker:

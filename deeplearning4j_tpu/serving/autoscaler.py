@@ -385,20 +385,27 @@ class Autoscaler:
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
-    def _loop(self) -> None:
-        while not self._stop_evt.wait(self.tick_interval_s):
+    def _loop(self, stop: threading.Event) -> None:
+        while not stop.wait(self.tick_interval_s):
             try:
                 self.tick()
             except Exception:
                 logger.exception("autoscaler tick failed")
 
     def start(self) -> "Autoscaler":
+        # each loop generation gets its OWN stop event (GL007 — the
+        # AlertManager revive bug class): clear()ing a shared event
+        # can race the previous, still-stopping generation — the
+        # clear lands before that loop observes the set, reviving it
+        # with no handle on it
+        stop = threading.Event()
         with self._lock:
             if self._thread is not None:
                 return self
-            self._stop_evt.clear()
+            self._stop_evt = stop
             self._thread = threading.Thread(
-                target=self._loop, daemon=True, name="autoscaler")
+                target=self._loop, args=(stop,), daemon=True,
+                name="autoscaler")
             self._thread.start()
         logger.info(
             "autoscaler: control loop up (bounds %d..%d, tick "
@@ -410,8 +417,11 @@ class Autoscaler:
         return self
 
     def stop(self, wait_retires: bool = True) -> None:
-        self._stop_evt.set()
+        # set under the SAME lock as the thread swap: set outside, a
+        # racing start() could swap in a fresh event between our set
+        # and our swap
         with self._lock:
+            self._stop_evt.set()
             t, self._thread = self._thread, None
             retires = list(self._retire_threads)
         if t is not None:

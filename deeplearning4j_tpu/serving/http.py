@@ -286,7 +286,8 @@ class ModelServer:
                 return b
             if self._draining.is_set():
                 raise ServerClosedError(
-                    "server is draining; not creating new backends")
+                    "server is draining; not creating new backends",
+                    retry_after_s=self.drain_retry_after_s)
             create_lock = self._create_locks.setdefault(
                 (kind,) + key, threading.Lock())
         with create_lock:
@@ -301,7 +302,8 @@ class ModelServer:
                     return b
         b.shutdown(drain=False)
         raise ServerClosedError(
-            "server is draining; not creating new backends")
+            "server is draining; not creating new backends",
+            retry_after_s=self.drain_retry_after_s)
 
     def resolve_serving_model(self, name: str,
                               version: Optional[int] = None):
@@ -823,6 +825,7 @@ class ModelServer:
         # blocking shutdown() itself runs outside the lock
         with self._lock:
             httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
         if httpd is not None:
             httpd.shutdown()
             try:
@@ -832,4 +835,9 @@ class ModelServer:
                 httpd.server_close()
             except OSError:
                 pass
+        if thread is not None:
+            # join the listener thread (GL007): stop() returning
+            # while serve_forever still winds down would let a
+            # restart race the old generation for the port
+            thread.join(timeout=5.0)
         return ok

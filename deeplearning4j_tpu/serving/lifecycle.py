@@ -65,12 +65,17 @@ class TierQueue:
       queued raises ``queue.Full`` and is shed itself.
     """
 
-    def __init__(self, maxsize: int):
+    def __init__(self, maxsize: int,
+                 stop: Optional[threading.Event] = None):
         self.maxsize = int(maxsize)
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._q = {t: collections.deque() for t in tiers.TIERS}
         self._picker = tiers.WeightedFairPicker()
+        # the owning backend's stop event: a timeout-less get() is
+        # bounded by it (raises queue.Empty once the backend stops
+        # and the queue is drained) instead of blocking forever
+        self._stop = stop
 
     def qsize(self) -> int:
         with self._lock:
@@ -111,10 +116,19 @@ class TierQueue:
         return self._q[self._picker.pick(avail)].popleft()
 
     def get(self, timeout: Optional[float] = None) -> "BaseRequest":
+        """Weighted-fair dequeue. With no ``timeout`` the wait is a
+        1s heartbeat bounded by the owner's stop event (GL008): once
+        the backend stops and nothing is queued, raises
+        ``queue.Empty`` — nothing will ever arrive — instead of
+        blocking its caller forever."""
         with self._not_empty:
             if timeout is None:
                 while not any(self._q.values()):
-                    self._not_empty.wait()
+                    self._not_empty.wait(1.0)
+                    if self._stop is not None \
+                            and self._stop.is_set() \
+                            and not any(self._q.values()):
+                        raise queue.Empty
             else:
                 deadline = time.monotonic() + max(0.0, timeout)
                 while not any(self._q.values()):
@@ -341,10 +355,10 @@ class ServingBackend:
                      "eviction or refusal), by priority tier",
                 labels={"endpoint": name, "tier": t})
             for t in tiers.TIERS}
-        self._queue = TierQueue(queue_limit)
         self._draining = threading.Event()
         self._drained = threading.Event()
         self._stop = threading.Event()
+        self._queue = TierQueue(queue_limit, stop=self._stop)
         self._worker = threading.Thread(target=self._run,
                                         name=f"{kind}-{name}",
                                         daemon=True)
@@ -436,9 +450,13 @@ class ServingBackend:
         admission is a half-open circuit probe (the subclass stamps
         it on the request)."""
         if self._draining.is_set() or self._stop.is_set():
+            # a draining backend is being replaced: "come back soon"
+            # is measured in seconds, and the hint must ride the
+            # error (GL010) — the HTTP layer forwards it as
+            # Retry-After on the 503
             raise ServerClosedError(
                 f"{self.name!r} is draining; not admitting new "
-                "requests")
+                "requests", retry_after_s=2.0)
         kind = self.breaker.try_admit()
         if not kind:
             raise CircuitOpenError(
@@ -488,7 +506,7 @@ class ServingBackend:
         if self._stop.is_set():
             self._deliver_failure(r, ServerClosedError(
                 f"{self.name!r} shut down while the request was "
-                "being admitted"))
+                "being admitted", retry_after_s=2.0))
         return r
 
     @staticmethod
@@ -516,7 +534,20 @@ class ServingBackend:
         self._deliver_failure(r, DeadlineExceededError(detail))
 
     def wait(self, r: BaseRequest):
-        r.event.wait()
+        # heartbeat wait, never an unbounded block (GL008). The
+        # worker's exit sweep normally fails every leftover, but a
+        # request leaked PAST the sweep (a subclass holding work in a
+        # structure _abort_inflight misses, an admission racing the
+        # final sweep) used to strand its caller on event.wait()
+        # forever; now, once the worker thread is gone — its finally
+        # block, sweep included, has run — an still-incomplete
+        # request is failed here with the same typed shutdown error.
+        while not r.event.wait(1.0):
+            if self._stop.is_set() and not self._worker.is_alive():
+                self._deliver_failure(r, ServerClosedError(
+                    f"{self.name!r} shut down without serving the "
+                    "request", retry_after_s=2.0))
+                break
         if r.error is not None:
             if r.ctx is not None:
                 # always-sample on failure: the error (deadline

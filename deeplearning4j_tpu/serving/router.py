@@ -1183,12 +1183,17 @@ class Router:
         self._stop_evt.set()
         with self._lock:
             httpd, self._httpd = self._httpd, None
-            prober = self._prober
+            prober, self._prober = self._prober, None
+            http_thread, self._http_thread = self._http_thread, None
         if prober is not None:
             prober.join(timeout=5.0)
         if httpd is not None:
             httpd.shutdown()
             httpd.server_close()
+        if http_thread is not None:
+            # join the listener thread too (GL007): stop() must not
+            # return while serve_forever is still winding down
+            http_thread.join(timeout=5.0)
 
 
 # ---------------------------------------------------------------------------

@@ -150,7 +150,10 @@ class _CheckpointWriter:
         while True:
             with self._cond:
                 while self._pending is None and not self._closed:
-                    self._cond.wait()
+                    # heartbeat, not an unbounded block (GL008): the
+                    # predicate loop re-checks closed/pending either
+                    # way, and the writer thread stays interruptible
+                    self._cond.wait(1.0)
                 if self._pending is None:
                     return                      # closed and drained
                 job, self._pending = self._pending, None

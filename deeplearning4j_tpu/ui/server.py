@@ -462,7 +462,16 @@ class UIServer:
         logger.info("UI server on http://localhost:%d/", self.port)
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd = None
+        httpd, self._httpd = self._httpd, None
+        thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            # release the bound port now, not at GC (GL009): a UI
+            # restarted on the same port would hit EADDRINUSE
+            httpd.server_close()
+        if thread is not None:
+            # join the listener thread (GL007): stop() returning
+            # while serve_forever still winds down leaks a
+            # generation per attach/detach cycle
+            thread.join(timeout=5.0)
         UIServer._instance = None

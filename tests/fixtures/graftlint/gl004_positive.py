@@ -40,6 +40,7 @@ class DoubleStart:
 
     def start(self):
         if self._thread is None:       # GL004: unlocked check ...
+            # graftlint: disable=GL007
             self._thread = threading.Thread(target=lambda: None)
             self._thread.start()       # ... then act
         return self

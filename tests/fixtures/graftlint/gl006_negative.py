@@ -20,6 +20,8 @@ class Backend:
         self._gauges = {}
         for phase in ("queue_wait", "device_step"):
             # loop-stored creation at init: the cache-fill pattern
+            # (gauge pairing is GL009's concern, not this fixture's)
+            # graftlint: disable=GL009
             self._gauges[phase] = registry.gauge(
                 "phase_depth", labels={"phase": phase})
 

@@ -1,0 +1,64 @@
+"""GL007 negatives: the swap-idiom join, a fresh per-generation
+stop event (the AlertManager idiom), an __init__-created thread
+joined at close, and a local thread joined in place."""
+
+import threading
+
+
+class CleanServer:
+    """Restartable: fresh Event per generation + swap-idiom join."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def start(self):
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(0.1):
+                pass
+
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop = stop
+            self._thread = threading.Thread(target=loop,
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+class OneShotWorker:
+    """Single generation, created at __init__, joined at close."""
+
+    def __init__(self):
+        self._closed = threading.Event()
+        self._worker = threading.Thread(target=self._run,
+                                        daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        self._closed.wait(1.0)
+
+    def close(self):
+        self._closed.set()
+        self._worker.join(timeout=5.0)
+
+
+def scatter_join(fns):
+    """Local threads joined in place never involve the class rule."""
+    threads = [threading.Thread(target=fn, daemon=True)
+               for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()

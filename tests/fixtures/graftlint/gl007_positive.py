@@ -1,0 +1,37 @@
+"""GL007 positives: an unjoined server thread, a stop event shared
+(and clear()ed) across thread generations, and an anonymous
+serve_forever thread nothing can ever join."""
+
+import threading
+
+
+class LeakyServer:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        # GL007: clearing the SHARED event races the previous
+        # (stopping) generation
+        self._stop.clear()
+        # GL007: started but never joined by any method
+        self._thread = threading.Thread(target=self._run,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(0.1):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread = None
+
+
+class AnonListener:
+    def start(self, httpd):
+        # GL007: anonymous serve_forever thread — unjoinable forever
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        return self

@@ -1,0 +1,23 @@
+"""GL007 suppression forms."""
+
+import threading
+
+
+class AcknowledgedLeak:
+    """A deliberately fire-and-forget thread, with the waiver."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        # process-lifetime loop; owner documents the no-join choice
+        # graftlint: disable=GL007
+        self._thread = threading.Thread(target=self._run,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(0.1):
+            pass

@@ -1,0 +1,59 @@
+"""GL008 negatives: the same blocking shapes carrying deadlines or
+heartbeats — and the acceptance twin: the same bare ``queue.get()``
+in a function NO handler or worker loop reaches stays silent."""
+
+import http.client
+import queue
+import threading
+
+
+class MiniServer:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._evt = threading.Event()
+        self._lock = threading.Lock()
+
+    def do_POST(self):
+        return self._handle_predict({})
+
+    def _handle_predict(self, body):
+        return self._dequeue_one()
+
+    def _dequeue_one(self):
+        # bounded: raises queue.Empty at the deadline
+        return self._q.get(timeout=0.5)
+
+    def _handle_proxy(self, body):
+        conn = http.client.HTTPConnection("127.0.0.1", 9999,
+                                          timeout=2.0)
+        conn.request("GET", "/")
+        return conn.getresponse()
+
+    def _handle_locked(self, body):
+        if not self._lock.acquire(timeout=1.0):
+            raise TimeoutError("lock contended")
+        try:
+            return body
+        finally:
+            self._lock.release()
+
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+        t.join(timeout=1.0)
+
+    def _run(self):
+        # heartbeat wait: bounded, re-checks its predicate
+        while not self._evt.wait(1.0):
+            pass
+
+
+def offline_drain(q):
+    # the SAME bare get() as the positive fixture, but no handler or
+    # worker loop reaches this function — not flagged
+    return q.get()
+
+
+def offline_collect(evt):
+    evt.wait()
+    return True

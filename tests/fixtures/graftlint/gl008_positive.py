@@ -1,0 +1,51 @@
+"""GL008 positives: timeout-less blocking primitives on paths an
+HTTP handler or a worker loop actually executes — including the
+acceptance case, a bare ``queue.get()`` TWO calls deep from the
+handler, resolved interprocedurally."""
+
+import http.client
+import queue
+import threading
+
+
+class MiniServer:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._evt = threading.Event()
+        self._lock = threading.Lock()
+
+    # ---- HTTP side ----
+    def do_POST(self):
+        return self._handle_predict({})
+
+    def _handle_predict(self, body):
+        return self._dequeue_one()
+
+    def _dequeue_one(self):
+        # GL008: blocking get, two calls deep from do_POST
+        return self._q.get()
+
+    def _handle_proxy(self, body):
+        # GL008: no timeout= — getresponse() can block forever
+        conn = http.client.HTTPConnection("127.0.0.1", 9999)
+        conn.request("GET", "/")
+        return conn.getresponse()
+
+    def _handle_locked(self, body):
+        # GL008: unbounded lock acquire on the request path
+        self._lock.acquire()
+        try:
+            return body
+        finally:
+            self._lock.release()
+
+    # ---- worker side ----
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+        t.join(timeout=1.0)
+
+    def _run(self):
+        while True:
+            # GL008: unbounded event wait in a worker loop
+            self._evt.wait()

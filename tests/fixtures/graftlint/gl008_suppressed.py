@@ -1,0 +1,13 @@
+"""GL008 suppression form."""
+
+import queue
+
+
+class MiniServer:
+    def __init__(self):
+        self._q = queue.Queue()
+
+    def do_POST(self):
+        # sentinel-terminated queue; producer is in-process and
+        # crash-contained — owner documents the unbounded get
+        return self._q.get()  # graftlint: disable=GL008

@@ -1,0 +1,52 @@
+"""GL009 negatives: paired register/unregister (f-string skeletons
+and labeled constants), a server_close()d listener, `with` /
+finally-close acquisition idioms, and ownership handoff."""
+
+import socket
+from http.server import ThreadingHTTPServer
+
+
+class PairedBackend:
+    def __init__(self, registry, name):
+        self.registry = registry
+        self.name = name
+        registry.register_gauge(f"{name}_queue_depth", lambda: 0)
+        registry.gauge("circuit_state", labels={"endpoint": name})
+        # constant name, no labels: process-lifetime singleton
+        registry.gauge("process_uptime_seconds", help="uptime")
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), None)
+
+    def stop(self):
+        self.registry.unregister_gauge(
+            f"{self.name}_queue_depth")
+        self.registry.unregister(
+            "circuit_state", labels={"endpoint": self.name})
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def read_all(path):
+    with open(path) as f:
+        return f.read()
+
+
+def read_checked(path):
+    f = open(path)
+    try:
+        return f.read()
+    finally:
+        f.close()
+
+
+def open_for_caller(path):
+    f = open(path)
+    return f                 # ownership transfers to the caller
+
+
+def send_probe(host, port, payload):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.connect((host, port))
+        s.sendall(payload)
+    finally:
+        s.close()

@@ -1,0 +1,31 @@
+"""GL009 positives: a per-instance gauge with no unregister, a
+listener with no server_close, an inline open() chain, and a leaked
+local socket."""
+
+import socket
+from http.server import ThreadingHTTPServer
+
+
+class LeakyBackend:
+    def __init__(self, registry, name):
+        self.registry = registry
+        self.name = name
+        # GL009: dynamic (per-instance) gauge, never unregistered
+        registry.register_gauge(f"{name}_queue_depth", lambda: 0)
+        # GL009: listener stored, shutdown() but never server_close()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), None)
+
+    def stop(self):
+        self._httpd.shutdown()
+
+
+def read_all(path):
+    # GL009: inline open — the fd closes only at GC
+    return open(path).read()
+
+
+def probe_port(host, port):
+    # GL009: local socket never closed on any path
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.connect((host, port))
+    return True

@@ -1,0 +1,38 @@
+"""GL010 negatives: priced backpressure on the admission path, the
+documented status mapping, client errors without hints, and a
+backpressure error on a path no handler reaches."""
+
+from deeplearning4j_tpu.serving.errors import (QueueFullError,
+                                               ServerClosedError)
+
+
+class MiniFront:
+    def do_POST(self):
+        try:
+            return self._handle_work({})
+        except QueueFullError as e:
+            self._send(429, {"error": str(e)})
+
+    def _handle_work(self, body):
+        self._admit(body)
+        return body
+
+    def _admit(self, body):
+        if body.get("overload"):
+            # priced: the Retry-After hint rides the error
+            raise QueueFullError("queue is at its limit",
+                                 retry_after_s=0.5)
+        if "model" not in body:
+            # client errors (400-class) carry no backoff hint
+            raise ValueError("body needs a model")
+
+    def _send(self, code, obj):
+        self.last = (code, obj)
+
+
+def boot_guard(flag):
+    # ServerClosedError on a path NO handler reaches (a boot/CLI
+    # guard): the hint requirement does not apply
+    if not flag:
+        raise ServerClosedError("not serving yet")
+    return True

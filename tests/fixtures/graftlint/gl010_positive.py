@@ -1,0 +1,26 @@
+"""GL010 positives: a backpressure error constructed WITHOUT
+retry_after_s on a handler-reachable admission path, and a handler
+remapping a documented error class to the wrong status."""
+
+from deeplearning4j_tpu.serving.errors import QueueFullError
+
+
+class MiniFront:
+    def do_POST(self):
+        try:
+            return self._handle_work({})
+        except QueueFullError as e:
+            # GL010: README maps QueueFullError to 429, not 500
+            self._send(500, {"error": str(e)})
+
+    def _handle_work(self, body):
+        self._admit()
+        return body
+
+    def _admit(self):
+        # GL010: 429-class error with no retry_after_s, reachable
+        # from do_POST via _handle_work
+        raise QueueFullError("queue is at its limit")
+
+    def _send(self, code, obj):
+        self.last = (code, obj)

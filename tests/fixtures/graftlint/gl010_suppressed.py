@@ -1,0 +1,12 @@
+"""GL010 suppression form."""
+
+from deeplearning4j_tpu.serving.errors import ServerClosedError
+
+
+class MiniFront:
+    def do_POST(self):
+        return self._handle_work({})
+
+    def _handle_work(self, body):
+        # test-only front: callers never retry, hint waived
+        raise ServerClosedError("gone")  # graftlint: disable=GL010

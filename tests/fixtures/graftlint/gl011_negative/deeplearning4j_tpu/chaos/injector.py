@@ -1,0 +1,28 @@
+"""Fixture injector: every declared site is threaded and
+documented; every site-specific kind is interpreted somewhere."""
+
+from typing import Dict
+
+SITES: Dict[str, str] = {
+    "fixture.step": "one fixture device step",
+    "fixture.io": "one fixture file write",
+}
+
+_GENERIC_KINDS = frozenset({"crash", "hang", "slow", "error",
+                            "enospc"})
+SITE_KINDS: Dict[str, frozenset] = {
+    "fixture.step": _GENERIC_KINDS | {"poison"},
+    "fixture.io": _GENERIC_KINDS | {"truncate", "corrupt"},
+}
+
+
+def hit(site):
+    return None
+
+
+def step_fault(site):
+    return None
+
+
+def file_fault(site, path):
+    return None

@@ -1,0 +1,16 @@
+"""Fixture consumer: threads both sites and interprets the
+site-specific kind."""
+
+from deeplearning4j_tpu.chaos import injector as chaos
+
+
+def device_step(batch):
+    fault = chaos.step_fault("fixture.step")
+    if fault is not None and fault.kind == "poison":
+        return None
+    return batch
+
+
+def write_blob(path, data):
+    chaos.file_fault("fixture.io", path)
+    return data

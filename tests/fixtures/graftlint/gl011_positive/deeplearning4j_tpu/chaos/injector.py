@@ -1,0 +1,27 @@
+"""Fixture injector: declares one consistent site, one site nobody
+threads, one site the README forgot, and one kind no call site
+interprets."""
+
+from typing import Dict
+
+SITES: Dict[str, str] = {
+    "fixture.used": "a threaded, documented site",
+    "fixture.unthreaded": "declared but never threaded",
+    "fixture.undocumented": "threaded but missing from the README",
+}
+
+_GENERIC_KINDS = frozenset({"crash", "hang", "slow", "error",
+                            "enospc"})
+SITE_KINDS: Dict[str, frozenset] = {
+    "fixture.used": _GENERIC_KINDS | {"poison"},
+    "fixture.unthreaded": _GENERIC_KINDS,
+    "fixture.undocumented": _GENERIC_KINDS | {"ghost"},
+}
+
+
+def hit(site):
+    return None
+
+
+def step_fault(site):
+    return None

@@ -1,0 +1,15 @@
+"""Fixture consumer: threads the good site, interprets 'poison',
+and also hits one site the injector never declared."""
+
+from deeplearning4j_tpu.chaos import injector as chaos
+
+
+def device_step(batch):
+    fault = chaos.step_fault("fixture.used")
+    if fault is not None and fault.kind == "poison":
+        return None
+    # GL011: 'fixture.typo' is not declared in SITES — this literal
+    # silently never fires
+    chaos.hit("fixture.typo")
+    chaos.hit("fixture.undocumented")
+    return batch

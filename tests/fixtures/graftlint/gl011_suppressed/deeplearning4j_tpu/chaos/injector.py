@@ -1,0 +1,21 @@
+"""Fixture injector for the suppression form."""
+
+from typing import Dict
+
+SITES: Dict[str, str] = {
+    "fixture.step": "one fixture device step",
+}
+
+_GENERIC_KINDS = frozenset({"crash", "hang", "slow", "error",
+                            "enospc"})
+SITE_KINDS: Dict[str, frozenset] = {
+    "fixture.step": _GENERIC_KINDS,
+}
+
+
+def hit(site):
+    return None
+
+
+def step_fault(site):
+    return None

@@ -1,0 +1,10 @@
+"""Fixture consumer: one undeclared site, explicitly waived."""
+
+from deeplearning4j_tpu.chaos import injector as chaos
+
+
+def device_step(batch):
+    chaos.step_fault("fixture.step")
+    # staged rollout: the site lands before its declaration
+    chaos.hit("fixture.next")  # graftlint: disable=GL011
+    return batch

@@ -146,6 +146,25 @@ class TestTierQueue:
         assert counts[tiers.STANDARD] == 30
         assert counts[tiers.BEST_EFFORT] == 10
 
+    def test_timeoutless_get_bounded_by_stop_event(self):
+        # GL008 regression (ISSUE 14): a timeout-less get() on a
+        # stopped, drained queue raises Empty within a heartbeat
+        # instead of blocking its caller forever
+        stop = threading.Event()
+        q = TierQueue(0, stop=stop)
+        stop.set()
+        t0 = time.monotonic()
+        with pytest.raises(_queue.Empty):
+            q.get()
+        assert time.monotonic() - t0 < 10.0
+        # queued work still drains after stop — Empty only when dry
+        stop2 = threading.Event()
+        q2 = TierQueue(0, stop=stop2)
+        r = _Req(tiers.STANDARD)
+        q2.put_nowait(r)
+        stop2.set()
+        assert q2.get() is r
+
     def test_single_tier_is_fifo(self):
         q = TierQueue(0)
         reqs = [_Req(tiers.STANDARD) for _ in range(5)]
@@ -1117,3 +1136,27 @@ class TestLoadgenProfiles:
         with pytest.raises(ValueError):
             parse_tier_mix("platinum=1")
         assert parse_tier_mix(None) is None
+
+
+class TestStopEventGenerations:
+    """GL007 regression (ISSUE 14): each control-loop generation owns
+    a FRESH stop event — restarting the autoscaler must neither
+    revive the previous generation nor un-stop it."""
+
+    def test_fresh_stop_event_per_generation(self):
+        clk = FakeClock()
+        fleet, router, _, sc = _make(clk, tick_interval_s=0.01)
+        sc.start()
+        first_evt = sc._stop_evt
+        sc.stop()
+        assert first_evt.is_set()      # generation 1 keeps its handle
+        sc.start()
+        try:
+            assert sc._stop_evt is not first_evt
+            assert not sc._stop_evt.is_set()
+            # the restart never cleared generation 1's event behind
+            # its back (the AlertManager revive bug class)
+            assert first_evt.is_set()
+        finally:
+            sc.stop()
+        assert sc._stop_evt.is_set()

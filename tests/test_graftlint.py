@@ -363,6 +363,274 @@ class TestGL006MetricsHygiene:
         assert r.new == [], "\n".join(f.render() for f in r.new)
 
 
+class TestGL007ThreadLifecycle:
+    def test_positive(self):
+        r = lint_fixture("gl007_positive.py", ["GL007"])
+        msgs = [f.message for f in r.new]
+        assert len(r.new) == 3, "\n".join(msgs)
+        assert any("never joined" in m for m in msgs)
+        assert any("FRESH Event per generation" in m for m in msgs)
+        assert any("started anonymously" in m for m in msgs)
+        syms = {f.symbol for f in r.new}
+        assert "LeakyServer._thread" in syms
+        assert "LeakyServer._stop" in syms
+
+    def test_negative(self):
+        # swap-idiom join, per-generation events, __init__+close
+        # threads and locally-joined threads all stay clean
+        assert lint_fixture("gl007_negative.py", ["GL007"]).new == []
+
+    def test_suppressed(self):
+        r = lint_fixture("gl007_suppressed.py", ["GL007"])
+        assert r.new == [] and r.suppressed == 1
+
+    def test_unrelated_local_start_does_not_mark_attr(self,
+                                                      tmp_path):
+        # a never-started attribute thread next to an unrelated
+        # (started AND joined) local thread must not be flagged:
+        # start credit flows only through the local actually stored
+        # to the attribute
+        pkg = tmp_path / "deeplearning4j_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(textwrap.dedent("""\
+            import threading
+
+
+            class C:
+                def go(self):
+                    self._maybe = threading.Thread(target=self.run)
+                    t = threading.Thread(target=self.run)
+                    t.start()
+                    t.join(timeout=1.0)
+
+                def run(self):
+                    pass
+            """))
+        r = run_lint(str(tmp_path), rules=["GL007"])
+        assert r.new == [], [f.render() for f in r.new]
+
+    def test_local_alias_start_and_join_credit_their_attr(
+            self, tmp_path):
+        pkg = tmp_path / "deeplearning4j_tpu"
+        pkg.mkdir()
+        # started via the local alias, never joined -> one finding
+        (pkg / "m.py").write_text(textwrap.dedent("""\
+            import threading
+
+
+            class Leaky:
+                def start(self):
+                    t = threading.Thread(target=self.run)
+                    t.start()
+                    self._w = t
+
+                def run(self):
+                    pass
+
+
+            class Clean:
+                def start(self):
+                    t = threading.Thread(target=self.run)
+                    t.start()
+                    self._w = t
+                    t.join(timeout=1.0)
+
+                def run(self):
+                    pass
+            """))
+        r = run_lint(str(tmp_path), rules=["GL007"])
+        assert len(r.new) == 1, [f.render() for f in r.new]
+        assert r.new[0].symbol == "Leaky._w"
+
+
+class TestGL008DeadlineDiscipline:
+    def test_positive(self):
+        r = lint_fixture("gl008_positive.py", ["GL008"])
+        msgs = [f.message for f in r.new]
+        assert len(r.new) == 4, "\n".join(msgs)
+        for needle in ("queue.get", "HTTPConnection",
+                       "lock.acquire", "`wait`"):
+            assert any(needle in m for m in msgs), needle
+        # both root kinds are named
+        assert any("HTTP handler" in m for m in msgs)
+        assert any("worker loop" in m for m in msgs)
+
+    def test_interprocedural_two_calls_deep(self):
+        # THE acceptance fixture: the bare queue.get() sits two
+        # resolved calls below do_POST and is still flagged there
+        r = lint_fixture("gl008_positive.py", ["GL008"])
+        deep = [f for f in r.new if f.symbol == "MiniServer._dequeue_one"]
+        assert len(deep) == 1
+        assert "reachable from HTTP handler" in deep[0].message
+
+    def test_negative_includes_unreachable_twin(self):
+        # same blocking shapes with deadlines — and the IDENTICAL
+        # bare get() in offline_drain(), which no handler or worker
+        # reaches, stays silent
+        assert lint_fixture("gl008_negative.py", ["GL008"]).new == []
+
+    def test_suppressed(self):
+        r = lint_fixture("gl008_suppressed.py", ["GL008"])
+        assert r.new == [] and r.suppressed == 1
+
+
+class TestInterproceduralResolution:
+    """Call-graph engine behaviors the serving-stack findings relied
+    on: annotated-return typing and base-to-subclass dispatch."""
+
+    def test_annotated_return_types_local(self, tmp_path):
+        pkg = tmp_path / "deeplearning4j_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(textwrap.dedent("""\
+            import queue
+            from typing import Tuple
+
+
+            class Backend:
+                def __init__(self):
+                    self._q = queue.Queue()
+
+                def pull(self):
+                    return self._q.get()
+
+
+            class Front:
+                def backend_for(self, name) -> Tuple[Backend, int]:
+                    return Backend(), 1
+
+                def _handle_predict(self, body):
+                    b, v = self.backend_for(body["model"])
+                    return b.pull()
+            """))
+        r = run_lint(str(tmp_path), rules=["GL008"])
+        assert len(r.new) == 1, [f.render() for f in r.new]
+        assert r.new[0].symbol == "Backend.pull"
+
+    def test_base_run_reaches_subclass_loop(self, tmp_path):
+        # Thread(target=self._run) on the BASE class must make the
+        # SUBCLASS _loop override a worker root too
+        pkg = tmp_path / "deeplearning4j_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(textwrap.dedent("""\
+            import queue
+            import threading
+
+
+            class Base:
+                def __init__(self):
+                    self._q = queue.Queue()
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    self._loop()
+
+                def _loop(self):
+                    raise NotImplementedError
+
+                def close(self):
+                    self._t.join(timeout=1.0)
+
+
+            class Impl(Base):
+                def _loop(self):
+                    while True:
+                        self._q.get()
+            """))
+        r = run_lint(str(tmp_path), rules=["GL008"])
+        assert len(r.new) == 1, [f.render() for f in r.new]
+        assert r.new[0].symbol == "Impl._loop"
+
+    def test_no_handler_no_worker_no_finding(self, tmp_path):
+        pkg = tmp_path / "deeplearning4j_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(textwrap.dedent("""\
+            import queue
+
+
+            class Offline:
+                def __init__(self):
+                    self._q = queue.Queue()
+
+                def drain(self):
+                    return self._q.get()
+            """))
+        assert run_lint(str(tmp_path), rules=["GL008"]).new == []
+
+
+class TestGL009ResourcePairing:
+    def test_positive(self):
+        r = lint_fixture("gl009_positive.py", ["GL009"])
+        msgs = [f.message for f in r.new]
+        assert len(r.new) == 4, "\n".join(msgs)
+        assert any("never unregisters" in m for m in msgs)
+        assert any("server_close" in m for m in msgs)
+        assert any("acquired inline" in m for m in msgs)
+        assert any("never close()d" in m for m in msgs)
+
+    def test_negative(self):
+        # paired skeletons, labeled-constant pairs, server_close,
+        # with/finally idioms and ownership handoff all stay clean
+        assert lint_fixture("gl009_negative.py", ["GL009"]).new == []
+
+    def test_suppressed(self):
+        r = lint_fixture("gl009_suppressed.py", ["GL009"])
+        assert r.new == [] and r.suppressed == 1
+
+
+class TestGL010ErrorContract:
+    def test_positive(self):
+        r = lint_fixture("gl010_positive.py", ["GL010"])
+        msgs = [f.message for f in r.new]
+        assert len(r.new) == 2, "\n".join(msgs)
+        assert any("without retry_after_s" in m for m in msgs)
+        assert any("README failure matrix" in m for m in msgs)
+        # the matrix half names both the wrong and the documented code
+        matrix = next(m for m in msgs if "failure matrix" in m)
+        assert "500" in matrix and "429" in matrix
+
+    def test_negative(self):
+        # priced admission errors, the documented mapping, plain
+        # client errors, and non-handler-reachable raises stay clean
+        assert lint_fixture("gl010_negative.py", ["GL010"]).new == []
+
+    def test_suppressed(self):
+        r = lint_fixture("gl010_suppressed.py", ["GL010"])
+        assert r.new == [] and r.suppressed == 1
+
+
+class TestGL011ChaosCoverage:
+    def _lint(self, name):
+        return run_lint(os.path.join(FIXTURES, name),
+                        paths=["deeplearning4j_tpu"],
+                        rules=["GL011"])
+
+    def test_positive_three_way(self):
+        r = self._lint("gl011_positive")
+        msgs = [f.message for f in r.new]
+        assert len(r.new) == 4, "\n".join(msgs)
+        assert any("never threaded" in m for m in msgs)
+        assert any("SITES does not declare" in m for m in msgs)
+        assert any("missing from the README" in m for m in msgs)
+        assert any("silent no-op" in m for m in msgs)
+        syms = {f.symbol for f in r.new}
+        assert {"fixture.unthreaded", "fixture.typo",
+                "fixture.undocumented",
+                "fixture.undocumented/ghost"} == syms
+
+    def test_negative(self):
+        assert self._lint("gl011_negative").new == []
+
+    def test_suppressed(self):
+        r = self._lint("gl011_suppressed")
+        assert r.new == [] and r.suppressed == 1
+
+    def test_real_tree_is_covered(self):
+        # the committed injector/call-sites/README agree three-way
+        r = run_lint(REPO, rules=["GL011"])
+        assert r.new == [], "\n".join(f.render() for f in r.new)
+
+
 class TestCheckPerfClaimsShim:
     """The deprecated tools/check_perf_claims.py keeps its API."""
 
@@ -613,14 +881,170 @@ class TestChangedOnly:
         assert "inconsistent lock order" in r.new[0].message
 
 
+class TestChangedOnlyDeleted:
+    """ISSUE 14 satellite: --changed-only must skip files the change
+    deleted or renamed away instead of erroring, while triggered
+    repo-scope rules still see the full tree."""
+
+    def _git(self, cwd, *args):
+        return subprocess.run(["git", *args], cwd=cwd,
+                              capture_output=True, text=True)
+
+    def _seed(self, tmp_path, files):
+        repo = tmp_path / "r"
+        pkg = repo / "deeplearning4j_tpu"
+        pkg.mkdir(parents=True)
+        for name, content in files.items():
+            (pkg / name).write_text(content)
+        self._git(repo, "init", "-q")
+        self._git(repo, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "add", "-A")
+        self._git(repo, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "commit", "-qm", "seed")
+        return repo, pkg
+
+    CLEAN = ("import jax\n\n"
+             "@jax.jit\n"
+             "def ok(x):\n"
+             "    return x\n")
+    DIRTY = ("import time\n"
+             "import jax\n\n"
+             "@jax.jit\n"
+             "def bad(x):\n"
+             "    time.time()\n"
+             "    return x\n")
+
+    def test_deleted_file_is_skipped(self, tmp_path):
+        repo, pkg = self._seed(tmp_path, {"a.py": self.CLEAN,
+                                          "b.py": self.CLEAN})
+        (pkg / "b.py").unlink()
+        r = run_lint(str(repo), rules=["GL001"], changed_only=True)
+        assert r.new == [] and r.files_checked == 0
+        # an EXPLICIT path naming the deleted file (what a hook
+        # feeding `git diff --name-only` through xargs produces)
+        # must be skipped too, not fatal
+        r = run_lint(str(repo), paths=["deeplearning4j_tpu/b.py"],
+                     rules=["GL001"], changed_only=True)
+        assert r.new == [] and r.files_checked == 0
+        # ...while outside --changed-only a missing path stays an
+        # invocation error
+        with pytest.raises(ValueError):
+            run_lint(str(repo), paths=["deeplearning4j_tpu/b.py"],
+                     rules=["GL001"])
+
+    def test_rename_lints_new_path_only(self, tmp_path):
+        repo, pkg = self._seed(tmp_path, {"a.py": self.DIRTY})
+        self._git(repo, "mv", "deeplearning4j_tpu/a.py",
+                  "deeplearning4j_tpu/b.py")
+        r = run_lint(str(repo), rules=["GL001"], changed_only=True)
+        assert r.files_checked == 1
+        assert len(r.new) == 1
+        assert r.new[0].path.endswith("b.py")
+
+    def test_repo_rules_still_fed_full_tree_after_delete(self,
+                                                         tmp_path):
+        # deleting one file must not stop a triggered repo-scope
+        # rule from seeing the UNCHANGED half of the tree
+        repo, pkg = self._seed(tmp_path, {
+            "a.py": ("import threading\n\n"
+                     "L1 = threading.Lock()\n"
+                     "L2 = threading.Lock()\n\n"
+                     "def fwd():\n"
+                     "    with L1:\n"
+                     "        with L2:\n"
+                     "            pass\n"),
+            "gone.py": self.CLEAN})
+        (pkg / "gone.py").unlink()
+        (pkg / "b.py").write_text(
+            "from deeplearning4j_tpu.a import L1, L2\n\n"
+            "def rev():\n"
+            "    with L2:\n"
+            "        with L1:\n"
+            "            pass\n")
+        r = run_lint(str(repo), rules=["GL004"], changed_only=True)
+        assert len(r.new) == 1, [f.render() for f in r.new]
+        assert r.new[0].path.endswith("b.py")
+
+
+class TestJobsAndCache:
+    """ISSUE 14 satellite: --jobs N parallel per-file analysis and
+    the content-hash result cache agree with the serial path."""
+
+    def test_jobs_matches_serial(self):
+        kw = dict(paths=[FIXTURES], rules=["GL001", "GL007"])
+        serial = run_lint(REPO, **kw)
+        par = run_lint(REPO, jobs=2, **kw)
+        assert ([f.key for f in par.new]
+                == [f.key for f in serial.new])
+        assert par.suppressed == serial.suppressed
+        assert par.files_checked == serial.files_checked
+
+    def test_cache_roundtrip_and_invalidation(self, tmp_path):
+        repo = tmp_path / "r"
+        pkg = repo / "deeplearning4j_tpu"
+        pkg.mkdir(parents=True)
+        (pkg / "m.py").write_text(TestChangedOnlyDeleted.DIRTY)
+        cache = str(repo / "cache.json")
+        r1 = run_lint(str(repo), rules=["GL001"], cache_path=cache)
+        assert (r1.cache_hits, r1.cache_misses) == (0, 1)
+        assert len(r1.new) == 1
+        r2 = run_lint(str(repo), rules=["GL001"], cache_path=cache)
+        assert (r2.cache_hits, r2.cache_misses) == (1, 0)
+        assert [f.key for f in r2.new] == [f.key for f in r1.new]
+        # a content edit invalidates exactly that file
+        (pkg / "m.py").write_text(TestChangedOnlyDeleted.CLEAN)
+        r3 = run_lint(str(repo), rules=["GL001"], cache_path=cache)
+        assert (r3.cache_hits, r3.cache_misses) == (0, 1)
+        assert r3.new == []
+
+    def test_cache_entry_scoped_to_rules(self, tmp_path):
+        # an entry written for GL001 must not satisfy a GL001+GL007
+        # request (different file-rule set)
+        repo = tmp_path / "r"
+        pkg = repo / "deeplearning4j_tpu"
+        pkg.mkdir(parents=True)
+        (pkg / "m.py").write_text(TestChangedOnlyDeleted.CLEAN)
+        cache = str(repo / "cache.json")
+        run_lint(str(repo), rules=["GL001"], cache_path=cache)
+        r = run_lint(str(repo), rules=["GL001", "GL007"],
+                     cache_path=cache)
+        assert r.cache_misses == 1
+
+    def test_stats_reports_wall_time(self):
+        p = run_cli("--stats", "--no-cache")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "wall_s" in p.stdout
+        assert "rule wall time" in p.stdout
+
+
+class TestPrePushHook:
+    """ISSUE 14 satellite: the pre-push gate ships, is executable,
+    and runs the changed-only lint with exit-code gating."""
+
+    HOOK = os.path.join(REPO, "tools", "hooks", "pre-push")
+
+    def test_hook_exists_and_is_executable(self):
+        assert os.path.isfile(self.HOOK)
+        assert os.access(self.HOOK, os.X_OK)
+
+    def test_hook_invokes_changed_only_lint(self):
+        text = open(self.HOOK).read()
+        # the hook must cover BOTH lint scopes, not just the default
+        # package path — a rule edit under tools/ gates the push too
+        assert ("python -m tools.graftlint deeplearning4j_tpu/ "
+                "tools/ --changed-only") in text
+        assert "exit" in text          # exit-code gating
+        assert "--no-verify" in text   # documents the escape hatch
+
+
 # ---------------------------------------------------------------------------
 # the rules stay registered + documented
 # ---------------------------------------------------------------------------
 
 class TestRegistry:
-    def test_all_six_rules_present(self):
-        assert sorted(ALL_RULES) == ["GL001", "GL002", "GL003",
-                                     "GL004", "GL005", "GL006"]
+    def test_all_eleven_rules_present(self):
+        assert sorted(ALL_RULES) == [f"GL{i:03d}"
+                                     for i in range(1, 12)]
         for cls in ALL_RULES.values():
             assert cls.title and cls.rationale
             assert cls.scope in ("file", "repo")
@@ -630,3 +1054,9 @@ class TestRegistry:
         for rid in ALL_RULES:
             assert rid in text, f"{rid} missing from README"
         assert "graftlint: disable=" in text
+        # the pre-push hook install one-liner ships in the README
+        assert "tools/hooks/pre-push" in text
+
+    def test_pytest_ini_marker_covers_all_rules(self):
+        text = open(os.path.join(REPO, "pytest.ini")).read()
+        assert "GL001-GL011" in text

@@ -869,3 +869,41 @@ class TestServeCLI:
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert r.returncode == 0, r.stderr.decode()
         assert b"--queue-limit" in r.stdout
+
+
+class TestWaitLeakGuard:
+    """GL008 regression (ISSUE 14): a request the shutdown sweep
+    never saw must not strand its caller in wait() forever — once
+    the worker thread is gone, wait()'s heartbeat delivers the typed
+    shutdown error itself."""
+
+    def test_request_leaked_past_sweep_fails_typed(self):
+        s = BatchScheduler(EchoModel(), max_batch_size=4,
+                           queue_limit=8)
+        s.shutdown(drain=False)      # worker exits; sweep has run
+        from deeplearning4j_tpu.serving.lifecycle import BaseRequest
+        r = BaseRequest(deadline=None)    # leaked: no sweep saw it
+        t0 = time.monotonic()
+        with pytest.raises(ServerClosedError) as ei:
+            s.wait(r)
+        # one heartbeat (~1s), not forever — and the 503 is priced
+        assert time.monotonic() - t0 < 10.0
+        assert ei.value.retry_after_s is not None
+
+    def test_normal_completion_still_instant(self):
+        s = BatchScheduler(EchoModel(), max_batch_size=4,
+                           queue_limit=8, wait_ms=1.0)
+        out = s.predict(np.ones((1, 3), np.float32))
+        np.testing.assert_array_equal(
+            out, 2.0 * np.ones((1, 3), np.float32))
+        s.shutdown()
+
+    def test_draining_503_carries_retry_hint(self):
+        # GL010 regression: the admission-path ServerClosedError
+        # ships a priced Retry-After hint
+        s = BatchScheduler(EchoModel(), max_batch_size=4,
+                           queue_limit=8)
+        s.shutdown(drain=False)
+        with pytest.raises(ServerClosedError) as ei:
+            s.submit(np.ones((1, 3), np.float32))
+        assert ei.value.retry_after_s is not None

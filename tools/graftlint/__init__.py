@@ -1,6 +1,6 @@
 """graftlint — repo-specific static analysis for the jax_graft tree.
 
-Five AST-level checkers enforce the invariants the threaded, jitted
+Eleven checkers enforce the invariants the threaded, jitted
 production substrate depends on, BEFORE execution (the runtime
 watchdogs in ``observability/`` catch the same bug classes only after
 they cost a compile or a deadlock):
@@ -16,13 +16,34 @@ GL004     lock-discipline: consistent acquisition order and
           its lock in thread-spawning classes
 GL005     literal-drift: doc perf claims / metric names /
           chaos sites must match code and bench artifacts
+GL006     metrics-hygiene: no per-request identity in metric
+          labels; instruments created once, not in hot loops
+GL007     thread-lifecycle: server threads joinable and
+          joined; one fresh stop event per generation
+GL008     deadline-discipline: no timeout-less blocking call
+          reachable from an HTTP handler or worker loop
+GL009     resource-pairing: per-instance gauges unregistered,
+          listeners server_close()d, fds released on all exits
+GL010     serving-error-contract: 429/503 errors carry
+          retry_after_s on admission paths; handler status
+          codes match the README failure matrix
+GL011     chaos-site-coverage: SITES/SITE_KINDS vs threaded
+          call-site literals vs the README table, three-way
 ========  ==================================================
+
+GL001-GL006 are per-file AST walks; GL007-GL011 (ISSUE 14) run over
+the project-wide call graph in ``callgraph.py`` — per-function
+summaries resolved through ``self``-dispatch, inferred attribute and
+local types, annotated returns, and thread-target/callback
+references.
 
 Run ``python -m tools.graftlint [paths]``; suppress one finding with
 ``# graftlint: disable=GL001`` (same line or the line above), a whole
 file with ``# graftlint: disable-file=GL001``. Pre-existing findings
 live in ``tools/graftlint/baseline.json`` (the ratchet): they do not
-fail the run, but any NEW finding does.
+fail the run, but any NEW finding does. ``--jobs N`` parallelizes
+the per-file pass; the content-hash cache (``.graftlint_cache.json``)
+keeps warm full-tree runs fast.
 """
 
 from tools.graftlint.core import (Baseline, Finding, LintReport,

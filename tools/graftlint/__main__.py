@@ -59,7 +59,18 @@ def main(argv=None) -> int:
                          "(current vs baseline allowance)")
     ap.add_argument("--changed-only", action="store_true",
                     help="lint only files changed vs git HEAD "
-                         "(plus untracked)")
+                         "(plus untracked); deleted/renamed paths "
+                         "are skipped, and triggered repo-scope "
+                         "rules still analyze the full tree")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="run the per-file pass on N worker "
+                         "processes (default 1)")
+    ap.add_argument("--cache", default=None, metavar="PATH",
+                    help="on-disk result cache for the per-file "
+                         "pass, keyed by content hash (default: "
+                         ".graftlint_cache.json at the repo root)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the on-disk cache")
     args = ap.parse_args(argv)
 
     repo = os.path.abspath(args.repo or os.path.dirname(
@@ -78,10 +89,17 @@ def main(argv=None) -> int:
                   f"{baseline_path}: {e}", file=sys.stderr)
             return 2
 
+    cache_path = None
+    if not args.no_cache:
+        cache_path = args.cache or os.path.join(
+            repo, ".graftlint_cache.json")
+
     try:
         report = run_lint(repo, paths=args.paths, rules=rules,
                           baseline=baseline,
-                          changed_only=args.changed_only)
+                          changed_only=args.changed_only,
+                          jobs=max(1, args.jobs),
+                          cache_path=cache_path)
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,141 @@
+"""On-disk result cache for the per-file (file-scope) lint pass.
+
+Keyed by content: a file's cache key is the sha256 of its
+repo-relative path plus its bytes, so any edit — or a rename —
+invalidates exactly that file. The whole cache is additionally
+guarded by a **toolchain fingerprint** (sha256 over the sources of
+``tools/graftlint`` itself): editing any rule, the engine, or this
+module discards every entry, so a rule fix can never be masked by
+stale results.
+
+Only file-scope rule findings are cached. Repo-scope rules (the
+lock graph, the call-graph passes, doc lints) are cross-file by
+nature and always re-run — they are also the reason a warm cache
+still parses: the cache removes rule *execution* per unchanged
+file, which is where the time goes as the rule set grows.
+
+The store is one JSON file (default ``.graftlint_cache.json`` at
+the repo root, written atomically via rename); delete it at will.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+from tools.graftlint.core import Finding
+
+_CACHE_VERSION = 1
+
+
+def toolchain_fingerprint() -> str:
+    """sha256 over the graftlint sources themselves."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__")
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fname)
+            h.update(os.path.relpath(full, root).encode())
+            with open(full, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def file_key(relpath: str, source: str) -> str:
+    h = hashlib.sha256()
+    h.update(relpath.encode())
+    h.update(b"\0")
+    h.update(source.encode("utf-8", "replace"))
+    return h.hexdigest()
+
+
+class LintCache:
+    # superseded file versions leave dead entries behind (a new
+    # content hash per edit); cap the store and evict least-recently
+    # used at save so the JSON file stays bounded over long histories
+    MAX_ENTRIES = 8192
+
+    def __init__(self, path: str):
+        self.path = path
+        self.fingerprint = toolchain_fingerprint()
+        self._entries: Dict[str, dict] = {}
+        self._dirty = False
+        self._clock = 0
+        self.hits = 0
+        self.misses = 0
+        self._load()
+        self._clock = max((e.get("t", 0)
+                           for e in self._entries.values()),
+                          default=0)
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        if data.get("version") != _CACHE_VERSION or \
+                data.get("fingerprint") != self.fingerprint:
+            return          # toolchain changed: start empty
+        entries = data.get("entries")
+        if isinstance(entries, dict):
+            self._entries = entries
+
+    def lookup(self, key: str, rule_ids: Sequence[str]
+               ) -> Optional[List[Finding]]:
+        """The cached findings, when the entry covers every
+        requested rule; None on any miss."""
+        e = self._entries.get(key)
+        if e is None or not set(rule_ids) <= set(e.get("rules", [])):
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._clock += 1
+        if e.get("t") != self._clock:
+            e["t"] = self._clock
+            self._dirty = True
+        wanted = set(rule_ids) | {"GL000"}
+        return [Finding(rule=f["rule"], path=f["path"],
+                        line=int(f["line"]), message=f["message"],
+                        symbol=f.get("symbol", ""))
+                for f in e.get("findings", [])
+                if f["rule"] in wanted]
+
+    def store(self, key: str, rule_ids: Sequence[str],
+              findings: Sequence[Finding]) -> None:
+        self._clock += 1
+        self._entries[key] = {
+            "rules": sorted(rule_ids),
+            "t": self._clock,
+            "findings": [{"rule": f.rule, "path": f.path,
+                          "line": f.line, "message": f.message,
+                          "symbol": f.symbol} for f in findings]}
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        entries = self._entries
+        if len(entries) > self.MAX_ENTRIES:
+            keep = sorted(entries, key=lambda k: entries[k].get(
+                "t", 0), reverse=True)[: self.MAX_ENTRIES]
+            entries = {k: entries[k] for k in keep}
+        data = {"version": _CACHE_VERSION,
+                "fingerprint": self.fingerprint,
+                "entries": entries}
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        try:
+            fd, tmp = tempfile.mkstemp(dir=d,
+                                       prefix=".graftlint_cache.")
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(data, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass            # a cache that can't write is just cold

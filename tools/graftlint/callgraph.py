@@ -1,0 +1,615 @@
+"""Project-wide call graph with per-function summaries — the
+interprocedural engine under GL007-GL011 (ISSUE 14).
+
+The jitscope module answers "which bodies are traced" lexically and
+per-module; this module answers "who calls whom" across the whole
+analyzed set, precisely enough to walk a request path from an HTTP
+handler into a backend three modules away:
+
+- every function/method gets a :class:`FunctionInfo` keyed by a
+  dotted qname (``pkg.mod.Class.method``, nested scopes included);
+- classes get a :class:`ClassInfo` with their base classes resolved
+  through import aliases, an MRO limited to the analyzed set, and
+  **attribute types** inferred from ``self.x = SomeClass(...)``
+  assignments anywhere in the class;
+- call edges resolve bare names, ``self.method()`` (through the MRO
+  *and* down to subclass overrides — ``self._loop()`` in a base
+  worker reaches every subclass loop), ``self.attr.method()`` and
+  ``local.method()`` through the inferred types, dotted module calls
+  through import aliases, and **tuple-unpacked return annotations**
+  (``sched, v = self.scheduler_for(...)`` types ``sched`` from the
+  ``-> Tuple[BatchScheduler, int]`` annotation);
+- a resolvable function passed as a *bare argument* (``Thread(
+  target=self._run)``, ``self._serve_request(server._handle_predict)``,
+  ``fn=self.queue_depth``) becomes a **ref edge**: the referencing
+  function is treated as a caller, which is exactly how thread
+  targets and handler callbacks flow;
+- per-function :class:`BlockingSite` summaries record the blocking
+  primitives GL008 cares about (timeout-less ``queue.get`` /
+  ``Event.wait`` / ``Condition.wait`` / ``lock.acquire`` / socket
+  ``accept``/``recv`` / ``HTTPConnection`` without a timeout), and
+  per-function raise/construct sites of the typed serving errors
+  feed GL010.
+
+Resolution stays purely lexical (no imports executed). Unresolvable
+receivers produce *no* edge — the rules built on top are precise
+along resolved paths and silent elsewhere, the polarity a CI gate
+needs.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint import jitscope
+from tools.graftlint.core import ParsedModule, RepoContext
+
+FunctionNode = jitscope.FunctionNode
+
+# blocking primitives whose zero-timeout forms GL008 flags
+_HTTP_CONN = {"http.client.HTTPConnection", "HTTPConnection",
+              "http.client.HTTPSConnection", "HTTPSConnection"}
+_SERVING_ERRORS_MODULE = "deeplearning4j_tpu.serving.errors"
+
+
+def _module_name(relpath: str) -> str:
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+@dataclasses.dataclass
+class BlockingSite:
+    line: int
+    primitive: str          # "queue.get", "Event/Condition.wait", ...
+    detail: str             # the receiver text, for the message
+
+
+@dataclasses.dataclass
+class ErrorSite:
+    line: int
+    error: str              # class name, e.g. "ServerClosedError"
+    raised: bool            # raise X(...) vs bare construction
+    has_retry_after: bool
+
+
+class FunctionInfo:
+    def __init__(self, qname: str, node: ast.AST,
+                 module: ParsedModule,
+                 class_qname: Optional[str]):
+        self.qname = qname
+        self.node = node
+        self.module = module
+        self.class_qname = class_qname
+        self.edges: Set[str] = set()          # callee qnames
+        self.blocking: List[BlockingSite] = []
+        self.errors: List[ErrorSite] = []
+
+    @property
+    def short(self) -> str:
+        """``Class.method`` / ``func`` — the readable identity."""
+        mod = _module_name(self.module.relpath)
+        s = self.qname[len(mod) + 1:] if self.qname.startswith(
+            mod + ".") else self.qname
+        return s
+
+    @property
+    def name(self) -> str:
+        return self.qname.rsplit(".", 1)[-1]
+
+
+class ClassInfo:
+    def __init__(self, qname: str, node: ast.ClassDef,
+                 module: ParsedModule):
+        self.qname = qname
+        self.node = node
+        self.module = module
+        self.base_names: List[str] = []       # canonical, unresolved
+        self.bases: List["ClassInfo"] = []    # resolved, in-set
+        self.subclasses: List["ClassInfo"] = []
+        self.methods: Dict[str, FunctionInfo] = {}
+        self.attr_types: Dict[str, str] = {}  # self.x -> class qname
+        self.calls_settimeout = False
+
+    def mro(self) -> List["ClassInfo"]:
+        out, seen, queue_ = [], set(), [self]
+        while queue_:
+            c = queue_.pop(0)
+            if c.qname in seen:
+                continue
+            seen.add(c.qname)
+            out.append(c)
+            queue_.extend(c.bases)
+        return out
+
+    def find_method(self, name: str) -> Optional[FunctionInfo]:
+        for c in self.mro():
+            if name in c.methods:
+                return c.methods[name]
+        return None
+
+    def all_subclasses(self) -> List["ClassInfo"]:
+        out, queue_ = [], list(self.subclasses)
+        while queue_:
+            c = queue_.pop(0)
+            out.append(c)
+            queue_.extend(c.subclasses)
+        return out
+
+    def attr_type(self, attr: str) -> Optional[str]:
+        for c in self.mro():
+            if attr in c.attr_types:
+                return c.attr_types[attr]
+        return None
+
+
+def _own_statements(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested
+    def/lambda/class bodies (those are separate graph nodes)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FunctionNode + (ast.Lambda,
+                                            ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CallGraph:
+    """Build once per :class:`RepoContext`; shared by GL008/GL010
+    (and anything else that needs reachability)."""
+
+    def __init__(self, ctx: RepoContext):
+        self.ctx = ctx
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        # per-module: local name -> class qname (defined or imported)
+        self._mod_classnames: Dict[str, Dict[str, str]] = {}
+        self._mod_settimeout: Dict[str, bool] = {}
+        self._index()
+        self._resolve_bases()
+        self._infer_attr_types()
+        self._build_edges()
+
+    # ------------------------------------------------------------ index
+    def _qualpath(self, module: ParsedModule, node: ast.AST) -> str:
+        info = module.jit_info
+        parts = []
+        cur = node
+        while cur is not None and not isinstance(cur, ast.Module):
+            if isinstance(cur, FunctionNode + (ast.ClassDef,)):
+                parts.append(cur.name)
+            cur = info.parents.get(cur)
+        return ".".join(reversed(parts))
+
+    def _index(self) -> None:
+        for module in self.ctx.modules:
+            modname = _module_name(module.relpath)
+            info = module.jit_info
+            names: Dict[str, str] = {}
+            settimeout = False
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.ClassDef):
+                    q = f"{modname}.{self._qualpath(module, node)}"
+                    self.classes[q] = ClassInfo(q, node, module)
+                    if isinstance(info.parents.get(node), ast.Module):
+                        names[node.name] = q
+                elif isinstance(node, FunctionNode):
+                    q = f"{modname}.{self._qualpath(module, node)}"
+                    parent = info.parents.get(node)
+                    cls_q = None
+                    if isinstance(parent, ast.ClassDef):
+                        cls_q = f"{modname}." + self._qualpath(
+                            module, parent)
+                    self.functions[q] = FunctionInfo(
+                        q, node, module, cls_q)
+                elif isinstance(node, ast.Attribute) and \
+                        node.attr == "settimeout":
+                    settimeout = True
+            # imported classes resolve through the alias map lazily;
+            # record module-level class names now
+            self._mod_classnames[modname] = names
+            self._mod_settimeout[modname] = settimeout
+        for fn in self.functions.values():
+            if fn.class_qname and fn.class_qname in self.classes:
+                self.classes[fn.class_qname].methods.setdefault(
+                    fn.name, fn)
+
+    def _canon(self, module: ParsedModule, node: ast.AST) -> str:
+        return module.jit_info.canon(node)
+
+    def _class_by_canonical(self, modname: str,
+                            canon: str) -> Optional[ClassInfo]:
+        """A canonical dotted name -> in-set class: exact qname, a
+        module-local name, or (for ``import x as y`` prefixes) the
+        longest matching class qname."""
+        if not canon:
+            return None
+        if canon in self.classes:
+            return self.classes[canon]
+        local = self._mod_classnames.get(modname, {})
+        if canon in local:
+            return self.classes.get(local[canon])
+        return None
+
+    def _resolve_bases(self) -> None:
+        for cls in self.classes.values():
+            modname = _module_name(cls.module.relpath)
+            for base in cls.node.bases:
+                canon = self._canon(cls.module, base)
+                cls.base_names.append(canon)
+                b = self._class_by_canonical(modname, canon)
+                if b is not None:
+                    cls.bases.append(b)
+                    b.subclasses.append(cls)
+            if self._mod_settimeout.get(modname) and any(
+                    isinstance(n, ast.Attribute)
+                    and n.attr == "settimeout"
+                    for n in ast.walk(cls.node)):
+                cls.calls_settimeout = True
+
+    def _infer_attr_types(self) -> None:
+        for cls in self.classes.values():
+            modname = _module_name(cls.module.relpath)
+            for node in ast.walk(cls.node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                t = self._class_by_canonical(
+                    modname, self._canon(cls.module,
+                                         node.value.func))
+                if t is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and isinstance(
+                            tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        cls.attr_types[tgt.attr] = t.qname
+
+    # ------------------------------------------------- type inference
+    def _annotation_types(self, module: ParsedModule,
+                          ann: Optional[ast.AST]
+                          ) -> List[Optional[str]]:
+        """Class qnames named by a return annotation: ``X`` ->
+        ``[X]``; ``Tuple[X, int]`` -> ``[X, None]``; ``Optional[X]``
+        -> ``[X]``. Unknown -> []."""
+        if ann is None:
+            return []
+        modname = _module_name(module.relpath)
+
+        def one(node) -> Optional[str]:
+            if isinstance(node, ast.Subscript):
+                head = self._canon(module, node.value)
+                if head.rsplit(".", 1)[-1] in ("Optional",):
+                    return one(node.slice)
+                return None
+            c = self._class_by_canonical(
+                modname, self._canon(module, node))
+            return c.qname if c else None
+
+        if isinstance(ann, ast.Subscript):
+            head = self._canon(module, ann.value)
+            tail = head.rsplit(".", 1)[-1]
+            if tail in ("Tuple", "tuple"):
+                elts = (ann.slice.elts
+                        if isinstance(ann.slice, ast.Tuple) else [])
+                return [one(e) for e in elts]
+            if tail == "Optional":
+                return [one(ann.slice)]
+            return []
+        t = one(ann)
+        return [t] if t else []
+
+    def _local_types(self, fn: FunctionInfo,
+                     scopes: Dict[ast.AST, Dict[str, str]]
+                     ) -> Dict[str, str]:
+        """name -> class qname for this function's locals (ctor
+        calls, ``x = self``, annotated-return unpacks), falling back
+        to lexically enclosing function scopes (closures)."""
+        module = fn.module
+        modname = _module_name(module.relpath)
+        out: Dict[str, str] = {}
+        # closure fallback: nearest enclosing function's locals
+        info = module.jit_info
+        cur = info.parents.get(fn.node)
+        while cur is not None:
+            if cur in scopes:
+                for k, v in scopes[cur].items():
+                    out.setdefault(k, v)
+            cur = info.parents.get(cur)
+        for node in _own_statements(fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            val = node.value
+            types: List[Optional[str]] = []
+            if isinstance(val, ast.Name) and val.id == "self" and \
+                    fn.class_qname:
+                types = [fn.class_qname]
+            elif isinstance(val, ast.Call):
+                c = self._class_by_canonical(
+                    modname, self._canon(module, val.func))
+                if c is not None:
+                    types = [c.qname]
+                else:
+                    callee = self._resolve_callable(fn, val.func,
+                                                    out, scopes)
+                    if callee and isinstance(callee.node,
+                                             FunctionNode):
+                        types = self._annotation_types(
+                            callee.module, callee.node.returns)
+            if not types:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and types[0]:
+                    out[tgt.id] = types[0]
+                elif isinstance(tgt, ast.Tuple):
+                    for i, e in enumerate(tgt.elts):
+                        if isinstance(e, ast.Name) and \
+                                i < len(types) and types[i]:
+                            out[e.id] = types[i]
+        return out
+
+    # ---------------------------------------------------- resolution
+    def _resolve_callable(self, fn: FunctionInfo, func: ast.AST,
+                          local_types: Dict[str, str],
+                          scopes) -> Optional[FunctionInfo]:
+        """The single call/ref resolver; returns the PRIMARY target
+        (subclass overrides are added by the edge builder)."""
+        module = fn.module
+        modname = _module_name(module.relpath)
+        # self.m()
+        if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name):
+            recv = func.value.id
+            if recv == "self" and fn.class_qname in self.classes:
+                return self.classes[fn.class_qname].find_method(
+                    func.attr)
+            t = local_types.get(recv)
+            if t and t in self.classes:
+                return self.classes[t].find_method(func.attr)
+        # self.attr.m()
+        if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Attribute) and isinstance(
+                func.value.value, ast.Name) and \
+                func.value.value.id == "self" and \
+                fn.class_qname in self.classes:
+            t = self.classes[fn.class_qname].attr_type(
+                func.value.attr)
+            if t and t in self.classes:
+                return self.classes[t].find_method(func.attr)
+        # dotted: mod.f / mod.Class.m / Class.m / imported f
+        canon = self._canon(module, func)
+        if canon:
+            if canon in self.functions:
+                return self.functions[canon]
+            # imported bare name / alias: canonical already dotted
+            if "." not in canon:
+                q = f"{modname}.{canon}"
+                if q in self.functions:
+                    return self.functions[q]
+                # nested function in an enclosing scope
+                target = module.jit_info.resolve_callable(
+                    module.jit_info.enclosing_scope(func), canon)
+                if target is not None and isinstance(target,
+                                                     FunctionNode):
+                    q2 = (f"{modname}."
+                          f"{self._qualpath(module, target)}")
+                    return self.functions.get(q2)
+            else:
+                head, _, meth = canon.rpartition(".")
+                c = self._class_by_canonical(modname, head)
+                if c is not None:
+                    return c.find_method(meth)
+        return None
+
+    def _targets_with_overrides(self, fn: FunctionInfo,
+                                target: FunctionInfo
+                                ) -> List[FunctionInfo]:
+        out = [target]
+        if target.class_qname and target.class_qname in self.classes:
+            cls = self.classes[target.class_qname]
+            # dynamic dispatch: a subclass override is a possible
+            # callee whenever the static target is a method
+            for sub in cls.all_subclasses():
+                m = sub.methods.get(target.name)
+                if m is not None:
+                    out.append(m)
+        return out
+
+    # --------------------------------------------------- edge builder
+    @staticmethod
+    def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+        for k in call.keywords:
+            if k.arg == name:
+                return k.value
+        return None
+
+    def _timeoutless(self, call: ast.Call) -> bool:
+        """True when this call passes NO deadline: no positional
+        args that could be one, and no ``timeout=`` kwarg (or a
+        literal ``timeout=None``)."""
+        to = self._kwarg(call, "timeout")
+        if to is not None:
+            return isinstance(to, ast.Constant) and to.value is None
+        # any positional argument may be the timeout (queue.get's
+        # first positional is `block`, but passing block without
+        # timeout is rare enough to stay silent on)
+        return not call.args
+
+    def _blocking_site(self, fn: FunctionInfo, call: ast.Call,
+                       resolved: Optional[FunctionInfo]
+                       ) -> Optional[BlockingSite]:
+        func = call.func
+        # HTTPConnection(...) constructor without a timeout: its
+        # getresponse()/connect() then block forever (dotted or
+        # bare-name form)
+        canon = self._canon(fn.module, func)
+        if canon in _HTTP_CONN and \
+                self._kwarg(call, "timeout") is None:
+            return BlockingSite(
+                call.lineno, f"{canon.rsplit('.', 1)[-1]}(...)", "")
+        if not isinstance(func, ast.Attribute):
+            return None
+        if resolved is not None:
+            return None          # analyzed callee: followed instead
+        recv = ast.unparse(func.value) if hasattr(ast, "unparse") \
+            else ""
+        name = func.attr
+        if name == "get" and self._timeoutless(call) and \
+                not call.args:
+            # zero-arg .get(): a queue (dict.get needs a key)
+            return BlockingSite(call.lineno, "queue.get", recv)
+        if name == "wait" and self._timeoutless(call):
+            return BlockingSite(call.lineno, "wait", recv)
+        if name == "acquire" and not call.args and \
+                self._kwarg(call, "timeout") is None and \
+                "lock" in recv.lower():
+            return BlockingSite(call.lineno, "lock.acquire", recv)
+        if name == "getresponse" and not call.args:
+            # only blocking when the connection has no timeout; the
+            # constructor check above owns that case
+            return None
+        if name in ("accept", "recv", "recvfrom"):
+            cls = self.classes.get(fn.class_qname or "")
+            has_settimeout = (cls.calls_settimeout if cls else False) \
+                or self._mod_settimeout.get(
+                    _module_name(fn.module.relpath), False)
+            if not has_settimeout:
+                return BlockingSite(call.lineno, f"socket.{name}",
+                                    recv)
+        if name == "communicate" and \
+                self._kwarg(call, "timeout") is None and \
+                not call.args:
+            return BlockingSite(call.lineno,
+                                "subprocess.communicate", recv)
+        return None
+
+    def _error_site(self, fn: FunctionInfo,
+                    call: ast.Call, raised: bool
+                    ) -> Optional[ErrorSite]:
+        canon = self._canon(fn.module, call.func)
+        name = canon.rsplit(".", 1)[-1]
+        if not name.endswith("Error"):
+            return None
+        return ErrorSite(call.lineno, name, raised,
+                         self._kwarg(call, "retry_after_s")
+                         is not None)
+
+    def _build_edges(self) -> None:
+        # per-function local-type scopes, for closure fallback
+        scopes: Dict[ast.AST, Dict[str, str]] = {}
+        ordered = sorted(self.functions.values(),
+                         key=lambda f: f.qname.count("."))
+        for fn in ordered:
+            scopes[fn.node] = self._local_types(fn, scopes)
+        for fn in self.functions.values():
+            local_types = scopes[fn.node]
+            raised_calls: Set[ast.AST] = set()
+            for node in _own_statements(fn.node):
+                if isinstance(node, ast.Raise) and isinstance(
+                        node.exc, ast.Call):
+                    raised_calls.add(node.exc)
+            for node in _own_statements(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = self._resolve_callable(
+                    fn, node.func, local_types, scopes)
+                if resolved is not None:
+                    for t in self._targets_with_overrides(fn,
+                                                          resolved):
+                        fn.edges.add(t.qname)
+                site = self._blocking_site(fn, node, resolved)
+                if site is not None:
+                    fn.blocking.append(site)
+                err = self._error_site(fn, node,
+                                       node in raised_calls)
+                if err is not None:
+                    fn.errors.append(err)
+                # ref edges: a resolvable function passed as a bare
+                # argument (thread target, handler callback, gauge fn)
+                for arg in list(node.args) + [
+                        k.value for k in node.keywords]:
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        t = self._resolve_callable(
+                            fn, arg, local_types, scopes)
+                        if t is not None:
+                            for tt in self._targets_with_overrides(
+                                    fn, t):
+                                fn.edges.add(tt.qname)
+
+    # ------------------------------------------------------- queries
+    def handler_roots(self) -> List[FunctionInfo]:
+        """HTTP entry points: ``do_*`` methods plus the
+        ``_handle_*``/``handle_*`` convention the serving stack
+        uses."""
+        out = []
+        for fn in self.functions.values():
+            n = fn.name
+            if n.startswith("do_") and n[3:].isupper():
+                out.append(fn)
+            elif (n.startswith("_handle_") or n.startswith("handle_")) \
+                    and fn.class_qname:
+                out.append(fn)
+        return sorted(out, key=lambda f: f.qname)
+
+    def worker_roots(self) -> List[FunctionInfo]:
+        """Thread-target functions: anything passed as ``target=`` to
+        ``threading.Thread`` (resolved), i.e. code that runs on a
+        spawned thread."""
+        out: Set[str] = set()
+        scopes: Dict[ast.AST, Dict[str, str]] = {
+            fn.node: self._local_types(fn, {})
+            for fn in self.functions.values()}
+        for fn in self.functions.values():
+            for node in _own_statements(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = self._canon(fn.module, node.func)
+                if canon.rsplit(".", 1)[-1] != "Thread":
+                    continue
+                tgt = self._kwarg(node, "target")
+                if tgt is None:
+                    continue
+                t = self._resolve_callable(fn, tgt,
+                                           scopes.get(fn.node, {}),
+                                           scopes)
+                if t is not None:
+                    for tt in self._targets_with_overrides(fn, t):
+                        out.add(tt.qname)
+        return sorted((self.functions[q] for q in out
+                       if q in self.functions),
+                      key=lambda f: f.qname)
+
+    def reachable_from(self, roots: Sequence[FunctionInfo]
+                       ) -> Dict[str, str]:
+        """qname -> the (sorted-first) root qname that reaches it."""
+        owner: Dict[str, str] = {}
+        for root in roots:
+            stack = [root.qname]
+            while stack:
+                q = stack.pop()
+                if q in owner:
+                    continue
+                owner[q] = root.qname
+                fn = self.functions.get(q)
+                if fn is None:
+                    continue
+                stack.extend(sorted(fn.edges - set(owner)))
+        return owner
+
+
+_GRAPH_ATTR = "_graftlint_callgraph"
+
+
+def get_graph(ctx: RepoContext) -> CallGraph:
+    """One graph per RepoContext — GL008 and GL010 share it."""
+    g = getattr(ctx, _GRAPH_ATTR, None)
+    if g is None:
+        g = CallGraph(ctx)
+        setattr(ctx, _GRAPH_ATTR, g)
+    return g

@@ -199,6 +199,11 @@ class LintReport:
     suppressed: int
     rules_run: List[str]
     files_checked: int
+    # per-rule wall time (seconds) and cache traffic, for --stats
+    timings: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    cache_hits: int = 0
+    cache_misses: int = 0
 
     @property
     def ok(self) -> bool:
@@ -217,15 +222,21 @@ class LintReport:
         return out
 
 
-def discover_files(repo: str, paths: Sequence[str]) -> List[str]:
+def discover_files(repo: str, paths: Sequence[str],
+                   missing_ok: bool = False) -> List[str]:
     """Expand the CLI path arguments into .py files (sorted,
     deduplicated). Directories recurse; __pycache__ is skipped. A
     path that exists as neither file nor directory is an ERROR — a
-    typo'd CI invocation must not lint nothing and exit 0."""
+    typo'd CI invocation must not lint nothing and exit 0 — EXCEPT
+    under ``missing_ok`` (the --changed-only mode): a changed-file
+    list naturally contains files the change DELETED or renamed
+    away, and those must be skipped, not fatal."""
     out = []
     for p in paths:
         full = p if os.path.isabs(p) else os.path.join(repo, p)
         if not os.path.exists(full):
+            if missing_ok:
+                continue
             raise ValueError(
                 f"path {p!r} does not exist under {repo} — nothing "
                 "would be linted")
@@ -302,11 +313,45 @@ def _suppressions_for(repo: str, relpath: str) -> Suppressions:
     return _suppression_cache[cache_key]
 
 
+def _run_file_rules(m: ParsedModule, rule_ids: Sequence[str]
+                    ) -> Tuple[List[Finding], Dict[str, float]]:
+    """One module through the file-scope rules (+ its parse error):
+    the ONE per-file implementation the serial and --jobs paths
+    share, so a change to the pass cannot diverge them."""
+    import time as _time
+    from tools.graftlint.rules import ALL_RULES
+    findings: List[Finding] = []
+    timings: Dict[str, float] = {}
+    if m.parse_error is not None:
+        findings.append(m.parse_error)
+    elif m.tree is not None:
+        for rid in rule_ids:
+            t0 = _time.perf_counter()
+            findings.extend(ALL_RULES[rid]().check(m))
+            timings[rid] = (timings.get(rid, 0.0)
+                            + _time.perf_counter() - t0)
+    return findings, timings
+
+
+def _analyze_file_job(args: Tuple[str, str, Tuple[str, ...]]):
+    """Worker for --jobs: parse one file, run the file-scope rules.
+    Top-level so it pickles into a process pool. Returns
+    ``(relpath, findings, per-rule timings)``."""
+    repo, path, rule_ids = args
+    m = ParsedModule(path, repo)
+    findings, timings = _run_file_rules(m, rule_ids)
+    return m.relpath, findings, timings
+
+
 def run_lint(repo: str,
              paths: Sequence[str] = (PACKAGE_DIR,),
              rules: Optional[Sequence[str]] = None,
              baseline: Optional[Baseline] = None,
-             changed_only: bool = False) -> LintReport:
+             changed_only: bool = False,
+             jobs: int = 1,
+             cache_path: Optional[str] = None) -> LintReport:
+    import time as _time
+
     from tools.graftlint.rules import ALL_RULES
 
     repo = os.path.abspath(repo)
@@ -317,7 +362,9 @@ def run_lint(repo: str,
             f"unknown rule(s) {sorted(unknown)}; "
             f"available: {sorted(ALL_RULES)}")
 
-    all_files = discover_files(repo, paths)
+    # under --changed-only a path argument may name a file the change
+    # DELETED or renamed away — skip it instead of erroring
+    all_files = discover_files(repo, paths, missing_ok=changed_only)
     changed = changed_files(repo) if changed_only else None
     files = all_files
     if changed is not None:
@@ -325,35 +372,102 @@ def run_lint(repo: str,
                  if os.path.relpath(f, repo).replace(os.sep, "/")
                  in changed]
 
-    modules = [ParsedModule(f, repo) for f in files]
-    raw: List[Finding] = [m.parse_error for m in modules
-                          if m.parse_error is not None]
-    parsed = [m for m in modules if m.tree is not None]
-    ctx = RepoContext(repo, parsed)
-    full_ctx = ctx if changed is None else None
+    file_rules = tuple(rid for rid in sorted(selected)
+                       if ALL_RULES[rid].scope == "file")
+    repo_rules = [rid for rid in sorted(selected)
+                  if ALL_RULES[rid].scope == "repo"]
+    timings: Dict[str, float] = {}
+    raw: List[Finding] = []
+    parsed_by_path: Dict[str, ParsedModule] = {}
 
-    for rid in sorted(selected):
-        rule = ALL_RULES[rid]()
-        if rule.scope == "file":
-            for m in parsed:
-                raw.extend(rule.check(m))
+    cache = None
+    if cache_path:
+        from tools.graftlint.cache import LintCache, file_key
+        cache = LintCache(cache_path)
+
+    # ---- file-scope pass (cacheable, parallelizable) ----
+    pending: List[str] = []
+    keys: Dict[str, str] = {}
+    for f in files:
+        hit = None
+        if cache is not None:
+            rel = os.path.relpath(f, repo).replace(os.sep, "/")
+            try:
+                with open(f, encoding="utf-8",
+                          errors="replace") as fh:
+                    keys[f] = file_key(rel, fh.read())
+            except OSError:
+                keys[f] = ""
+            if keys[f]:
+                hit = cache.lookup(keys[f], file_rules)
+        if hit is not None:
+            raw.extend(hit)
         else:
+            pending.append(f)
+    if jobs > 1 and len(pending) > 1:
+        import concurrent.futures
+        with concurrent.futures.ProcessPoolExecutor(
+                max_workers=jobs) as pool:
+            for rel, findings, t in pool.map(
+                    _analyze_file_job,
+                    [(repo, f, file_rules) for f in pending],
+                    chunksize=8):
+                raw.extend(findings)
+                for rid, dt in t.items():
+                    timings[rid] = timings.get(rid, 0.0) + dt
+                if cache is not None and keys.get(
+                        os.path.join(repo, rel)):
+                    cache.store(keys[os.path.join(repo, rel)],
+                                file_rules, findings)
+    else:
+        for f in pending:
+            m = ParsedModule(f, repo)
+            parsed_by_path[f] = m
+            findings, t = _run_file_rules(m, file_rules)
+            for rid, dt in t.items():
+                timings[rid] = timings.get(rid, 0.0) + dt
+            raw.extend(findings)
+            if cache is not None and keys.get(f):
+                cache.store(keys[f], file_rules, findings)
+    if cache is not None:
+        cache.save()
+
+    # ---- repo-scope pass (always live: cross-file by nature) ----
+    def module_for(f: str) -> ParsedModule:
+        m = parsed_by_path.get(f)
+        if m is None:
+            m = parsed_by_path[f] = ParsedModule(f, repo)
+        return m
+
+    full_ctx = None
+    if repo_rules:
+        modules = [module_for(f) for f in files]
+        # (parse errors are owned by the file pass above — it runs,
+        # or is served from cache, for every file in scope)
+        ctx = RepoContext(repo,
+                          [m for m in modules if m.tree is not None])
+        full_ctx = ctx if changed is None else None
+        for rid in repo_rules:
+            rule = ALL_RULES[rid]()
             # repo-scope rules still honour --changed-only: with a
             # change set and nothing relevant touched, skip the pass
             if changed is not None and not any(
                     rule.repo_triggered(p) for p in changed):
                 continue
             # a triggered repo-scope rule analyzes the FULL tree —
-            # cross-file context (GL004's acquisition graph) must see
-            # unchanged modules or an inversion against one is
-            # invisible — but only findings in changed files are
-            # reported (the unchanged half of a new inversion is a
-            # pre-existing site)
+            # cross-file context (GL004's acquisition graph, the
+            # GL008/GL010 call graph) must see unchanged modules or
+            # an inversion/path through one is invisible — but only
+            # findings in changed files are reported (the unchanged
+            # half of a new inversion is a pre-existing site)
             if full_ctx is None:
-                fm = [ParsedModule(f, repo) for f in all_files]
+                fm = [module_for(f) for f in all_files]
                 full_ctx = RepoContext(
                     repo, [m for m in fm if m.tree is not None])
-            found = rule.check_repo(full_ctx)
+            t0 = _time.perf_counter()
+            found = list(rule.check_repo(full_ctx))
+            timings[rid] = (timings.get(rid, 0.0)
+                            + _time.perf_counter() - t0)
             if changed is not None:
                 found = [f for f in found if f.path in changed]
             raw.extend(found)
@@ -370,7 +484,10 @@ def run_lint(repo: str,
     new, old = base.split(kept)
     return LintReport(new=new, baselined=old, suppressed=suppressed,
                       rules_run=sorted(selected),
-                      files_checked=len(modules))
+                      files_checked=len(files),
+                      timings=timings,
+                      cache_hits=cache.hits if cache else 0,
+                      cache_misses=cache.misses if cache else 0)
 
 
 # ---------------------------------------------------------------------------
@@ -413,15 +530,17 @@ def format_stats(report: LintReport,
         allowance[key.split("|", 1)[0]] = (
             allowance.get(key.split("|", 1)[0], 0) + e["count"])
     per = report.per_rule()
-    rows = [("rule", "current", "baselined", "new", "allowance")]
+    rows = [("rule", "current", "baselined", "new", "allowance",
+             "wall_s")]
     for rid in sorted(set(per) | set(allowance)):
         c = per.get(rid, {"new": 0, "baselined": 0})
         title = getattr(ALL_RULES.get(rid), "title", "")
         rows.append((f"{rid} {title}".strip(),
                      str(c["new"] + c["baselined"]),
                      str(c["baselined"]), str(c["new"]),
-                     str(allowance.get(rid, 0))))
-    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+                     str(allowance.get(rid, 0)),
+                     f"{report.timings.get(rid, 0.0):.3f}"))
+    widths = [max(len(r[i]) for r in rows) for i in range(6)]
     out = ["  ".join(cell.ljust(widths[i])
                      for i, cell in enumerate(row)).rstrip()
            for row in rows]
@@ -431,5 +550,9 @@ def format_stats(report: LintReport,
     out.append(f"total: {len(report.new) + len(report.baselined)} "
                f"finding(s) ({len(report.new)} new, "
                f"{len(report.baselined)} baselined, "
-               f"{fixed} baseline slot(s) no longer hit)")
+               f"{fixed} baseline slot(s) no longer hit); rule "
+               f"wall time {sum(report.timings.values()):.3f}s")
+    if report.cache_hits or report.cache_misses:
+        out.append(f"cache: {report.cache_hits} hit(s), "
+                   f"{report.cache_misses} miss(es)")
     return "\n".join(out)

@@ -9,9 +9,20 @@ from tools.graftlint.rules.gl004_locks import LockDisciplineRule
 from tools.graftlint.rules.gl005_literal_drift import LiteralDriftRule
 from tools.graftlint.rules.gl006_metrics_hygiene import (
     MetricsHygieneRule)
+from tools.graftlint.rules.gl007_thread_lifecycle import (
+    ThreadLifecycleRule)
+from tools.graftlint.rules.gl008_deadlines import (
+    DeadlineDisciplineRule)
+from tools.graftlint.rules.gl009_resources import ResourcePairingRule
+from tools.graftlint.rules.gl010_error_contract import (
+    ErrorContractRule)
+from tools.graftlint.rules.gl011_chaos_coverage import (
+    ChaosCoverageRule)
 
 ALL_RULES = {cls.id: cls for cls in (
     JitPurityRule, RecompileHazardRule, DonationAuditRule,
-    LockDisciplineRule, LiteralDriftRule, MetricsHygieneRule)}
+    LockDisciplineRule, LiteralDriftRule, MetricsHygieneRule,
+    ThreadLifecycleRule, DeadlineDisciplineRule, ResourcePairingRule,
+    ErrorContractRule, ChaosCoverageRule)}
 
 __all__ = ["ALL_RULES", "Rule"]

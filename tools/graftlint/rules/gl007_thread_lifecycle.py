@@ -1,0 +1,233 @@
+"""GL007 — thread lifecycle.
+
+Server-lifetime objects (routers, model servers, brokers, control
+loops) spawn ``threading.Thread``\\ s that must be *stoppable* and
+*stopped*: the fleet soaks found every variant of getting this wrong
+by hand, and each one is mechanically detectable per class:
+
+- **unjoined thread**: ``self.X = threading.Thread(...)`` is started
+  but no method of the class ever joins it (directly, or through the
+  swap idiom ``t, self.X = self.X, None; t.join(...)``). Shutdown
+  then returns while the loop still runs — the UI-server/router bug
+  class: ``stop()`` asks the listener to exit and never waits for
+  it.
+- **stale stop event across generations**: a method that creates a
+  NEW thread generation (any thread-assigning method other than
+  ``__init__``) calls ``self.E.clear()`` on a stop event that some
+  other method ``set()``\\ s. The clear races the previous
+  (stopping) generation — it can be cleared before the old loop
+  observed it, reviving that loop with no handle on it. This is the
+  AlertManager revive bug class; the fix is one fresh ``Event`` per
+  generation, swapped under the lock.
+- **unjoinable server thread**: ``threading.Thread(target=
+  <x>.serve_forever).start()`` fired anonymously — the thread is
+  never bound to an attribute, so no stop path can ever join it.
+
+Daemon threads are NOT exempt: daemonhood only means the
+interpreter won't wait at exit; a server object that is stopped and
+restarted within one process still leaks a generation per cycle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint import jitscope
+from tools.graftlint.core import Finding, ParsedModule
+from tools.graftlint.rules.base import Rule
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_EVENT_CTORS = {"threading.Event", "Event"}
+
+
+def _method_of(info, cls: ast.ClassDef,
+               node: ast.AST) -> Optional[ast.AST]:
+    cur = node
+    while cur is not None:
+        parent = info.parents.get(cur)
+        if parent is cls and isinstance(cur, jitscope.FunctionNode):
+            return cur
+        cur = parent
+    return None
+
+
+class ThreadLifecycleRule(Rule):
+    id = "GL007"
+    title = "thread-lifecycle"
+    rationale = ("a started thread with no join path outlives its "
+                 "owner's shutdown; a stop event shared across "
+                 "restart generations revives orphan loops")
+    scope = "file"
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        info = module.jit_info
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(module, info, node))
+        return out
+
+    # ----------------------------------------------------------- class
+    def _check_class(self, module, info,
+                     cls: ast.ClassDef) -> List[Finding]:
+        out: List[Finding] = []
+        # thread-typed locals per method: name -> ctor line
+        thread_attrs: Dict[str, Tuple[int, str]] = {}  # attr -> (line, method)
+        event_attrs: Set[str] = set()
+        set_events: Set[str] = set()         # self.E.set() anywhere
+        cleared: List[Tuple[str, str, int]] = []  # (attr, method, line)
+        joined_attrs: Set[str] = set()
+        started_attrs: Set[str] = set()
+
+        methods = [n for n in cls.body
+                   if isinstance(n, jitscope.FunctionNode)]
+        for m in methods:
+            local_threads: Dict[str, int] = {}
+            # names locally sourced FROM a self attribute (the swap
+            # idiom): name -> attr
+            from_attr: Dict[str, str] = {}
+            # local thread vars stored TO a self attribute
+            # (`t = Thread(...); self.X = t`): name -> attr, so a
+            # start/join through the local credits exactly that
+            # attribute and no other
+            local_to_attr: Dict[str, str] = {}
+            # assignments first, calls second: `t.start()` before the
+            # `self.X = t` line must still mark X started
+            for n in ast.walk(m):
+                if isinstance(n, ast.Assign):
+                    tgts = n.targets
+                    vals = [n.value]
+                    if len(tgts) == 1 and isinstance(
+                            tgts[0], ast.Tuple) and isinstance(
+                            n.value, ast.Tuple) and len(
+                            tgts[0].elts) == len(n.value.elts):
+                        tgts, vals = tgts[0].elts, n.value.elts
+                    for tgt, val in zip(tgts, vals * (
+                            len(tgts) if len(vals) == 1 else 1)):
+                        self._track_assign(
+                            module, info, tgt, val, m,
+                            local_threads, from_attr, local_to_attr,
+                            thread_attrs, event_attrs)
+            for n in ast.walk(m):
+                if isinstance(n, ast.Call) and isinstance(
+                        n.func, ast.Attribute):
+                    f = n.func
+                    # self.E.set() / self.X.join() / self.X.start()
+                    if isinstance(f.value, ast.Attribute) and \
+                            isinstance(f.value.value, ast.Name) and \
+                            f.value.value.id == "self":
+                        attr = f.value.attr
+                        if f.attr == "set":
+                            set_events.add(attr)
+                        elif f.attr == "clear":
+                            cleared.append((attr, m.name, n.lineno))
+                        elif f.attr == "join":
+                            joined_attrs.add(attr)
+                        elif f.attr == "start":
+                            started_attrs.add(attr)
+                    elif isinstance(f.value, ast.Name):
+                        name = f.value.id
+                        if f.attr == "join":
+                            if name in from_attr:
+                                joined_attrs.add(from_attr[name])
+                            if name in local_to_attr:
+                                joined_attrs.add(local_to_attr[name])
+                        elif f.attr == "start" and \
+                                name in local_to_attr:
+                            # started via the local alias: credits
+                            # ONLY the attribute this local was
+                            # stored to — an unrelated local thread
+                            # starting in the same method must not
+                            # mark other attrs started
+                            started_attrs.add(local_to_attr[name])
+            # anonymous serve_forever threads
+            out.extend(self._anonymous_server_threads(
+                module, info, cls, m))
+
+        for attr, (line, meth) in sorted(thread_attrs.items()):
+            if attr not in started_attrs:
+                continue
+            if attr in joined_attrs:
+                continue
+            out.append(Finding(
+                rule=self.id, path=module.relpath, line=line,
+                symbol=f"{cls.name}.{attr}",
+                message=(
+                    f"thread 'self.{attr}' started by "
+                    f"'{cls.name}' is never joined: no method "
+                    "joins it (directly or via the swap idiom), so "
+                    "shutdown returns while the loop still runs — "
+                    "join it with a timeout on the stop path")))
+
+        # stale stop event: a non-__init__ thread-creating method
+        # clears an event that another method sets
+        gen_methods = {meth for _a, (_l, meth) in
+                       thread_attrs.items() if meth != "__init__"}
+        for attr, meth, line in cleared:
+            if meth in gen_methods and attr in event_attrs and \
+                    attr in set_events:
+                out.append(Finding(
+                    rule=self.id, path=module.relpath, line=line,
+                    symbol=f"{cls.name}.{attr}",
+                    message=(
+                        f"stop event 'self.{attr}' is clear()ed in "
+                        f"'{cls.name}.{meth}' while a new thread "
+                        "generation starts, but other methods "
+                        "set() it: the clear can race the previous "
+                        "(stopping) generation and revive it with "
+                        "no handle — create a FRESH Event per "
+                        "generation instead of reusing one")))
+        return out
+
+    def _track_assign(self, module, info, tgt, val, method,
+                      local_threads, from_attr, local_to_attr,
+                      thread_attrs, event_attrs) -> None:
+        is_thread = (isinstance(val, ast.Call)
+                     and info.canon(val.func) in _THREAD_CTORS)
+        is_event = (isinstance(val, ast.Call)
+                    and info.canon(val.func) in _EVENT_CTORS)
+        if isinstance(tgt, ast.Name):
+            if is_thread:
+                local_threads[tgt.id] = val.lineno
+            elif isinstance(val, ast.Attribute) and isinstance(
+                    val.value, ast.Name) and val.value.id == "self":
+                from_attr[tgt.id] = val.attr
+        elif isinstance(tgt, ast.Attribute) and isinstance(
+                tgt.value, ast.Name) and tgt.value.id == "self":
+            if is_thread:
+                thread_attrs[tgt.attr] = (val.lineno, method.name)
+            elif is_event:
+                event_attrs.add(tgt.attr)
+            elif isinstance(val, ast.Name) and \
+                    val.id in local_threads:
+                thread_attrs[tgt.attr] = (local_threads[val.id],
+                                          method.name)
+                local_to_attr[val.id] = tgt.attr
+
+    def _anonymous_server_threads(self, module, info, cls,
+                                  method) -> List[Finding]:
+        out = []
+        for n in ast.walk(method):
+            if not (isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and n.func.attr == "start"):
+                continue
+            inner = n.func.value
+            if not (isinstance(inner, ast.Call)
+                    and info.canon(inner.func) in _THREAD_CTORS):
+                continue
+            tgt = next((k.value for k in inner.keywords
+                        if k.arg == "target"), None)
+            if isinstance(tgt, ast.Attribute) and \
+                    tgt.attr == "serve_forever":
+                out.append(Finding(
+                    rule=self.id, path=module.relpath,
+                    line=inner.lineno,
+                    symbol=f"{cls.name}.{method.name}",
+                    message=(
+                        "server thread started anonymously "
+                        "(Thread(target=...serve_forever).start()): "
+                        "it is never bound to an attribute, so no "
+                        "stop path can join it — store it and join "
+                        "it after shutdown()")))
+        return out

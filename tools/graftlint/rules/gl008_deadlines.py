@@ -1,0 +1,73 @@
+"""GL008 — deadline discipline.
+
+A blocking primitive with no timeout, sitting anywhere on a path a
+request or a worker loop actually executes, turns one wedged peer
+into a wedged thread (and, pooled, a wedged server). The serving
+errors module states the contract — "blocking forever is never an
+option" — and this rule enforces it interprocedurally:
+
+flag a timeout-less blocking call (``queue.get()``, ``Event.wait()``
+/ ``Condition.wait()``, ``lock.acquire()``, socket ``accept``/
+``recv`` in classes that never ``settimeout``, ``HTTPConnection``
+built without ``timeout=`` — its ``getresponse`` then blocks
+forever, ``Popen.communicate()``) **iff** it is reachable from
+
+- an HTTP handler (``do_*`` / ``_handle_*`` methods), or
+- a worker loop (any resolved ``threading.Thread`` target and its
+  callees),
+
+through the project call graph (``self.method()``, attribute and
+local types, annotated returns, callback/ref arguments — see
+``callgraph.py``). The same call in a function no handler or worker
+reaches is NOT flagged: slow-path tooling may block at will.
+
+The fix is always one of: pass a real deadline, convert to a
+heartbeat wait (``while not evt.wait(1.0): <check stop>``) so the
+thread stays interruptible, or move the call off the serving path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from tools.graftlint import callgraph
+from tools.graftlint.core import Finding, RepoContext
+from tools.graftlint.rules.base import Rule
+
+
+class DeadlineDisciplineRule(Rule):
+    id = "GL008"
+    title = "deadline-discipline"
+    rationale = ("a timeout-less blocking call reachable from a "
+                 "handler or worker loop wedges the thread when a "
+                 "peer dies")
+    scope = "repo"
+
+    def check_repo(self, ctx: RepoContext) -> Iterable[Finding]:
+        graph = callgraph.get_graph(ctx)
+        handler_owner = graph.reachable_from(graph.handler_roots())
+        worker_owner = graph.reachable_from(graph.worker_roots())
+        out: List[Finding] = []
+        for qname in sorted(set(handler_owner) | set(worker_owner)):
+            fn = graph.functions.get(qname)
+            if fn is None or not fn.blocking:
+                continue
+            if qname in handler_owner:
+                kind = "HTTP handler"
+                root = handler_owner[qname]
+            else:
+                kind = "worker loop"
+                root = worker_owner[qname]
+            root_fn = graph.functions[root]
+            for site in fn.blocking:
+                recv = f" on `{site.detail}`" if site.detail else ""
+                out.append(Finding(
+                    rule=self.id, path=fn.module.relpath,
+                    line=site.line, symbol=fn.short,
+                    message=(
+                        f"blocking `{site.primitive}`{recv} without "
+                        f"a timeout is reachable from {kind} "
+                        f"'{root_fn.short}' — a wedged peer blocks "
+                        "this thread forever; pass a deadline or "
+                        "use a heartbeat wait")))
+        return out

@@ -1,0 +1,270 @@
+"""GL009 — resource pairing.
+
+Three acquire/release pairings the serving stack keeps getting
+wrong by hand, each class-local and mechanically checkable:
+
+- **per-instance gauge pairing** (the PR 8 ``_sync_views`` leak
+  class): a gauge registered with a *dynamic* name (an f-string —
+  one gauge per backend/replica) or with non-constant label values
+  (``labels={"endpoint": name}``) pins its callback — and through
+  the bound method, the whole backend and its device buffers — until
+  unregistered. Any class registering such a gauge must also call
+  the matching ``unregister``/``unregister_gauge`` with the same
+  name skeleton somewhere in the class. Constant-named, unlabeled
+  gauges are process-lifetime singletons and exempt.
+- **listener pairing**: a class that stores an HTTP listener
+  (``ThreadingHTTPServer`` / the shared ``_make_listener``) must
+  call ``server_close()`` somewhere — ``shutdown()`` only stops the
+  serve loop; without ``server_close`` the bound port leaks until
+  GC, and cycling fleet replicas hit EADDRINUSE.
+- **unclosed acquisitions**: ``open(...)`` / ``socket.socket(...)``
+  / ``ThreadPoolExecutor(...)`` whose result is chained inline
+  (``open(p).read()``) or bound to a local that is never closed /
+  shut down, never returned, never stored on ``self``, and never
+  passed on — a leak on every exit path. ``with`` and
+  try/finally-close forms are the clean idioms and stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint import jitscope
+from tools.graftlint.core import Finding, ParsedModule
+from tools.graftlint.rules.base import Rule
+
+_REGISTER_METHODS = {"register_gauge", "gauge"}
+_UNREGISTER_METHODS = {"unregister_gauge", "unregister"}
+_LISTENER_CTORS = {"ThreadingHTTPServer", "HTTPServer",
+                   "_make_listener",
+                   "http.server.ThreadingHTTPServer",
+                   "http.server.HTTPServer"}
+_ACQUIRE_CTORS = {
+    "open": ("file", "close"),
+    "socket.socket": ("socket", "close"),
+    "ThreadPoolExecutor": ("executor", "shutdown"),
+    "concurrent.futures.ThreadPoolExecutor": ("executor",
+                                              "shutdown"),
+    "ProcessPoolExecutor": ("executor", "shutdown"),
+    "concurrent.futures.ProcessPoolExecutor": ("executor",
+                                               "shutdown"),
+}
+
+
+def _name_skeleton(node: ast.AST) -> Optional[Tuple]:
+    """Stable identity for a gauge-name expression: a constant
+    string, or the tuple of literal fragments of an f-string (the
+    dynamic parts vary per instance; the skeleton pairs the
+    register with its unregister)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return ("const", node.value)
+    if isinstance(node, ast.JoinedStr):
+        parts = tuple(v.value for v in node.values
+                      if isinstance(v, ast.Constant))
+        return ("fstr",) + parts
+    return None
+
+
+def _is_dynamic(node: ast.AST) -> bool:
+    return isinstance(node, ast.JoinedStr)
+
+
+def _labels_are_dynamic(call: ast.Call) -> bool:
+    for k in call.keywords:
+        if k.arg != "labels" or not isinstance(k.value, ast.Dict):
+            continue
+        for v in k.value.values:
+            if not isinstance(v, ast.Constant):
+                return True
+    return False
+
+
+class ResourcePairingRule(Rule):
+    id = "GL009"
+    title = "resource-pairing"
+    rationale = ("per-instance gauges without an unregister pin dead "
+                 "backends; listeners without server_close leak "
+                 "ports; unclosed files/sockets/executors leak fds")
+    scope = "file"
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        out: List[Finding] = []
+        info = module.jit_info
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._gauge_pairing(module, info, node))
+                out.extend(self._listener_pairing(module, info,
+                                                  node))
+        for fn in [n for n in ast.walk(module.tree)
+                   if isinstance(n, jitscope.FunctionNode)]:
+            out.extend(self._unclosed_acquisitions(module, info, fn))
+        return out
+
+    # ------------------------------------------------------- gauges
+    def _gauge_pairing(self, module, info,
+                       cls: ast.ClassDef) -> List[Finding]:
+        registered: List[Tuple[Tuple, int, str]] = []
+        unregistered: Set[Tuple] = set()
+        for n in ast.walk(cls):
+            if not (isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and n.args):
+                continue
+            skel = _name_skeleton(n.args[0])
+            if skel is None:
+                continue
+            if n.func.attr in _REGISTER_METHODS:
+                if n.func.attr == "gauge" and not (
+                        _is_dynamic(n.args[0])
+                        or _labels_are_dynamic(n)):
+                    continue        # process-lifetime singleton
+                if n.func.attr == "register_gauge" and not \
+                        _is_dynamic(n.args[0]):
+                    continue
+                registered.append((skel, n.lineno,
+                                   ast.unparse(n.args[0])
+                                   if hasattr(ast, "unparse")
+                                   else str(skel)))
+            elif n.func.attr in _UNREGISTER_METHODS:
+                unregistered.add(skel)
+        out = []
+        for skel, line, text in registered:
+            if skel in unregistered:
+                continue
+            out.append(Finding(
+                rule=self.id, path=module.relpath, line=line,
+                symbol=cls.name,
+                message=(
+                    f"per-instance gauge {text} is registered by "
+                    f"'{cls.name}' but the class never unregisters "
+                    "it: each instance generation leaks a gauge "
+                    "whose callback pins the dead instance — pair "
+                    "it with unregister on the shutdown path")))
+        return out
+
+    # ----------------------------------------------------- listeners
+    def _listener_pairing(self, module, info,
+                          cls: ast.ClassDef) -> List[Finding]:
+        created_line = None
+        closes = False
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Call):
+                canon = info.canon(n.func)
+                if canon.rsplit(".", 1)[-1] in {
+                        "ThreadingHTTPServer", "HTTPServer",
+                        "_make_listener"}:
+                    parent = info.parents.get(n)
+                    if isinstance(parent, ast.Assign):
+                        created_line = created_line or n.lineno
+                if isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "server_close":
+                    closes = True
+        if created_line is not None and not closes:
+            return [Finding(
+                rule=self.id, path=module.relpath,
+                line=created_line, symbol=cls.name,
+                message=(
+                    f"'{cls.name}' creates an HTTP listener but "
+                    "never calls server_close(): shutdown() only "
+                    "stops the serve loop — the bound port leaks "
+                    "until GC and a restart on the same port hits "
+                    "EADDRINUSE"))]
+        return []
+
+    # ------------------------------------------- unclosed acquisitions
+    def _unclosed_acquisitions(self, module, info,
+                               fn) -> List[Finding]:
+        out: List[Finding] = []
+        # walk this function's own statements only
+        own: List[ast.AST] = []
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            own.append(n)
+            if isinstance(n, jitscope.FunctionNode + (ast.Lambda,
+                                                      ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+        def acquire_kind(call: ast.Call) -> Optional[Tuple[str, str]]:
+            canon = info.canon(call.func)
+            if canon == "open" and not call.args:
+                return None                   # not the builtin form
+            return _ACQUIRE_CTORS.get(canon)
+
+        # classify each acquisition call by how its value is used
+        assigned: Dict[str, Tuple[int, str, str]] = {}
+        released: Set[str] = set()
+        escaped: Set[str] = set()
+        for n in own:
+            if isinstance(n, ast.withitem) and isinstance(
+                    n.context_expr, ast.Call):
+                continue
+            if isinstance(n, ast.Call):
+                kind = acquire_kind(n)
+                if kind is None:
+                    continue
+                parent = info.parents.get(n)
+                if isinstance(parent, ast.withitem):
+                    continue                      # with open(...)
+                if isinstance(parent, ast.Attribute):
+                    # open(p).read() — closed only at GC
+                    out.append(Finding(
+                        rule=self.id, path=module.relpath,
+                        line=n.lineno, symbol=fn.name,
+                        message=(
+                            f"{kind[0]} acquired inline "
+                            f"(`{info.canon(n.func)}(...)"
+                            f".{parent.attr}`) is never closed — "
+                            "use `with` so every exit path "
+                            "releases it")))
+                    continue
+                if isinstance(parent, ast.Assign) and len(
+                        parent.targets) == 1 and isinstance(
+                        parent.targets[0], ast.Name):
+                    assigned[parent.targets[0].id] = (
+                        n.lineno, kind[0], kind[1])
+                elif isinstance(parent, ast.Assign) and isinstance(
+                        parent.targets[0], ast.Attribute):
+                    pass                           # stored on self
+                elif not isinstance(parent, (ast.Return,
+                                             ast.withitem)):
+                    # passed as an argument / yielded: escapes
+                    pass
+        for n in own:
+            if isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Attribute) and isinstance(
+                        n.func.value, ast.Name):
+                    if n.func.attr in ("close", "shutdown",
+                                       "release", "server_close"):
+                        released.add(n.func.value.id)
+                for a in list(n.args) + [k.value for k in
+                                         n.keywords]:
+                    if isinstance(a, ast.Name):
+                        escaped.add(a.id)
+            elif isinstance(n, ast.Return) and isinstance(
+                    n.value, ast.Name):
+                escaped.add(n.value.id)
+            elif isinstance(n, ast.Return) and isinstance(
+                    n.value, ast.Tuple):
+                for e in n.value.elts:
+                    if isinstance(e, ast.Name):
+                        escaped.add(e.id)
+            elif isinstance(n, ast.Assign) and isinstance(
+                    n.value, ast.Name):
+                escaped.add(n.value.id)            # re-bound/stored
+            elif isinstance(n, ast.withitem) and isinstance(
+                    n.context_expr, ast.Name):
+                released.add(n.context_expr.id)    # with f: ...
+        for name, (line, kind, closer) in sorted(assigned.items()):
+            if name in released or name in escaped:
+                continue
+            out.append(Finding(
+                rule=self.id, path=module.relpath, line=line,
+                symbol=fn.name,
+                message=(
+                    f"{kind} '{name}' is acquired but never "
+                    f"{closer}()d on any path out of "
+                    f"'{fn.name}' — wrap it in `with` or release "
+                    "it in `finally`")))
+        return out

@@ -1,0 +1,172 @@
+"""GL010 — serving-error contract.
+
+Two halves of one contract between the typed serving errors, the
+HTTP layer, and the README failure matrix:
+
+- **retry hints on admission paths** (interprocedural): the
+  backpressure error classes — ``QueueFullError``,
+  ``KVPagePoolExhaustedError``, ``ServerClosedError``,
+  ``CircuitOpenError``, ``NoReplicaAvailableError`` — map to
+  429/503, where the HTTP layer forwards the raiser's
+  ``retry_after_s`` as ``Retry-After``. Constructing one of these
+  WITHOUT ``retry_after_s=`` anywhere an HTTP handler can reach
+  ships a blind-backoff 429/503: routers and load generators lose
+  the priced hint the tier system promises. Construction sites
+  unreachable from any handler (boot paths, CLI tooling) are
+  exempt.
+- **status-matrix drift** (doc vs code): the README documents the
+  error→status mapping (```SomeError` ... 503`` within a line).
+  Every ``except SomeServingError`` arm in the HTTP layer that
+  answers with a literal status must agree with the documented
+  code. A handler quietly remapping an error class is exactly the
+  contract drift PRs 8–13 kept catching in review.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint import callgraph, jitscope
+from tools.graftlint.core import Finding, RepoContext
+from tools.graftlint.rules.base import Rule
+
+# 429/503-mapped backpressure errors: the ones whose Retry-After the
+# HTTP layer forwards from the raiser
+_BACKPRESSURE_ERRORS = {
+    "QueueFullError", "KVPagePoolExhaustedError", "ServerClosedError",
+    "CircuitOpenError", "NoReplicaAvailableError",
+}
+_SERVING_ERRORS = _BACKPRESSURE_ERRORS | {
+    "ServingError", "DeadlineExceededError", "ModelNotFoundError",
+    "ReplicaGoneError", "ReplicaBootError",
+}
+
+_DOC_PAIR_RE = re.compile(r"`(?P<err>[A-Z]\w*Error)`|"
+                          r"(?<!\d)(?P<code>4\d\d|5\d\d)(?!\d)")
+
+
+def _doc_matrix(repo: str) -> Dict[str, Set[int]]:
+    """README error -> documented status codes, from lines that
+    mention both a backticked ``*Error`` and a 4xx/5xx literal."""
+    path = os.path.join(repo, "README.md")
+    out: Dict[str, Set[int]] = {}
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return out
+    for line in text.splitlines():
+        errs, codes = [], []
+        for m in _DOC_PAIR_RE.finditer(line):
+            if m.group("err"):
+                errs.append(m.group("err"))
+            else:
+                codes.append(int(m.group("code")))
+        if len(errs) == 1 and codes:
+            # one error + codes on the line: an explicit mapping;
+            # multi-error lines are prose, too ambiguous to bind
+            out.setdefault(errs[0], set()).update(codes)
+    return out
+
+
+class ErrorContractRule(Rule):
+    id = "GL010"
+    title = "serving-error-contract"
+    rationale = ("a 429/503 without retry_after_s ships a blind "
+                 "backoff; a handler remapping a typed error drifts "
+                 "from the documented failure matrix")
+    scope = "repo"
+
+    def repo_triggered(self, relpath: str) -> bool:
+        return relpath.endswith(".py") or relpath == "README.md"
+
+    def check_repo(self, ctx: RepoContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        out.extend(self._retry_hints(ctx))
+        out.extend(self._status_matrix(ctx))
+        return out
+
+    # --------------------------------------------------- retry hints
+    def _retry_hints(self, ctx: RepoContext) -> List[Finding]:
+        graph = callgraph.get_graph(ctx)
+        reach = graph.reachable_from(graph.handler_roots())
+        out: List[Finding] = []
+        for qname in sorted(reach):
+            fn = graph.functions.get(qname)
+            if fn is None:
+                continue
+            for site in fn.errors:
+                if site.error not in _BACKPRESSURE_ERRORS:
+                    continue
+                if site.has_retry_after:
+                    continue
+                root = graph.functions[reach[qname]]
+                out.append(Finding(
+                    rule=self.id, path=fn.module.relpath,
+                    line=site.line, symbol=fn.short,
+                    message=(
+                        f"{site.error} constructed without "
+                        f"retry_after_s on an admission path "
+                        f"(reachable from '{root.short}'): the "
+                        "429/503 goes out with a blind Retry-After "
+                        "— pass the raiser's backoff hint")))
+        return out
+
+    # ------------------------------------------------- status matrix
+    def _status_matrix(self, ctx: RepoContext) -> List[Finding]:
+        doc = _doc_matrix(ctx.repo)
+        if not doc:
+            return []
+        out: List[Finding] = []
+        for module in ctx.modules:
+            info = module.jit_info
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.ExceptHandler) or \
+                        node.type is None:
+                    continue
+                names = self._caught_names(node.type)
+                codes = self._sent_codes(node)
+                if not codes:
+                    continue
+                for name in names:
+                    if name not in _SERVING_ERRORS or name not in doc:
+                        continue
+                    bad = codes - doc[name]
+                    for code in sorted(bad):
+                        out.append(Finding(
+                            rule=self.id, path=module.relpath,
+                            line=node.lineno, symbol=name,
+                            message=(
+                                f"handler maps {name} to HTTP "
+                                f"{code}, but the README failure "
+                                f"matrix documents "
+                                f"{sorted(doc[name])} — fix the "
+                                "handler or the matrix")))
+        return out
+
+    @staticmethod
+    def _caught_names(t: ast.AST) -> List[str]:
+        nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+        out = []
+        for n in nodes:
+            if isinstance(n, ast.Name):
+                out.append(n.id)
+            elif isinstance(n, ast.Attribute):
+                out.append(n.attr)
+        return out
+
+    @staticmethod
+    def _sent_codes(handler: ast.ExceptHandler) -> Set[int]:
+        """Literal 4xx/5xx status arguments of calls made in the
+        except body (``err(429, e)``, ``self._send(503, ...)``)."""
+        codes: Set[int] = set()
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Call):
+                for a in n.args[:1]:
+                    if isinstance(a, ast.Constant) and isinstance(
+                            a.value, int) and 400 <= a.value < 600:
+                        codes.add(a.value)
+        return codes

@@ -1,0 +1,207 @@
+"""GL011 — chaos-site coverage.
+
+``chaos/injector.py`` is the single authority for injection sites
+(``SITES``) and their kinds (``SITE_KINDS``); the README documents
+them; the stack threads them as string literals at ``chaos.hit`` /
+``step_fault`` / ``file_fault`` call sites. Three artifacts, one
+truth — and three drift modes, checked three-way:
+
+- **declared but never threaded**: a site in ``SITES`` with no
+  ``hit``/``step_fault``/``file_fault`` call site anywhere in the
+  analyzed tree — a fault plan naming it installs cleanly and
+  injects nothing.
+- **threaded but undeclared**: a call-site literal missing from
+  ``SITES`` — ``hit("typo.site")`` silently never fires (plan
+  validation can't name it), the worst kind of dead chaos coverage.
+- **doc drift**: a declared site missing from the README fault-
+  injection table, or a site-looking token documented there that
+  ``SITES`` does not declare (the GL005 token check, made
+  bidirectional and site-complete).
+- **kind never interpreted**: a site-specific kind in
+  ``SITE_KINDS`` (beyond the generic crash/hang/slow/error/enospc
+  handled centrally by ``step_fault``) that never appears in a
+  ``.kind`` comparison or membership test — the plan accepts it,
+  the call site ignores it, and it "fires" as a no-op.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.graftlint.core import Finding, ParsedModule, RepoContext
+from tools.graftlint.rules.base import Rule
+
+_INJECTOR_RELPATH = "deeplearning4j_tpu/chaos/injector.py"
+_HIT_FUNCS = {"hit", "step_fault", "file_fault",
+              # chaos.retry's wrapper: retrying_io(site, fn) hits
+              # the site through the shared retry policy
+              "retrying_io"}
+# generic kinds are applied centrally by step_fault/file_fault
+_CENTRAL_KINDS = {"crash", "hang", "slow", "error", "enospc",
+                  "truncate", "corrupt"}
+_DOC_SITE_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`")
+
+
+class ChaosCoverageRule(Rule):
+    id = "GL011"
+    title = "chaos-site-coverage"
+    rationale = ("an undeclared or unthreaded chaos site is dead "
+                 "fault coverage that still looks installed")
+    scope = "repo"
+
+    def repo_triggered(self, relpath: str) -> bool:
+        return relpath.endswith(".py") or relpath == "README.md"
+
+    # ------------------------------------------------------------------
+    def check_repo(self, ctx: RepoContext) -> Iterable[Finding]:
+        injector = next((m for m in ctx.modules
+                         if m.relpath == _INJECTOR_RELPATH), None)
+        if injector is None:
+            return []        # fixture runs / partial trees: no gate
+        declared = self._declared(injector)
+        if declared is None:
+            return []
+        sites, kinds_by_site, sites_line, kinds_line = declared
+        threaded = self._threaded(ctx)
+        kind_literals = self._kind_comparisons(ctx)
+        doc_sites = self._doc_sites(ctx.repo)
+        out: List[Finding] = []
+
+        for site in sorted(sites):
+            if site not in threaded:
+                out.append(Finding(
+                    rule=self.id, path=injector.relpath,
+                    line=sites_line, symbol=site,
+                    message=(
+                        f"chaos site '{site}' is declared in SITES "
+                        "but never threaded: no hit()/step_fault()/"
+                        "file_fault() call site names it — a plan "
+                        "naming it installs cleanly and injects "
+                        "nothing")))
+        for site, (relpath, line) in sorted(threaded.items()):
+            if site not in sites:
+                out.append(Finding(
+                    rule=self.id, path=relpath, line=line,
+                    symbol=site,
+                    message=(
+                        f"chaos call site names '{site}' which "
+                        "SITES does not declare: plans cannot "
+                        "target it and a typo here silently never "
+                        "fires — declare it or fix the literal")))
+        if doc_sites is not None:
+            for site in sorted(sites):
+                if site not in doc_sites:
+                    out.append(Finding(
+                        rule=self.id, path="README.md", line=0,
+                        symbol=site,
+                        message=(
+                            f"chaos site '{site}' is declared and "
+                            "threaded but missing from the README "
+                            "fault-injection table")))
+        for site in sorted(kinds_by_site):
+            for kind in sorted(kinds_by_site[site]
+                               - _CENTRAL_KINDS):
+                if kind not in kind_literals:
+                    out.append(Finding(
+                        rule=self.id, path=injector.relpath,
+                        line=kinds_line, symbol=f"{site}/{kind}",
+                        message=(
+                            f"site-specific chaos kind '{kind}' "
+                            f"(site '{site}') is declared in "
+                            "SITE_KINDS but no call site ever "
+                            "compares fault.kind against it — it "
+                            "fires as a silent no-op")))
+        return out
+
+    # ------------------------------------------------------- declared
+    def _declared(self, injector: ParsedModule):
+        sites: Set[str] = set()
+        kinds: Dict[str, Set[str]] = {}
+        sites_line = kinds_line = 0
+        for node in injector.tree.body:
+            if not (isinstance(node, ast.AnnAssign) or isinstance(
+                    node, ast.Assign)):
+                continue
+            targets = ([node.target] if isinstance(node,
+                                                   ast.AnnAssign)
+                       else node.targets)
+            name = next((t.id for t in targets
+                         if isinstance(t, ast.Name)), "")
+            value = node.value
+            if name == "SITES" and isinstance(value, ast.Dict):
+                sites_line = node.lineno
+                for k in value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str):
+                        sites.add(k.value)
+            elif name == "SITE_KINDS" and isinstance(value,
+                                                     ast.Dict):
+                kinds_line = node.lineno
+                for k, v in zip(value.keys, value.values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        continue
+                    kinds[k.value] = {
+                        n.value for n in ast.walk(v)
+                        if isinstance(n, ast.Constant)
+                        and isinstance(n.value, str)}
+        if not sites:
+            return None
+        return sites, kinds, sites_line, kinds_line
+
+    # ------------------------------------------------------- threaded
+    def _threaded(self, ctx: RepoContext
+                  ) -> Dict[str, tuple]:
+        out: Dict[str, tuple] = {}
+        for module in ctx.modules:
+            if module.relpath == _INJECTOR_RELPATH:
+                continue     # the injector's own helpers don't count
+            info = module.jit_info
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                canon = info.canon(node.func)
+                if canon.rsplit(".", 1)[-1] not in _HIT_FUNCS:
+                    continue
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(
+                        a.value, str) and "." in a.value:
+                    out.setdefault(a.value,
+                                   (module.relpath, node.lineno))
+        return out
+
+    def _kind_comparisons(self, ctx: RepoContext) -> Set[str]:
+        """String literals compared against a ``.kind`` attribute
+        anywhere in the tree (== / in (...))."""
+        out: Set[str] = set()
+        for module in ctx.modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Compare):
+                    continue
+                sides = [node.left] + list(node.comparators)
+                if not any(isinstance(s, ast.Attribute)
+                           and s.attr == "kind" for s in sides):
+                    continue
+                for s in sides:
+                    for c in ast.walk(s):
+                        if isinstance(c, ast.Constant) and \
+                                isinstance(c.value, str):
+                            out.add(c.value)
+        return out
+
+    # ------------------------------------------------------------ docs
+    def _doc_sites(self, repo: str) -> Optional[Set[str]]:
+        path = os.path.join(repo, "README.md")
+        try:
+            with open(path, encoding="utf-8",
+                      errors="replace") as f:
+                text = f.read()
+        except OSError:
+            return None
+        return set(_DOC_SITE_RE.findall(text))
+    # the "documented but undeclared" direction is GL005's token
+    # check and stays there — this rule owns completeness of the
+    # declared set

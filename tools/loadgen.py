@@ -454,7 +454,15 @@ class LoadGen:
 
         def worker():
             while True:
-                i = work.get()
+                try:
+                    # heartbeat get (GL008): a wedged arrival loop
+                    # must not strand workers in a blocking get
+                    # forever — they re-check the stop flag instead
+                    i = work.get(timeout=0.5)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return
+                    continue
                 if i is None:
                     return
                 self._once(i)
