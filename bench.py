@@ -2597,6 +2597,158 @@ print(json.dumps(out))
     }
 
 
+DISAGG_V, DISAGG_D, DISAGG_H = 64, 32, 2
+DISAGG_CAP, DISAGG_PS = 96, 16
+DISAGG_PROMPT, DISAGG_TOKENS = 32, 8
+DISAGG_REQUESTS = 160
+DISAGG_CONC = 4
+
+
+def _leg_disagg_kv_routing(peak):
+    """KV-aware (prefix-fingerprint) routing vs the affinity-only
+    router over a 4-replica in-process fleet under a
+    ``--dup-ratio 0.5`` duplicate-prompt generate mix: the KV-aware
+    router sends a repeated prompt to the replica whose prefix cache
+    already holds it, so the fleet-wide prefix-hit ratio rises and
+    the duplicate population's TTFT collapses to the hit path.
+    Everything here shares one process (replicas + router + GIL), so
+    the honest read is the RATIO between the two router modes in the
+    same harness, plus the hit-vs-cold TTFT split scraped from the
+    replicas' own ``serving_ttft_seconds{population=...}``
+    histograms."""
+    import subprocess
+    import urllib.request
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        EmbeddingSequenceLayer, RnnOutputLayer,
+        TransformerEncoderLayer)
+    from deeplearning4j_tpu.serving.fleet import ReplicaFleet
+    from deeplearning4j_tpu.serving.router import Router
+    from tools.loadgen import scrape_ttft_populations
+
+    def lm():
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(EmbeddingSequenceLayer(n_in=DISAGG_V,
+                                              n_out=DISAGG_D))
+                .layer(TransformerEncoderLayer(n_heads=DISAGG_H,
+                                               causal=True))
+                .layer(RnnOutputLayer(n_out=DISAGG_V, loss="mcxent"))
+                .set_input_type(InputType.recurrent(DISAGG_V,
+                                                    DISAGG_CAP))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def factory():
+        return {"default": lm()}
+
+    def loadgen(port, total):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.loadgen",
+             "--url", f"http://127.0.0.1:{port}",
+             "--mode", "generate", "--dup-ratio", "0.5",
+             "--prompt-len", str(DISAGG_PROMPT),
+             "--n-tokens", str(DISAGG_TOKENS),
+             "--vocab", str(DISAGG_V),
+             "--concurrency", str(DISAGG_CONC),
+             "--total", str(total),
+             "--timeout", "60", "--retries", "2",
+             "--metrics-url", "off"],
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if not proc.stdout.strip():
+            raise RuntimeError(
+                f"loadgen exited {proc.returncode} with no report; "
+                f"stderr: {proc.stderr[-800:]}")
+        return json.loads(proc.stdout)
+
+    def run(kv_routing):
+        fleet = ReplicaFleet(
+            factory, n=4,
+            server_kwargs=dict(slots=4, capacity=DISAGG_CAP,
+                               page_size=DISAGG_PS)).start()
+        router = Router(fleet, probe_interval_s=0.2,
+                        hedge_after_s=None, sample_rate=0.0,
+                        request_timeout_s=60.0,
+                        kv_routing=kv_routing).start()
+        try:
+            # warm every replica's compiled decode DIRECTLY (not via
+            # the router) with a sub-page prompt: 8 tokens < one
+            # 16-token page, so nothing enters any prefix cache and
+            # the measured mix starts cold on every replica
+            warm = json.dumps({"model": "default",
+                               "prompt": list(range(1, 9)),
+                               "n_tokens": 2}).encode()
+            for r in fleet.snapshot():
+                req = urllib.request.Request(
+                    r.url + "/v1/generate", data=warm,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=120).read()
+            rep = loadgen(router.port, DISAGG_REQUESTS)
+            if rep.get("failed"):
+                raise RuntimeError(
+                    f"disagg_kv_routing dropped requests: "
+                    f"{rep['failed']} ({rep.get('errors')})")
+            hits = sum(
+                s["prefix_cache_hits_total"]
+                for s in router.load_signals())
+            ttft = scrape_ttft_populations(
+                [r.url for r in fleet.snapshot()], timeout_s=10)
+            kv_routed = router._kv_routed.value
+        finally:
+            router.stop()
+            fleet.stop(drain=False, timeout=5.0)
+        return {"report": rep, "hits": hits, "ttft": ttft,
+                "hit_ratio": hits / max(1, rep["ok"]),
+                "kv_routed": kv_routed}
+
+    kv = run(True)
+    aff = run(False)
+    print(f"disagg_kv_routing: KV-aware hit ratio "
+          f"{kv['hit_ratio']:.2f} ({int(kv['hits'])}/"
+          f"{kv['report']['ok']}, {int(kv['kv_routed'])} "
+          f"prefix-routed) vs affinity-only {aff['hit_ratio']:.2f} "
+          f"({int(aff['hits'])}/{aff['report']['ok']}); TTFT hit "
+          f"p50 {kv['ttft']['prefix_hit']['p50']:.1f} ms vs cold "
+          f"p50 {kv['ttft']['cold']['p50']:.1f} ms (baseline cold "
+          f"p50 {aff['ttft']['cold']['p50']:.1f} ms)",
+          file=sys.stderr)
+    return {
+        "metric": (f"disagg_kv_routing: fleet-wide prefix-hit "
+                   f"ratio under a dup-ratio 0.5 generate mix "
+                   f"(4 in-process replicas, prompt "
+                   f"{DISAGG_PROMPT}, page {DISAGG_PS}, "
+                   f"{DISAGG_REQUESTS} requests) — KV-aware "
+                   f"router vs affinity-only"),
+        "value": round(kv["hit_ratio"], 3),
+        "unit": "prefix-hit ratio",
+        "baseline": round(aff["hit_ratio"], 3),
+        "vs_baseline": round(
+            kv["hit_ratio"] / max(1e-9, aff["hit_ratio"]), 3),
+        "kv_routed_requests": int(kv["kv_routed"]),
+        "ttft_ms": {
+            "kv_hit_p50": kv["ttft"]["prefix_hit"]["p50"],
+            "kv_hit_p99": kv["ttft"]["prefix_hit"]["p99"],
+            "kv_cold_p50": kv["ttft"]["cold"]["p50"],
+            "kv_cold_p99": kv["ttft"]["cold"]["p99"],
+            "affinity_hit_p50": aff["ttft"]["prefix_hit"]["p50"],
+            "affinity_cold_p50": aff["ttft"]["cold"]["p50"]},
+        "hit_counts": {"kv": int(kv["hits"]),
+                       "affinity": int(aff["hits"]),
+                       "requests": kv["report"]["ok"]},
+        "client_latency_ms": {
+            "kv_p50": kv["report"]["latency_ms"]["p50"],
+            "affinity_p50": aff["report"]["latency_ms"]["p50"]},
+        "note": ("replicas, router and their GIL share one "
+                 "process on the 2-core host: read the two router "
+                 "modes as a controlled A/B, not absolute "
+                 "throughput"),
+    }
+
+
 # (name, fn, warm-cache wall estimate sec). Order = priority: the five
 # BASELINE.md configs first (VGG before the informational flash leg —
 # round-2 lost config 4 to the wall clock with the legs the other way).
@@ -2632,6 +2784,9 @@ _LEGS = [
     ("tracing_overhead", _leg_tracing_overhead, 180),
     # CPU-dominated (loopback HTTP, tiny MLP replicas): cheap
     ("router_fleet", _leg_router_fleet, 240),
+    # CPU-dominated (loopback HTTP, tiny transformer replicas):
+    # the KV-aware vs affinity-only router A/B
+    ("disagg_kv_routing", _leg_disagg_kv_routing, 300),
     # CPU-dominated (sleep-based replicas, control-loop timing):
     # cheap, runs last
     ("autoscaler_soak", _leg_autoscaler_soak, 240),

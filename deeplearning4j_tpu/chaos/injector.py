@@ -135,6 +135,8 @@ SITES: Dict[str, str] = {
     "serving.replica": "one request routed to a fleet replica",
     "serving.replica.boot": "one fleet replica boot (scale-up / "
                             "replace successor)",
+    "serving.kv.migrate": "one KV lease serialized or rebuilt "
+                          "(prefill export, drain migration, import)",
     "parallel.device": "one ParallelWrapper data-parallel mesh step",
 }
 
@@ -162,6 +164,13 @@ SITE_KINDS: Dict[str, frozenset] = {
     # backoff instead of wedging the control loop), boot_slow
     # sleeps args.delay_s first (a replica importing jax forever)
     "serving.replica.boot": frozenset({"boot_fail", "boot_slow"}),
+    # KV-migration faults are interpreted by ContinuousBatcher's
+    # export/import paths: corrupt flips a payload byte AFTER the
+    # CRC is stamped (the importer's integrity check must catch it
+    # and the router must fall back), error raises a transient
+    # ChaosIOError (the exporting slot stays put and finishes on the
+    # incumbent), slow stalls the hop by args.delay_s
+    "serving.kv.migrate": frozenset({"corrupt", "slow", "error"}),
     "parallel.device": _GENERIC_KINDS | {"loss"},
 }
 

@@ -305,18 +305,32 @@ def _cmd_serve_fleet(args):
         # instances (and their compiled executables) outright
         return {name: restore_model(path) for name, path in specs}
 
+    roles = None
+    if args.roles:
+        from deeplearning4j_tpu.serving.fleet import parse_roles
+        try:
+            roles = parse_roles(args.roles, args.replicas)
+        except ValueError as e:
+            raise SystemExit(f"bad --roles: {e}")
     fleet = ReplicaFleet(
-        factory, n=args.replicas,
+        factory, n=args.replicas, roles=roles,
         server_kwargs=dict(max_batch_size=args.max_batch_size,
                            queue_limit=args.queue_limit,
                            wait_ms=args.wait_ms, slots=args.slots,
                            capacity=args.capacity,
+                           kv_mode=args.kv_mode,
+                           page_size=args.page_size,
+                           kv_pages=args.kv_pages,
                            mesh=args.mesh)).start()
+    if roles:
+        print("fleet roles: " + ", ".join(
+            f"replica {r.id}={r.role}" for r in fleet.snapshot()))
     router = Router(
         fleet, port=args.port, host=args.host,
         probe_interval_s=args.probe_interval,
         hedge_after_s=None if args.hedge_after_ms <= 0
         else args.hedge_after_ms / 1e3,
+        kv_routing=not args.no_kv_routing,
         sample_rate=args.trace_sample).start()
     slos = None
     if args.slo:
@@ -561,6 +575,30 @@ def main(argv=None):
     f.add_argument("--wait-ms", type=float, default=2.0)
     f.add_argument("--slots", type=int, default=4)
     f.add_argument("--capacity", type=int, default=256)
+    f.add_argument("--roles", metavar="SPEC", default=None,
+                   help="disaggregated prefill/decode serving: "
+                        "per-replica roles as 'prefill=1,decode=3' "
+                        "(counts must sum to --replicas; roles are "
+                        "prefill / decode / mixed). A prefill "
+                        "replica runs prompts and exports KV leases "
+                        "(/v1/kv/export); the router rebuilds them "
+                        "on a decode replica (/v1/kv/import) which "
+                        "streams the completion — token-identical "
+                        "to a single-replica run")
+    f.add_argument("--kv-mode", choices=("auto", "paged", "dense"),
+                   default="auto",
+                   help="replica decode KV mode (see serve "
+                        "--kv-mode); disaggregation and prefix-"
+                        "aware routing need the paged path")
+    f.add_argument("--page-size", type=int, default=16,
+                   help="tokens per KV page on every replica")
+    f.add_argument("--kv-pages", type=int, default=None,
+                   help="KV pool pages per replica (default: "
+                        "memory parity with the dense session)")
+    f.add_argument("--no-kv-routing", action="store_true",
+                   help="disable prefix-aware generate routing "
+                        "(affinity + least-loaded only — the bench "
+                        "baseline)")
     f.add_argument("--probe-interval", type=float, default=1.0,
                    metavar="S",
                    help="active health-probe period (seconds)")

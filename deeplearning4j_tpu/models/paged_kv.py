@@ -40,19 +40,125 @@ corrupt a live page. The allocator hands out ids ``1..n_pages``.
 
 from __future__ import annotations
 
+import json
+import struct
 import threading
+import zlib
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.serving.errors import KVPagePoolExhaustedError
+from deeplearning4j_tpu.serving.errors import (KVLeaseCorruptError,
+                                               KVLeaseVersionError,
+                                               KVPagePoolExhaustedError)
 
-__all__ = ["PagedKVAllocator", "PrefixCache", "PagedSlotSession"]
+__all__ = ["PagedKVAllocator", "PrefixCache", "PagedSlotSession",
+           "prefix_fingerprint", "prefix_fingerprints", "parse_lease",
+           "LEASE_WIRE_VERSION"]
 
 
 def _pages_for(tokens: int, page_size: int) -> int:
     return -(-int(tokens) // int(page_size))
+
+
+# ---------------------------------------------------------------------------
+# prefix fingerprints — the router-side half of KV-aware routing
+# ---------------------------------------------------------------------------
+
+def _prefix_bytes(tokens, n_tokens: Optional[int] = None) -> bytes:
+    arr = np.asarray(tokens).reshape(-1)
+    if n_tokens is not None:
+        arr = arr[:int(n_tokens)]
+    return np.ascontiguousarray(arr, dtype=np.int64).tobytes()
+
+
+def prefix_fingerprint(tokens, n_tokens: Optional[int] = None) -> str:
+    """8-hex digest of a page-aligned token prefix — the SAME bytes
+    :class:`PrefixCache` keys on, so a fingerprint computed by the
+    fleet router from a request's prompt matches the one a replica
+    advertises for its cached entry. A routing hint, not an identity
+    check: a (1-in-4-billion) collision merely routes to a replica
+    without the prefix, which then prefills cold."""
+    return format(zlib.crc32(_prefix_bytes(tokens, n_tokens))
+                  & 0xFFFFFFFF, "08x")
+
+
+def prefix_fingerprints(tokens, page_size: int) -> List[Tuple[int, str]]:
+    """``[(n_tokens, fingerprint)]`` for every page-aligned prefix of
+    the prompt, LONGEST FIRST — the probe order for "which replica
+    holds my longest cached prefix". Runs on the router's routing
+    hot path, so the digests are computed in ONE pass with a running
+    crc32 (a from-scratch hash per prefix would make routing
+    O(prompt² / page_size))."""
+    tokens = np.asarray(tokens).reshape(-1)
+    ps = int(page_size)
+    data = _prefix_bytes(tokens)
+    stride = ps * 8                    # int64 bytes per page
+    crc = 0
+    out = []
+    for n in range(1, tokens.size // ps + 1):
+        crc = zlib.crc32(data[(n - 1) * stride:n * stride], crc)
+        out.append((n * ps, format(crc & 0xFFFFFFFF, "08x")))
+    out.reverse()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lease wire format
+# ---------------------------------------------------------------------------
+
+_LEASE_MAGIC = b"DKVL"
+LEASE_WIRE_VERSION = 1
+
+
+def parse_lease(blob: bytes) -> Tuple[dict, bytes]:
+    """Split and validate a serialized lease: ``(header, payload)``.
+    Bad magic / truncation / CRC mismatch raise
+    :class:`KVLeaseCorruptError`; an unknown wire version raises
+    :class:`KVLeaseVersionError`. Schema-vs-session compatibility is
+    the importing session's job (:meth:`PagedSlotSession
+    .import_lease`) — this function needs no model."""
+    if not isinstance(blob, (bytes, bytearray, memoryview)):
+        raise KVLeaseCorruptError(
+            f"lease blob must be bytes, got {type(blob).__name__}")
+    blob = bytes(blob)
+    if len(blob) < len(_LEASE_MAGIC) + 8 \
+            or blob[:len(_LEASE_MAGIC)] != _LEASE_MAGIC:
+        raise KVLeaseCorruptError(
+            "not a KV lease blob (bad magic or truncated header)")
+    frame, tail = blob[:-4], blob[-4:]
+    (frame_crc,) = struct.unpack("<I", tail)
+    computed = zlib.crc32(frame) & 0xFFFFFFFF
+    if computed != frame_crc:
+        raise KVLeaseCorruptError(
+            f"lease frame CRC mismatch (stored {frame_crc}, "
+            f"computed {computed}) — the blob was corrupted in "
+            "transit")
+    (hdr_len,) = struct.unpack_from("<I", frame, len(_LEASE_MAGIC))
+    start = len(_LEASE_MAGIC) + 4
+    if len(frame) < start + hdr_len:
+        raise KVLeaseCorruptError("lease header truncated")
+    try:
+        header = json.loads(frame[start:start + hdr_len].decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise KVLeaseCorruptError(
+            f"lease header is not valid JSON: {e}") from e
+    if not isinstance(header, dict):
+        raise KVLeaseCorruptError("lease header is not an object")
+    version = header.get("version")
+    if version != LEASE_WIRE_VERSION:
+        raise KVLeaseVersionError(
+            f"lease wire version {version!r} != supported "
+            f"{LEASE_WIRE_VERSION}")
+    payload = frame[start + hdr_len:]
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    if crc != header.get("payload_crc"):
+        raise KVLeaseCorruptError(
+            f"lease payload CRC mismatch (stored "
+            f"{header.get('payload_crc')!r}, computed {crc}) — the "
+            "blob was corrupted in transit")
+    return header, payload
 
 
 class PagedKVAllocator:
@@ -225,6 +331,18 @@ class PrefixCache:
             for chain in self._entries.values():
                 self._alloc.decref(chain)
             self._entries.clear()
+
+    def fingerprints(self, limit: int = 512) -> List[str]:
+        """Digests of the (up to ``limit``) most-recently-used
+        cached prefixes — the per-replica advertisement the fleet
+        router's prober scrapes for KV-aware routing. Entry keys ARE
+        the page-aligned token-prefix bytes, so hashing them here
+        matches :func:`prefix_fingerprint` over the same tokens."""
+        with self._lock:
+            keys = list(self._entries.keys())
+        keys = keys[-int(limit):]
+        return [format(zlib.crc32(k) & 0xFFFFFFFF, "08x")
+                for k in keys]
 
 
 class _Lease:
@@ -408,6 +526,182 @@ class PagedSlotSession:
     def release_all(self) -> None:
         for slot in list(self._leases):
             self.release(slot)
+
+    def register_written_prefix(self, slot: int, prompt) -> int:
+        """Donate the slot's FULLY-WRITTEN prompt pages to the
+        prefix cache without releasing the lease — the prefill-
+        export path's registration, where only ``slot_pos``
+        positions (all but the last prompt token) are in the cache
+        and the boundary page may be half-written. Returns how many
+        pages were registered."""
+        lease = self._leases.get(slot)
+        if lease is None:
+            return 0
+        pos = int(self.slot_pos[slot])
+        prompt = np.asarray(prompt).reshape(-1)
+        n_full = min(pos, prompt.size) // self.page_size
+        if n_full > 0:
+            self.prefix_cache.register(prompt, lease.pages[:n_full])
+        return n_full
+
+    # ---- lease serialization: the prefill→decode / drain-migration
+    #      wire format. A slot's attention state is its page-table
+    #      pages' contents plus its position; everything else about
+    #      the stream (prompt, sampled tokens, rng) is the CALLER's
+    #      ``extra`` dict, carried opaquely in the header ----
+    def _pool_schema(self) -> List[Optional[List[dict]]]:
+        """Per-layer leaf schema (page-row shape + dtype) — what two
+        replicas must agree on for a lease to be portable. None for
+        stateless layers."""
+        import jax
+        schema: List[Optional[List[dict]]] = []
+        for pool in self._pools:
+            if pool is None:
+                schema.append(None)
+                continue
+            leaves = jax.tree_util.tree_leaves(pool)
+            schema.append([{"shape": list(leaf.shape[1:]),
+                            "dtype": str(leaf.dtype)}
+                           for leaf in leaves])
+        return schema
+
+    def export_lease(self, slot: int,
+                     extra: Optional[dict] = None) -> bytes:
+        """Serialize slot ``slot``'s attention state: a versioned
+        header (wire version, page size, position, per-layer pool
+        schema, the caller's ``extra``) followed by the raw contents
+        of every page the stream has written, CRC-tagged. The slot
+        and its lease are left untouched — the caller decides
+        whether the incumbent keeps decoding (failed handoff) or
+        releases (acked migration). Device→host gather happens here,
+        one fixed-shape fetch per (layer leaf, page)."""
+        import jax
+        lease = self._leases.get(slot)
+        if lease is None:
+            raise ValueError(f"slot {slot} holds no lease to export")
+        pos = int(self.slot_pos[slot])
+        # only pages with WRITTEN positions travel: [0, pos)
+        pages_written = _pages_for(pos, self.page_size) if pos else 0
+        page_ids = lease.pages[:pages_written]
+        chunks: List[bytes] = []
+        for pool in self._pools:
+            if pool is None:
+                continue
+            for leaf in jax.tree_util.tree_leaves(pool):
+                for pid in page_ids:
+                    chunks.append(np.ascontiguousarray(
+                        np.asarray(leaf[pid])).tobytes())
+        payload = b"".join(chunks)
+        header = {
+            "version": LEASE_WIRE_VERSION,
+            "page_size": self.page_size,
+            "pos": pos,
+            "pages_written": pages_written,
+            "layers": self._pool_schema(),
+            "payload_crc": zlib.crc32(payload) & 0xFFFFFFFF,
+            "extra": dict(extra or {}),
+        }
+        hdr = json.dumps(header).encode()
+        frame = (_LEASE_MAGIC + struct.pack("<I", len(hdr)) + hdr
+                 + payload)
+        # trailing frame CRC over EVERYTHING (header included): the
+        # payload CRC alone would let a bit flip inside the header —
+        # pos, rng state, an emitted token — import silently-wrong
+        # stream state instead of failing typed
+        return frame + struct.pack("<I", zlib.crc32(frame)
+                                   & 0xFFFFFFFF)
+
+    def import_lease(self, blob: bytes,
+                     total_tokens: int) -> Tuple[_Lease, dict]:
+        """Rebuild an exported lease into THIS session's pool:
+        validate the blob (magic/CRC → :class:`KVLeaseCorruptError`;
+        wire version / page size / pool schema skew →
+        :class:`KVLeaseVersionError`), reserve ``total_tokens``'
+        worth of fresh pages (all-or-nothing, prefix cache evicted
+        under pressure exactly like :meth:`reserve`), and scatter the
+        payload pages into the physical pools — the rebuilt
+        attention state is bit-identical to the exporter's (same
+        bytes at the same in-page positions; everything past ``pos``
+        is masked). Returns ``(lease, extra)``; bind the lease like
+        any reservation."""
+        import jax
+        header, payload = parse_lease(blob)
+        # every header field a crafted/corrupt blob controls is
+        # validated TYPED here: this runs on the batcher worker
+        # thread, and an untyped KeyError/IndexError would crash the
+        # whole decode loop instead of failing one request
+        try:
+            page_size = int(header["page_size"])
+            pos = int(header["pos"])
+            pages_written = int(header["pages_written"])
+            layers = header["layers"]
+        except (KeyError, TypeError, ValueError) as e:
+            raise KVLeaseCorruptError(
+                f"lease header field missing or malformed: "
+                f"{e!r}") from e
+        if page_size != self.page_size:
+            raise KVLeaseVersionError(
+                f"lease page_size {page_size} != this "
+                f"session's {self.page_size}")
+        schema = self._pool_schema()
+        if layers != schema:
+            raise KVLeaseVersionError(
+                "lease pool schema does not match this model's "
+                "attention layers (different model or dtype)")
+        if pos < 0 or pages_written != _pages_for(pos,
+                                                  self.page_size):
+            raise KVLeaseCorruptError(
+                f"lease header inconsistent: pos {pos} does not "
+                f"need {pages_written} page(s) of {self.page_size} "
+                "tokens")
+        if pos > int(total_tokens):
+            raise KVLeaseCorruptError(
+                f"lease position {pos} exceeds the request's token "
+                f"budget {total_tokens}")
+        total_pages = _pages_for(total_tokens, self.page_size)
+        fresh = self.allocator.alloc(total_pages,
+                                     evictor=self.prefix_cache)
+        try:
+            import jax.numpy as jnp
+            n_leaf_rows = sum(len(s) for s in schema
+                              if s is not None)
+            row_bytes = [np.dtype(d["dtype"]).itemsize
+                         * int(np.prod(d["shape"]))
+                         for s in schema if s is not None
+                         for d in s]
+            expect = sum(b * pages_written for b in row_bytes)
+            if len(payload) != expect:
+                raise KVLeaseCorruptError(
+                    f"lease payload is {len(payload)} bytes; schema "
+                    f"demands {expect} ({n_leaf_rows} pool leaves x "
+                    f"{pages_written} pages)")
+            off = 0
+            for i, pool in enumerate(self._pools):
+                if pool is None:
+                    continue
+                leaves, treedef = jax.tree_util.tree_flatten(pool)
+                new_leaves = []
+                for leaf, spec in zip(leaves, schema[i]):
+                    shape = tuple(spec["shape"])
+                    dtype = np.dtype(spec["dtype"])
+                    nb = dtype.itemsize * int(np.prod(shape))
+                    for k in range(pages_written):
+                        page = np.frombuffer(
+                            payload, dtype=dtype, count=nb
+                            // dtype.itemsize, offset=off
+                        ).reshape(shape)
+                        off += nb
+                        leaf = leaf.at[fresh[k]].set(
+                            jnp.asarray(page))
+                    new_leaves.append(leaf)
+                self._pools[i] = jax.tree_util.tree_unflatten(
+                    treedef, new_leaves)
+        except BaseException:
+            self.allocator.decref(fresh)
+            raise
+        lease = _Lease(fresh, pos, prefix_hit_tokens=0,
+                       prompt_len=pos)
+        return lease, dict(header.get("extra") or {})
 
     # ---- device step ----
     def _device_copy_page(self, dst: int, src: int) -> None:

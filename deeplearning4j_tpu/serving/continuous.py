@@ -36,25 +36,77 @@ tokens are bit-identical either way — tested).
 from __future__ import annotations
 
 import queue
+import threading
 import time
-from typing import List, Optional
+import uuid
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from deeplearning4j_tpu import chaos
 from deeplearning4j_tpu.observability.tracing import RequestContext
 from deeplearning4j_tpu.serving import tiers
-from deeplearning4j_tpu.serving.errors import KVPagePoolExhaustedError
+from deeplearning4j_tpu.serving.errors import (KVLeaseError,
+                                               KVPagePoolExhaustedError,
+                                               ServingError)
 from deeplearning4j_tpu.serving.lifecycle import (BaseRequest,
                                                   CircuitBreaker,
                                                   ServingBackend)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
-__all__ = ["ContinuousBatcher"]
+__all__ = ["ContinuousBatcher", "MigrationOffer"]
+
+
+def _migrate_chaos(blob: bytes) -> bytes:
+    """The ``serving.kv.migrate`` chaos site, hit once per lease hop
+    (export and import): ``error`` raises a transient ChaosIOError
+    (an export that fails leaves the stream on the incumbent; a
+    failed import makes the router fall back), ``slow`` stalls the
+    hop, ``corrupt`` flips one payload byte AFTER the CRC was
+    stamped — the importer's integrity check must catch it."""
+    fault = chaos.hit("serving.kv.migrate")
+    if fault is None:
+        return blob
+    if fault.kind == "error":
+        raise chaos.ChaosIOError(
+            f"[chaos] KV lease hop failed at ordinal "
+            f"#{fault.ordinal}")
+    if fault.kind == "slow":
+        time.sleep(float(fault.args.get("delay_s", 0.1)))
+        return blob
+    if fault.kind == "corrupt" and len(blob) > 8:
+        # ordinal-spread flip index: an export-side and an
+        # import-side corruption in one run must not XOR the same
+        # byte back to clean
+        b = bytearray(blob)
+        b[-1 - (fault.ordinal % 4)] ^= 0xFF
+        return bytes(b)
+    return blob
+
+
+class MigrationOffer:
+    """A request completed with an OFFER instead of tokens: the
+    draining backend exported the stream's KV lease and parked its
+    slot. Whoever holds the response (the fleet router) either
+    imports the ``blob`` on a survivor and ``/v1/kv/ack``s the
+    ``handle`` (the parked pages free), or ``/v1/kv/resume``s it —
+    the stream un-parks and finishes on the incumbent. A parked slot
+    nobody claims within the failsafe window auto-resumes."""
+
+    __slots__ = ("handle", "blob", "pos", "tokens_out")
+
+    def __init__(self, handle: str, blob: bytes, pos: int,
+                 tokens_out: int):
+        self.handle = handle
+        self.blob = blob
+        self.pos = int(pos)
+        self.tokens_out = int(tokens_out)
 
 
 class _GenRequest(BaseRequest):
-    __slots__ = ("prompt", "n_tokens", "temperature", "seed")
+    __slots__ = ("prompt", "n_tokens", "temperature", "seed",
+                 "prefill_export", "export_extra", "import_blob",
+                 "import_state")
 
     def __init__(self, prompt, n_tokens, temperature, seed, deadline):
         super().__init__(deadline)
@@ -62,11 +114,20 @@ class _GenRequest(BaseRequest):
         self.n_tokens = n_tokens
         self.temperature = temperature
         self.seed = seed
+        # disaggregated-serving shapes of the same request: a
+        # prefill-only submission completes with an exported lease
+        # blob instead of tokens; an imported one starts from a
+        # rebuilt lease instead of a cold prefill
+        self.prefill_export = False
+        self.export_extra: Optional[dict] = None
+        self.import_blob: Optional[bytes] = None
+        self.import_state: Optional[dict] = None
 
 
 class _Slot:
     __slots__ = ("req", "feed", "prompt_left", "out", "rng",
-                 "t_slotted", "t_last_token", "prefix_hit")
+                 "t_slotted", "t_last_token", "prefix_hit", "parked",
+                 "no_migrate")
 
     def __init__(self, req: _GenRequest, resume: int = 0):
         # ``resume``: prompt positions [0, resume) are already in the
@@ -82,6 +143,36 @@ class _Slot:
                     if req.temperature > 0 else None)
         self.t_slotted = time.monotonic()
         self.t_last_token: Optional[float] = None
+        # parked = mid-migration: the slot holds its pages and is
+        # skipped by the device step until acked (released) or
+        # resumed (decoding continues here). A resumed stream sets
+        # no_migrate — the handoff already failed once; offering it
+        # again would ping-pong it forever.
+        self.parked = False
+        self.no_migrate = False
+
+    @classmethod
+    def restored(cls, req: _GenRequest, pos: int, out,
+                 rng_state) -> "_Slot":
+        """Rebuild a slot from an imported lease: ``pos`` KV
+        positions already written elsewhere, ``out`` tokens already
+        emitted. An out-empty restore is exactly the prefix-hit
+        shape (resume at ``pos``); a mid-decode one re-feeds the
+        last emitted token. The sampling rng resumes from the
+        exporter's serialized state so temperature streams stay
+        bit-identical across the hop."""
+        out = [int(t) for t in (out or [])]
+        if out:
+            s = cls(req, resume=len(req.prompt) - 1)
+            s.prompt_left = []
+            s.feed = out[-1]
+            s.out = out
+        else:
+            s = cls(req, resume=pos)
+        s.prefix_hit = int(pos)
+        if rng_state is not None and s.rng is not None:
+            s.rng.bit_generator.state = rng_state
+        return s
 
 
 class ContinuousBatcher(ServingBackend):
@@ -100,7 +191,8 @@ class ContinuousBatcher(ServingBackend):
                  breaker: Optional[CircuitBreaker] = None,
                  version: str = "0", kv_mode: str = "auto",
                  page_size: int = 16,
-                 kv_pages: Optional[int] = None):
+                 kv_pages: Optional[int] = None,
+                 model_name: Optional[str] = None):
         if kv_mode not in ("auto", "paged", "dense"):
             raise ValueError(
                 f"kv_mode must be auto|paged|dense, got {kv_mode!r}")
@@ -146,6 +238,12 @@ class ContinuousBatcher(ServingBackend):
         # version — a whole-request histogram can't show a
         # first-token stall inside an otherwise-fast stream
         self._stream = self.metrics.streaming(name, version)
+        self.version = version
+        # registry identity (the MODEL name, not the backend name):
+        # exported leases carry it so an importing replica can
+        # resolve the same model — without it a drain offer can only
+        # ever resume on the incumbent
+        self.model_name = model_name
         self.slots = slots
         self.capacity = capacity
         self._slots: List[Optional[_Slot]] = [None] * slots
@@ -162,6 +260,14 @@ class ContinuousBatcher(ServingBackend):
         # higher-tier ones each grabbing the pages it was waiting
         # for — the pre-tier FIFO no-starvation contract, kept
         self._kv_blocked: Optional[_GenRequest] = None
+        # drain-migration state: request_migration() arms the flag;
+        # the worker loop then exports every active paged slot as a
+        # MigrationOffer and parks it until acked / resumed /
+        # failsafe-expired (migrate_resume_timeout_s)
+        self._migrate = threading.Event()
+        self._migrate_lock = threading.Lock()
+        self._parked: Dict[str, dict] = {}
+        self.migrate_resume_timeout_s = 10.0
         self._start_worker()
 
     # ---- paged-KV observability ----
@@ -191,6 +297,27 @@ class ContinuousBatcher(ServingBackend):
                                     sess.pages_in_use)
         self.metrics.register_gauge(f"{self.name}_kv_pages_total",
                                     sess.pages_total)
+        # JSON-snapshot mirrors of the prefix-cache counters: the
+        # fleet router's prober reads the gauges dict, so fleet-wide
+        # prefix-cache effectiveness must be summable from there the
+        # same way kv_pages_* already are
+        cache = sess.prefix_cache
+        self.metrics.register_gauge(
+            f"{self.name}_prefix_cache_hits_total",
+            lambda c=cache: c.hits_total)
+        self.metrics.register_gauge(
+            f"{self.name}_prefix_cache_evictions_total",
+            lambda c=cache: c.evictions_total)
+        # disaggregation traffic: prefill handoffs + drain offers
+        # leaving this backend, exported streams rebuilt into it
+        self._kv_exports = reg.counter(
+            "kv_stream_exports_total",
+            help="KV leases exported (prefill handoffs + drain "
+                 "migration offers)", labels=lbl)
+        self._kv_imports = reg.counter(
+            "kv_stream_imports_total",
+            help="exported streams rebuilt into this backend's "
+                 "page pool", labels=lbl)
 
     def _unregister_gauges(self) -> None:
         super()._unregister_gauges()
@@ -199,6 +326,10 @@ class ContinuousBatcher(ServingBackend):
                 f"{self.name}_kv_pages_in_use")
             self.metrics.unregister_gauge(
                 f"{self.name}_kv_pages_total")
+            self.metrics.unregister_gauge(
+                f"{self.name}_prefix_cache_hits_total")
+            self.metrics.unregister_gauge(
+                f"{self.name}_prefix_cache_evictions_total")
             lbl = {"endpoint": self.name}
             self.metrics.registry.unregister("kv_pages_in_use",
                                              labels=lbl)
@@ -227,7 +358,9 @@ class ContinuousBatcher(ServingBackend):
     def submit(self, prompt, n_tokens: int, temperature: float = 0.0,
                seed: int = 0,
                timeout: Optional[float] = None,
-               ctx=None, tier: Optional[str] = None) -> _GenRequest:
+               ctx=None, tier: Optional[str] = None,
+               prefill_export: bool = False,
+               export_extra: Optional[dict] = None) -> _GenRequest:
         """Enqueue one generate request. ``prompt`` is a 1-d (or
         (1, T0)) sequence of token ids; returns a waitable handle.
         ``ctx`` is the request's trace context (minted at HTTP
@@ -238,6 +371,13 @@ class ContinuousBatcher(ServingBackend):
         first and slots are granted weighted-fair."""
         probe = self._admit_guard()
         tier = tiers.parse_tier(tier)
+        if prefill_export and not self._paged:
+            # the exported artifact IS the page set; a dense session
+            # has no portable representation of its cache rows
+            raise ServingError(
+                f"{self.name!r} decodes over a dense KV session; "
+                "prefill export needs kv_mode=paged (or auto with a "
+                "transformer model)")
         prompt = np.asarray(prompt)
         if prompt.ndim > 1 and prompt.shape[0] != 1:
             # a (B, T) batch of prompts is NOT one request: silently
@@ -280,6 +420,9 @@ class ContinuousBatcher(ServingBackend):
         r.ctx = ctx
         r.probe = probe
         r.tier = tier
+        r.prefill_export = bool(prefill_export)
+        r.export_extra = dict(export_extra or {}) if prefill_export \
+            else None
         return self._enqueue(r)
 
     def generate(self, prompt, n_tokens: int, temperature: float = 0.0,
@@ -289,6 +432,90 @@ class ContinuousBatcher(ServingBackend):
         return self.wait(self.submit(prompt, n_tokens, temperature,
                                      seed, timeout=timeout, ctx=ctx,
                                      tier=tier))
+
+    # ---- disaggregated prefill/decode (models/paged_kv.py leases) --
+    def prefill_export(self, prompt, n_tokens: int,
+                       temperature: float = 0.0, seed: int = 0,
+                       timeout: Optional[float] = None, ctx=None,
+                       tier: Optional[str] = None,
+                       export_extra: Optional[dict] = None) -> bytes:
+        """Run the prompt's prefill (all but the last token) and
+        return the stream's serialized KV lease instead of decoding:
+        the prefill half of disaggregated serving. The blob imports
+        on any replica holding the same model
+        (:meth:`import_stream`), which resumes at the last prompt
+        token and streams the completion — token-for-token identical
+        to running the whole request here."""
+        return self.wait(self.submit(
+            prompt, n_tokens, temperature, seed, timeout=timeout,
+            ctx=ctx, tier=tier, prefill_export=True,
+            export_extra=export_extra))
+
+    def import_stream(self, blob: bytes,
+                      timeout: Optional[float] = None, ctx=None,
+                      tier: Optional[str] = None,
+                      header: Optional[dict] = None) -> _GenRequest:
+        """Admit an exported stream (a prefill handoff or a
+        drain-migration offer): validate the blob, reconstruct the
+        request, and queue it for slotting — where the lease is
+        rebuilt into this session's page pool and decode resumes
+        mid-stream. Corrupt blobs raise
+        :class:`~.errors.KVLeaseCorruptError`, version/model skew
+        :class:`~.errors.KVLeaseVersionError` (both at submit, both
+        mapped to 422 — re-sending a bad blob elsewhere cannot
+        help). Pool pressure parks the request pending exactly like
+        a cold reservation."""
+        from deeplearning4j_tpu.models.paged_kv import parse_lease
+        probe = self._admit_guard()
+        tier = tiers.parse_tier(tier)
+        if not self._paged:
+            raise ServingError(
+                f"{self.name!r} decodes over a dense KV session; "
+                "lease import needs kv_mode=paged")
+        blob = _migrate_chaos(bytes(blob))
+        if header is None:
+            # synchronous integrity gate (callers that already
+            # parsed the blob — the HTTP handler resolving the model
+            # — pass the header so the payload CRC runs once here
+            # and once, authoritatively, at admission)
+            header, _ = parse_lease(blob)
+        extra = dict(header.get("extra") or {})
+        prompt = np.asarray(extra.get("prompt", []),
+                            np.int64).reshape(-1)
+        n_tokens = int(extra.get("n_tokens", 0))
+        if prompt.size == 0 or n_tokens < 1:
+            raise KVLeaseError(
+                "lease extra lacks the stream state (prompt / "
+                "n_tokens) — not a stream export")
+        if prompt.size + n_tokens > self.capacity:
+            raise ValueError(
+                f"imported stream's prompt ({prompt.size}) + "
+                f"n_tokens ({n_tokens}) exceeds slot capacity "
+                f"{self.capacity}")
+        if not self.session.can_ever_fit(prompt.size, n_tokens):
+            raise ValueError(
+                f"imported stream needs more KV pages than the "
+                f"whole pool ({self.session.pages_total()} pages of "
+                f"{self.session.page_size} tokens)")
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        if ctx is None:
+            ctx = RequestContext(route=self.name, deadline=deadline)
+        req_tier = tiers.parse_tier(extra.get("tier")) \
+            if extra.get("tier") else tier
+        ctx.attrs["tier"] = req_tier
+        ctx.phase_done("admission", now_in="queue_wait")
+        r = _GenRequest(prompt, n_tokens,
+                        float(extra.get("temperature", 0.0)),
+                        int(extra.get("seed", 0)), deadline)
+        r.ctx = ctx
+        r.probe = probe
+        r.tier = req_tier
+        r.import_blob = blob
+        r.import_state = {"pos": int(header.get("pos", 0)),
+                          "out": extra.get("out") or [],
+                          "rng_state": extra.get("rng_state")}
+        return self._enqueue(r)
 
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s is not None)
@@ -361,6 +588,81 @@ class ContinuousBatcher(ServingBackend):
             else:
                 nxt = self._next_pending()
             resume = 0
+            slot_obj = None
+            if self._paged and self._pending[nxt].import_blob \
+                    is not None:
+                # an exported stream re-entering: the lease rebuilds
+                # into THIS pool (fresh pages, payload scattered in)
+                # and decode resumes where the exporter stopped
+                head = self._pending[nxt]
+                try:
+                    lease, _ = self.session.import_lease(
+                        head.import_blob,
+                        head.prompt.size + head.n_tokens)
+                except KVPagePoolExhaustedError:
+                    self._kv_blocked = head
+                    return
+                except Exception as e:
+                    # the blob itself is bad (typed KVLeaseError) —
+                    # or something the validators missed: either
+                    # way /v1/kv/import is a public surface, and an
+                    # escaped exception HERE would crash the worker
+                    # loop and fail every active stream, so the
+                    # request fails typed and admission continues
+                    if not isinstance(e, KVLeaseError):
+                        e = KVLeaseError(
+                            f"lease import failed: {e!r}")
+                    self._pending.pop(nxt)
+                    if head is self._kv_blocked:
+                        self._kv_blocked = None
+                    self._endpoint.count_error()
+                    self._deliver_failure(head, e)
+                    continue
+                r = self._pending.pop(nxt)
+                if r is self._kv_blocked:
+                    self._kv_blocked = None
+                st = r.import_state or {}
+                out_toks = st.get("out") or []
+                pos_val = int(st.get("pos", lease.resume_pos))
+                if not out_toks and pos_val >= r.prompt.size:
+                    # an out-empty restore re-feeds prompt[pos]; a
+                    # blob claiming more written positions than the
+                    # prompt has would index past it — fail typed,
+                    # give the reservation back
+                    self.session.allocator.decref(lease.pages)
+                    self._endpoint.count_error()
+                    self._deliver_failure(r, KVLeaseError(
+                        f"lease position {pos_val} exceeds the "
+                        f"prompt length {r.prompt.size} with no "
+                        "emitted tokens"))
+                    continue
+                self.session.bind(free[0], lease)
+                try:
+                    slot_obj = _Slot.restored(
+                        r, pos_val, out_toks, st.get("rng_state"))
+                except Exception as e:
+                    # e.g. a malformed rng state: the slot is bound,
+                    # so release() returns the pages; the request
+                    # fails typed, the worker survives
+                    self.session.release(free[0])
+                    self._endpoint.count_error()
+                    self._deliver_failure(r, KVLeaseError(
+                        f"lease stream state failed to restore: "
+                        f"{e!r}"))
+                    continue
+                self._sync_evictions()
+                self._kv_imports.inc()
+                resume = slot_obj.prefix_hit
+                if r.ctx is not None:
+                    r.ctx.attrs["kv_imported_tokens"] = resume
+                    r.ctx.phase_done(
+                        "queue_wait",
+                        now_in="decode" if slot_obj.out
+                        else "prefill",
+                        attrs={"slot": free[0],
+                               "kv_imported_tokens": resume})
+                self._slots[free[0]] = slot_obj
+                continue
             if self._paged:
                 # admission asks the allocator: pages for this
                 # request's worst case, reusing cached prefix pages.
@@ -400,7 +702,13 @@ class ContinuousBatcher(ServingBackend):
                 r.ctx.attrs["prefix_hit_tokens"] = resume
                 r.ctx.phase_done("queue_wait", now_in="prefill",
                                  attrs=attrs)
-            self._slots[free[0]] = _Slot(r, resume)
+            slot_obj = _Slot(r, resume)
+            self._slots[free[0]] = slot_obj
+            if r.prefill_export and not slot_obj.prompt_left:
+                # the whole prefill was covered by cached pages (or
+                # a one-token prompt): the export point is already
+                # here — no device step needed
+                self._finish_prefill_export(free[0], slot_obj)
 
     @staticmethod
     def _sample(probs: np.ndarray, slot: _Slot) -> int:
@@ -418,16 +726,221 @@ class ContinuousBatcher(ServingBackend):
         p = p / p.sum()
         return int(slot.rng.choice(p.size, p=p))
 
+    # ---- drain migration (the fleet's zero-downtime replace) ----
+    def _stream_extra(self, s: _Slot) -> dict:
+        """The stream state a lease blob carries besides the pages:
+        everything the importing batcher needs to resume decoding
+        bit-identically."""
+        extra = {"prompt": [int(t) for t in s.req.prompt],
+                 "out": [int(t) for t in s.out],
+                 "n_tokens": int(s.req.n_tokens),
+                 "temperature": float(s.req.temperature),
+                 "seed": int(s.req.seed),
+                 "tier": s.req.tier}
+        if s.rng is not None:
+            extra["rng_state"] = s.rng.bit_generator.state
+        if self.model_name is not None:
+            extra["model"] = self.model_name
+            try:
+                extra["version"] = int(self.version)
+            except (TypeError, ValueError):
+                pass
+        if s.req.export_extra:
+            extra.update(s.req.export_extra)
+        return extra
+
+    def _finish_prefill_export(self, i: int, s: _Slot) -> None:
+        """Complete a prefill-only request: serialize the slot's
+        lease, donate the fully-written prompt pages to the local
+        prefix cache (a later identical prompt prefills free here
+        too), and recycle the slot. Runs on the worker thread at the
+        export point — every prompt position except the last is in
+        the KV cache."""
+        ctx = s.req.ctx
+        try:
+            blob = _migrate_chaos(self.session.export_lease(
+                i, extra=self._stream_extra(s)))
+        except BaseException as e:
+            self._endpoint.count_error()
+            self._deliver_failure(s.req, e)
+            self._release_slot(i)
+            return
+        self.session.register_written_prefix(i, s.req.prompt)
+        self._kv_exports.inc()
+        pos = int(self.session.slot_pos[i])
+        s.req.result = blob
+        if ctx is not None:
+            ctx.attrs["kv_exported_tokens"] = pos
+            ctx.phase_done("prefill", now_in="respond",
+                           attrs={"kv_exported_tokens": pos})
+        s.req.event.set()
+        self._release_slot(i)
+
+    def _offer_migration(self, i: int, s: _Slot) -> None:
+        """Export one live stream and PARK its slot: the waiting
+        request completes with a :class:`MigrationOffer` (the 202
+        the router turns into an import-on-survivor), while the
+        pages stay resident so a failed handoff can resume here. A
+        chaos/export failure is silent: the stream simply keeps
+        decoding on this backend — finish-on-incumbent."""
+        try:
+            blob = _migrate_chaos(self.session.export_lease(
+                i, extra=self._stream_extra(s)))
+        except BaseException:
+            # one failed export decides the stream: it finishes on
+            # this backend (re-trying every iteration would gather
+            # the pages device→host once per step for nothing)
+            s.no_migrate = True
+            return
+        handle = uuid.uuid4().hex
+        with self._migrate_lock:
+            self._parked[handle] = {"slot": i, "state": "parked",
+                                    "t": time.monotonic()}
+        s.parked = True
+        self._kv_exports.inc()
+        pos = int(self.session.slot_pos[i])
+        ctx = s.req.ctx
+        offer = MigrationOffer(handle, blob, pos, len(s.out))
+        s.req.result = offer
+        if ctx is not None:
+            ctx.attrs["kv_migrated"] = True
+            ctx.phase_done("decode" if s.out else "prefill",
+                           now_in="respond",
+                           attrs={"kv_migrated": True})
+        s.req.event.set()
+
+    def _service_migration(self) -> None:
+        """Worker-side migration bookkeeping each iteration: free
+        acked slots, un-park resumed or failsafe-expired ones, and
+        offer every active stream once migration is armed."""
+        if not self._paged:
+            return
+        now = time.monotonic()
+        with self._migrate_lock:
+            entries = list(self._parked.items())
+        for handle, ent in entries:
+            i = ent["slot"]
+            s = self._slots[i]
+            if s is None:
+                with self._migrate_lock:
+                    self._parked.pop(handle, None)
+                continue
+            if ent["state"] == "acked":
+                # a survivor owns the stream now: drop the pages
+                self._release_slot(i)
+                with self._migrate_lock:
+                    self._parked.pop(handle, None)
+            elif ent["state"] == "resumed":
+                # failed handoff: finish here. The original context
+                # already closed with the offer response; the
+                # resume caller owns the fresh waiter.
+                s.req.ctx = None
+                s.parked = False
+                s.no_migrate = True
+                with self._migrate_lock:
+                    self._parked.pop(handle, None)
+            elif now - ent["t"] > self.migrate_resume_timeout_s:
+                # nobody claimed the offer (router died mid-drain, or
+                # a non-router caller got the 202): finish the decode
+                # so the pages free and the drain completes
+                s.req.ctx = None
+                s.parked = False
+                s.no_migrate = True
+                with self._migrate_lock:
+                    self._parked.pop(handle, None)
+        if self._migrate.is_set():
+            for i, s in enumerate(self._slots):
+                if s is not None and not s.parked \
+                        and not s.no_migrate \
+                        and not s.req.prefill_export \
+                        and not s.req.event.is_set():
+                    self._offer_migration(i, s)
+
+    def request_migration(self) -> int:
+        """Arm drain migration: every active stream is exported as a
+        :class:`MigrationOffer` on the next worker iteration (new
+        admissions keep being offered too until the backend stops).
+        Returns how many streams were live at the call — dense
+        backends return 0 and keep the PR-8 finish-in-place drain."""
+        if not self._paged:
+            return 0
+        n = sum(1 for s in self._slots
+                if s is not None and not s.parked)
+        self._migrate.set()
+        return n
+
+    def resume_stream(self, handle: str):
+        """Failed-handoff fallback: un-park the offered stream and
+        finish it HERE, returning the completed token array. The
+        caller (the router, after an import failed) blocks on the
+        backend's usual heartbeat wait."""
+        with self._migrate_lock:
+            ent = self._parked.get(handle)
+            if ent is None or ent["state"] != "parked":
+                raise ValueError(
+                    f"unknown or already-claimed migration handle "
+                    f"{handle!r}")
+            s = self._slots[ent["slot"]]
+            if s is None:
+                self._parked.pop(handle, None)
+                raise ValueError(
+                    f"migration handle {handle!r} no longer holds a "
+                    "stream")
+            r = s.req
+            r.event = threading.Event()
+            r.result = None
+            r.error = None
+            ent["state"] = "resumed"
+        return self.wait(r)
+
+    def has_migration(self, handle: str) -> bool:
+        """Does this backend hold the parked stream behind
+        ``handle`` (still unclaimed)?"""
+        with self._migrate_lock:
+            ent = self._parked.get(handle)
+            return ent is not None and ent["state"] == "parked"
+
+    def ack_migration(self, handle: str) -> bool:
+        """Successful handoff: the survivor imported the lease, so
+        the parked slot's pages free on the next worker iteration.
+        False when the handle is unknown/claimed (the failsafe may
+        have resumed it — the incumbent then finishes a stream the
+        survivor also runs; idempotent for the client, who only ever
+        sees the survivor's response)."""
+        with self._migrate_lock:
+            ent = self._parked.get(handle)
+            if ent is None or ent["state"] != "parked":
+                return False
+            ent["state"] = "acked"
+        return True
+
+    def prefix_digest(self, limit: int = 512) -> Optional[dict]:
+        """The replica-side advertisement for KV-aware routing: this
+        backend's page size and the fingerprints of its cached
+        prompt prefixes (None on the dense path)."""
+        if not self._paged:
+            return None
+        return {"page_size": self.session.page_size,
+                "prefixes":
+                    self.session.prefix_cache.fingerprints(limit)}
+
     def _loop(self) -> None:
         while not self._stop.is_set():
-            have_active = any(s is not None for s in self._slots)
+            self._service_migration()
+            have_active = any(s is not None and not s.parked
+                              for s in self._slots)
             self._pump(block=not have_active and not self._pending)
             self._expire_pending()
             self._admit()
-            active = np.asarray([s is not None for s in self._slots])
+            active = np.asarray([s is not None and not s.parked
+                                 for s in self._slots])
             if not active.any():
                 if (self._draining.is_set() and self._queue.empty()
-                        and not self._pending):
+                        and not self._pending
+                        and not any(s is not None
+                                    for s in self._slots)):
+                    # parked slots count: a drain must not complete
+                    # while an un-acked offer still owns pages
                     self._drained.set()
                 continue
             x = np.zeros((self.slots, 1, 1), np.float32)
@@ -478,6 +991,12 @@ class ContinuousBatcher(ServingBackend):
                     # still prefilling: teacher-force the next prompt
                     # token; this step's output is discarded
                     s.feed = s.prompt_left.pop(0)
+                    if not s.prompt_left and s.req.prefill_export:
+                        # the export point: every prompt position
+                        # except the last is in the KV cache — the
+                        # decode replica re-feeds the last token and
+                        # samples, bit-identical to staying here
+                        self._finish_prefill_export(i, s)
                     continue
                 try:
                     nxt = self._sample(h[i, 0], s)
@@ -498,11 +1017,15 @@ class ContinuousBatcher(ServingBackend):
                 if len(s.out) == 1:
                     # first emitted token: prefill ends, decode
                     # begins; TTFT measured from admission (what the
-                    # caller actually waited for a first token)
+                    # caller actually waited for a first token).
+                    # Prefix-hit streams (cache hits AND imported
+                    # leases) land in their own TTFT population so
+                    # the hit-vs-cold split is scrapeable.
                     if ctx is not None:
                         ctx.phase_done("prefill", now_in="decode")
                     self._stream.record_ttft(
-                        now_t - s.req.t_submit, trace_id=tid)
+                        now_t - s.req.t_submit, trace_id=tid,
+                        prefix_hit=s.prefix_hit > 0)
                 elif s.t_last_token is not None:
                     self._stream.record_itl(
                         now_t - s.t_last_token, trace_id=tid)
@@ -535,7 +1058,8 @@ class ContinuousBatcher(ServingBackend):
                 out.append({"slot": i, "state": "free"})
                 continue
             entry = {"slot": i,
-                     "state": "prefill" if s.prompt_left else "decode",
+                     "state": "parked" if s.parked
+                     else "prefill" if s.prompt_left else "decode",
                      "tokens_out": len(s.out),
                      "prompt_left": len(s.prompt_left),
                      "prefix_hit_tokens": s.prefix_hit,

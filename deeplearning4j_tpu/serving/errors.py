@@ -17,7 +17,8 @@ __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
            "ModelNotFoundError", "ServerClosedError",
            "CircuitOpenError", "ReplicaGoneError",
            "NoReplicaAvailableError", "KVPagePoolExhaustedError",
-           "ReplicaBootError"]
+           "ReplicaBootError", "KVLeaseError", "KVLeaseCorruptError",
+           "KVLeaseVersionError"]
 
 
 class ServingError(RuntimeError):
@@ -95,6 +96,29 @@ class NoReplicaAvailableError(ServingError):
     """Every replica in the fleet is dead, ejected, or draining: the
     router has nowhere to send the request (HTTP maps this to 503;
     ``retry_after_s`` is the soonest a replica may be readmitted)."""
+
+
+class KVLeaseError(ServingError):
+    """A serialized KV lease (the prefill→decode / drain-migration
+    wire blob from ``PagedSlotSession.export_lease``) could not be
+    imported. The blob itself is bad — re-sending it to another
+    replica cannot help, so the router falls back to recomputing the
+    stream from the original request (or resuming it on the
+    incumbent) instead of retrying the import (HTTP maps this to
+    422)."""
+
+
+class KVLeaseCorruptError(KVLeaseError):
+    """The lease blob failed its integrity checks (bad magic,
+    truncated payload, CRC mismatch) — bit rot or a corrupting hop,
+    never a version question."""
+
+
+class KVLeaseVersionError(KVLeaseError):
+    """The lease blob's schema does not match this replica: wire
+    format version skew, a different ``page_size``, or per-layer
+    pool shapes from a different model — importing it would rebuild
+    the wrong attention state."""
 
 
 class ReplicaBootError(ServingError):

@@ -56,10 +56,52 @@ from typing import Callable, Dict, List, Optional
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["ReplicaFleet", "InProcessReplica", "SubprocessReplica"]
+__all__ = ["ReplicaFleet", "InProcessReplica", "SubprocessReplica",
+           "parse_roles"]
 
 # fleet_state lifecycle: up -> draining -> dead (kill skips draining)
 UP, DRAINING, DEAD = "up", "draining", "dead"
+
+# disaggregated-serving roles: a PREFILL replica runs prompts and
+# exports KV leases, a DECODE replica imports them and streams the
+# completion, MIXED does both (the pre-disaggregation default). The
+# router reads the role off the fleet snapshot per pick.
+PREFILL, DECODE, MIXED = "prefill", "decode", "mixed"
+ROLES = (PREFILL, DECODE, MIXED)
+
+
+def parse_roles(spec, n: Optional[int] = None) -> List[str]:
+    """``"prefill=1,decode=3"`` (or a plain list) → per-replica role
+    list, boot order. With ``n`` given, the list must sum to it —
+    the CLI's ``--roles``/``--replicas`` consistency check."""
+    if spec is None:
+        return [MIXED] * (n or 0)
+    if isinstance(spec, (list, tuple)):
+        roles = [str(r) for r in spec]
+    else:
+        roles = []
+        for part in str(spec).split(","):
+            name, _, count = part.partition("=")
+            name = name.strip()
+            if name not in ROLES:
+                raise ValueError(
+                    f"unknown replica role {name!r}; known: "
+                    f"{ROLES}")
+            try:
+                k = int(count) if count else 1
+            except ValueError:
+                raise ValueError(
+                    f"bad role count in {part!r}") from None
+            roles.extend([name] * k)
+    bad = [r for r in roles if r not in ROLES]
+    if bad:
+        raise ValueError(f"unknown replica role(s) {bad}; known: "
+                         f"{ROLES}")
+    if n is not None and len(roles) != n:
+        raise ValueError(
+            f"roles name {len(roles)} replica(s) but the fleet has "
+            f"{n} — make them agree")
+    return roles
 
 
 class _BaseReplica:
@@ -73,6 +115,9 @@ class _BaseReplica:
         # fleet_state is the FLEET's intent (up/draining/dead); the
         # router's health view (ok/degraded/dead) is probed, not told
         self.fleet_state = UP
+        # disaggregation role (prefill/decode/mixed) — routing
+        # intent, also the fleet's to declare
+        self.role = MIXED
 
     @property
     def url(self) -> str:
@@ -89,6 +134,13 @@ class _BaseReplica:
 
     def hang(self, delay_s: float) -> None:
         raise NotImplementedError
+
+    def migrate(self) -> int:
+        """Arm drain migration on the replica's generate backends
+        (active streams export as offers the router re-homes).
+        Returns the number of live streams offered; 0 when the
+        replica has no paged decode state."""
+        return 0
 
 
 class InProcessReplica(_BaseReplica):
@@ -142,6 +194,11 @@ class InProcessReplica(_BaseReplica):
     def hang(self, delay_s: float) -> None:
         if self.server is not None:
             self.server.chaos_delay_s = float(delay_s)
+
+    def migrate(self) -> int:
+        if self.server is None:
+            return 0
+        return self.server.migrate_streams()
 
 
 class SubprocessReplica(_BaseReplica):
@@ -204,6 +261,28 @@ class SubprocessReplica(_BaseReplica):
             "hang needs in-process reach; use an InProcessReplica "
             "or SIGSTOP the child yourself")
 
+    def migrate(self) -> int:
+        """The HTTP form of the migrate verb — a subprocess replica
+        is only reachable over its listener."""
+        import http.client
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=5.0)
+        try:
+            conn.request("POST", "/v1/kv/migrate", body=b"{}",
+                         headers={"Content-Type":
+                                  "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return 0
+            import json as _json
+            return int(_json.loads(body.decode()
+                                   or "{}").get("parked", 0))
+        except OSError:
+            return 0
+        finally:
+            conn.close()
+
 
 class ReplicaFleet:
     """N replicas managed as one unit; the router holds a reference
@@ -213,7 +292,7 @@ class ReplicaFleet:
     def __init__(self, model_factory: Optional[Callable[[], Dict]] = None,
                  n: int = 2, server_kwargs: Optional[dict] = None,
                  model_specs: Optional[List[str]] = None,
-                 base_port: int = 0):
+                 base_port: int = 0, roles=None):
         if model_factory is None and not model_specs:
             raise ValueError("fleet needs a model_factory (in-process"
                              " replicas) or model_specs (subprocess)")
@@ -229,6 +308,11 @@ class ReplicaFleet:
         self._model_specs = list(model_specs or [])
         self._base_port = base_port
         self.n = n
+        # disaggregation roles, boot order ("prefill=1,decode=3" /
+        # list); replicas past the list (grow) boot MIXED, replace
+        # successors inherit the incumbent's role
+        self._roles = parse_roles(roles, n) if roles is not None \
+            else [MIXED] * n
         self._lock = threading.Lock()
         self._replicas: List[_BaseReplica] = []
         self._next_id = 0
@@ -252,17 +336,25 @@ class ReplicaFleet:
                 logger.exception("fleet change subscriber failed")
 
     # ---- construction ----
-    def _new_replica(self) -> _BaseReplica:
+    def _new_replica(self, role: Optional[str] = None
+                     ) -> _BaseReplica:
         with self._lock:
             rid = self._next_id
             self._next_id += 1
         if self._model_factory is not None:
-            return InProcessReplica(rid, self._model_factory,
-                                    self._server_kwargs)
-        return SubprocessReplica(rid, self._model_specs,
-                                 self._base_port + rid)
+            r = InProcessReplica(rid, self._model_factory,
+                                 self._server_kwargs)
+        else:
+            r = SubprocessReplica(rid, self._model_specs,
+                                  self._base_port + rid)
+        if role is not None:
+            r.role = role
+        elif rid < len(self._roles):
+            r.role = self._roles[rid]
+        return r
 
-    def _boot_replica(self) -> _BaseReplica:
+    def _boot_replica(self, role: Optional[str] = None
+                      ) -> _BaseReplica:
         """Boot ONE new replica through the ``serving.replica.boot``
         chaos site: ``boot_fail`` raises a typed
         :class:`~.errors.ReplicaBootError` before the listener opens
@@ -281,15 +373,15 @@ class ReplicaFleet:
                     f"#{fault.ordinal}")
             if fault.kind == "boot_slow":
                 time.sleep(float(fault.args.get("delay_s", 0.25)))
-        r = self._new_replica()
+        r = self._new_replica(role)
         try:
             return r.start()
         except Exception as e:
             raise ReplicaBootError(
                 f"replica {r.id} failed to boot: {e!r}") from e
 
-    def _boot_retrying(self, max_boot_retries: int = 3
-                       ) -> _BaseReplica:
+    def _boot_retrying(self, max_boot_retries: int = 3,
+                       role: Optional[str] = None) -> _BaseReplica:
         """Boot with bounded exponential backoff between failed
         attempts — a flaky boot path must not wedge the autoscaler's
         control loop, and a persistently failing one must fail TYPED
@@ -298,7 +390,7 @@ class ReplicaFleet:
         attempt = 0
         while True:
             try:
-                return self._boot_replica()
+                return self._boot_replica(role)
             except ReplicaBootError as e:
                 if attempt >= max_boot_retries:
                     raise
@@ -411,14 +503,15 @@ class ReplicaFleet:
                       for_s=fault.args.get("for_s"))
 
     # ---- elasticity (the autoscaler's verbs) ----
-    def grow(self, max_boot_retries: int = 3) -> _BaseReplica:
+    def grow(self, max_boot_retries: int = 3,
+             role: Optional[str] = None) -> _BaseReplica:
         """Boot-first scale-up: a fresh replica joins the pool only
         once its listener is actually up — booting capacity is never
         counted as serving capacity. Failed boots retry under
         bounded exponential backoff (``replica_boot_retries_total``);
         a spent retry budget raises :class:`~.errors.ReplicaBootError`
         for the caller to log and re-attempt next tick."""
-        successor = self._boot_retrying(max_boot_retries)
+        successor = self._boot_retrying(max_boot_retries, role=role)
         with self._lock:
             self._replicas.append(successor)
         logger.info("fleet: grew to %d replicas (replica %d up)",
@@ -445,6 +538,7 @@ class ReplicaFleet:
         self._notify()
         logger.info("fleet: retiring replica %d (drain-based "
                     "scale-down)", rid)
+        self._migrate_streams(target)
         ok = target.stop(drain=True, timeout=drain_timeout)
         if not ok:
             logger.warning("fleet: replica %d drain timed out after "
@@ -455,6 +549,24 @@ class ReplicaFleet:
                 self._replicas.remove(target)
         self._notify()
         return ok
+
+    def _migrate_streams(self, target: _BaseReplica) -> None:
+        """Best-effort mid-stream migration at drain start: the
+        replica's live generate streams export as 202 offers the
+        router re-homes onto survivors, so the drain below finishes
+        in milliseconds instead of a stream's lifetime. The router
+        already stopped new sends (DRAINING flipped before this);
+        replicas without paged decode state no-op and keep the PR-8
+        finish-in-place drain."""
+        try:
+            n = target.migrate()
+            if n:
+                logger.info("fleet: replica %d exporting %d live "
+                            "stream(s) for migration", target.id, n)
+        except Exception:
+            logger.exception("fleet: stream migration on replica "
+                             "%d failed; falling back to "
+                             "finish-in-place drain", target.id)
 
     def draining_count(self) -> int:
         """Members already on their way out (scale-down / replace
@@ -484,7 +596,14 @@ class ReplicaFleet:
         ``serving.replica.boot`` chaos site like any scale-up (one
         attempt — a failed replace boot raises before the incumbent
         is touched, so the pool is left intact)."""
-        successor = self._boot_replica()
+        with self._lock:
+            incumbent_role = (
+                self._replicas[pos % len(self._replicas)].role
+                if self._replicas else None)
+        # the successor inherits the incumbent's disaggregation role
+        # — a replace must not silently turn the fleet's only
+        # prefill replica into a mixed one
+        successor = self._boot_replica(role=incumbent_role)
         with self._lock:
             if not self._replicas:
                 # the pool was emptied (seeded kills can outpace a
@@ -505,6 +624,7 @@ class ReplicaFleet:
             return successor
         logger.info("fleet: replacing replica %d with %d", old.id,
                     successor.id)
+        self._migrate_streams(old)
         ok = old.stop(drain=True, timeout=drain_timeout)
         if not ok:
             logger.warning("fleet: replica %d drain timed out after "

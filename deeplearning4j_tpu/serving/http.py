@@ -37,6 +37,8 @@ then the listener stops.
 
 from __future__ import annotations
 
+import base64
+import binascii
 import collections
 import itertools
 import json
@@ -44,7 +46,7 @@ import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
@@ -52,9 +54,12 @@ import numpy as np
 from deeplearning4j_tpu.observability.tracing import (RequestContext,
                                                       Sampler,
                                                       get_tracer)
-from deeplearning4j_tpu.serving.continuous import ContinuousBatcher
+from deeplearning4j_tpu.serving.continuous import (ContinuousBatcher,
+                                                   MigrationOffer)
 from deeplearning4j_tpu.serving.errors import (CircuitOpenError,
                                                DeadlineExceededError,
+                                               KVLeaseCorruptError,
+                                               KVLeaseError,
                                                ModelNotFoundError,
                                                QueueFullError,
                                                ServerClosedError,
@@ -255,6 +260,11 @@ class ModelServer:
         self.kv_pages = kv_pages
         self._schedulers: Dict[Tuple[str, int], BatchScheduler] = {}
         self._batchers: Dict[Tuple[str, int], ContinuousBatcher] = {}
+        # batchers mid-drain: stop() clears _batchers before the
+        # concurrent drains, but /v1/kv/resume and /v1/kv/ack must
+        # still find a draining backend's parked streams — that is
+        # exactly when they arrive
+        self._stopping_batchers: List[ContinuousBatcher] = []
         self._lock = threading.Lock()
         self._create_locks: Dict[tuple, threading.Lock] = {}
         self._draining = threading.Event()
@@ -366,7 +376,8 @@ class ModelServer:
                 queue_limit=self.queue_limit, metrics=self.metrics,
                 name=f"generate/{name}/v{version}",
                 version=str(version), kv_mode=self.kv_mode,
-                page_size=self.page_size, kv_pages=self.kv_pages))
+                page_size=self.page_size, kv_pages=self.kv_pages,
+                model_name=name))
         return b, version
 
     def warmup(self, **kwargs) -> Dict[str, dict]:
@@ -425,6 +436,8 @@ class ModelServer:
                 elif path == "/v1/models":
                     self._send(200, {"models":
                                      server.registry.models()})
+                elif path == "/v1/kv/prefixes":
+                    self._send(200, server.kv_prefixes())
                 elif path == "/debug/requests":
                     self._send(200, server.debug_requests())
                 elif path == "/debug/slots":
@@ -440,8 +453,49 @@ class ModelServer:
                     self._serve_request(server._handle_predict, path)
                 elif path == "/v1/generate":
                     self._serve_request(server._handle_generate, path)
+                elif path == "/v1/kv/export":
+                    self._serve_request(server._handle_kv_export,
+                                        path)
+                elif path == "/v1/kv/import":
+                    self._serve_request(server._handle_kv_import,
+                                        path)
+                elif path in ("/v1/kv/migrate", "/v1/kv/resume",
+                              "/v1/kv/ack"):
+                    # migration control plane: these three MUST work
+                    # while the server drains (that is exactly when
+                    # they fire), so they bypass _serve_request's
+                    # draining refusal
+                    self._kv_control(path)
                 else:
                     self._send(404, {"error": "not found"})
+
+            def _kv_control(self, path):
+                if server.chaos_delay_s:
+                    time.sleep(server.chaos_delay_s)
+                try:
+                    body = self._body()
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"bad JSON: {e}"})
+                    return
+                try:
+                    if path == "/v1/kv/migrate":
+                        self._send(200, {"parked":
+                                         server.migrate_streams()})
+                    elif path == "/v1/kv/ack":
+                        self._send(200, {"acked":
+                                         server.kv_ack(
+                                             body.get("handle"))})
+                    else:
+                        self._send(200,
+                                   server.kv_resume(
+                                       body.get("handle")))
+                except (ValueError, KeyError, TypeError) as e:
+                    # an unknown/claimed handle is the caller's
+                    # answer, not a server fault: it falls back
+                    self._send(404, {"error": str(e)})
+                except Exception as e:
+                    logger.exception("kv control error")
+                    self._send(500, {"error": str(e)})
 
             def _serve_request(self, handler, route):
                 if server.chaos_delay_s:
@@ -498,13 +552,25 @@ class ModelServer:
                     # thread only, restored on exit — pooled HTTP
                     # threads cannot leak a request's context
                     with ctx.attach():
-                        send(200, handler(body, ctx=ctx))
+                        rv = handler(body, ctx=ctx)
+                    if isinstance(rv, tuple):
+                        # handlers may override the status (the 202
+                        # migration-offer shape)
+                        send(rv[0], rv[1])
+                    else:
+                        send(200, rv)
                 except QueueFullError as e:
                     err(429, e)
                 except DeadlineExceededError as e:
                     err(504, e)
                 except ModelNotFoundError as e:
                     err(404, e)
+                except KVLeaseError as e:
+                    # the lease blob itself is bad (corrupt bytes /
+                    # version skew): re-sending it anywhere cannot
+                    # help — 422 tells the router to fall back to
+                    # recompute/resume instead of retrying
+                    err(422, e)
                 except (ServerClosedError, CircuitOpenError) as e:
                     # both are "this backend cannot take work right
                     # now, retry later" — 503 for the load balancer
@@ -574,7 +640,20 @@ class ModelServer:
         return {"outputs": np.asarray(out).tolist(),
                 "model_version": version}
 
-    def _handle_generate(self, body: dict, ctx=None) -> dict:
+    @staticmethod
+    def _offer_payload(offer: MigrationOffer, version) -> Tuple[int,
+                                                                dict]:
+        """The 202 body a :class:`MigrationOffer` result becomes:
+        the router imports ``blob`` on a survivor and acks, or
+        resumes ``handle`` here."""
+        return 202, {"migration": {
+            "handle": offer.handle,
+            "blob": base64.b64encode(offer.blob).decode(),
+            "pos": offer.pos,
+            "tokens_out": offer.tokens_out,
+            "model_version": version}}
+
+    def _handle_generate(self, body: dict, ctx=None):
         if "model" not in body or "prompt" not in body:
             raise ValueError('generate body needs "model" and '
                              '"prompt"')
@@ -588,6 +667,68 @@ class ModelServer:
             seed=int(body.get("seed", 0)),
             timeout=self._timeout_s(body), ctx=ctx,
             tier=body.get("tier"))
+        if isinstance(ids, MigrationOffer):
+            # the backend started draining mid-stream and exported
+            # this stream's lease instead of finishing it
+            return self._offer_payload(ids, version)
+        return {"ids": np.asarray(ids).tolist(),
+                "model_version": version}
+
+    # ---- disaggregated prefill/decode + drain migration ----
+    def _handle_kv_export(self, body: dict, ctx=None):
+        """``POST /v1/kv/export`` — the prefill half: run the
+        prompt's prefill here, return the serialized lease for a
+        decode replica's ``/v1/kv/import``. Body = the generate
+        body."""
+        if "model" not in body or "prompt" not in body:
+            raise ValueError('kv export body needs "model" and '
+                             '"prompt"')
+        batcher, version = self.batcher_for(body["model"],
+                                            body.get("version"))
+        if ctx is not None:
+            ctx.attrs["model_version"] = version
+        blob = batcher.prefill_export(
+            body["prompt"], int(body.get("n_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)),
+            seed=int(body.get("seed", 0)),
+            timeout=self._timeout_s(body), ctx=ctx,
+            tier=body.get("tier"),
+            export_extra={"model": body["model"],
+                          "version": version})
+        if isinstance(blob, MigrationOffer):
+            return self._offer_payload(blob, version)
+        return {"blob": base64.b64encode(blob).decode(),
+                "model_version": version}
+
+    def _handle_kv_import(self, body: dict, ctx=None):
+        """``POST /v1/kv/import`` — rebuild an exported stream into
+        this replica's page pool and stream it to completion. The
+        lease's ``extra`` names the model; version/page/CRC skew
+        fail typed (422)."""
+        from deeplearning4j_tpu.models.paged_kv import parse_lease
+        if "blob" not in body:
+            raise ValueError('kv import body needs "blob"')
+        try:
+            blob = base64.b64decode(body["blob"], validate=True)
+        except (binascii.Error, ValueError, TypeError) as e:
+            raise KVLeaseCorruptError(
+                f"lease blob is not valid base64: {e}") from e
+        header, _ = parse_lease(blob)
+        extra = dict(header.get("extra") or {})
+        model = extra.get("model")
+        if not model:
+            raise KVLeaseError(
+                "lease extra names no model — exported outside the "
+                "serving stack?")
+        batcher, version = self.batcher_for(model,
+                                            extra.get("version"))
+        if ctx is not None:
+            ctx.attrs["model_version"] = version
+        ids = batcher.wait(batcher.import_stream(
+            blob, timeout=self._timeout_s(body), ctx=ctx,
+            tier=body.get("tier"), header=header))
+        if isinstance(ids, MigrationOffer):
+            return self._offer_payload(ids, version)
         return {"ids": np.asarray(ids).tolist(),
                 "model_version": version}
 
@@ -681,6 +822,62 @@ class ModelServer:
                 entry["kv"] = kv
             out[b.name] = entry
         return {"backends": out}
+
+    # ---- disaggregation / migration control plane ----
+    def _all_batchers(self) -> List[ContinuousBatcher]:
+        """Live + mid-drain generate backends — the handle-lookup
+        set for the migration control plane."""
+        with self._lock:
+            return (list(self._batchers.values())
+                    + list(self._stopping_batchers))
+
+    def migrate_streams(self) -> int:
+        """Arm drain migration on every paged generate backend:
+        active streams complete with 202 migration offers the fleet
+        router re-homes onto survivors. Returns how many live
+        streams will be offered. The fleet calls this right before
+        a retire/replace drain; ``POST /v1/kv/migrate`` is the
+        same verb for subprocess replicas."""
+        return sum(b.request_migration()
+                   for b in self._all_batchers())
+
+    def kv_ack(self, handle) -> bool:
+        """``POST /v1/kv/ack`` — a survivor imported the offered
+        stream; the parked pages free."""
+        if not handle:
+            raise ValueError('kv ack body needs "handle"')
+        return any(b.ack_migration(str(handle))
+                   for b in self._all_batchers())
+
+    def kv_resume(self, handle) -> dict:
+        """``POST /v1/kv/resume`` — the handoff failed; finish the
+        parked stream HERE and return its completed ids (the
+        generate response shape, so the router can hand it straight
+        to the client)."""
+        if not handle:
+            raise ValueError('kv resume body needs "handle"')
+        for b in self._all_batchers():
+            if b.has_migration(str(handle)):
+                ids = b.resume_stream(str(handle))
+                return {"ids": np.asarray(ids).tolist(),
+                        "model_version": b.version}
+        raise ValueError(f"unknown migration handle {handle!r}")
+
+    def kv_prefixes(self, limit: int = 512) -> dict:
+        """``GET /v1/kv/prefixes`` — this replica's prefix-cache
+        advertisement for KV-aware routing: page size + cached
+        prefix fingerprints, merged over the paged generate
+        backends."""
+        page_size = None
+        prefixes: List[str] = []
+        for b in self._all_batchers():
+            d = b.prefix_digest(limit)
+            if d is None:
+                continue
+            page_size = d["page_size"]
+            prefixes.extend(d["prefixes"])
+        return {"page_size": page_size,
+                "prefixes": prefixes[-int(limit):]}
 
     def debug_traces(self) -> dict:
         """Recent slow/errored traces with their phase breakdown —
@@ -806,6 +1003,9 @@ class ModelServer:
         with self._lock:
             backends = (list(self._schedulers.values())
                         + list(self._batchers.values()))
+            # parked-stream lookups (/v1/kv/resume, /v1/kv/ack) must
+            # keep working through the concurrent drains below
+            self._stopping_batchers = list(self._batchers.values())
             self._schedulers.clear()
             self._batchers.clear()
             self._tp_models.clear()
@@ -818,6 +1018,8 @@ class ModelServer:
             t.start()
         for t in threads:
             t.join(timeout + 10.0)
+        with self._lock:
+            self._stopping_batchers = []
         ok = all(oks.get(b, False) for b in backends)
         # swap under the lock: two racing stop() calls must not both
         # pass the None test (the loser would call shutdown() on a

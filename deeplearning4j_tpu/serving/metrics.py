@@ -240,18 +240,32 @@ class StreamingMetrics:
                  name: str = "generate", version: str = "0"):
         reg = registry or MetricsRegistry()
         lbl = {"endpoint": name, "model_version": str(version)}
+        # TTFT is split into COLD and PREFIX-HIT populations (the
+        # ``population`` label): the headline of prefix caching /
+        # KV-aware routing is the gap between the two, and one
+        # blended histogram can never show it — scrapers summing
+        # both labels recover the old single-series view exactly
         self.ttft = reg.histogram(
             "serving_ttft_seconds",
             help="time from admission to first generated token "
-                 "(seconds)", labels=lbl, buckets=_EDGES)
+                 "(seconds), cold prefill",
+            labels=dict(lbl, population="cold"), buckets=_EDGES)
+        self.ttft_hit = reg.histogram(
+            "serving_ttft_seconds",
+            help="time from admission to first generated token "
+                 "(seconds), prefix-hit / imported-lease resume",
+            labels=dict(lbl, population="prefix_hit"),
+            buckets=_EDGES)
         self.itl = reg.histogram(
             "serving_itl_seconds",
             help="inter-token latency within one stream (seconds)",
             labels=lbl, buckets=_EDGES)
 
     def record_ttft(self, seconds: float,
-                    trace_id: Optional[str] = None) -> None:
-        self.ttft.record(
+                    trace_id: Optional[str] = None,
+                    prefix_hit: bool = False) -> None:
+        h = self.ttft_hit if prefix_hit else self.ttft
+        h.record(
             seconds,
             exemplar={"trace_id": trace_id} if trace_id else None)
 

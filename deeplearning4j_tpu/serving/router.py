@@ -71,7 +71,9 @@ from deeplearning4j_tpu.serving import tiers
 from deeplearning4j_tpu.serving.errors import (NoReplicaAvailableError,
                                                ReplicaGoneError,
                                                ServerClosedError)
-from deeplearning4j_tpu.serving.fleet import DRAINING, UP, ReplicaFleet
+from deeplearning4j_tpu.serving.fleet import (DECODE, DRAINING, MIXED,
+                                              PREFILL, UP,
+                                              ReplicaFleet)
 from deeplearning4j_tpu.serving.http import (_JsonRequestHandler,
                                               _make_listener,
                                               _retry_after_header)
@@ -107,7 +109,9 @@ class _ReplicaView:
     __slots__ = ("rid", "url", "breaker", "health", "queue_depth",
                  "circuits", "inflight", "consecutive_failures",
                  "unavailable_until", "probe_ok_total", "ejections",
-                 "readmissions", "kv_pages_in_use", "kv_pages_total")
+                 "readmissions", "kv_pages_in_use", "kv_pages_total",
+                 "role", "prefix_fps", "prefix_page_size",
+                 "prefix_hits", "prefix_evictions")
 
     def __init__(self, rid: int, url: str, breaker: CircuitBreaker):
         self.rid = rid
@@ -119,6 +123,15 @@ class _ReplicaView:
         # replica is out of KV memory"
         self.kv_pages_in_use = 0.0
         self.kv_pages_total = 0.0
+        # disaggregation role (refreshed from the fleet snapshot at
+        # eligibility time) and the replica's prefix-cache
+        # advertisement (refreshed by the prober) — the KV-aware
+        # routing inputs
+        self.role = MIXED
+        self.prefix_fps: frozenset = frozenset()
+        self.prefix_page_size = 0
+        self.prefix_hits = 0.0
+        self.prefix_evictions = 0.0
         # probed: ok|degraded|draining|dead. Starts NOT-eligible:
         # "eligible" must mean probe-confirmed, or a readiness gate
         # polling /healthz right after start() would pass while the
@@ -158,7 +171,8 @@ class Router:
                  hedge_after_s: Optional[float] = 0.75,
                  hedge_min_budget_s: float = 1.0,
                  affinity_max: int = 4096,
-                 sample_rate: float = 0.01, tracer=None):
+                 sample_rate: float = 0.01, tracer=None,
+                 kv_routing: bool = True):
         self.fleet = fleet
         self.host = host
         self.port = port
@@ -174,6 +188,9 @@ class Router:
         self.hedge_after_s = hedge_after_s
         self.hedge_min_budget_s = hedge_min_budget_s
         self.affinity_max = affinity_max
+        # kv_routing=False disables the prefix-aware generate pick
+        # (affinity + least-loaded only) — the bench baseline knob
+        self.kv_routing = bool(kv_routing)
         self.sampler = Sampler(rate=sample_rate)
         self.tracer = tracer if tracer is not None else get_tracer()
         self._lock = threading.Lock()
@@ -226,6 +243,31 @@ class Router:
         self._affinity_breaks = self.registry.counter(
             "router_affinity_breaks_total",
             help="session pins broken by replica death")
+        # KV-aware routing + disaggregation accounting
+        self._kv_routed = self.registry.counter(
+            "router_kv_routed_total",
+            help="generate requests routed to the replica holding "
+                 "their longest cached prefix")
+        self._prefix_hit_tokens = self.registry.counter(
+            "router_prefix_hit_tokens_total",
+            help="prompt tokens expected to skip prefill thanks to "
+                 "KV-aware routing")
+        self._kv_handoffs = self.registry.counter(
+            "router_kv_handoffs_total",
+            help="prefill→decode lease handoffs completed across "
+                 "replicas")
+        self._kv_migrations = self.registry.counter(
+            "router_kv_migrations_total",
+            help="mid-stream drain migrations re-homed onto a "
+                 "survivor")
+        self._kv_resumes = self.registry.counter(
+            "router_kv_resumes_total",
+            help="failed handoffs finished on the draining "
+                 "incumbent (finish-on-incumbent fallback)")
+        self._kv_fallbacks = self.registry.counter(
+            "router_kv_fallbacks_total",
+            help="disaggregated splits abandoned for a plain "
+                 "single-replica generate")
         # router-level shed accounting by priority tier: a request
         # the router turns away with no replica to try (the fleet is
         # dead/ejected/benched) is a shed too, and the soak's
@@ -402,12 +444,25 @@ class Router:
                     r.id == view.rid and r.fleet_state == UP
                     for r in self.fleet.snapshot()):
                 self._note_failure(view)
+        prefixes = None
+        if (ok or health) and self.kv_routing and (
+                load is None or load["kv_pages_total"] > 0):
+            # only paged replicas can advertise prefixes; skip the
+            # extra call when the metrics snapshot proves there is
+            # no paged pool behind this replica
+            prefixes = self._read_prefixes(view.url)
         with self._lock:
             view.health = health if health is not None else "dead"
             if load is not None:
                 view.queue_depth = load["queue_depth"]
                 view.kv_pages_in_use = load["kv_pages_in_use"]
                 view.kv_pages_total = load["kv_pages_total"]
+                view.prefix_hits = load["prefix_cache_hits_total"]
+                view.prefix_evictions = \
+                    load["prefix_cache_evictions_total"]
+            if prefixes is not None:
+                view.prefix_page_size = prefixes["page_size"] or 0
+                view.prefix_fps = frozenset(prefixes["prefixes"])
             view.circuits = circuits
             if ok:
                 view.probe_ok_total += 1
@@ -436,10 +491,11 @@ class Router:
         return status == 200, health, circuits
 
     def _read_load_signals(self, url: str) -> Optional[dict]:
-        """Queue depth + paged-KV pool pressure from one /metrics
-        snapshot (None when unreachable): the ``*_queue_depth``,
-        ``*_kv_pages_in_use`` and ``*_kv_pages_total`` gauges summed
-        over the replica's backends."""
+        """Queue depth + paged-KV pool pressure + prefix-cache
+        effectiveness from one /metrics snapshot (None when
+        unreachable): the ``*_queue_depth``, ``*_kv_pages_*`` and
+        ``*_prefix_cache_*`` gauges summed over the replica's
+        backends."""
         try:
             status, body, _ = _http_call(
                 url, "GET", "/metrics", timeout=self.probe_timeout_s)
@@ -450,7 +506,9 @@ class Router:
             return None
         gauges = snap.get("gauges") or {}
         out = {"queue_depth": 0.0, "kv_pages_in_use": 0.0,
-               "kv_pages_total": 0.0}
+               "kv_pages_total": 0.0,
+               "prefix_cache_hits_total": 0.0,
+               "prefix_cache_evictions_total": 0.0}
         for name, value in gauges.items():
             if not isinstance(value, (int, float)):
                 continue
@@ -458,6 +516,22 @@ class Router:
                 if name.endswith("_" + suffix):
                     out[suffix] += value
         return out
+
+    def _read_prefixes(self, url: str) -> Optional[dict]:
+        """One replica's ``/v1/kv/prefixes`` advertisement (None
+        when unreachable or not serving the endpoint)."""
+        try:
+            status, body, _ = _http_call(
+                url, "GET", "/v1/kv/prefixes",
+                timeout=self.probe_timeout_s)
+            if status != 200:
+                return None
+            payload = json.loads(body.decode() or "{}")
+        except (_NetError, ValueError):
+            return None
+        return {"page_size": payload.get("page_size"),
+                "prefixes": [str(p) for p in
+                             (payload.get("prefixes") or [])]}
 
     def _probe_all(self) -> None:
         """One whole probe pass, replicas probed CONCURRENTLY: a
@@ -514,7 +588,12 @@ class Router:
     # ------------------------------------------------------------------
     # replica selection
     # ------------------------------------------------------------------
-    def _eligible(self, exclude=()) -> List[_ReplicaView]:
+    def _eligible(self, exclude=(),
+                  role: Optional[str] = None) -> List[_ReplicaView]:
+        """Eligible views, optionally filtered to a disaggregation
+        role (``mixed`` replicas serve every role; an empty filtered
+        set falls back to the unfiltered one — availability beats
+        role purity)."""
         now = time.monotonic()
         pool = [r for r in self.fleet.snapshot()
                 if r.fleet_state == UP]
@@ -532,19 +611,55 @@ class Router:
             if now < v.unavailable_until:
                 continue              # honoring its Retry-After
             v.url = r.url
+            v.role = getattr(r, "role", MIXED)
             out.append(v)
+        if role is not None:
+            filtered = [v for v in out if v.role in (role, MIXED)]
+            if filtered:
+                return filtered
         return out
 
-    def _pick(self, exclude=()) -> _ReplicaView:
+    def _prompt_hit_tokens(self, view: _ReplicaView, prompt,
+                           fp_cache: Dict[int, list]) -> int:
+        """How many of the prompt's leading tokens this replica's
+        advertised prefix cache covers (longest page-aligned
+        match)."""
+        ps = view.prefix_page_size
+        if not ps or not view.prefix_fps:
+            return 0
+        fps = fp_cache.get(ps)
+        if fps is None:
+            from deeplearning4j_tpu.models.paged_kv import (
+                prefix_fingerprints)
+            fps = fp_cache[ps] = prefix_fingerprints(prompt, ps)
+        for n_tokens, fp in fps:          # longest first
+            if fp in view.prefix_fps:
+                return n_tokens
+        return 0
+
+    def _pick(self, exclude=(), role: Optional[str] = None,
+              prompt=None) -> _ReplicaView:
         """Least-loaded eligible replica: probed queue depth +
         router-side in-flight, degraded and open-circuit penalties;
-        round-robin tie-break."""
-        candidates = self._eligible(exclude)
+        round-robin tie-break. With a ``prompt`` (KV-aware generate
+        routing), replicas advertising a cached prefix of it outrank
+        the rest — the longest hit wins, load breaks ties."""
+        candidates = self._eligible(exclude, role=role)
         if not candidates:
             raise NoReplicaAvailableError(
                 "no replica is eligible (all dead, ejected, "
                 "draining, or backing off)",
                 retry_after_s=self._soonest_retry_s())
+        hit_tokens = 0
+        if prompt is not None and self.kv_routing:
+            fp_cache: Dict[int, list] = {}
+            hits = {v.rid: self._prompt_hit_tokens(v, prompt,
+                                                   fp_cache)
+                    for v in candidates}
+            hit_tokens = max(hits.values())
+            if hit_tokens > 0:
+                candidates = [v for v in candidates
+                              if hits[v.rid] == hit_tokens]
         with self._lock:
             def weight(v: _ReplicaView) -> float:
                 w = v.queue_depth + 2.0 * v.inflight \
@@ -559,6 +674,9 @@ class Router:
             rotated = candidates[start:] + candidates[:start]
             best = min(rotated, key=weight)
             best.inflight += 1
+        if hit_tokens > 0:
+            self._kv_routed.inc()
+            self._prefix_hit_tokens.inc(hit_tokens)
         return best
 
     def _release(self, view: _ReplicaView) -> None:
@@ -744,7 +862,40 @@ class Router:
                         retry_after_s=self._soonest_retry_s())
                 return status, data, resp_headers
 
-    # ---- /v1/generate: session affinity ----
+    # ---- /v1/generate: session affinity + disaggregated split ----
+    def _roles_present(self) -> bool:
+        """Is the fleet split into prefill/decode roles (≥2 serving
+        replicas, at least one with a dedicated role)? Only then is
+        the prefill→decode handoff worth a second hop."""
+        roles = [getattr(r, "role", MIXED)
+                 for r in self.fleet.snapshot()
+                 if r.fleet_state == UP]
+        return len(roles) >= 2 and any(x != MIXED for x in roles)
+
+    def _pinned(self, session) -> bool:
+        if session is None:
+            return False
+        with self._lock:
+            return str(session) in self._affinity
+
+    def _pin_to(self, session, view: _ReplicaView,
+                only_from: Optional[int] = None) -> None:
+        """Point a session's pin at the replica now holding its KV
+        state. Conditional like ``_pin``'s locked get-or-set: a
+        fresh handoff (``only_from=None``) only installs a pin where
+        none exists — two concurrent first requests must not
+        clobber each other's established state — while a drain
+        migration (``only_from=<incumbent rid>``) moves the pin only
+        if it still points at the incumbent."""
+        if session is None:
+            return
+        with self._lock:
+            cur = self._affinity.get(str(session))
+            if cur is not None and cur != only_from:
+                return
+            self._affinity.pop(str(session), None)
+            self._affinity[str(session)] = view.rid
+
     def _route_generate(self, body_bytes: bytes, body: dict,
                         ctx: RequestContext
                         ) -> Tuple[int, bytes, Dict[str, str]]:
@@ -757,9 +908,22 @@ class Router:
         # per-request budget
         deadline = ctx.deadline if ctx.deadline is not None \
             else time.monotonic() + self.request_timeout_s
+        prompt = body.get("prompt")
+        prompt = prompt if isinstance(prompt, (list, tuple)) \
+            and prompt else None
+        # disaggregated prefill/decode: fresh streams only — a
+        # pinned session's KV state already lives on its replica
+        if prompt is not None and not self._pinned(session) \
+                and self._roles_present():
+            split = self._route_disagg(body_bytes, body, ctx,
+                                       deadline, fwd_headers,
+                                       session, prompt)
+            if split is not None:
+                return split
+            self._kv_fallbacks.inc()
         timeout = max(0.05, min(deadline - time.monotonic(),
                                 self.request_timeout_s))
-        view = self._pin(session)
+        view = self._pin(session, prompt=prompt)
         try:
             status, data, resp_headers = self._forward(
                 view, "POST", "/v1/generate", body_bytes,
@@ -777,7 +941,9 @@ class Router:
                     f"stream; trace {ctx.trace_id}") from e
         else:
             self._account_response(view, status, resp_headers)
-            return status, data, resp_headers
+            return self._maybe_migrate(
+                status, data, resp_headers, view, deadline,
+                fwd_headers, session, ctx, body_bytes=body_bytes)
         finally:
             self._release(view)
         # connect-refused: the stream never STARTED on the dead
@@ -792,7 +958,8 @@ class Router:
                 f"deadline exhausted after a connect-refused "
                 f"generate attempt on replica {view.rid}")
         timeout = max(0.05, min(remaining, self.request_timeout_s))
-        retry = self._pin(session, exclude=(view.rid,))
+        retry = self._pin(session, exclude=(view.rid,),
+                          prompt=prompt)
         self._failovers.inc()
         try:
             status, data, resp_headers = self._forward(
@@ -807,17 +974,259 @@ class Router:
                 f"started ({e2}); trace {ctx.trace_id}") from e2
         else:
             self._account_response(retry, status, resp_headers)
-            return status, data, resp_headers
+            return self._maybe_migrate(
+                status, data, resp_headers, retry, deadline,
+                fwd_headers, session, ctx, body_bytes=body_bytes)
         finally:
             self._release(retry)
 
+    def _route_disagg(self, body_bytes: bytes, body: dict,
+                      ctx: RequestContext, deadline: float,
+                      fwd_headers: Dict[str, str], session,
+                      prompt) -> Optional[Tuple[int, bytes,
+                                                Dict[str, str]]]:
+        """The prefill→decode split: run the prompt on a prefill
+        replica (``/v1/kv/export``), rebuild the lease on the
+        decode replica holding the longest cached prefix
+        (``/v1/kv/import``), pin the session there, hand the stream
+        back — one trace id across the hop. Returns None whenever
+        the split cannot complete; the caller falls back to the
+        plain single-replica path (counted as
+        ``router_kv_fallbacks_total``), so disaggregation can only
+        ever ADD capacity, never drop a request."""
+        remaining = deadline - time.monotonic()
+        if remaining <= 0.05:
+            return None
+        try:
+            pv = self._pick(role=PREFILL)
+        except NoReplicaAvailableError:
+            return None
+        t = max(0.05, min(self.attempt_timeout_s, remaining))
+        try:
+            status, data, hdrs = self._forward(
+                pv, "POST", "/v1/kv/export", body_bytes,
+                fwd_headers, t)
+        except _NetError:
+            self._note_failure(pv)
+            return None
+        finally:
+            self._release(pv)
+        self._account_response(pv, status, hdrs)
+        if status != 200:
+            return None
+        try:
+            blob_b64 = json.loads(data.decode() or "{}").get("blob")
+        except ValueError:
+            blob_b64 = None
+        if not blob_b64:
+            return None
+        remaining = deadline - time.monotonic()
+        if remaining <= 0.05:
+            return None
+        try:
+            dv = self._pick(exclude=(pv.rid,), role=DECODE,
+                            prompt=prompt)
+        except NoReplicaAvailableError:
+            return None
+        import_body = {"blob": blob_b64}
+        if body.get("timeout_ms") is not None:
+            import_body["timeout_ms"] = max(
+                50.0, remaining * 1e3)
+        if body.get("tier") is not None:
+            import_body["tier"] = body["tier"]
+        t = max(0.05, min(remaining, self.request_timeout_s))
+        try:
+            st2, d2, h2 = self._forward(
+                dv, "POST", "/v1/kv/import",
+                json.dumps(import_body).encode(), fwd_headers, t)
+        except _NetError:
+            self._note_failure(dv)
+            return None
+        finally:
+            self._release(dv)
+        self._account_response(dv, st2, h2)
+        if st2 == 202:
+            st2, d2, h2 = self._maybe_migrate(
+                st2, d2, h2, dv, deadline, fwd_headers, session,
+                ctx, body_bytes=body_bytes)
+        if st2 != 200:
+            # 422 (bad blob), 429/503 (pressure), 5xx: recompute
+            # from the original request instead
+            return None
+        self._pin_to(session, dv)
+        self._kv_handoffs.inc()
+        return st2, d2, h2
+
+    # ---- drain-migration offers (202 from a draining replica) ----
+    # a survivor import of a migration offer is capped well below
+    # the incumbent's failsafe auto-resume window (10s): a stalled
+    # import must lose the race to the RESUME fallback, not to the
+    # failsafe (which would leave nobody holding the stream)
+    offer_import_timeout_s = 5.0
+
+    def _maybe_migrate(self, status: int, data: bytes,
+                       resp_headers: Dict[str, str],
+                       incumbent: _ReplicaView, deadline: float,
+                       fwd_headers: Dict[str, str], session,
+                       ctx: RequestContext, depth: int = 0,
+                       body_bytes: Optional[bytes] = None,
+                       pin_from: Optional[int] = None
+                       ) -> Tuple[int, bytes, Dict[str, str]]:
+        """Pass non-offer responses through; complete a migration
+        offer by importing the lease on a survivor (ack → pin
+        moves), else resuming the stream on the draining incumbent,
+        else recomputing the ORIGINAL request from scratch on a
+        survivor (deterministic decode: same prompt, same seed ⇒
+        same tokens) — zero client-visible drops on every rung."""
+        if status != 202:
+            return status, data, resp_headers
+        try:
+            payload = json.loads(data.decode() or "{}")
+        except ValueError:
+            return status, data, resp_headers
+        mig = payload.get("migration")
+        if not isinstance(mig, dict):
+            return status, data, resp_headers
+        if pin_from is None:
+            # the replica the session's pin points at — carried
+            # through chained offers (a 202-chase recurses with the
+            # INTERMEDIATE hop as incumbent, but the pin still
+            # names the first one)
+            pin_from = incumbent.rid
+        handle = mig.get("handle")
+        blob_b64 = mig.get("blob")
+        remaining = deadline - time.monotonic()
+        survivor = None
+        if blob_b64 and remaining > 0.05 and depth < 2:
+            try:
+                survivor = self._pick(exclude=(incumbent.rid,),
+                                      role=DECODE)
+            except NoReplicaAvailableError:
+                survivor = None
+        if survivor is not None:
+            t = max(0.05, min(remaining,
+                              self.offer_import_timeout_s))
+            st2 = None
+            d2, h2 = b"", {}
+            try:
+                st2, d2, h2 = self._forward(
+                    survivor, "POST", "/v1/kv/import",
+                    json.dumps({"blob": blob_b64}).encode(),
+                    fwd_headers, t)
+            except _NetError:
+                self._note_failure(survivor)
+            finally:
+                self._release(survivor)
+            if st2 is not None:
+                self._account_response(survivor, st2, h2)
+            if st2 == 202 and depth < 2:
+                # the survivor is draining too: it now owns the
+                # stream (import succeeded before its own offer),
+                # so ack the first incumbent and chase the new offer
+                self._ack_migration(incumbent, handle)
+                return self._maybe_migrate(
+                    st2, d2, h2, survivor, deadline, fwd_headers,
+                    session, ctx, depth + 1,
+                    body_bytes=body_bytes, pin_from=pin_from)
+            if st2 == 200:
+                self._ack_migration(incumbent, handle)
+                self._pin_to(session, survivor,
+                             only_from=pin_from)
+                self._kv_migrations.inc()
+                return st2, d2, h2
+        # no survivor / import failed: finish on the incumbent
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            self._errors.inc()
+            raise TimeoutError(
+                f"deadline exhausted completing a migration offer "
+                f"from replica {incumbent.rid}")
+        t = max(0.05, min(remaining, self.request_timeout_s))
+        resume_err: Optional[str] = None
+        try:
+            st3, d3, h3 = self._forward(
+                incumbent, "POST", "/v1/kv/resume",
+                json.dumps({"handle": handle}).encode(),
+                fwd_headers, t)
+        except _NetError as e:
+            resume_err = repr(e)
+        else:
+            if st3 == 200:
+                self._kv_resumes.inc()
+                return st3, d3, h3
+            # 404 = the failsafe already reclaimed the handle (a
+            # slow import lost the race); anything else is the
+            # incumbent mid-collapse — either way, recompute below
+            resume_err = f"resume returned {st3}"
+        redo = self._recompute_fallback(body_bytes, incumbent,
+                                        deadline, fwd_headers,
+                                        session, pin_from)
+        if redo is not None:
+            return redo
+        self._errors.inc()
+        self._break_pin(session)
+        raise ReplicaGoneError(
+            f"migration offer from replica {incumbent.rid} could "
+            f"not be completed ({resume_err}) and no survivor "
+            f"could recompute the stream; trace {ctx.trace_id}")
+
+    def _recompute_fallback(self, body_bytes: Optional[bytes],
+                            incumbent: _ReplicaView,
+                            deadline: float,
+                            fwd_headers: Dict[str, str], session,
+                            pin_from: Optional[int] = None
+                            ) -> Optional[Tuple[int, bytes,
+                                                Dict[str, str]]]:
+        """Last rung of the zero-drop ladder: re-run the ORIGINAL
+        generate request from scratch on an eligible replica.
+        Decode is deterministic in (prompt, seed), so the recomputed
+        stream is token-identical to the one that was mid-flight."""
+        if body_bytes is None:
+            return None
+        remaining = deadline - time.monotonic()
+        if remaining <= 0.05:
+            return None
+        try:
+            view = self._pick(exclude=(incumbent.rid,))
+        except NoReplicaAvailableError:
+            return None
+        t = max(0.05, min(remaining, self.request_timeout_s))
+        try:
+            st, d, h = self._forward(view, "POST", "/v1/generate",
+                                     body_bytes, fwd_headers, t)
+        except _NetError:
+            self._note_failure(view)
+            return None
+        finally:
+            self._release(view)
+        self._account_response(view, st, h)
+        if st != 200:
+            return None
+        self._pin_to(session, view,
+                     only_from=incumbent.rid if pin_from is None
+                     else pin_from)
+        self._kv_fallbacks.inc()
+        return st, d, h
+
+    def _ack_migration(self, view: _ReplicaView,
+                       handle) -> None:
+        """Best-effort: tell the draining incumbent its offered
+        stream found a new home (frees the parked pages now; the
+        failsafe auto-resume would free them anyway)."""
+        try:
+            self._forward(view, "POST", "/v1/kv/ack",
+                          json.dumps({"handle": handle}).encode(),
+                          {"Content-Type": "application/json"}, 2.0)
+        except _NetError:
+            pass
+
     def _pin(self, session: Optional[str],
-             exclude=()) -> _ReplicaView:
+             exclude=(), prompt=None) -> _ReplicaView:
         """Resolve the replica for a session (pinning it on first
         use); sessionless requests route least-loaded as usual. The
         returned view's in-flight count is already incremented."""
         if session is None:
-            return self._pick(exclude)
+            return self._pick(exclude, prompt=prompt)
         with self._lock:
             rid = self._affinity.get(str(session))
             if rid is not None:
@@ -850,7 +1259,7 @@ class Router:
             # pinned replica left the pool or stopped accepting
             # work: the pin breaks here, a fresh one forms below
             self._break_pin(session)
-        view = self._pick(exclude)
+        view = self._pick(exclude, prompt=prompt)
         # pin with a locked get-or-set: two concurrent FIRST
         # requests for the same session must agree on one replica,
         # or the stream's decode state silently splits across two
@@ -910,8 +1319,10 @@ class Router:
         members are excluded — a replica on its way out is not
         capacity."""
         eligible = {v.rid for v in self._eligible()}
-        fleet_states = {r.id: r.fleet_state
-                        for r in self.fleet.snapshot()}
+        snapshot = self.fleet.snapshot()
+        fleet_states = {r.id: r.fleet_state for r in snapshot}
+        fleet_roles = {r.id: getattr(r, "role", MIXED)
+                       for r in snapshot}
         with self._lock:
             views = list(self._views.values())
         out = []
@@ -919,10 +1330,15 @@ class Router:
             if fleet_states.get(v.rid) != UP:
                 continue
             out.append({"rid": v.rid, "health": v.health,
+                        "role": fleet_roles.get(v.rid, MIXED),
                         "queue_depth": float(v.queue_depth),
                         "inflight": int(v.inflight),
                         "kv_pages_in_use": float(v.kv_pages_in_use),
                         "kv_pages_total": float(v.kv_pages_total),
+                        "prefix_cache_hits_total":
+                            float(v.prefix_hits),
+                        "prefix_cache_evictions_total":
+                            float(v.prefix_evictions),
                         "eligible": v.rid in eligible})
         return out
 
@@ -1167,14 +1583,20 @@ class Router:
         with self._lock:
             views = list(self._views.values())
         states = self.replica_states()
+        roles = {r.id: getattr(r, "role", MIXED)
+                 for r in self.fleet.snapshot()}
         return {"replicas": [
             {"id": v.rid, "url": v.url,
              "state": states.get(v.rid, "dead"),
              "health": v.health,
+             "role": roles.get(v.rid, MIXED),
              "breaker": v.breaker.state,
              "queue_depth": v.queue_depth,
              "kv_pages_in_use": v.kv_pages_in_use,
              "kv_pages_total": v.kv_pages_total,
+             "prefix_cache_hits_total": v.prefix_hits,
+             "prefix_cache_evictions_total": v.prefix_evictions,
+             "prefix_fingerprints": len(v.prefix_fps),
              "inflight": v.inflight,
              "consecutive_failures": v.consecutive_failures}
             for v in sorted(views, key=lambda v: v.rid)]}

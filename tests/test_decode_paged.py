@@ -329,9 +329,19 @@ class TestPrefixCacheHTTP:
             assert r1["ids"] == r2["ids"]
             # the phase ledger proves the skip: the second request
             # resumed after the 8-token cached page (deterministic
-            # attr, not a timing heuristic)
-            recent = _get(base + "/debug/requests")["recent"]
-            gen = [e for e in recent if e["route"] == "/v1/generate"]
+            # attr, not a timing heuristic). The completion ring is
+            # appended AFTER the response bytes go out (the finally
+            # block must time the respond phase), so poll briefly —
+            # on a loaded 2-core host the client can read back
+            # before the handler's finally has run
+            deadline = time.monotonic() + 5.0
+            while True:
+                recent = _get(base + "/debug/requests")["recent"]
+                gen = [e for e in recent
+                       if e["route"] == "/v1/generate"]
+                if len(gen) >= 2 or time.monotonic() > deadline:
+                    break
+                time.sleep(0.02)
             assert gen[-2]["attrs"]["prefix_hit_tokens"] == 0
             assert gen[-1]["attrs"]["prefix_hit_tokens"] == 8
             # /debug/slots carries the pool + prefix-cache state

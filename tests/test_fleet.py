@@ -281,8 +281,16 @@ class TestFailover:
                 found = tid
                 break
         assert found, "no request ever failed over"
-        evs = trace.events_for_trace(found)
-        roots = [e for e in evs if e["name"] == "request"]
+        # the replica records its root span in the handler's finally
+        # block, AFTER its response bytes reach the router — poll
+        # briefly so a loaded host can't read the ring first
+        deadline = time.monotonic() + 5.0
+        while True:
+            evs = trace.events_for_trace(found)
+            roots = [e for e in evs if e["name"] == "request"]
+            if len(roots) >= 2 or time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
         # the router's root (parented to the CLIENT span) and the
         # replica's root (parented to the ROUTER's root) — one trace
         assert len(roots) >= 2

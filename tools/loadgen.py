@@ -74,7 +74,8 @@ from typing import Callable, Dict, Optional
 from deeplearning4j_tpu.serving.tiers import TIERS as _TIERS
 
 __all__ = ["LoadGen", "generate_body_fn", "scrape_streaming_latency",
-           "parse_profile", "parse_tier_mix", "tiered_body_fn"]
+           "scrape_ttft_populations", "parse_profile",
+           "parse_tier_mix", "tiered_body_fn"]
 
 
 def _default_body(i: int) -> dict:
@@ -217,47 +218,109 @@ def _histogram_quantiles(buckets: Dict[float, float], count: float):
     return out
 
 
+def _label_value(line: str, label: str) -> Optional[str]:
+    marker = label + '="'
+    at = line.find(marker)
+    if at < 0:
+        return None
+    return line[at + len(marker):line.index('"', at + len(marker))]
+
+
+def _accumulate_histogram(text: str, metric: str,
+                          buckets: Dict[float, float],
+                          counts: Dict[str, float],
+                          pop_buckets: Dict[str, Dict[float, float]],
+                          pop_counts: Dict[str, float]) -> None:
+    """Fold one Prometheus exposition's ``metric`` histogram lines
+    into running bucket/count accumulators (overall + split by the
+    ``population`` label) — the ONE parser behind both the per-
+    server scrape below and bench.py's fleet-merged TTFT read
+    (summing buckets before quantiles; merging per-server quantiles
+    would be statistically wrong)."""
+    for line in text.splitlines():
+        if not line.startswith(metric):
+            continue
+        rest = line[len(metric):]
+        try:
+            value = float(line.rsplit(" ", 1)[1])
+        except (IndexError, ValueError):
+            continue
+        pop = _label_value(line, "population")
+        if rest.startswith("_bucket"):
+            le = _label_value(line, "le")
+            if le is None:
+                continue
+            edge = float("inf") if le in ("+Inf", "inf") \
+                else float(le)
+            buckets[edge] = buckets.get(edge, 0.0) + value
+            if pop is not None:
+                pb = pop_buckets.setdefault(pop, {})
+                pb[edge] = pb.get(edge, 0.0) + value
+        elif rest.startswith("_count"):
+            counts["total"] = counts.get("total", 0.0) + value
+            if pop is not None:
+                pop_counts[pop] = pop_counts.get(pop, 0.0) + value
+
+
+def _quantile_entry(buckets: Dict[float, float],
+                    count: float) -> dict:
+    entry = {"count": int(count)}
+    entry.update(_histogram_quantiles(buckets, count)
+                 if count else {"p50": 0.0, "p95": 0.0,
+                                "p99": 0.0})
+    return entry
+
+
+def _fetch_exposition(url: str, timeout_s: float) -> str:
+    req = urllib.request.Request(
+        url.rstrip("/") + "/metrics?format=prometheus")
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        return r.read().decode()
+
+
 def scrape_streaming_latency(url: str,
                              timeout_s: float = 5.0) -> dict:
     """TTFT / inter-token latency percentiles from a server's OWN
     metrics: parses the Prometheus exposition's
     ``serving_ttft_seconds`` / ``serving_itl_seconds`` histograms
     (buckets summed across model versions). Returns
-    ``{metric: {count, p50, p95, p99}}`` in milliseconds."""
-    req = urllib.request.Request(
-        url.rstrip("/") + "/metrics?format=prometheus")
-    with urllib.request.urlopen(req, timeout=timeout_s) as r:
-        text = r.read().decode()
+    ``{metric: {count, p50, p95, p99}}`` in milliseconds; TTFT is
+    ADDITIONALLY split by the ``population`` label into ``cold``
+    vs ``prefix_hit`` sub-entries — the headline ratio of prefix
+    caching / KV-aware routing, measurable without
+    post-processing."""
+    text = _fetch_exposition(url, timeout_s)
     out = {}
     for metric in ("serving_ttft_seconds", "serving_itl_seconds"):
         buckets: Dict[float, float] = {}
-        count = 0.0
-        for line in text.splitlines():
-            if not line.startswith(metric):
-                continue
-            rest = line[len(metric):]
-            try:
-                value = float(line.rsplit(" ", 1)[1])
-            except (IndexError, ValueError):
-                continue
-            if rest.startswith("_bucket"):
-                marker = 'le="'
-                at = line.find(marker)
-                if at < 0:
-                    continue
-                le = line[at + len(marker):line.index('"', at
-                                                      + len(marker))]
-                edge = float("inf") if le in ("+Inf", "inf") \
-                    else float(le)
-                buckets[edge] = buckets.get(edge, 0.0) + value
-            elif rest.startswith("_count"):
-                count += value
-        entry = {"count": int(count)}
-        entry.update(_histogram_quantiles(buckets, count)
-                     if count else {"p50": 0.0, "p95": 0.0,
-                                    "p99": 0.0})
+        counts: Dict[str, float] = {}
+        pop_buckets: Dict[str, Dict[float, float]] = {}
+        pop_counts: Dict[str, float] = {}
+        _accumulate_histogram(text, metric, buckets, counts,
+                              pop_buckets, pop_counts)
+        entry = _quantile_entry(buckets, counts.get("total", 0.0))
+        for pop, pc in pop_counts.items():
+            entry[pop] = _quantile_entry(pop_buckets[pop], pc)
         out[metric] = entry
     return out
+
+
+def scrape_ttft_populations(urls, timeout_s: float = 5.0) -> dict:
+    """Fleet-merged TTFT split: sum every server's
+    ``serving_ttft_seconds`` buckets per ``population`` label, then
+    take quantiles — ``{"cold": {count, p50, p95, p99},
+    "prefix_hit": {...}}`` in milliseconds."""
+    buckets: Dict[float, float] = {}
+    counts: Dict[str, float] = {}
+    pop_buckets: Dict[str, Dict[float, float]] = {
+        "cold": {}, "prefix_hit": {}}
+    pop_counts: Dict[str, float] = {"cold": 0.0, "prefix_hit": 0.0}
+    for url in urls:
+        _accumulate_histogram(_fetch_exposition(url, timeout_s),
+                              "serving_ttft_seconds", buckets,
+                              counts, pop_buckets, pop_counts)
+    return {pop: _quantile_entry(pop_buckets[pop], pop_counts[pop])
+            for pop in ("cold", "prefix_hit")}
 
 
 class LoadGen:
