@@ -2213,6 +2213,7 @@ def _leg_flash_attention_masked(peak):
 CKPT_HIDDEN = 1024        # ~4.3M params -> ~17MB of f32 to zip
 CKPT_LAYERS = 4
 CKPT_SAVES = 6
+PS_EPOCH_CAP = 40         # per-variant epoch bound for the PS leg
 
 
 def _leg_checkpoint_async(peak):
@@ -2308,6 +2309,194 @@ def _leg_checkpoint_async(peak):
                  "checkpoint_write_seconds{phase=blocked} histogram "
                  "after an async-only reset — the operators' own "
                  "instrument, not a bench-local stopwatch")}
+
+
+def _leg_ps_async_training(peak):
+    """Async parameter-server leg: time-to-target-loss for 3 async
+    PS workers (int8+EF compressed pushes) vs a synchronous
+    single-process SGD loop over the SAME batches, model and rate —
+    plus the staleness-vs-accuracy frontier (max_staleness 0 / 4 /
+    16 / unbounded). The target is self-calibrating: 80% of the loss
+    drop the sync loop achieves inside the epoch cap, so the leg
+    measures wall-clock to equivalent progress, not steps. Workers
+    are threads (the jitted grad step releases the GIL) against an
+    in-process server — the same wire protocol and staleness
+    machinery as the multi-process ``train-ps`` CLI, minus process
+    spawn noise."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.parallel.paramserver import (
+        ParameterServer, PSClient, PSWorker)
+
+    N_IN, N_OUT, HIDDEN = 8, 3, 16
+    N_BATCHES, BATCH = 24, 16
+    LR, EPOCH_CAP, WORKERS = 0.2, PS_EPOCH_CAP, 3
+
+    def net(seed=0):
+        conf = (NeuralNetConfiguration.builder().set_seed(seed)
+                .updater(updaters.sgd(LR)).list()
+                .layer(DenseLayer(n_out=HIDDEN, activation="relu"))
+                .layer(OutputLayer(n_out=N_OUT))
+                .set_input_type(InputType.feed_forward(N_IN))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(N_BATCHES):
+        c = rng.integers(0, N_OUT, BATCH)
+        x = (rng.normal(size=(BATCH, N_IN))
+             + c[:, None] * 1.5).astype(np.float32)
+        batches.append(DataSet(x, np.eye(N_OUT,
+                                         dtype=np.float32)[c]))
+
+    ev_model = net(seed=0)
+    ev_batches = [ev_model._batch_tuple(ds) for ds in batches]
+
+    @jax.jit
+    def _ev_one(params, batch):
+        loss, _ = ev_model._loss(params, ev_model.state, batch,
+                                 None, training=False)
+        return loss
+
+    def eval_loss(params):
+        return float(np.mean([_ev_one(params, b)
+                              for b in ev_batches]))
+
+    # -- synchronous baseline: plain SGD, exact (uncompressed) grads
+    sync = net(seed=0)
+    state = sync.state
+
+    def loss_fn(p, batch, r):
+        loss, _ = sync._loss(p, state, batch, r, training=True)
+        return loss
+
+    vg = jax.jit(jax.value_and_grad(loss_fn))
+    params = sync.params
+    init_loss = eval_loss(params)
+    key = sync._rng_key
+    vg(params, ev_batches[0], key)     # compile outside the clock
+    t0 = time.perf_counter()
+    sync_curve = []
+    for epoch in range(EPOCH_CAP):
+        for i, b in enumerate(ev_batches):
+            _, g = vg(params, b, jax.random.fold_in(
+                key, epoch * N_BATCHES + i))
+            params = jax.tree_util.tree_map(
+                lambda p, gg: p - LR * gg, params, g)
+        sync_curve.append((time.perf_counter() - t0,
+                           eval_loss(params)))
+    sync_total = time.perf_counter() - t0
+    sync_final = sync_curve[-1][1]
+    target = init_loss - 0.8 * (init_loss - sync_final)
+
+    def first_crossing(curve):
+        for t, l in curve:
+            if l <= target:
+                return t
+        return None
+
+    sync_ttl = first_crossing(sync_curve)
+
+    # -- async PS: workers run to the cap; a monitor thread records
+    # the first target crossing from the server's own params
+    def run_ps(max_staleness):
+        m0 = net(seed=0)
+        server = ParameterServer(m0.params, lr=LR,
+                                 max_staleness=max_staleness).start()
+        crossed = [None]
+        stop = threading.Event()
+        t0 = time.perf_counter()
+
+        def monitor():
+            while not stop.wait(0.05):
+                if crossed[0] is None \
+                        and eval_loss(server.params_tree()) <= target:
+                    crossed[0] = time.perf_counter() - t0
+
+        mon = threading.Thread(target=monitor, name="ps-bench-mon",
+                               daemon=True)
+        stats = [None] * WORKERS
+
+        def work(i):
+            model = m0 if i == 0 else net(seed=i)
+            client = PSClient(server.address)
+            try:
+                stats[i] = PSWorker(model, client,
+                                    name=f"ps-bench-{i}").run(
+                    batches[i::WORKERS], epochs=EPOCH_CAP)
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=work, args=(i,),
+                                    name=f"ps-bench-{i}",
+                                    daemon=True)
+                   for i in range(WORKERS)]
+        mon.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        total = time.perf_counter() - t0
+        stop.set()
+        mon.join(10)
+        final = eval_loss(server.params_tree())
+        if crossed[0] is None and final <= target:
+            crossed[0] = total      # crossed between monitor ticks
+        st = dict(server.stats)
+        server.stop()
+        return {"max_staleness": max_staleness,
+                "time_to_target_s": None if crossed[0] is None
+                else round(crossed[0], 3),
+                "total_s": round(total, 3),
+                "final_loss": round(final, 4),
+                "stale_rejects": st["pushes_stale"],
+                "pushes_applied": st["pushes_applied"]}
+
+    frontier = [run_ps(ms) for ms in (0, 4, 16, None)]
+    headline = next(f for f in frontier if f["max_staleness"] == 4)
+    ttl = headline["time_to_target_s"]
+    print("ps_async_training: sync time-to-target "
+          f"{sync_ttl and round(sync_ttl, 2)}s "
+          f"(final {sync_final:.4f}); async s=4 time-to-target "
+          f"{ttl}s; frontier "
+          + ", ".join(f"s={f['max_staleness']}: "
+                      f"loss {f['final_loss']} in "
+                      f"{f['time_to_target_s']}s"
+                      for f in frontier), file=sys.stderr)
+    return {
+        "metric": (f"async PS time-to-target-loss, {WORKERS} "
+                   f"int8+EF workers, max_staleness=4 (target = 80% "
+                   f"of the sync loss drop, {N_BATCHES}x{BATCH} "
+                   "synthetic 3-class batches)"),
+        "value": ttl, "unit": "s",
+        "baseline": None if sync_ttl is None else round(sync_ttl, 3),
+        "vs_baseline": None if not (ttl and sync_ttl)
+        else round(sync_ttl / ttl, 3),
+        "target_loss": round(target, 4),
+        "init_loss": round(init_loss, 4),
+        "sync_final_loss": round(sync_final, 4),
+        "sync_total_s": round(sync_total, 3),
+        "staleness_frontier": frontier,
+        "note": ("vs_baseline is sync/async time-to-target "
+                 "(>1 = async reaches equivalent progress faster). "
+                 "The frontier shows the bounded-staleness "
+                 "accuracy/speed trade: s=0 serializes pushes "
+                 "(stale_rejects climb), unbounded runs free. "
+                 "Same server/worker/wire stack as `train-ps`; "
+                 "workers are in-process threads so the number "
+                 "isolates protocol + staleness cost from process "
+                 "spawn noise")}
 
 
 def _kstep_lenet(c1=4, c2=8, dense=64, seed=0):
@@ -2774,6 +2963,9 @@ _LEGS = [
     ("resnet_native_etl", _leg_resnet_native_etl, 480),
     # host-side (no device step in the loop): cheap, runs last
     ("checkpoint_async", _leg_checkpoint_async, 120),
+    # CPU-dominated (tiny MLP, loopback TCP PS + worker threads):
+    # time-to-target-loss vs sync + the staleness frontier
+    ("ps_async_training", _leg_ps_async_training, 240),
     # CPU-dominated (tiny models, dispatch path): cheap, runs last
     ("lenet_kstep", _leg_lenet_kstep, 240),
     # nested subprocess with the forced 8-host-device mesh: cheap,

@@ -59,6 +59,16 @@ the docs lint checks the README table against these):
                      each data-parallel mesh step (``crash``, and
                      ``loss`` — simulate losing one mesh device; the
                      wrapper shrinks the mesh and continues)
+``ps.push.drop``     one compressed-delta push received by the
+                     parameter server (``drop``: swallow it unacked —
+                     the worker retries the same sequence number and
+                     the dedupe table keeps the retry idempotent)
+``ps.pull.timeout``  one parameter pull served by the PS
+                     (``timeout``: swallow the reply — the worker's
+                     deadline expires and it re-pulls)
+``ps.server.restart`` one PS push applied (``restart``:
+                     crash-restart the server from its newest
+                     durable checkpoint; workers reconnect)
 ==================== ====================================================
 
 Generic kinds every site understands via :func:`step_fault`:
@@ -138,6 +148,15 @@ SITES: Dict[str, str] = {
     "serving.kv.migrate": "one KV lease serialized or rebuilt "
                           "(prefill export, drain migration, import)",
     "parallel.device": "one ParallelWrapper data-parallel mesh step",
+    "ps.push.drop": "one compressed-delta push received by the "
+                    "parameter server (the worker's packet, lost "
+                    "on the wire)",
+    "ps.pull.timeout": "one parameter pull served by the parameter "
+                       "server (the snapshot reply, lost on the "
+                       "wire)",
+    "ps.server.restart": "one parameter-server push applied "
+                         "(crash-restart the PS from its last "
+                         "durable checkpoint)",
 }
 
 # kinds every site understands via step_fault(), plus the
@@ -172,6 +191,18 @@ SITE_KINDS: Dict[str, frozenset] = {
     # incumbent), slow stalls the hop by args.delay_s
     "serving.kv.migrate": frozenset({"corrupt", "slow", "error"}),
     "parallel.device": _GENERIC_KINDS | {"loss"},
+    # parameter-server faults are interpreted by ParameterServer's
+    # request handlers (parallel/paramserver.py): drop swallows a
+    # received push without applying OR acking it (the worker's
+    # deadline expires and it retries the SAME sequence number — the
+    # dedupe table makes the retry idempotent), timeout swallows a
+    # pull reply the same way (the worker re-pulls), restart
+    # crash-restarts the server in place from its newest durable
+    # checkpoint (workers reconnect, re-hello and re-pull; versions
+    # roll back to the last durable generation)
+    "ps.push.drop": frozenset({"drop"}),
+    "ps.pull.timeout": frozenset({"timeout"}),
+    "ps.server.restart": frozenset({"restart"}),
 }
 
 

@@ -152,6 +152,143 @@ def _cmd_train(args):
     print(f"trained {args.epochs} epochs; saved to {out}")
 
 
+def _install_chaos(args):
+    if not args.chaos:
+        return
+    from deeplearning4j_tpu import chaos
+    inj = chaos.install(args.chaos, seed=args.chaos_seed)
+    print(f"chaos: fault plan installed "
+          f"({len(inj.plan.faults)} spec(s), seed {inj.seed}; "
+          f"replay with --chaos-seed {inj.seed})")
+
+
+def _ps_batches(args):
+    from deeplearning4j_tpu.data.records import (
+        CSVRecordReader, RecordReaderDataSetIterator)
+    rr = CSVRecordReader().initialize(args.data)
+    it = RecordReaderDataSetIterator(
+        rr, args.batch_size, label_index=args.label_index,
+        num_classes=args.classes, regression=args.classes == 0)
+    return list(it)
+
+
+def _cmd_train_ps(args):
+    """Async parameter-server training (the reference's Aeron
+    ``VoidParameterServer`` sharing, TF-style PS architecture). The
+    launcher role runs the server in-process and spawns worker
+    subprocesses; the server/worker roles exist so soak tests (and
+    real deployments) can place each piece in its own killable
+    process."""
+    _install_chaos(args)
+    from deeplearning4j_tpu.parallel.paramserver import (
+        ParameterServer, PSClient, PSWorker)
+    from deeplearning4j_tpu.util.model_serializer import (
+        restore_model, write_model)
+    max_staleness = (None if args.max_staleness < 0
+                     else args.max_staleness)
+
+    if args.role == "worker":
+        if not args.connect:
+            sys.exit("train-ps: --role worker needs --connect "
+                     "HOST:PORT")
+        host, _, port = args.connect.rpartition(":")
+        model = restore_model(args.model)
+        if model.params is None:
+            model.init()
+        batches = _ps_batches(args)
+        shard = batches[args.worker_index::max(1, args.num_workers)]
+        client = PSClient((host, int(port)),
+                          op_timeout_s=args.op_timeout)
+        try:
+            worker = PSWorker(model, client,
+                              threshold=args.push_threshold,
+                              name=f"ps-worker-{args.worker_index}")
+            stats = worker.run(shard, epochs=args.epochs)
+        finally:
+            client.close()
+        print(f"train-ps worker {args.worker_index}: "
+              f"{stats['steps']} steps, "
+              f"{stats['pushes_applied']} pushes applied, "
+              f"{stats['stale_rejects']} stale rejects, "
+              f"last loss {stats['last_loss']:.4f}")
+        return
+
+    model = restore_model(args.model)
+    if model.params is None:
+        model.init()
+    ckpt_dir = args.ckpt_dir or ((args.output or args.model)
+                                 + ".ps-ckpts")
+    server = ParameterServer(
+        model.params, lr=args.lr, max_staleness=max_staleness,
+        host=args.host, port=args.ps_port, checkpoint_dir=ckpt_dir,
+        save_every=args.save_every,
+        heartbeat_timeout_s=args.heartbeat_timeout).start()
+    print(f"train-ps: parameter server on "
+          f"{server.host}:{server.port} (version {server.version}, "
+          f"max_staleness={max_staleness}, ckpts in {ckpt_dir})",
+          flush=True)
+
+    if args.role == "server":
+        # standalone (killable) server: serve until interrupted,
+        # then drain — a restart against the same --ckpt-dir resumes
+        # from the newest durable generation
+        import time
+        try:
+            while True:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.stop()
+            model.params = server.params_tree()
+            if args.output:
+                write_model(model, args.output)
+                print(f"train-ps: saved v{server.version} to "
+                      f"{args.output}")
+        return
+
+    # launcher: one worker subprocess per --ps-workers
+    import subprocess
+    procs = []
+    try:
+        for i in range(args.ps_workers):
+            cmd = [sys.executable, "-m", "deeplearning4j_tpu",
+                   "train-ps", "--role", "worker",
+                   "--connect", f"{server.host}:{server.port}",
+                   "--model", args.model, "--data", args.data,
+                   "--label-index", str(args.label_index),
+                   "--classes", str(args.classes),
+                   "--batch-size", str(args.batch_size),
+                   "--epochs", str(args.epochs),
+                   "--worker-index", str(i),
+                   "--num-workers", str(args.ps_workers),
+                   "--push-threshold", str(args.push_threshold),
+                   "--op-timeout", str(args.op_timeout)]
+            procs.append(subprocess.Popen(cmd))
+        failures = 0
+        for i, pr in enumerate(procs):
+            if pr.wait() != 0:
+                failures += 1
+                print(f"train-ps: worker {i} exited "
+                      f"{pr.returncode}", file=sys.stderr)
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.terminate()
+        server.stop()
+    model.params = server.params_tree()
+    out = args.output or args.model
+    write_model(model, out)
+    st = server.stats
+    print(f"train-ps: v{server.version} "
+          f"({st['pushes_applied']} pushes applied, "
+          f"{st['pushes_stale']} stale, "
+          f"{st['workers_reaped']} reaped, "
+          f"{st['restarts']} restarts); saved to {out}")
+    if failures:
+        sys.exit(f"train-ps: {failures} worker(s) failed")
+
+
 def _cmd_ui(args):
     import time
     from deeplearning4j_tpu.ui.server import UIServer
@@ -478,6 +615,71 @@ def main(argv=None):
                         "recorded random one) — rerunning with the "
                         "printed seed replays the faults")
     t.set_defaults(fn=_cmd_train)
+
+    ps = sub.add_parser(
+        "train-ps",
+        help="asynchronous parameter-server training: compressed-"
+             "delta push/pull with bounded staleness")
+    ps.add_argument("--model", required=True)
+    ps.add_argument("--data", required=True)
+    ps.add_argument("--label-index", type=int, required=True)
+    ps.add_argument("--classes", type=int, default=0,
+                    help="0 = regression")
+    ps.add_argument("--batch-size", type=int, default=64)
+    ps.add_argument("--epochs", type=int, default=1)
+    ps.add_argument("--role",
+                    choices=("launcher", "server", "worker"),
+                    default="launcher",
+                    help="launcher runs the server here and spawns "
+                         "worker subprocesses; server/worker run one "
+                         "piece each (for soaks that SIGKILL them)")
+    ps.add_argument("--ps-workers", type=int, default=2,
+                    help="worker subprocesses the launcher spawns")
+    ps.add_argument("--connect", metavar="HOST:PORT", default=None,
+                    help="(worker role) the server to join")
+    ps.add_argument("--worker-index", type=int, default=0,
+                    help="(worker role) this worker's shard index")
+    ps.add_argument("--num-workers", type=int, default=1,
+                    help="(worker role) total shard count")
+    ps.add_argument("--host", default="127.0.0.1")
+    ps.add_argument("--ps-port", type=int, default=0,
+                    help="server listen port (0 = ephemeral)")
+    ps.add_argument("--lr", type=float, default=0.05,
+                    help="server-side SGD rate applied to pushed "
+                         "deltas")
+    ps.add_argument("--max-staleness", type=int, default=-1,
+                    metavar="N",
+                    help="refuse pushes based on params more than N "
+                         "versions behind (-1 = unbounded async, "
+                         "0 = every push must be current)")
+    ps.add_argument("--push-threshold", type=float, default=0.0,
+                    help="EF sparsification threshold (entries with "
+                         "|g+residual| below it wait in the "
+                         "residual; the reference's "
+                         "ThresholdAlgorithm knob)")
+    ps.add_argument("--ckpt-dir", default=None,
+                    help="durable-generation directory (default "
+                         "OUTPUT.ps-ckpts); a restarted server "
+                         "resumes from the newest intact one")
+    ps.add_argument("--save-every", type=int, default=50,
+                    metavar="N", help="checkpoint every N applied "
+                                      "pushes (async, off the "
+                                      "serving path)")
+    ps.add_argument("--heartbeat-timeout", type=float, default=3.0,
+                    metavar="S",
+                    help="retire a worker silent for S seconds")
+    ps.add_argument("--op-timeout", type=float, default=2.0,
+                    metavar="S",
+                    help="per-op client deadline before "
+                         "reconnect+retry")
+    ps.add_argument("--output", default=None)
+    ps.add_argument("--chaos", metavar="PLAN", default=None,
+                    help="deterministic fault plan (sites "
+                         "ps.push.drop / ps.pull.timeout / "
+                         "ps.server.restart)")
+    ps.add_argument("--chaos-seed", type=int, default=None,
+                    metavar="N")
+    ps.set_defaults(fn=_cmd_train_ps)
 
     u = sub.add_parser("ui", help="training dashboard server")
     u.add_argument("--port", type=int, default=9000)

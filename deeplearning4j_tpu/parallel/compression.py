@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["ThresholdCompressor", "int8_all_reduce",
-           "int8_all_reduce_ef", "make_compressed_psum",
+           "int8_all_reduce_ef", "int8_quantize_ef",
+           "int8_dequantize", "make_compressed_psum",
            "make_compressed_psum_ef"]
 
 
@@ -73,6 +74,56 @@ def int8_all_reduce(x, axis_name: str) -> jnp.ndarray:
     return total.astype(x.dtype) * scale
 
 
+def _ef_carry(x, residual, threshold: float):
+    """EF pre-quantization: fold the carried residual in and apply
+    the sparsification threshold. ALL arithmetic runs in float32: the
+    EF contract is that the new residual equals the EXACT
+    quantization (+ threshold) error, and computing ``g - sent`` in a
+    narrow input dtype (bf16 grads on a DCN path, bf16 deltas on the
+    parameter-server path) would round part of that error away — the
+    compressor would then silently LOSE signal instead of carrying
+    it, which is the one thing error feedback exists to prevent. The
+    residual therefore stays float32 end to end, whatever dtype the
+    values being compressed are. Returns ``(g, g_kept)``."""
+    g = jnp.asarray(x, jnp.float32) + jnp.asarray(residual,
+                                                  jnp.float32)
+    if threshold > 0.0:
+        g_kept = jnp.where(jnp.abs(g) >= threshold, g, 0.0)
+    else:
+        g_kept = g
+    return g, g_kept
+
+
+def _ef_encode(g, g_kept, absmax):
+    """Quantize ``g_kept`` against ``absmax`` (local max for the
+    point-to-point path, pmax'd for the collective) and compute the
+    float32 residual. Returns ``(q_int8, scale, new_residual)``."""
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(g_kept / scale), -127, 127).astype(jnp.int8)
+    sent = q.astype(jnp.float32) * scale
+    return q, scale, g - sent          # exact quantization error (f32)
+
+
+def int8_quantize_ef(x, residual, threshold: float = 0.0):
+    """Point-to-point half of :func:`int8_all_reduce_ef`: quantize
+    ONE tensor to int8 with error feedback, no collective required —
+    the codec the parameter-server delta path pushes over TCP
+    (parallel/paramserver.py), where there is no psum to hide inside.
+
+    Returns ``(q_int8, scale, new_residual)``; ``new_residual`` is
+    ALWAYS float32 and equals the exact quantization + threshold
+    error ``(x + residual) - dequant(q)`` computed in float32 (the
+    EF invariant the property test in tests/test_parallel.py pins,
+    bf16 inputs included). Decode with :func:`int8_dequantize`."""
+    g, g_kept = _ef_carry(x, residual, threshold)
+    return _ef_encode(g, g_kept, jnp.max(jnp.abs(g_kept)))
+
+
+def int8_dequantize(q, scale, dtype=jnp.float32):
+    """Decode :func:`int8_quantize_ef`'s wire pair back to values."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 def int8_all_reduce_ef(x, residual, axis_name: str,
                        threshold: float = 0.0):
     """int8 quantized all-reduce WITH in-step residual error feedback —
@@ -80,21 +131,18 @@ def int8_all_reduce_ef(x, residual, axis_name: str,
     with residual carry (EncodingHandler.java:116-181: values below
     threshold stay in the updates array for future steps). The local
     quantization error (g + residual − dequant(q)) becomes the next
-    step's residual, so nothing is permanently lost.
+    step's residual, so nothing is permanently lost. The residual is
+    carried in float32 (see :func:`_ef_carry`): a bf16 gradient's
+    quantization error is itself sub-bf16-resolution, and rounding
+    the carry would break the EF invariant the tests pin.
 
     Returns (reduced_sum, new_residual)."""
-    g = x + residual
-    if threshold > 0.0:
-        g_kept = jnp.where(jnp.abs(g) >= threshold, g, 0.0)
-    else:
-        g_kept = g
+    g, g_kept = _ef_carry(x, residual, threshold)
     absmax = lax.pmax(jnp.max(jnp.abs(g_kept)), axis_name)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(g_kept / scale), -127, 127).astype(jnp.int8)
-    sent = q.astype(x.dtype) * scale
-    new_residual = g - sent            # quantization + threshold error
-    total = lax.psum(q.astype(jnp.int32), axis_name).astype(x.dtype) * scale
-    return total, new_residual
+    q, scale, new_residual = _ef_encode(g, g_kept, absmax)
+    total = (lax.psum(q.astype(jnp.int32), axis_name)
+             .astype(jnp.float32) * scale)
+    return total.astype(x.dtype), new_residual
 
 
 def make_compressed_psum_ef(threshold: float = 0.0):

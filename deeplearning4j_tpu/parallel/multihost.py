@@ -72,9 +72,20 @@ def is_coordinator() -> bool:
 def local_batch_slice(global_batch: int) -> slice:
     """This host's slice of a globally-indexed batch — the analog of
     the reference's per-executor RDD partitions (ExportSupport) and
-    per-host sharded iterators."""
+    per-host sharded iterators.
+
+    ``global_batch`` must divide evenly by the host count: silently
+    truncating the remainder would drop ``global_batch % n`` examples
+    from EVERY batch on every host — a data bug no loss curve would
+    ever point back here."""
     n = jax.process_count()
-    per = global_batch // n
+    per, rem = divmod(global_batch, n)
+    if rem:
+        raise ValueError(
+            f"global batch {global_batch} is not divisible by the "
+            f"host count {n}: {rem} example(s) per batch would be "
+            f"silently dropped — pad the batch to a multiple of "
+            f"{n} or change the host count")
     i = jax.process_index()
     return slice(i * per, (i + 1) * per)
 

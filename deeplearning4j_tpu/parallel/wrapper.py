@@ -565,8 +565,12 @@ class ParallelWrapper:
 
     def _init_residual(self):
         ndev = self.mesh.shape["data"]
+        # float32 regardless of param dtype: the EF residual carries
+        # the exact quantization error (compression._ef_carry), and
+        # int8_all_reduce_ef returns it as float32 — a narrower init
+        # would change the carry aval after the first step
         zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros((ndev,) + p.shape, p.dtype),
+            lambda p: jnp.zeros((ndev,) + p.shape, jnp.float32),
             self.model.params)
         return jax.device_put(zeros, NamedSharding(self.mesh, P("data")))
 

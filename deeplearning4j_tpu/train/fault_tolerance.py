@@ -92,7 +92,7 @@ from deeplearning4j_tpu import chaos
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-__all__ = ["ElasticTrainer"]
+__all__ = ["ElasticTrainer", "CheckpointWriter"]
 
 _CKPT_RE = re.compile(r"ckpt_(\d+)\.zip$")
 _TMP_RE = re.compile(r"ckpt_\d+\.zip\.tmp(\d+)$")
@@ -197,6 +197,14 @@ class _CheckpointWriter:
             if self._error is not None:
                 err, self._error = self._error, None
                 raise err
+
+
+# public name for the async-checkpoint writer: the parameter server
+# (parallel/paramserver.py) reuses the same one-in-flight coalescing
+# writer + barrier discipline for its durable version snapshots, so
+# "PS failover restores the last durable version" rides exactly the
+# machinery the preemption PR proved out
+CheckpointWriter = _CheckpointWriter
 
 
 def _hash_array(h, a) -> None:

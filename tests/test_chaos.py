@@ -103,6 +103,35 @@ class TestFaultInjector:
                 {"faults": [{"site": "data.fetch",
                              "kind": "truncate", "p": 1.0}]})
 
+    def test_ps_site_typo_fails_fast_at_parse_time(self):
+        """A fat-fingered parameter-server site must fail the whole
+        plan at parse time — a soak that 'passed' because its faults
+        targeted a site nothing ever hits is worse than no soak."""
+        with pytest.raises(ValueError, match="unknown chaos site"):
+            chaos.parse_plan(
+                {"faults": [{"site": "ps.push.dorp", "kind": "drop",
+                             "p": 1.0}]})
+        with pytest.raises(ValueError, match="unknown chaos site"):
+            chaos.parse_plan(
+                {"faults": [{"site": "ps.restart", "kind": "restart",
+                             "p": 1.0}]})
+
+    def test_ps_sites_accept_their_kinds_and_reject_others(self):
+        for site, kind in (("ps.push.drop", "drop"),
+                           ("ps.pull.timeout", "timeout"),
+                           ("ps.server.restart", "restart")):
+            plan = chaos.parse_plan(
+                {"faults": [{"site": site, "kind": kind, "p": 1.0}]})
+            assert plan.faults[0].site == site
+        with pytest.raises(ValueError, match="does not support"):
+            chaos.parse_plan(
+                {"faults": [{"site": "ps.push.drop",
+                             "kind": "timeout", "p": 1.0}]})
+        with pytest.raises(ValueError, match="does not support"):
+            chaos.parse_plan(
+                {"faults": [{"site": "ps.server.restart",
+                             "kind": "crash", "p": 1.0}]})
+
     def test_plan_from_json_string_and_file(self, tmp_path):
         doc = {"seed": 11, "faults": [
             {"site": "data.fetch", "kind": "slow", "p": 0.5,

@@ -345,6 +345,30 @@ def _free_port():
     return port
 
 
+class TestLocalBatchSlice:
+    def test_divisible_batch_slices_evenly(self, monkeypatch):
+        import jax
+
+        from deeplearning4j_tpu.parallel import multihost
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        monkeypatch.setattr(jax, "process_index", lambda: 2)
+        assert multihost.local_batch_slice(64) == slice(32, 48)
+
+    def test_non_divisible_batch_raises_naming_both_numbers(
+            self, monkeypatch):
+        """A 65-example batch over 4 hosts used to silently truncate
+        to 16 per host — one example dropped from EVERY batch. The
+        refusal must name both numbers so the error is actionable
+        from a log line alone."""
+        import jax
+
+        from deeplearning4j_tpu.parallel import multihost
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        with pytest.raises(ValueError, match=r"65.*4"):
+            multihost.local_batch_slice(65)
+
+
 class TestMultiProcessDistributed:
     def test_two_process_dp_equals_single_process(self, tmp_path):
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -328,6 +328,65 @@ class TestCompression:
         np.testing.assert_allclose(np.asarray(tot), 0.0)
         np.testing.assert_allclose(np.asarray(nr), x)
 
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    @pytest.mark.parametrize("threshold", [0.0, 0.3])
+    def test_ef_residual_is_exact_quantization_error(self, dtype,
+                                                     threshold):
+        """The EF invariant: after a quantize step, residual ==
+        (gradient + old residual) - dequant(sent), EXACTLY, in
+        float32 — including for bf16 inputs, where running the carry
+        in input precision used to leak the sub-ulp part of the
+        error every step (the dtype drift the point-to-point
+        refactor pinned down)."""
+        from deeplearning4j_tpu.parallel.compression import (
+            int8_dequantize, int8_quantize_ef)
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(0, 1, (256,)), dtype)
+        r = jnp.asarray(rng.normal(0, 0.1, (256,)), jnp.float32)
+        q, scale, nr = int8_quantize_ef(x, r, threshold=threshold)
+        assert np.asarray(q).dtype == np.int8
+        assert np.asarray(nr).dtype == np.float32   # never narrows
+        g = (np.asarray(x, np.float32)
+             + np.asarray(r, np.float32))
+        sent = np.asarray(int8_dequantize(q, scale))
+        # exact: the residual IS the quantization error, bit for bit
+        np.testing.assert_array_equal(np.asarray(nr), g - sent)
+        # and nothing exceeds half a quantization step unless it was
+        # withheld whole by the threshold
+        step = float(scale)
+        kept = np.abs(g) >= threshold
+        assert np.all(np.abs(np.asarray(nr)[kept]) <= step / 2 + 1e-7)
+
+    def test_point_to_point_matches_collective_singleton(self):
+        """int8_quantize_ef on one member must produce the same
+        residual and total as int8_all_reduce_ef over a 1-wide axis:
+        the PS push path and the DCN all-reduce share one quantizer."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel.compression import (
+            int8_all_reduce_ef, int8_dequantize, int8_quantize_ef)
+        mesh = build_mesh(MeshSpec(data=1), jax.devices()[:1])
+        rng = np.random.default_rng(5)
+        x = rng.normal(0, 1, (1, 64)).astype(np.float32)
+        r = rng.normal(0, 0.05, (1, 64)).astype(np.float32)
+
+        def f(a, res):
+            tot, nr = int8_all_reduce_ef(a[0], res[0], "data",
+                                         threshold=0.2)
+            return tot, nr[None]
+        tot, nr = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=(P(), P("data"))))(x, r)
+        q, scale, nr2 = int8_quantize_ef(x[0], r[0], threshold=0.2)
+        # same math, different XLA programs (fusion/FMA): tight
+        # tolerance, not bit equality
+        np.testing.assert_allclose(np.asarray(nr)[0],
+                                   np.asarray(nr2), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tot),
+                                   np.asarray(int8_dequantize(
+                                       q, scale)), atol=1e-6)
+
 
 class TestCompressedTrainer:
     def test_compressed_dp_close_to_single_device(self):

@@ -1,0 +1,635 @@
+"""Async parameter-server training: wire-protocol integrity (CRC
+frames, typed errors), bounded staleness, idempotent push dedupe,
+heartbeat-reaper worker churn, durable-generation crash-restart, the
+three ps.* chaos drills, in-process 3-worker convergence — and the
+slow multi-process soak (SIGKILL a worker AND the server mid-run;
+training still completes)."""
+
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.parallel.paramserver import (
+    ParameterServer, PSClient, PSError, PSFrameError, PSProtocolError,
+    PSTimeoutError, PSWorker, StalenessExceededError, pack_frame,
+    read_frame, run_async_training)
+from fixtures import make_batches, tiny_classifier
+
+pytestmark = pytest.mark.ps
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    chaos.uninstall()
+
+
+def _tiny_params():
+    return {"w": np.ones((3, 2), np.float32),
+            "b": np.zeros((2,), np.float32)}
+
+
+def _clustered_batches(n_batches, batch=8, seed=0):
+    """Learnable 3-class data (cluster-shifted gaussians) matching
+    tiny_classifier's 4-in/3-out shape — the NaN-fixture batches are
+    noise by design, useless for convergence assertions."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        c = rng.integers(0, 3, batch)
+        x = (rng.normal(size=(batch, 4))
+             + c[:, None] * 1.5).astype(np.float32)
+        out.append(DataSet(x, np.eye(3, dtype=np.float32)[c]))
+    return out
+
+
+def _eval_loss(model, batches):
+    losses = [float(model._loss(model.params, model.state,
+                                model._batch_tuple(ds), None,
+                                training=False)[0])
+              for ds in batches]
+    return float(np.mean(losses))
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+
+class TestWireFrames:
+    def _roundtrip(self, raw):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(raw)
+            a.shutdown(socket.SHUT_WR)   # sender done (or dead)
+            b.settimeout(0.5)
+            return read_frame(b, deadline=time.monotonic() + 2.0)
+        finally:
+            a.close()
+            b.close()
+
+    def test_frame_round_trip(self):
+        hdr, payload = self._roundtrip(
+            pack_frame({"op": "push", "seq": 7}, b"\x01\x02\x03"))
+        assert hdr["op"] == "push" and hdr["seq"] == 7
+        assert payload == b"\x01\x02\x03"
+
+    def test_bit_flip_anywhere_fails_crc(self):
+        raw = bytearray(pack_frame({"op": "pull"}, b"payload-bytes"))
+        for pos in (6, len(raw) // 2, len(raw) - 6):
+            bad = bytearray(raw)
+            bad[pos] ^= 0x40
+            with pytest.raises(PSFrameError):
+                self._roundtrip(bytes(bad))
+
+    def test_truncation_mid_frame_fails_typed(self):
+        """The SIGKILL'd-worker signature: the peer dies mid-send and
+        the stream ends short — a typed frame error, never a
+        half-applied message."""
+        raw = pack_frame({"op": "push"}, b"x" * 64)
+        with pytest.raises(PSFrameError, match="short of a complete"):
+            self._roundtrip(raw[:len(raw) - 10])
+
+    def test_bad_magic_rejected(self):
+        raw = b"NOPE" + pack_frame({"op": "pull"})[4:]
+        with pytest.raises(PSFrameError, match="magic"):
+            self._roundtrip(raw)
+
+    def test_insane_header_length_bounded(self):
+        raw = b"DPS1" + struct.pack("<I", 1 << 24) + b"{}"
+        with pytest.raises(PSFrameError, match="sanity bound"):
+            self._roundtrip(raw)
+
+
+# ---------------------------------------------------------------------------
+# server ops over a live socket
+# ---------------------------------------------------------------------------
+
+class TestServerOps:
+    @pytest.fixture()
+    def server(self):
+        s = ParameterServer(_tiny_params(), lr=0.5,
+                            heartbeat_timeout_s=30.0).start()
+        yield s
+        s.stop()
+
+    def test_hello_assigns_ids_and_pull_returns_params(self, server):
+        c = PSClient(server.address)
+        try:
+            leaves, version = c.pull()
+            assert version == 0
+            assert c.worker_id == "w0"
+            # pytree leaf order: dict keys sorted -> b then w
+            np.testing.assert_array_equal(leaves[0],
+                                          np.zeros((2,), np.float32))
+            np.testing.assert_array_equal(leaves[1],
+                                          np.ones((3, 2), np.float32))
+        finally:
+            c.close()
+
+    def test_push_applies_sgd_update(self, server):
+        c = PSClient(server.address)
+        try:
+            leaves, version = c.pull()
+            # a delta of exactly scale*q per element
+            q = [np.full((2,), 10, np.int8),
+                 np.full((3, 2), -20, np.int8)]
+            ack = c.push([(q[0], 0.1), (q[1], 0.05)], version)
+            assert ack["applied"] is True and ack["version"] == 1
+            got = server.params_tree()
+            # b: 0 - 0.5*(10*0.1) = -0.5 ; w: 1 - 0.5*(-20*0.05) = 1.5
+            np.testing.assert_allclose(np.asarray(got["b"]), -0.5,
+                                       atol=1e-6)
+            np.testing.assert_allclose(np.asarray(got["w"]), 1.5,
+                                       atol=1e-6)
+        finally:
+            c.close()
+
+    def test_duplicate_seq_discarded_idempotently(self, server):
+        c = PSClient(server.address)
+        try:
+            _, version = c.pull()
+            q = [(np.ones((2,), np.int8), 1.0),
+                 (np.ones((3, 2), np.int8), 1.0)]
+            c.push(q, version)
+            before = server.params_tree()
+            c._seq -= 1             # simulate a retry after lost ack
+            ack = c.push(q, version)
+            assert ack.get("duplicate") is True
+            assert ack["applied"] is False
+            assert server.version == 1          # applied exactly once
+            after = server.params_tree()
+            np.testing.assert_array_equal(np.asarray(before["w"]),
+                                          np.asarray(after["w"]))
+        finally:
+            c.close()
+
+    def test_bounded_staleness_refusal_is_typed(self):
+        server = ParameterServer(_tiny_params(), lr=0.1,
+                                 max_staleness=1,
+                                 heartbeat_timeout_s=30.0).start()
+        a, b = PSClient(server.address), PSClient(server.address)
+        try:
+            _, va = a.pull()
+            _, vb = b.pull()
+            q = [(np.ones((2,), np.int8), 0.1),
+                 (np.ones((3, 2), np.int8), 0.1)]
+            a.push(q, va)           # v1
+            a.push(q, a.server_version)   # v2: b is now 2 behind
+            with pytest.raises(StalenessExceededError) as ei:
+                b.push(q, vb)
+            assert ei.value.base_version == 0
+            assert ei.value.server_version == 2
+            assert ei.value.max_staleness == 1
+            # a fresh pull unblocks the refused worker
+            _, vb = b.pull()
+            assert b.push(q, vb)["applied"] is True
+        finally:
+            a.close()
+            b.close()
+            server.stop()
+
+    def test_leaf_count_mismatch_is_protocol_error(self, server):
+        c = PSClient(server.address)
+        try:
+            _, version = c.pull()
+            with pytest.raises(PSProtocolError, match="leaves"):
+                c.push([(np.ones((2,), np.int8), 0.1)], version)
+        finally:
+            c.close()
+
+    def test_unknown_op_is_protocol_error(self, server):
+        c = PSClient(server.address)
+        try:
+            with pytest.raises(PSProtocolError, match="unknown op"):
+                c._request({"op": "frobnicate"})
+        finally:
+            c.close()
+
+    def test_version_vector_tracks_workers(self, server):
+        a, b = PSClient(server.address), PSClient(server.address)
+        try:
+            a.pull()
+            b.pull()
+            vv = server.worker_versions()
+            assert set(vv) == {"w0", "w1"}
+            assert all(v == 0 for v in vv.values())
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# churn: heartbeats, the reaper, replacement workers
+# ---------------------------------------------------------------------------
+
+class TestWorkerChurn:
+    def test_silent_worker_reaped_and_replacement_joins(self):
+        server = ParameterServer(_tiny_params(),
+                                 heartbeat_timeout_s=0.3).start()
+        try:
+            dead = PSClient(server.address)
+            dead.pull()
+            assert server.live_workers() == ["w0"]
+            dead._drop()            # vanish without a bye (SIGKILL)
+            deadline = time.monotonic() + 5.0
+            while server.live_workers() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert server.live_workers() == []
+            assert server.stats["workers_reaped"] == 1
+            # a replacement joins mid-run and is served immediately
+            repl = PSClient(server.address)
+            try:
+                leaves, version = repl.pull()
+                assert len(leaves) == 2
+            finally:
+                repl.close()
+        finally:
+            server.stop()
+
+    def test_bye_deregisters_without_reap(self):
+        server = ParameterServer(_tiny_params(),
+                                 heartbeat_timeout_s=0.3).start()
+        try:
+            c = PSClient(server.address)
+            c.pull()
+            c.close()               # polite exit
+            deadline = time.monotonic() + 5.0
+            while server.live_workers() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert server.stats["workers_reaped"] == 0
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# durable generations + crash-restart
+# ---------------------------------------------------------------------------
+
+class TestDurableRestart:
+    def _push_n(self, client, n):
+        client.pull()                    # learn the base version
+        q = [(np.ones((2,), np.int8), 0.01),
+             (np.ones((3, 2), np.int8), 0.01)]
+        for _ in range(n):
+            client.push(q, client.server_version)
+
+    def test_new_server_resumes_from_newest_generation(self, tmp_path):
+        d = str(tmp_path / "ckpts")
+        server = ParameterServer(_tiny_params(), lr=0.1,
+                                 checkpoint_dir=d,
+                                 save_every=2).start()
+        c = PSClient(server.address)
+        self._push_n(c, 5)
+        c.close()
+        server.stop()               # final durable write at v5
+        expect = server.params_tree()
+
+        resumed = ParameterServer(_tiny_params(), lr=0.1,
+                                  checkpoint_dir=d, save_every=2)
+        assert resumed.version == 5
+        np.testing.assert_array_equal(
+            np.asarray(resumed.params_tree()["w"]),
+            np.asarray(expect["w"]))
+
+    def test_corrupt_newest_generation_quarantined(self, tmp_path):
+        d = str(tmp_path / "ckpts")
+        server = ParameterServer(_tiny_params(), lr=0.1,
+                                 checkpoint_dir=d,
+                                 save_every=2).start()
+        c = PSClient(server.address)
+        self._push_n(c, 4)
+        c.close()
+        server.stop()
+        zips = sorted(f for f in os.listdir(d) if f.endswith(".zip"))
+        newest = os.path.join(d, zips[-1])
+        with open(newest, "r+b") as f:   # flip a payload bit
+            f.seek(200)
+            b = f.read(1)
+            f.seek(200)
+            f.write(bytes([b[0] ^ 0xFF]))
+        resumed = ParameterServer(_tiny_params(), lr=0.1,
+                                  checkpoint_dir=d, save_every=2)
+        assert resumed.version < 4           # fell back a generation
+        assert any(f.endswith(".corrupt") for f in os.listdir(d))
+
+    def test_push_ahead_of_restarted_server_refused_typed(
+            self, tmp_path):
+        """After a restart rolls versions back, a surviving worker's
+        base version LEADS the server — that push must be refused
+        with the staleness error (pull a fresh snapshot), not
+        applied against the wrong base."""
+        d = str(tmp_path / "ckpts")
+        server = ParameterServer(_tiny_params(), lr=0.1,
+                                 checkpoint_dir=d,
+                                 save_every=100).start()
+        c = PSClient(server.address)
+        try:
+            self._push_n(c, 3)               # v3, nothing durable yet
+            server._restart_req.set()        # crash-restart drill
+            deadline = time.monotonic() + 5.0
+            while server.version != 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert server.version == 0       # rolled back (no ckpt)
+            q = [(np.ones((2,), np.int8), 0.01),
+                 (np.ones((3, 2), np.int8), 0.01)]
+            with pytest.raises(StalenessExceededError,
+                               match="ahead of the server"):
+                c.push(q, 3)
+            _, v = c.pull()
+            assert v == 0
+            assert c.push(q, v)["applied"] is True
+        finally:
+            c.close()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos drills
+# ---------------------------------------------------------------------------
+
+class TestChaosDrills:
+    def test_push_drop_retried_and_applied_exactly_once(self):
+        chaos.install({"faults": [{"site": "ps.push.drop",
+                                   "kind": "drop", "at": [1]}]},
+                      seed=0)
+        server = ParameterServer(_tiny_params(), lr=0.1,
+                                 heartbeat_timeout_s=30.0).start()
+        c = PSClient(server.address, op_timeout_s=0.4)
+        try:
+            _, version = c.pull()
+            q = [(np.ones((2,), np.int8), 0.1),
+                 (np.ones((3, 2), np.int8), 0.1)]
+            ack = c.push(q, version)         # dropped once, retried
+            assert ack["applied"] is True
+            assert server.version == 1       # exactly once
+            assert server.stats["pushes_applied"] == 1
+            assert server.stats["pushes_duplicate"] == 0
+        finally:
+            c.close()
+            server.stop()
+
+    def test_pull_timeout_retried(self):
+        chaos.install({"faults": [{"site": "ps.pull.timeout",
+                                   "kind": "timeout", "at": [1]}]},
+                      seed=0)
+        server = ParameterServer(_tiny_params(),
+                                 heartbeat_timeout_s=30.0).start()
+        c = PSClient(server.address, op_timeout_s=0.4)
+        try:
+            leaves, version = c.pull()       # reply swallowed once
+            assert version == 0 and len(leaves) == 2
+        finally:
+            c.close()
+            server.stop()
+
+    def test_server_restart_mid_training_recovers(self, tmp_path):
+        """The full drill: restart the server after the 6th applied
+        push; the run completes, the restart rolled versions back to
+        a durable generation, and the workers' stale/ahead pushes
+        were refused typed and refolded — training still converges
+        forward from the restored params."""
+        chaos.install({"faults": [{"site": "ps.server.restart",
+                                   "kind": "restart", "at": [6]}]},
+                      seed=0)
+        batches = make_batches(8, batch=8)
+        model, sstats, wstats = run_async_training(
+            lambda i: tiny_classifier(seed=i), batches, n_workers=2,
+            epochs=4, lr=0.2, max_staleness=None,
+            checkpoint_dir=str(tmp_path / "ck"), save_every=4)
+        assert sstats["restarts"] == 1
+        assert sstats["pushes_applied"] > 6  # kept training after
+        total_steps = sum(w["steps"] for w in wstats)
+        assert total_steps == 2 * 4 * 4      # nobody lost their loop
+
+
+# ---------------------------------------------------------------------------
+# end-to-end training (in-process)
+# ---------------------------------------------------------------------------
+
+class TestAsyncTraining:
+    def test_three_workers_reach_sync_target(self):
+        """Acceptance: the async PS run must reach the same loss
+        neighborhood as a synchronous SGD loop over the same batches
+        at the same rate (int8+EF compression and staleness included
+        in the loop)."""
+        import jax
+        batches = _clustered_batches(12, batch=8)
+        lr, epochs = 0.2, 8
+
+        sync = tiny_classifier(seed=0)
+        state = sync.state
+
+        def loss_fn(p, b, r):
+            loss, _ = sync._loss(p, state, b, r, training=True)
+            return loss
+
+        vg = jax.jit(jax.value_and_grad(loss_fn))
+        params = sync.params
+        init = _eval_loss(sync, batches)
+        for epoch in range(epochs):
+            for i, ds in enumerate(batches):
+                _, g = vg(params, sync._batch_tuple(ds),
+                          jax.random.fold_in(sync._rng_key,
+                                             epoch * 12 + i))
+                params = jax.tree_util.tree_map(
+                    lambda p, gg: p - lr * gg, params, g)
+        sync.params = params
+        sync_final = _eval_loss(sync, batches)
+        assert sync_final < init             # baseline actually learns
+
+        model, sstats, wstats = run_async_training(
+            lambda i: tiny_classifier(seed=i), batches, n_workers=3,
+            epochs=epochs, lr=lr, max_staleness=4)
+        ps_final = _eval_loss(model, batches)
+        # same neighborhood: within 80% of the sync loss drop
+        target = init - 0.8 * (init - sync_final)
+        assert ps_final <= target, (
+            f"async PS final {ps_final:.4f} vs sync {sync_final:.4f} "
+            f"(target {target:.4f}, init {init:.4f})")
+        # every worker step produced exactly one push: applied, or
+        # refused-stale and refolded into the residual
+        total_steps = sum(w["steps"] for w in wstats)
+        assert (sstats["pushes_applied"] + sstats["pushes_stale"]
+                == total_steps)
+
+    def test_staleness_zero_forces_fresh_pulls(self):
+        batches = make_batches(6, batch=8)
+        model, sstats, wstats = run_async_training(
+            lambda i: tiny_classifier(seed=i), batches, n_workers=2,
+            epochs=3, lr=0.1, max_staleness=0)
+        # with two racing workers, serialization shows up as stale
+        # refusals that were refolded into the residual — never lost
+        total_steps = sum(w["steps"] for w in wstats)
+        assert total_steps == 2 * 3 * 3
+        assert (sstats["pushes_applied"] + sstats["pushes_stale"]
+                == total_steps)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+class TestCLI:
+    def test_train_ps_help_lists_the_knobs(self, capsys):
+        from deeplearning4j_tpu.cli import main
+        with pytest.raises(SystemExit) as ei:
+            main(["train-ps", "--help"])
+        assert ei.value.code == 0
+        out = capsys.readouterr().out
+        for flag in ("--max-staleness", "--push-threshold",
+                     "--ps-workers", "--role", "--heartbeat-timeout",
+                     "--chaos"):
+            assert flag in out
+
+
+# ---------------------------------------------------------------------------
+# the multi-process soak: SIGKILL a worker AND the server mid-run
+# ---------------------------------------------------------------------------
+
+def _write_soak_fixtures(tmp_path):
+    from deeplearning4j_tpu.util.model_serializer import write_model
+    model_zip = str(tmp_path / "m.zip")
+    write_model(tiny_classifier(seed=0), model_zip)
+    rng = np.random.default_rng(0)
+    rows = []
+    for _ in range(96):
+        c = int(rng.integers(0, 3))
+        x = rng.normal(size=4) + c * 1.5
+        rows.append(",".join(f"{v:.4f}" for v in x) + f",{c}")
+    csv = str(tmp_path / "d.csv")
+    with open(csv, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    return model_zip, csv
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+class TestMultiProcessSoak:
+    def test_sigkill_worker_and_server_training_completes(
+            self, tmp_path):
+        """The acceptance soak: 3 worker processes against a server
+        process; SIGKILL worker 0 mid-run and start a replacement;
+        SIGKILL the server mid-run and restart it on the same port +
+        checkpoint dir. Every surviving process exits 0, the final
+        model trains BELOW its starting loss, and no process hangs
+        (every wait here is bounded)."""
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        model_zip, csv = _write_soak_fixtures(tmp_path)
+        port = _free_port()
+        ck = str(tmp_path / "ck")
+        out_zip = str(tmp_path / "out.zip")
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+
+        def start_server():
+            return subprocess.Popen(
+                [sys.executable, "-m", "deeplearning4j_tpu",
+                 "train-ps", "--role", "server", "--model", model_zip,
+                 "--data", csv, "--label-index", "4", "--classes",
+                 "3", "--host", "127.0.0.1", "--ps-port", str(port),
+                 "--ckpt-dir", ck, "--save-every", "5", "--lr", "0.2",
+                 "--heartbeat-timeout", "2.0", "--output", out_zip],
+                env=env, cwd=repo, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT)
+
+        def start_worker(i):
+            return subprocess.Popen(
+                [sys.executable, "-m", "deeplearning4j_tpu",
+                 "train-ps", "--role", "worker", "--connect",
+                 f"127.0.0.1:{port}", "--model", model_zip,
+                 "--data", csv, "--label-index", "4", "--classes",
+                 "3", "--batch-size", "8", "--epochs", "10",
+                 "--worker-index", str(i), "--num-workers", "3",
+                 "--op-timeout", "2.0"],
+                env=env, cwd=repo, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT)
+
+        procs = []
+        server = start_server()
+        procs.append(server)
+        try:
+            # wait (bounded) for the listener before pointing
+            # workers at it
+            deadline = time.monotonic() + 60
+            up = False
+            while time.monotonic() < deadline:
+                line = server.stdout.readline().decode()
+                if "parameter server on" in line:
+                    up = True
+                    break
+                if server.poll() is not None:
+                    break
+            assert up, "server never came up"
+
+            workers = [start_worker(i) for i in range(3)]
+            procs += workers
+            time.sleep(8.0)          # let everyone join and push
+
+            # --- churn drill 1: SIGKILL worker 0, start replacement
+            workers[0].kill()
+            workers[0].wait(timeout=30)
+            time.sleep(1.0)
+            replacement = start_worker(0)
+            procs.append(replacement)
+
+            # --- churn drill 2: SIGKILL the server, restart it
+            time.sleep(2.0)
+            server.kill()
+            server.wait(timeout=30)
+            server2 = start_server()
+            procs.append(server2)
+
+            outs = {}
+            for name, p in (("w1", workers[1]), ("w2", workers[2]),
+                            ("repl", replacement)):
+                try:
+                    out, _ = p.communicate(timeout=600)
+                except subprocess.TimeoutExpired:
+                    raise AssertionError(f"{name} hung")
+                outs[name] = out.decode()
+                assert p.returncode == 0, \
+                    f"{name} exited {p.returncode}:\n{outs[name]}"
+                assert "pushes applied" in outs[name]
+
+            # polite shutdown of the restarted server -> final save
+            server2.send_signal(signal.SIGINT)
+            out2, _ = server2.communicate(timeout=60)
+            assert server2.returncode == 0, out2.decode()
+            assert os.path.exists(out_zip)
+
+            from deeplearning4j_tpu.util.model_serializer import (
+                restore_model)
+            final = restore_model(out_zip)
+            fresh = restore_model(model_zip)
+            from deeplearning4j_tpu.data.records import (
+                CSVRecordReader, RecordReaderDataSetIterator)
+            batches = list(RecordReaderDataSetIterator(
+                CSVRecordReader().initialize(csv), 8, label_index=4,
+                num_classes=3))
+            assert _eval_loss(final, batches) \
+                < _eval_loss(fresh, batches) - 0.1
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
