@@ -332,14 +332,135 @@ def _parse_model_spec(spec):
     return name, path
 
 
+def _parse_random_corpus(spec):
+    """``random:n=4096,dim=64,seed=0[,clusters=32]`` -> params dict.
+    Clustered gaussian data, NOT uniform: uniform low-D gaussians are
+    adversarial for IVF (every cell borders every other), clustered
+    corpora are what the recall acceptance gate measures."""
+    params = {"n": 4096, "dim": 64, "seed": 0, "clusters": 32}
+    body = spec.split(":", 1)[1] if ":" in spec else ""
+    for part in filter(None, body.split(",")):
+        key, sep, val = part.partition("=")
+        if not sep or key not in params:
+            raise SystemExit(
+                f"bad --index random spec field {part!r} (want "
+                "n=,dim=,seed=,clusters=)")
+        try:
+            params[key] = int(val)
+        except ValueError:
+            raise SystemExit(f"--index random spec field {part!r} "
+                             "must be an integer")
+    if params["n"] < 1 or params["dim"] < 1 or params["clusters"] < 1:
+        raise SystemExit("--index random spec wants positive "
+                         "n/dim/clusters")
+    return params
+
+
+def _load_corpus(spec):
+    """--index SPEC -> (ids, vectors, vocab|None, table|None).
+
+    SPEC is either ``random:...`` (synthetic clustered corpus with a
+    w{i}->row vocab so text search works out of the box) or a .npz
+    with ``vectors`` (n,d) [+ ``ids``] [+ ``tokens``/``table`` for
+    the embedder].
+    """
+    import numpy as np
+    if spec.startswith("random:") or spec == "random":
+        p = _parse_random_corpus(spec)
+        rng = np.random.default_rng(p["seed"])
+        centers = rng.normal(size=(p["clusters"], p["dim"]))
+        assign = rng.integers(0, p["clusters"], size=p["n"])
+        vectors = (centers[assign]
+                   + 0.15 * rng.normal(size=(p["n"], p["dim"]))
+                   ).astype(np.float32)
+        ids = np.arange(p["n"], dtype=np.int64)
+        vocab = {f"w{i}": i for i in range(p["n"])}
+        return ids, vectors, vocab, vectors
+    if not os.path.exists(spec):
+        raise SystemExit(f"--index: no such corpus file: {spec}")
+    data = np.load(spec, allow_pickle=False)
+    if "vectors" not in data:
+        raise SystemExit(f"--index: {spec} has no 'vectors' array "
+                         f"(found {sorted(data.files)})")
+    vectors = np.asarray(data["vectors"], np.float32)
+    ids = (np.asarray(data["ids"], np.int64) if "ids" in data
+           else np.arange(vectors.shape[0], dtype=np.int64))
+    vocab = table = None
+    if "tokens" in data and "table" in data:
+        toks = [str(t) for t in data["tokens"]]
+        vocab = {t: i for i, t in enumerate(toks)}
+        table = np.asarray(data["table"], np.float32)
+    return ids, vectors, vocab, table
+
+
+def _retrieval_factory(args):
+    """--index/--index-kind/--nlist/--nprobe/--index-metric -> a
+    ``metrics -> RetrievalService`` factory. Each call builds a FRESH
+    index + embedder, so every replica owns its device arrays (and a
+    replaced replica reloads, not shares, the corpus)."""
+    spec, kind = args.index, args.index_kind
+    metric, nlist = args.index_metric, args.nlist
+    nprobe = args.nprobe
+
+    def factory(metrics):
+        from deeplearning4j_tpu.retrieval import (BruteForceIndex,
+                                                  IVFIndex,
+                                                  TextEmbedder)
+        from deeplearning4j_tpu.serving.retrieval_backend import (
+            RetrievalService)
+        ids, vectors, vocab, table = _load_corpus(spec)
+        dim = int(vectors.shape[1])
+        if kind == "ivf":
+            index = IVFIndex(dim, nlist=nlist, metric=metric)
+            index.build(ids, vectors)
+        else:
+            index = BruteForceIndex(dim, metric=metric)
+            index.add(ids, vectors)
+        embedder = None
+        if vocab is not None and table is not None:
+            embedder = TextEmbedder(vocab, table)
+        svc = RetrievalService(
+            index, embedder=embedder,
+            max_batch_size=args.max_batch_size,
+            queue_limit=args.queue_limit, wait_ms=args.wait_ms,
+            default_nprobe=nprobe)
+        return svc.attach_metrics(metrics)
+
+    return factory
+
+
+def _add_index_flags(p):
+    """The retrieval knobs serve and serve-fleet share."""
+    p.add_argument("--index", metavar="SPEC", default=None,
+                   help="host a vector index: 'random:n=4096,dim=64,"
+                        "seed=0,clusters=32' or an .npz with "
+                        "vectors[+ids][+tokens/table for /v1/embed] "
+                        "(enables /v1/embed /v1/search /v1/index/*)")
+    p.add_argument("--index-kind", choices=("brute", "ivf"),
+                   default="brute",
+                   help="brute = exact matmul top-k; ivf = coarse-"
+                        "quantized cells, recall traded for latency "
+                        "via nprobe")
+    p.add_argument("--nlist", type=int, default=16,
+                   help="IVF cell count (k-means centroids)")
+    p.add_argument("--nprobe", type=int, default=None,
+                   help="server default IVF cells probed per query "
+                        "(requests may override per call)")
+    p.add_argument("--index-metric",
+                   choices=("cosine", "dot", "euclidean"),
+                   default="cosine", help="similarity metric")
+
+
 def _cmd_serve(args):
     import time
     from deeplearning4j_tpu.serving.http import ModelServer
     from deeplearning4j_tpu.serving.metrics import ServingMetrics
     from deeplearning4j_tpu.serving.registry import ModelRegistry
     from deeplearning4j_tpu.util.model_serializer import restore_model
+    if not args.model and not args.index:
+        raise SystemExit("serve needs --model and/or --index")
     registry = ModelRegistry()
-    for spec in args.model:
+    for spec in args.model or []:
         name, path = _parse_model_spec(spec)
         version = registry.register(name, restore_model(path))
         print(f"registered {name} v{version} from {path}")
@@ -360,7 +481,15 @@ def _cmd_serve(args):
         slots=args.slots, capacity=args.capacity, metrics=metrics,
         sample_rate=args.trace_sample, slow_ms=args.slow_ms,
         slos=slos, kv_mode=args.kv_mode, page_size=args.page_size,
-        kv_pages=args.kv_pages, mesh=args.mesh)
+        kv_pages=args.kv_pages, mesh=args.mesh,
+        retrieval=_retrieval_factory(args) if args.index else None)
+    if args.index:
+        st = server.retrieval.stats()["index"]
+        print(f"index: {st['kind']}/{st['metric']} — "
+              f"{st['vectors']} vector(s), dim {st['dim']}"
+              + (f", nlist {st['nlist']}" if "nlist" in st else "")
+              + ("; embedder attached (/v1/embed, text /v1/search)"
+                 if server.retrieval.embedder is not None else ""))
     if args.mesh:
         print(f"serving mesh: {server.mesh_plan} "
               f"({server.mesh_plan.n_devices()} device(s); predict "
@@ -435,7 +564,9 @@ def _cmd_serve_fleet(args):
         print(f"chaos: fault plan installed "
               f"({len(inj.plan.faults)} spec(s), seed {inj.seed}; "
               f"replay with --chaos-seed {inj.seed})")
-    specs = [_parse_model_spec(s) for s in args.model]
+    if not args.model and not args.index:
+        raise SystemExit("serve-fleet needs --model and/or --index")
+    specs = [_parse_model_spec(s) for s in args.model or []]
 
     def factory(specs=specs):
         # called once per replica boot: each replica owns its model
@@ -458,7 +589,13 @@ def _cmd_serve_fleet(args):
                            kv_mode=args.kv_mode,
                            page_size=args.page_size,
                            kv_pages=args.kv_pages,
-                           mesh=args.mesh)).start()
+                           mesh=args.mesh,
+                           retrieval=_retrieval_factory(args)
+                           if args.index else None)).start()
+    if args.index:
+        print(f"index: {args.index_kind} over --index {args.index} "
+              f"(one copy per replica; /v1/search fails over, "
+              f"/v1/index/* fans out to every replica)")
     if roles:
         print("fleet roles: " + ", ".join(
             f"replica {r.id}={r.role}" for r in fleet.snapshot()))
@@ -507,6 +644,58 @@ def _cmd_serve_fleet(args):
             scaler.stop(wait_retires=False)
         router.stop()
         fleet.stop(drain=True)
+
+
+def _cmd_index_build(args):
+    """The offline index workload: load/synthesize a corpus, build
+    the index on device, report stats (+ IVF recall vs exact), and
+    optionally write the .npz corpus serve --index consumes."""
+    import time as _time
+    import numpy as np
+    from deeplearning4j_tpu.retrieval import BruteForceIndex, IVFIndex
+    ids, vectors, vocab, table = _load_corpus(args.corpus)
+    dim = int(vectors.shape[1])
+    t0 = _time.perf_counter()
+    if args.index_kind == "ivf":
+        index = IVFIndex(dim, nlist=args.nlist,
+                         metric=args.index_metric)
+        index.build(ids, vectors)
+    else:
+        index = BruteForceIndex(dim, metric=args.index_metric)
+        index.add(ids, vectors)
+    built_s = _time.perf_counter() - t0
+    st = index.stats()
+    extra = (f", {st['cells']['count']} populated cell(s) of nlist "
+             f"{st['nlist']} (largest {st['cells']['max_size']})"
+             if "nlist" in st else "")
+    print(f"built {st['kind']}/{st['metric']}: {st['vectors']} "
+          f"vector(s), dim {st['dim']}{extra} in {built_s:.2f}s")
+    if args.report_recall and hasattr(index, "estimate_recall"):
+        k = args.report_recall
+        probes = sorted({max(1, min(n, args.nlist))
+                         for n in (1, 4, 16, args.nlist)})
+        for npb in probes:
+            t0 = _time.perf_counter()
+            r = index.estimate_recall(k=k, sample=64, nprobe=npb)
+            dt = _time.perf_counter() - t0
+            if r is None:
+                continue
+            print(f"recall@{k} nprobe={npb}: {r:.3f} "
+                  f"(64-query probe, {dt:.2f}s)")
+    elif args.report_recall:
+        print(f"recall@{args.report_recall}: 1.000 (brute force is "
+              "the exact oracle)")
+    if args.out:
+        payload = {"ids": np.asarray(ids), "vectors": vectors}
+        if vocab is not None and table is not None:
+            payload["tokens"] = np.array(
+                sorted(vocab, key=vocab.get))
+            payload["table"] = table
+        np.savez_compressed(args.out, **payload)
+        print(f"wrote {args.out}: {vectors.shape[0]} vector(s)"
+              + (", embedder vocab+table included"
+                 if vocab is not None else "")
+              + " — load it with serve --index")
 
 
 def _cmd_summary(args):
@@ -697,7 +886,7 @@ def main(argv=None):
         "serve",
         help="model-serving HTTP server (dynamic + continuous "
              "batching, admission control, /metrics)")
-    v.add_argument("--model", action="append", required=True,
+    v.add_argument("--model", action="append", required=False,
                    metavar="[NAME=]PATH",
                    help="model zip to host; repeatable; NAME defaults "
                         "to 'default'")
@@ -754,6 +943,7 @@ def main(argv=None):
                         "pow2 batch bucket; the mesh shape is "
                         "surfaced on /healthz and the "
                         "serving_mesh_devices gauge")
+    _add_index_flags(v)
     v.set_defaults(fn=_cmd_serve)
 
     f = sub.add_parser(
@@ -761,7 +951,7 @@ def main(argv=None):
         help="N-replica serving fleet behind the health-aware "
              "router (failover, hedging, session affinity, "
              "zero-downtime drain)")
-    f.add_argument("--model", action="append", required=True,
+    f.add_argument("--model", action="append", required=False,
                    metavar="[NAME=]PATH",
                    help="model zip hosted on EVERY replica; "
                         "repeatable")
@@ -850,7 +1040,36 @@ def main(argv=None):
                         "'router_latency_seconds' with labels "
                         "{'route': '/v1/predict'} for latency "
                         "objectives at the router")
+    _add_index_flags(f)
     f.set_defaults(fn=_cmd_serve_fleet)
+
+    ix = sub.add_parser(
+        "index",
+        help="vector-index workloads (build / recall report)")
+    ixsub = ix.add_subparsers(dest="index_cmd", required=True)
+    ib = ixsub.add_parser(
+        "build",
+        help="build an index from a corpus, report recall, write "
+             "the .npz serve --index loads")
+    ib.add_argument("--corpus", required=True, metavar="SPEC",
+                    help="'random:n=4096,dim=64,seed=0,clusters=32' "
+                         "or an existing .npz with vectors[+ids]"
+                         "[+tokens/table]")
+    ib.add_argument("--out", default=None, metavar="FILE",
+                    help="write the corpus as .npz (ids, vectors "
+                         "[, tokens, table]) for serve --index")
+    ib.add_argument("--index-kind", choices=("brute", "ivf"),
+                    default="ivf")
+    ib.add_argument("--nlist", type=int, default=16,
+                    help="IVF cell count")
+    ib.add_argument("--index-metric",
+                    choices=("cosine", "dot", "euclidean"),
+                    default="cosine")
+    ib.add_argument("--report-recall", type=int, default=10,
+                    metavar="K",
+                    help="estimate recall@K vs the exact answer "
+                         "over a seeded 64-query probe (0 skips)")
+    ib.set_defaults(fn=_cmd_index_build)
 
     s = sub.add_parser("summary", help="inspect a model file")
     s.add_argument("--model", required=True)

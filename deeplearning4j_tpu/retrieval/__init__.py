@@ -1,0 +1,21 @@
+"""deeplearning4j_tpu.retrieval — device-resident vector search.
+
+The reference DL4J ships a nearest-neighbors REST server (VPTree) and
+the Word2Vec family as first-class products; this package turns them
+into a production retrieval subsystem: batched on-device embedding
+(:mod:`~deeplearning4j_tpu.retrieval.embedder`) and top-k vector
+search (:mod:`~deeplearning4j_tpu.retrieval.index` — a jitted
+brute-force matmul index plus an IVF coarse quantizer), served through
+the existing scheduler/router stack by
+:mod:`deeplearning4j_tpu.serving.retrieval_backend`.
+"""
+
+from deeplearning4j_tpu.retrieval.index import (  # noqa: F401
+    BruteForceIndex, IVFIndex, pow2_bucket,
+)
+from deeplearning4j_tpu.retrieval.embedder import (  # noqa: F401
+    TextEmbedder,
+)
+
+__all__ = ["BruteForceIndex", "IVFIndex", "TextEmbedder",
+           "pow2_bucket"]

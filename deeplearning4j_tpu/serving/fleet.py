@@ -292,10 +292,13 @@ class ReplicaFleet:
     def __init__(self, model_factory: Optional[Callable[[], Dict]] = None,
                  n: int = 2, server_kwargs: Optional[dict] = None,
                  model_specs: Optional[List[str]] = None,
-                 base_port: int = 0, roles=None):
-        if model_factory is None and not model_specs:
+                 base_port: int = 0, roles=None,
+                 extra_args: Optional[List[str]] = None):
+        if model_factory is None and not model_specs \
+                and not extra_args:
             raise ValueError("fleet needs a model_factory (in-process"
-                             " replicas) or model_specs (subprocess)")
+                             " replicas) or model_specs / extra_args "
+                             "such as --index (subprocess)")
         if model_factory is None and base_port <= 0:
             # subprocess replicas advertise base_port + rid to the
             # router; 0 would mean "probe http://127.0.0.1:0 forever"
@@ -306,6 +309,9 @@ class ReplicaFleet:
         self._model_factory = model_factory
         self._server_kwargs = dict(server_kwargs or {})
         self._model_specs = list(model_specs or [])
+        # extra CLI flags each subprocess replica boots with (e.g.
+        # ``--index`` so every replica hosts its own index copy)
+        self._extra_args = list(extra_args or [])
         self._base_port = base_port
         self.n = n
         # disaggregation roles, boot order ("prefill=1,decode=3" /
@@ -346,7 +352,8 @@ class ReplicaFleet:
                                  self._server_kwargs)
         else:
             r = SubprocessReplica(rid, self._model_specs,
-                                  self._base_port + rid)
+                                  self._base_port + rid,
+                                  extra_args=self._extra_args)
         if role is not None:
             r.role = role
         elif rid < len(self._roles):

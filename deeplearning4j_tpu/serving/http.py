@@ -14,6 +14,17 @@ front of the registry + schedulers:
 ``best_effort``, default standard — see ``serving/tiers.py``): under
 queue pressure the cheapest backlogged tier is shed first and 429/503
 ``Retry-After`` hints are priced by tier.
+Retrieval (``serve --index``; see ``serving/retrieval_backend.py``):
+
+- ``POST /v1/embed``    {"texts" | "text", "timeout_ms"?, "tier"?} →
+  {"embeddings", "dim", "model_version"} — the embedder is a
+  registered model ("embedder"), batched by the ordinary scheduler
+- ``POST /v1/search``   {"query" (text) | "vector"/"vectors", "k"?,
+  "nprobe"?, "filter_ids"?, "timeout_ms"?, "tier"?} → {"results":
+  [[{"id", "score"}...]...], "generation"} — text queries embed
+  first, then search; both hops share one deadline budget
+- ``POST /v1/index/{upsert,delete,compact,stats}`` — admin verbs,
+  single-writer serialized on the service's admin lock
 - ``GET  /v1/models``   → registry listing
 - ``GET  /healthz``     → {"status": "ok" | "degraded" | "draining"}
   — always 200 for humans; the STATUS field carries the judgement
@@ -40,6 +51,7 @@ from __future__ import annotations
 import base64
 import binascii
 import collections
+import functools
 import itertools
 import json
 import logging
@@ -174,7 +186,8 @@ class ModelServer:
                  sample_routes: Optional[Dict[str, float]] = None,
                  slow_ms: float = 250.0, slos=None, tracer=None,
                  kv_mode: str = "auto", page_size: int = 16,
-                 kv_pages: Optional[int] = None, mesh=None):
+                 kv_pages: Optional[int] = None, mesh=None,
+                 retrieval=None):
         self.registry = registry or ModelRegistry()
         self.metrics = metrics or ServingMetrics()
         # mesh: a declarative serving mesh spec ("tp=2" |
@@ -278,6 +291,25 @@ class ModelServer:
         # hung replica looks to the router exactly like a real one:
         # probe timeouts, rising latency, passive ejection
         self.chaos_delay_s = 0.0
+        # retrieval: a RetrievalService (or a callable building one —
+        # the in-process-fleet shape, so each replica owns fresh
+        # search backends) hosting /v1/search + /v1/index. Its
+        # embedder registers as the "embedder" model, so /v1/embed is
+        # literally the predict path over a different model.
+        self.retrieval = None
+        if retrieval is not None:
+            if self.mesh_plan is not None:
+                raise ServingError(
+                    "retrieval serving does not compose with --mesh "
+                    "(the embedder/search models are not "
+                    "tensor-parallel); host the index on an "
+                    "unsharded replica")
+            self.retrieval = retrieval(self.metrics) \
+                if callable(retrieval) \
+                else retrieval.attach_metrics(self.metrics)
+            emb = self.retrieval.embedder
+            if emb is not None and "embedder" not in self.registry:
+                self.registry.register("embedder", emb)
 
     # ---- backend resolution ----
     def _get_or_create(self, cache: dict, key: tuple, factory,
@@ -387,7 +419,14 @@ class ModelServer:
         one landing in a warmed bucket — never pays an XLA compile
         (see serving/warmup.py). Call before serving traffic."""
         from deeplearning4j_tpu.serving.warmup import warmup_server
-        return warmup_server(self, **kwargs)
+        report = warmup_server(self, **kwargs)
+        if self.retrieval is not None:
+            # the search buckets compile too (one executable per
+            # (k_pad, nprobe) pair) — warm the default so first-query
+            # latency is a queue wait, not an XLA compile
+            report["_search"] = {
+                "buckets": self.retrieval.warmup()}
+        return report
 
     # ---- HTTP plumbing ----
     def start(self) -> "ModelServer":
@@ -453,6 +492,16 @@ class ModelServer:
                     self._serve_request(server._handle_predict, path)
                 elif path == "/v1/generate":
                     self._serve_request(server._handle_generate, path)
+                elif path == "/v1/embed":
+                    self._serve_request(server._handle_embed, path)
+                elif path == "/v1/search":
+                    self._serve_request(server._handle_search, path)
+                elif path in ("/v1/index/upsert", "/v1/index/delete",
+                              "/v1/index/compact", "/v1/index/stats"):
+                    verb = path.rsplit("/", 1)[1]
+                    self._serve_request(
+                        functools.partial(server._handle_index,
+                                          verb), path)
                 elif path == "/v1/kv/export":
                     self._serve_request(server._handle_kv_export,
                                         path)
@@ -673,6 +722,117 @@ class ModelServer:
             return self._offer_payload(ids, version)
         return {"ids": np.asarray(ids).tolist(),
                 "model_version": version}
+
+    # ---- retrieval: embed + search + index admin ----
+    def _require_retrieval(self):
+        if self.retrieval is None:
+            raise ModelNotFoundError(
+                "no index hosted on this server (start it with "
+                "serve --index)")
+        return self.retrieval
+
+    @staticmethod
+    def _texts_of(body: dict, plural: str = "texts",
+                  singular: str = "text"):
+        texts = body.get(plural, body.get(singular))
+        if texts is None:
+            raise ValueError(f'body needs "{plural}" (list) or '
+                             f'"{singular}" (string)')
+        if isinstance(texts, str):
+            texts = [texts]
+        if not texts or not all(isinstance(t, str) for t in texts):
+            raise ValueError(f'"{plural}" must be a non-empty list '
+                             "of strings")
+        return texts
+
+    def _embed_sched(self, texts, timeout, ctx, tier):
+        """Embed texts through the REGISTERED embedder's scheduler
+        (the predict path, not a host-side shortcut): returns the
+        (B, D) query matrix + the served model version."""
+        r = self._require_retrieval()
+        if r.embedder is None:
+            raise ValueError(
+                "this index has no embedder — send raw vectors")
+        sched, version = self.scheduler_for("embedder")
+        packed = r.embedder.encode(texts)
+        out = sched.predict(packed, timeout=timeout, ctx=ctx,
+                            tier=tier)
+        return np.asarray(out), version
+
+    def _handle_embed(self, body: dict, ctx=None) -> dict:
+        texts = self._texts_of(body)
+        out, version = self._embed_sched(
+            texts, self._timeout_s(body), ctx, body.get("tier"))
+        if ctx is not None:
+            ctx.attrs["model_version"] = version
+        return {"embeddings": out.tolist(),
+                "dim": int(out.shape[1]),
+                "model_version": version}
+
+    def _handle_search(self, body: dict, ctx=None) -> dict:
+        r = self._require_retrieval()
+        has_text = "query" in body or "queries" in body
+        has_vec = "vector" in body or "vectors" in body
+        if has_text == has_vec:
+            raise ValueError(
+                'search body needs exactly one of "query"/"queries" '
+                '(text) or "vector"/"vectors" (raw floats)')
+        k = int(body.get("k", 10))
+        nprobe = body.get("nprobe")
+        if nprobe is not None:
+            nprobe = int(nprobe)
+        filter_ids = body.get("filter_ids")
+        if filter_ids is not None and not isinstance(
+                filter_ids, (list, tuple)):
+            raise ValueError('"filter_ids" must be a list of ids')
+        tier = body.get("tier")
+        timeout = self._timeout_s(body)
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        embedder_version = None
+        if has_text:
+            texts = self._texts_of(body, "queries", "query")
+            q, embedder_version = self._embed_sched(
+                texts, timeout, ctx, tier)
+        else:
+            q = np.asarray(body.get("vectors", body.get("vector")),
+                           np.float32)
+            if q.ndim == 1:
+                q = q[None, :]
+        # one deadline budget across both hops: the search leg gets
+        # whatever the embed leg left, so "timeout_ms" bounds the
+        # request, not each stage
+        remaining = None if deadline is None \
+            else deadline - time.monotonic()
+        ids, scores = r.search(q, k=k, nprobe=nprobe,
+                               filter_ids=filter_ids,
+                               timeout=remaining, ctx=ctx, tier=tier)
+        results = [[{"id": int(i), "score": float(s)}
+                    for i, s in zip(row_ids, row_scores) if i >= 0]
+                   for row_ids, row_scores in zip(ids, scores)]
+        out = {"results": results, "k": k,
+               "generation": r.index.generation}
+        if embedder_version is not None:
+            out["embedder_version"] = embedder_version
+        if ctx is not None:
+            ctx.attrs["index_generation"] = r.index.generation
+        return out
+
+    def _handle_index(self, verb: str, body: dict, ctx=None) -> dict:
+        r = self._require_retrieval()
+        if verb == "upsert":
+            if "ids" not in body:
+                raise ValueError('index upsert body needs "ids"')
+            return r.upsert(body["ids"],
+                            vectors=body.get("vectors"),
+                            texts=body.get("texts"))
+        if verb == "delete":
+            if "ids" not in body:
+                raise ValueError('index delete body needs "ids"')
+            return r.delete(body["ids"])
+        if verb == "compact":
+            return r.compact()
+        return r.stats()
 
     # ---- disaggregated prefill/decode + drain migration ----
     def _handle_kv_export(self, body: dict, ctx=None):
@@ -935,6 +1095,11 @@ class ModelServer:
             # operators (and the fleet router's prober) see the
             # serving mesh shape next to health, not buried in logs
             payload["mesh"] = self.mesh_plan.describe()
+        if self.retrieval is not None:
+            # index generation + size ride the health payload: the
+            # fleet's convergence checks (did the upsert land on
+            # every replica) read them here, not via a scrape
+            payload["index"] = self.retrieval.describe()
         return payload
 
     def _unready_retry_after_s(self, payload: dict) -> float:
@@ -963,6 +1128,8 @@ class ModelServer:
             state = b.breaker.state
             if state != "closed":
                 out[b.name] = state
+        if self.retrieval is not None:
+            out.update(self.retrieval.breaker_states())
         return out
 
     # ---- lifecycle ----
@@ -1014,13 +1181,23 @@ class ModelServer:
             target=lambda b=b: oks.__setitem__(
                 b, b.shutdown(drain=drain, timeout=timeout)),
             daemon=True) for b in backends]
+        retrieval = self.retrieval
+        if retrieval is not None:
+            # the search backends drain in the same concurrent wave
+            # (close() also releases the retrieval gauges)
+            threads.append(threading.Thread(
+                target=lambda: oks.__setitem__(
+                    "retrieval", retrieval.close(drain=drain,
+                                                 timeout=timeout)),
+                daemon=True))
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout + 10.0)
         with self._lock:
             self._stopping_batchers = []
-        ok = all(oks.get(b, False) for b in backends)
+        ok = all(oks.get(b, False) for b in backends) \
+            and (retrieval is None or oks.get("retrieval", False))
         # swap under the lock: two racing stop() calls must not both
         # pass the None test (the loser would call shutdown() on a
         # dead server or on None) — found by graftlint GL004; the

@@ -111,7 +111,7 @@ class _ReplicaView:
                  "unavailable_until", "probe_ok_total", "ejections",
                  "readmissions", "kv_pages_in_use", "kv_pages_total",
                  "role", "prefix_fps", "prefix_page_size",
-                 "prefix_hits", "prefix_evictions")
+                 "prefix_hits", "prefix_evictions", "index_info")
 
     def __init__(self, rid: int, url: str, breaker: CircuitBreaker):
         self.rid = rid
@@ -130,6 +130,10 @@ class _ReplicaView:
         self.role = MIXED
         self.prefix_fps: frozenset = frozenset()
         self.prefix_page_size = 0
+        # retrieval advertisement from /healthz ("index" key):
+        # generation + vector count, the convergence evidence for
+        # /v1/index fanout writes
+        self.index_info: Optional[dict] = None
         self.prefix_hits = 0.0
         self.prefix_evictions = 0.0
         # probed: ok|degraded|draining|dead. Starts NOT-eligible:
@@ -219,13 +223,15 @@ class Router:
                 "router_requests_total",
                 help="requests routed, by route",
                 labels={"route": route})
-            for route in ("/v1/predict", "/v1/generate")}
+            for route in ("/v1/predict", "/v1/generate",
+                          "/v1/embed", "/v1/search", "/v1/index")}
         self._latency = {
             route: self.registry.histogram(
                 "router_latency_seconds",
                 help="router-side whole-request latency (seconds)",
                 labels={"route": route})
-            for route in ("/v1/predict", "/v1/generate")}
+            for route in ("/v1/predict", "/v1/generate",
+                          "/v1/embed", "/v1/search", "/v1/index")}
         self._failovers = self.registry.counter(
             "router_failovers_total",
             help="attempts re-sent to a different replica after a "
@@ -404,7 +410,8 @@ class Router:
     def _probe_one(self, view: _ReplicaView) -> None:
         """One active health check: classify, refresh load signals,
         and spend the half-open probe budget on ejected replicas."""
-        ok, health, circuits = self._check_ready(view.url)
+        ok, health, circuits, index_info = self._check_ready(
+            view.url)
         load = self._read_load_signals(view.url) if ok or health \
             else None
         st = view.breaker.state
@@ -463,32 +470,37 @@ class Router:
             if prefixes is not None:
                 view.prefix_page_size = prefixes["page_size"] or 0
                 view.prefix_fps = frozenset(prefixes["prefixes"])
+            if index_info is not None:
+                view.index_info = index_info
             view.circuits = circuits
             if ok:
                 view.probe_ok_total += 1
 
     def _check_ready(self, url: str
-                     ) -> Tuple[bool, Optional[str], int]:
-        """(ready, health-classification, non-closed circuit count)
-        from /healthz?ready. ``health`` None means unreachable."""
+                     ) -> Tuple[bool, Optional[str], int,
+                                Optional[dict]]:
+        """(ready, health-classification, non-closed circuit count,
+        index advertisement) from /healthz?ready. ``health`` None
+        means unreachable."""
         try:
             status, body, _ = _http_call(
                 url, "GET", "/healthz?ready",
                 timeout=self.probe_timeout_s)
         except _NetError:
-            return False, None, 0
+            return False, None, 0, None
         try:
             payload = json.loads(body.decode() or "{}")
         except ValueError:
             payload = {}
         circuits = len(payload.get("circuits") or {})
+        index_info = payload.get("index")
         health = payload.get("status", "dead")
         if health == "draining":
             # the fleet snapshot is authoritative for draining; the
             # probed form only matters for replicas the fleet still
             # calls up (an external drain)
-            return False, "draining", circuits
-        return status == 200, health, circuits
+            return False, "draining", circuits, index_info
+        return status == 200, health, circuits, index_info
 
     def _read_load_signals(self, url: str) -> Optional[dict]:
         """Queue depth + paged-KV pool pressure + prefix-cache
@@ -753,10 +765,16 @@ class Router:
             view.unavailable_until = max(
                 view.unavailable_until, time.monotonic() + delay)
 
-    # ---- /v1/predict: failover + hedging ----
+    # ---- /v1/predict (+ the other idempotent routes):
+    # failover + hedging ----
     def _route_predict(self, body_bytes: bytes, body: dict,
-                       ctx: RequestContext
+                       ctx: RequestContext,
+                       route: str = "/v1/predict"
                        ) -> Tuple[int, bytes, Dict[str, str]]:
+        """The idempotent-route contract. /v1/embed and /v1/search
+        ride the same implementation (``route`` is the replica path):
+        a search re-sent to a second replica returns the same answer
+        modulo index generation, exactly like a re-sent predict."""
         deadline = ctx.deadline if ctx.deadline is not None \
             else time.monotonic() + self.request_timeout_s
         fwd_headers = {"Content-Type": "application/json",
@@ -775,12 +793,12 @@ class Router:
                 # hedging off: no second attempt can ever need to
                 # race this one, so run it inline on the handler
                 # thread instead of paying a thread per request
-                self._attempt(view, "/v1/predict", body_bytes,
+                self._attempt(view, route, body_bytes,
                               fwd_headers, t, results, tag)
             else:
                 threading.Thread(
                     target=self._attempt,
-                    args=(view, "/v1/predict", body_bytes,
+                    args=(view, route, body_bytes,
                           fwd_headers, t, results, tag),
                     daemon=True, name=f"router-attempt-{view.rid}"
                 ).start()
@@ -861,6 +879,81 @@ class Router:
                         f"retry-safe; replicas tried: {tried}",
                         retry_after_s=self._soonest_retry_s())
                 return status, data, resp_headers
+
+    # ---- /v1/index: fan-out to every eligible replica ----
+    def _route_index(self, body_bytes: bytes, body: dict,
+                     ctx: RequestContext, path: str
+                     ) -> Tuple[int, bytes, Dict[str, str]]:
+        """Broadcast an index admin verb (upsert/delete/compact/
+        stats) to every eligible replica and aggregate per-replica
+        outcomes. 200 only when EVERY replica accepted — a partial
+        write answers 502 with the per-replica evidence, and the
+        caller re-sends (upserts are idempotent: same ids, same
+        vectors)."""
+        deadline = ctx.deadline if ctx.deadline is not None \
+            else time.monotonic() + self.request_timeout_s
+        views = self._eligible()
+        if not views:
+            raise NoReplicaAvailableError(
+                "no replica is eligible for the index fanout",
+                retry_after_s=self._soonest_retry_s())
+        fwd_headers = {"Content-Type": "application/json",
+                       "traceparent": ctx.traceparent()}
+        results: "queue.Queue" = queue.Queue()
+        with self._lock:
+            for view in views:
+                view.inflight += 1
+
+        def call(view: _ReplicaView) -> None:
+            t = max(0.05, min(self.attempt_timeout_s,
+                              deadline - time.monotonic()))
+            try:
+                status, data, _ = self._forward(
+                    view, "POST", path, body_bytes, fwd_headers, t)
+                try:
+                    payload = json.loads(data.decode() or "{}")
+                except ValueError:
+                    payload = {"raw": data.decode(errors="replace")}
+                if status is not None and status < 500:
+                    self._note_success(view)
+                else:
+                    self._note_failure(view)
+                results.put((view.rid, {"status": status,
+                                        "body": payload}))
+            except _NetError as e:
+                self._note_failure(view)
+                results.put((view.rid, {"status": None,
+                                        "error": str(e)}))
+            finally:
+                self._release(view)
+
+        threads = [threading.Thread(target=call, args=(v,),
+                                    daemon=True,
+                                    name=f"router-index-{v.rid}")
+                   for v in views]
+        for t in threads:
+            t.start()
+        for t in threads:
+            # bounded join (GL008): a wedged replica cannot hold the
+            # handler past the request deadline + one attempt slack
+            t.join(max(0.05, deadline - time.monotonic())
+                   + self.attempt_timeout_s)
+        per_replica: Dict[str, dict] = {}
+        while not results.empty():
+            rid, entry = results.get_nowait()
+            per_replica[str(rid)] = entry
+        missing = [v.rid for v in views
+                   if str(v.rid) not in per_replica]
+        for rid in missing:
+            per_replica[str(rid)] = {"status": None,
+                                     "error": "no response before "
+                                              "deadline"}
+        ok = all(e.get("status") == 200
+                 for e in per_replica.values())
+        code = 200 if ok else 502
+        out = {"ok": ok, "verb": path.rsplit("/", 1)[1],
+               "replicas": per_replica}
+        return code, json.dumps(out).encode(), {}
 
     # ---- /v1/generate: session affinity + disaggregated split ----
     def _roles_present(self) -> bool:
@@ -1420,6 +1513,22 @@ class Router:
                     self._route(router._route_predict, path)
                 elif path == "/v1/generate":
                     self._route(router._route_generate, path)
+                elif path in ("/v1/embed", "/v1/search"):
+                    # idempotent like predict: same failover +
+                    # hedging machinery, forwarded to the same path
+                    self._route(
+                        lambda raw, body, ctx, _p=path:
+                        router._route_predict(raw, body, ctx,
+                                              route=_p), path)
+                elif path in ("/v1/index/upsert", "/v1/index/delete",
+                              "/v1/index/compact", "/v1/index/stats"):
+                    # admin writes fan out to EVERY eligible replica
+                    # (each hosts its own index copy); metrics are
+                    # keyed by the route family
+                    self._route(
+                        lambda raw, body, ctx, _p=path:
+                        router._route_index(raw, body, ctx, _p),
+                        "/v1/index")
                 else:
                     self._send(404, {"error": "not found"})
 
@@ -1576,8 +1685,15 @@ class Router:
             status = "degraded"
         else:
             status = "ok"
-        return {"status": status, "eligible": eligible,
-                "replicas": {str(k): v for k, v in states.items()}}
+        payload = {"status": status, "eligible": eligible,
+                   "replicas": {str(k): v for k, v in states.items()}}
+        with self._lock:
+            index = {str(v.rid): v.index_info
+                     for v in self._views.values()
+                     if v.index_info is not None}
+        if index:
+            payload["index"] = index
+        return payload
 
     def fleet_debug(self) -> dict:
         with self._lock:
@@ -1598,6 +1714,7 @@ class Router:
              "prefix_cache_evictions_total": v.prefix_evictions,
              "prefix_fingerprints": len(v.prefix_fps),
              "inflight": v.inflight,
+             "index": v.index_info,
              "consecutive_failures": v.consecutive_failures}
             for v in sorted(views, key=lambda v: v.rid)]}
 

@@ -1,0 +1,675 @@
+"""Retrieval subsystem: device index, embedder, serving backend,
+HTTP surface, fleet failover, legacy k-NN shim.
+
+The ISSUE's acceptance bullets live here: brute-force exactness
+against a float64 numpy oracle on all three metrics, IVF recall@10
+>= 0.9 on seeded clustered data, tombstone/compaction bookkeeping,
+mean-pool embedding semantics (OOV drop, empty text, normalization),
+deadline-expired searches never reaching the device, upsert/delete
+under concurrent search, chaos ``serving.worker.step`` crash
+restarting the search worker with the index intact, the /v1/embed +
+/v1/search + /v1/index HTTP contract, router failover for /v1/search,
+and the legacy ``/knn`` wire-compat regression (including the
+Content-Length hardening).
+"""
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.retrieval.embedder import TextEmbedder
+from deeplearning4j_tpu.retrieval.index import (BruteForceIndex,
+                                                IVFIndex, pow2_bucket)
+from deeplearning4j_tpu.serving import (DeadlineExceededError,
+                                        ModelRegistry, ModelServer,
+                                        ServingMetrics)
+from deeplearning4j_tpu.serving.fleet import ReplicaFleet
+from deeplearning4j_tpu.serving.retrieval_backend import (
+    RetrievalService, SearchModel)
+from deeplearning4j_tpu.serving.router import Router
+from deeplearning4j_tpu.services.nearest_neighbors import (
+    NearestNeighborsClient, NearestNeighborsServer)
+
+pytestmark = pytest.mark.retrieval
+
+
+# ---------------------------------------------------------------------------
+# corpus + oracle helpers
+# ---------------------------------------------------------------------------
+
+def _clustered(n, dim, clusters, seed=0):
+    """The corpus recipe every retrieval test uses: gaussian blobs,
+    NOT uniform noise — uniform data has no cell structure, so it
+    grades the IVF index on an adversarial distribution no real
+    embedding corpus resembles."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(clusters, dim)).astype(np.float32)
+    assign = rng.integers(0, clusters, size=n)
+    vecs = (centers[assign]
+            + 0.15 * rng.normal(size=(n, dim))).astype(np.float32)
+    return np.arange(n, dtype=np.int64), vecs
+
+
+def _exact_topk(vectors, ids, q, k, metric):
+    """float64 host oracle, independent of the device kernels."""
+    v = np.asarray(vectors, np.float64)
+    q = np.asarray(q, np.float64)
+    if metric == "euclidean":
+        scores = -np.sum((v - q[None, :]) ** 2, axis=1)
+    elif metric == "cosine":
+        vn = v / np.maximum(np.linalg.norm(v, axis=1),
+                            1e-12)[:, None]
+        qn = q / max(np.linalg.norm(q), 1e-12)
+        scores = vn @ qn
+    else:
+        scores = v @ q
+    order = np.argsort(-scores, kind="stable")[:k]
+    return [int(ids[r]) for r in order]
+
+
+def _post(base, path, body, timeout=10.0):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), \
+                dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}"), \
+            dict(e.headers)
+
+
+def _get(base, path, timeout=5.0):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+# ---------------------------------------------------------------------------
+# brute force: device answers == float64 oracle
+# ---------------------------------------------------------------------------
+
+class TestBruteForceExactness:
+    @pytest.mark.parametrize("metric",
+                             ["cosine", "dot", "euclidean"])
+    def test_matches_numpy_oracle(self, metric):
+        ids, vecs = _clustered(256, 16, 8, seed=3)
+        idx = BruteForceIndex(16, metric=metric)
+        idx.add(ids, vecs)
+        rng = np.random.default_rng(7)
+        queries = rng.normal(size=(8, 16)).astype(np.float32)
+        got_ids, got_scores = idx.search(queries, k=5)
+        assert got_ids.shape == (8, 5)
+        for q, got in zip(queries, got_ids):
+            want = _exact_topk(vecs, ids, q, 5, metric)
+            # sets, not sequences: ties inside the top-5 may order
+            # differently between float32 device and float64 host
+            assert set(int(g) for g in got) == set(want)
+
+    def test_scores_descend_and_k_clamps(self):
+        ids, vecs = _clustered(32, 8, 4, seed=1)
+        idx = BruteForceIndex(8, metric="dot")
+        idx.add(ids, vecs)
+        got_ids, got_scores = idx.search(vecs[:2], k=5)
+        for row in got_scores:
+            assert all(a >= b for a, b in zip(row, row[1:]))
+        # k > live rows: missing slots carry the -1 sentinel
+        got_ids, _ = idx.search(vecs[:1], k=64)
+        assert got_ids.shape == (1, 64)
+        valid = got_ids[0][got_ids[0] >= 0]
+        assert valid.size == 32 and np.unique(valid).size == 32
+
+    def test_pow2_bucket(self):
+        assert [pow2_bucket(n) for n in (1, 2, 3, 5, 8, 9)] == \
+            [1, 2, 4, 8, 8, 16]
+
+
+# ---------------------------------------------------------------------------
+# mutation: upsert / tombstone / compaction bookkeeping
+# ---------------------------------------------------------------------------
+
+class TestMutation:
+    def test_remove_tombstones_then_compact(self):
+        ids, vecs = _clustered(64, 8, 4, seed=2)
+        idx = BruteForceIndex(8)
+        g0 = idx.add(ids, vecs)
+        assert len(idx) == 64
+        removed = idx.remove(ids[:10])
+        assert removed == 10 and len(idx) == 54
+        st = idx.stats()
+        assert st["tombstones"] == 10
+        assert idx.generation > g0
+        assert idx.get(int(ids[0])) is None
+        # tombstoned ids never come back from a search
+        got, _ = idx.search(vecs[:4], k=54)
+        assert not (set(got.ravel().tolist())
+                    & set(int(i) for i in ids[:10]))
+        g1 = idx.generation
+        idx.compact()
+        st = idx.stats()
+        assert st["tombstones"] == 0 and st["vectors"] == 54
+        assert idx.generation > g1
+        got2, _ = idx.search(vecs[:4], k=54)
+        np.testing.assert_array_equal(np.sort(got, axis=1),
+                                      np.sort(got2, axis=1))
+
+    def test_upsert_replaces_in_place(self):
+        idx = BruteForceIndex(4, metric="dot")
+        idx.add([5, 6], [[1, 0, 0, 0], [0, 1, 0, 0]])
+        idx.add([5], [[0, 0, 9, 0]])          # upsert id 5
+        assert len(idx) == 2
+        np.testing.assert_allclose(idx.get(5),
+                                   [0, 0, 9, 0], atol=0)
+        got, _ = idx.search(np.array([[0, 0, 1, 0]], np.float32),
+                            k=1)
+        assert got[0, 0] == 5
+
+    def test_bad_inputs_rejected(self):
+        idx = BruteForceIndex(4)
+        with pytest.raises(ValueError, match="non-negative"):
+            idx.add([-1], [[0, 0, 0, 1]])
+        with pytest.raises(ValueError, match="duplicate"):
+            idx.add([1, 1], [[0] * 4, [1] * 4])
+        with pytest.raises(ValueError, match="vectors must be"):
+            idx.add([1], [[0, 0]])
+
+
+# ---------------------------------------------------------------------------
+# IVF: recall on clustered data, full-probe exactness, cell stats
+# ---------------------------------------------------------------------------
+
+class TestIVF:
+    def test_recall_at_10_on_seeded_corpus(self):
+        ids, vecs = _clustered(2048, 32, 32, seed=0)
+        idx = IVFIndex(32, nlist=32, seed=0).build(ids, vecs)
+        rec = idx.estimate_recall(k=10, sample=64, nprobe=4, seed=0)
+        assert rec is not None and rec >= 0.9, rec
+        st = idx.stats()
+        assert st["nlist"] == 32 and st["trained"]
+        assert st["cells"]["count"] == 32
+        assert st["cells"]["max_size"] >= 1
+
+    def test_full_probe_equals_brute_force(self):
+        ids, vecs = _clustered(512, 16, 16, seed=4)
+        ivf = IVFIndex(16, nlist=16, seed=1).build(ids, vecs)
+        brute = BruteForceIndex(16)
+        brute.add(ids, vecs)
+        q = vecs[100:104]
+        ivf_ids, _ = ivf.search(q, k=8, nprobe=16)
+        b_ids, _ = brute.search(q, k=8)
+        for a, b in zip(ivf_ids, b_ids):
+            assert set(a.tolist()) == set(b.tolist())
+
+    def test_add_after_train_lands_in_cells(self):
+        ids, vecs = _clustered(256, 8, 8, seed=5)
+        idx = IVFIndex(8, nlist=8, seed=0).build(ids, vecs)
+        new_vec = vecs[17] + 0.01
+        idx.add([9000], new_vec[None, :])
+        got, _ = idx.search(new_vec[None, :], k=2, nprobe=8)
+        assert 9000 in got[0].tolist()
+        idx.remove([9000])
+        got, _ = idx.search(new_vec[None, :], k=2, nprobe=8)
+        assert 9000 not in got[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# embedder: mean pooling semantics
+# ---------------------------------------------------------------------------
+
+class TestEmbedder:
+    VOCAB = {"alpha": 0, "beta": 1, "gamma": 2}
+    TABLE = np.array([[1, 0, 0, 0],
+                      [0, 2, 0, 0],
+                      [0, 0, 4, 0]], np.float32)
+
+    def _emb(self, **kw):
+        return TextEmbedder(self.VOCAB, self.TABLE, **kw)
+
+    def test_mean_pool_exact(self):
+        e = self._emb(normalize=False)
+        out = e.embed(["alpha beta"])
+        np.testing.assert_allclose(out[0], [0.5, 1.0, 0.0, 0.0],
+                                   atol=1e-6)
+
+    def test_oov_tokens_dropped(self):
+        e = self._emb(normalize=False)
+        np.testing.assert_allclose(
+            e.embed(["alpha zzzz unknown"])[0],
+            e.embed(["alpha"])[0], atol=1e-6)
+
+    def test_empty_text_is_zero_vector(self):
+        e = self._emb(normalize=False)
+        out = e.embed(["", "zzzz"])
+        np.testing.assert_allclose(out, np.zeros((2, 4)), atol=1e-6)
+
+    def test_normalize_unit_norm(self):
+        e = self._emb(normalize=True)
+        out = e.embed(["alpha beta gamma"])
+        assert abs(np.linalg.norm(out[0]) - 1.0) < 1e-5
+
+    def test_encode_is_pow2_padded_wire_tensor(self):
+        e = self._emb()
+        packed = e.encode(["alpha", "alpha beta gamma"])
+        assert packed.shape[0] == 2 and packed.shape[1] == 2
+        assert packed.shape[2] == pow2_bucket(packed.shape[2])
+        # mask row counts the real tokens
+        assert packed[0, 1].sum() == 1 and packed[1, 1].sum() == 3
+
+    def test_from_word2vec(self):
+        class FakeW2V:
+            vocab = self.VOCAB
+            syn0 = self.TABLE
+        e = TextEmbedder.from_word2vec(FakeW2V(), normalize=False)
+        np.testing.assert_allclose(e.embed(["gamma"])[0],
+                                   [0, 0, 4, 0], atol=1e-6)
+        assert e.info()["vocab"] == 3 and e.dim == 4
+
+
+# ---------------------------------------------------------------------------
+# service: deadline discipline — expired work never touches the device
+# ---------------------------------------------------------------------------
+
+class _RecordingSearchModel:
+    """Wraps the scheduler's SearchModel: records every batch and
+    slows the device step so a queued deadline can lapse."""
+
+    def __init__(self, inner, delay):
+        self.inner = inner
+        self.delay = delay
+        self.batches = []
+        self._lock = threading.Lock()
+
+    def output(self, x):
+        with self._lock:
+            self.batches.append(np.array(x))
+        time.sleep(self.delay)
+        return self.inner.output(x)
+
+
+@pytest.mark.chaos
+class TestDeadlineDiscipline:
+    def test_expired_search_never_reaches_device(self):
+        ids, vecs = _clustered(128, 8, 4, seed=6)
+        idx = BruteForceIndex(8)
+        idx.add(ids, vecs)
+        svc = RetrievalService(idx, max_batch_size=2, wait_ms=1.0)
+        try:
+            sched, _, _ = svc.scheduler_for(4)
+            rec = _RecordingSearchModel(sched.model, delay=0.25)
+            sched.model = rec
+            first = threading.Thread(
+                target=lambda: svc.search(vecs[:1], k=4),
+                daemon=True)
+            first.start()
+            time.sleep(0.05)           # collector is inside the sleep
+            doomed = np.full((1, 8), 7.5, np.float32)
+            with pytest.raises(DeadlineExceededError):
+                svc.search(doomed, k=4, timeout=0.05)
+            first.join(5.0)
+            assert not first.is_alive()
+            # the doomed marker payload was in no device batch
+            assert not any((b == 7.5).any() for b in rec.batches)
+        finally:
+            svc.close(drain=False)
+
+    def test_filtered_search_expired_before_scoring(self):
+        ids, vecs = _clustered(64, 8, 4, seed=6)
+        idx = BruteForceIndex(8)
+        idx.add(ids, vecs)
+        calls = {"n": 0}
+        real = idx.search
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        idx.search = counting
+        svc = RetrievalService(idx)
+        try:
+            with pytest.raises(DeadlineExceededError):
+                svc.search(vecs[:1], k=4, filter_ids=[1, 2, 3],
+                           timeout=0.0)
+            assert calls["n"] == 0
+            # a live deadline goes through and respects the filter
+            got, _ = svc.search(vecs[:1], k=4,
+                                filter_ids=[1, 2, 3], timeout=5.0)
+            assert set(got[0][got[0] >= 0].tolist()) <= {1, 2, 3}
+        finally:
+            svc.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# service: mutations under concurrent search; worker-crash chaos
+# ---------------------------------------------------------------------------
+
+class TestUpsertUnderSearch:
+    def test_concurrent_search_and_admin(self):
+        ids, vecs = _clustered(512, 16, 16, seed=8)
+        idx = IVFIndex(16, nlist=16, seed=0).build(ids, vecs)
+        svc = RetrievalService(idx, max_batch_size=8, wait_ms=1.0)
+        stop = threading.Event()
+        errors = []
+
+        def searcher(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                q = rng.normal(size=(2, 16)).astype(np.float32)
+                try:
+                    got, _ = svc.search(q, k=8, nprobe=4)
+                    assert got.shape == (2, 8)
+                except Exception as e:        # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=searcher, args=(s,),
+                                    daemon=True) for s in range(4)]
+        for t in threads:
+            t.start()
+        g0 = idx.generation
+        try:
+            rng = np.random.default_rng(99)
+            for i in range(10):
+                nid = 10_000 + i
+                svc.upsert([nid],
+                           vectors=rng.normal(size=(1, 16))
+                           .astype(np.float32))
+                if i % 3 == 0:
+                    svc.delete([int(ids[i])])
+                if i == 5:
+                    svc.compact()
+            time.sleep(0.2)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5.0)
+        assert not errors, errors
+        assert idx.generation > g0
+        assert len(idx) == 512 + 10 - 4       # 10 added, 4 deleted
+        # a freshly upserted vector is findable right away
+        v = idx.get(10_009)
+        got, _ = svc.search(v[None, :], k=4,
+                            nprobe=idx.nlist)
+        assert 10_009 in got[0].tolist()
+        svc.close(drain=False)
+
+
+@pytest.mark.chaos
+class TestWorkerCrashChaos:
+    @pytest.fixture(autouse=True)
+    def _clean_injector(self):
+        yield
+        chaos.uninstall()
+
+    def test_search_worker_crash_restarts_with_index_intact(self):
+        chaos.install({"faults": [{"site": "serving.worker.step",
+                                   "kind": "crash", "at": [1]}]},
+                      seed=1)
+        ids, vecs = _clustered(256, 8, 8, seed=9)
+        idx = BruteForceIndex(8)
+        idx.add(ids, vecs)
+        g0 = idx.generation
+        svc = RetrievalService(idx, max_batch_size=4, wait_ms=1.0)
+        try:
+            with pytest.raises(chaos.SimulatedCrashError):
+                svc.search(vecs[:1], k=4)
+            # the restarted worker serves; answers still exact
+            got, _ = svc.search(vecs[:1], k=1)
+            assert got[0, 0] == ids[0]
+            assert idx.generation == g0 and len(idx) == 256
+        finally:
+            svc.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /v1/embed + /v1/search + /v1/index on one server
+# ---------------------------------------------------------------------------
+
+def _service(n=256, dim=8, nlist=8, seed=10, with_embedder=True):
+    ids, vecs = _clustered(n, dim, nlist, seed=seed)
+    idx = IVFIndex(dim, nlist=nlist, seed=0).build(ids, vecs)
+    emb = None
+    if with_embedder:
+        emb = TextEmbedder({f"w{i}": i for i in range(n)}, vecs)
+    return RetrievalService(idx, embedder=emb, max_batch_size=8,
+                            wait_ms=1.0), ids, vecs
+
+
+class TestRetrievalHTTP:
+    @pytest.fixture()
+    def server(self):
+        svc, ids, vecs = _service()
+        server = ModelServer(ModelRegistry(), port=0,
+                             retrieval=svc).start()
+        yield server, f"http://127.0.0.1:{server.port}", ids, vecs
+        server.stop(drain=False, timeout=2.0)
+
+    def test_healthz_advertises_index(self, server):
+        _, base, _, _ = server
+        st, h = _get(base, "/healthz")
+        assert st == 200
+        info = h["index"]
+        assert info["kind"] == "ivf" and info["vectors"] == 256
+        assert info["generation"] >= 1 and info["nlist"] == 8
+        assert info["embedder_dim"] == 8
+
+    def test_embed_then_vector_search_round_trip(self, server):
+        _, base, ids, vecs = server
+        st, body, _ = _post(base, "/v1/embed", {"texts": ["w7"]})
+        assert st == 200 and body["dim"] == 8
+        st, body, _ = _post(base, "/v1/search",
+                            {"vector": body["embeddings"][0],
+                             "k": 3, "nprobe": 8})
+        assert st == 200
+        assert body["results"][0][0]["id"] == 7
+
+    def test_text_search(self, server):
+        _, base, _, _ = server
+        st, body, _ = _post(base, "/v1/search",
+                            {"query": "w12", "k": 5, "nprobe": 8})
+        assert st == 200 and len(body["results"]) == 1
+        assert body["results"][0][0]["id"] == 12
+        assert body["generation"] >= 1
+        assert "embedder_version" in body
+
+    def test_filter_ids(self, server):
+        _, base, _, vecs = server
+        st, body, _ = _post(base, "/v1/search",
+                            {"vector": vecs[0].tolist(), "k": 4,
+                             "filter_ids": [3, 4, 5]})
+        assert st == 200
+        got = {r["id"] for r in body["results"][0]}
+        assert got and got <= {3, 4, 5}
+
+    def test_validation_errors(self, server):
+        _, base, _, vecs = server
+        vec = vecs[0].tolist()
+        st, body, _ = _post(base, "/v1/search", {"vector": vec,
+                                                 "k": 0})
+        assert st == 400
+        st, body, _ = _post(base, "/v1/search", {"vector": vec,
+                                                 "k": 100000})
+        assert st == 400
+        st, body, _ = _post(base, "/v1/search",
+                            {"vector": vec, "query": "w1"})
+        assert st == 400          # exactly one of text | vector
+        st, body, _ = _post(base, "/v1/search", {"k": 5})
+        assert st == 400
+        st, body, _ = _post(base, "/v1/index/upsert",
+                            {"vectors": [vec]})
+        assert st == 400          # ids missing
+
+    def test_index_admin_verbs(self, server):
+        _, base, _, _ = server
+        st, body, _ = _post(base, "/v1/index/upsert",
+                            {"ids": [9001],
+                             "vectors": [[9.0] * 8]})
+        assert st == 200 and body["upserted"] == 1
+        gen = body["generation"]
+        st, body, _ = _post(base, "/v1/index/stats", {})
+        assert st == 200 and body["index"]["vectors"] == 257
+        st, body, _ = _post(base, "/v1/search",
+                            {"vector": [9.0] * 8, "k": 1,
+                             "nprobe": 8})
+        assert body["results"][0][0]["id"] == 9001
+        st, body, _ = _post(base, "/v1/index/delete",
+                            {"ids": [9001]})
+        assert st == 200 and body["deleted"] == 1
+        assert body["generation"] > gen
+        st, body, _ = _post(base, "/v1/index/compact", {})
+        assert st == 200
+        st, body, _ = _post(base, "/v1/index/stats", {})
+        assert body["index"]["vectors"] == 256
+        assert body["index"]["tombstones"] == 0
+
+    def test_upsert_by_text_uses_embedder(self, server):
+        _, base, _, _ = server
+        st, body, _ = _post(base, "/v1/index/upsert",
+                            {"ids": [7777], "texts": ["w3 w4"]})
+        assert st == 200 and body["upserted"] == 1
+
+    def test_search_without_index_404(self):
+        server = ModelServer(ModelRegistry(), port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            st, body, _ = _post(base, "/v1/search",
+                                {"vector": [0.0] * 4, "k": 1})
+            assert st == 404
+        finally:
+            server.stop(drain=False, timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# fleet e2e: router failover for /v1/search
+# ---------------------------------------------------------------------------
+
+class TestRouterFailover:
+    def test_search_survives_replica_kill(self):
+        def retrieval_factory(metrics):
+            svc, _, _ = _service(n=128, dim=8, nlist=8, seed=11)
+            return svc.attach_metrics(metrics)
+
+        fleet = ReplicaFleet(lambda: {}, n=2, server_kwargs=dict(
+            wait_ms=1.0, retrieval=retrieval_factory)).start()
+        router = Router(fleet, probe_interval_s=0.05,
+                        probe_timeout_s=0.4, attempt_timeout_s=2.0,
+                        request_timeout_s=10.0,
+                        hedge_after_s=None).start()
+        base = f"http://127.0.0.1:{router.port}"
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                st, h = _get(base, "/healthz")
+                if h.get("eligible") == 2:
+                    break
+                time.sleep(0.05)
+            assert h["eligible"] == 2
+            # the router health page advertises per-replica indexes
+            assert set(h["index"]) == {"0", "1"}
+            assert all(v["vectors"] == 128
+                       for v in h["index"].values())
+            st, body, _ = _post(base, "/v1/search",
+                                {"query": "w9", "k": 3,
+                                 "nprobe": 8})
+            assert st == 200
+            assert body["results"][0][0]["id"] == 9
+            # index fanout reaches every replica
+            st, body, _ = _post(base, "/v1/index/stats", {})
+            assert st == 200 and body["ok"]
+            assert len(body["replicas"]) == 2
+            fleet.snapshot()[0].kill()
+            ok = 0
+            for i in range(12):
+                st, body, _ = _post(base, "/v1/search",
+                                    {"query": f"w{i}", "k": 2,
+                                     "nprobe": 8}, timeout=10.0)
+                ok += st == 200
+                time.sleep(0.02)
+            assert ok == 12
+        finally:
+            router.stop()
+            fleet.stop(drain=False, timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# legacy shim: /knn wire compat over the new index
+# ---------------------------------------------------------------------------
+
+class TestLegacyKnnShim:
+    @pytest.fixture()
+    def knn(self):
+        rng = np.random.default_rng(12)
+        pts = rng.normal(size=(80, 6))
+        server = NearestNeighborsServer(pts, port=0,
+                                        distance="euclidean").start()
+        yield server, pts, NearestNeighborsClient(port=server.port)
+        server.stop()
+
+    def test_wire_contract_and_agreement(self, knn):
+        server, pts, client = knn
+        res = client.knn(pts[13], k=5)
+        assert set(res) == {"indices", "distances"}
+        # legacy promise: exact 0.0 self-distance, ascending order
+        assert res["indices"][0] == 13
+        assert res["distances"][0] == 0.0
+        assert res["distances"] == sorted(res["distances"])
+        # answers agree with the float64 oracle over the same points
+        want = _exact_topk(pts, np.arange(80), pts[13], 5,
+                           "euclidean")
+        assert set(res["indices"]) == set(want)
+        res2 = client.knn_index(13, k=5)
+        assert res2["indices"] == res["indices"]
+        st, status = _get(f"http://127.0.0.1:{server.port}",
+                          "/status")
+        assert status == {"points": 80, "dims": 6}
+
+    def test_validation(self, knn):
+        server, pts, client = knn
+        base = f"http://127.0.0.1:{server.port}"
+        st, body, _ = _post(base, "/knn", {"vector": [1.0], "k": 3})
+        assert st == 400          # wrong dim
+        st, body, _ = _post(base, "/knnindex", {"index": 999,
+                                                "k": 3})
+        assert st == 400          # out of range
+        st, body, _ = _post(base, "/knn", {"vector": pts[0].tolist(),
+                                           "k": "lots"})
+        assert st == 400
+        st, body, _ = _post(base, "/nope", {})
+        assert st == 404
+
+    def _raw(self, port, headers, payload=b""):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=5.0)
+        try:
+            conn.putrequest("POST", "/knn")
+            for k, v in headers.items():
+                conn.putheader(k, v)
+            conn.endheaders()
+            if payload:
+                conn.send(payload)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def test_negative_content_length_is_400(self, knn):
+        server, _, _ = knn
+        st, _ = self._raw(server.port, {"Content-Length": "-1"})
+        assert st == 400
+
+    def test_oversized_body_is_413(self, knn):
+        server, _, _ = knn
+        # the guard trips on the DECLARED length, before any read —
+        # no need to actually ship a megabyte
+        st, _ = self._raw(server.port,
+                          {"Content-Length": str((1 << 20) + 1)})
+        assert st == 413

@@ -73,9 +73,9 @@ from typing import Callable, Dict, Optional
 # only add drift risk)
 from deeplearning4j_tpu.serving.tiers import TIERS as _TIERS
 
-__all__ = ["LoadGen", "generate_body_fn", "scrape_streaming_latency",
-           "scrape_ttft_populations", "parse_profile",
-           "parse_tier_mix", "tiered_body_fn"]
+__all__ = ["LoadGen", "SearchWorkload", "generate_body_fn",
+           "scrape_streaming_latency", "scrape_ttft_populations",
+           "parse_profile", "parse_tier_mix", "tiered_body_fn"]
 
 
 def _default_body(i: int) -> dict:
@@ -194,6 +194,104 @@ def generate_body_fn(model: str = "default", prompt_len: int = 16,
                 "n_tokens": n_tokens}
 
     return body
+
+
+class SearchWorkload:
+    """``--mode search``: a Zipf-skewed query stream over a corpus
+    plus the client-side recall@k oracle.
+
+    A fixed pool of queries (corpus vectors + gaussian noise) is
+    ranked by a seeded popularity permutation; request ordinal ``i``
+    maps DETERMINISTICALLY to a pool rank through the Zipf CDF (same
+    replayable-spread idiom as the duplicate-prompt mix), so head
+    queries repeat the way real retrieval traffic does — the shape
+    that makes batching and cache effects measurable. The exact
+    brute-force top-k over the corpus is computed host-side up
+    front; every 200 response's ids score against it, and the report
+    carries the measured ``recall_at_k``.
+    """
+
+    def __init__(self, vectors, ids=None, k: int = 10,
+                 nprobe: Optional[int] = None,
+                 metric: str = "cosine", pool: int = 256,
+                 zipf_s: float = 1.1, noise: float = 0.05,
+                 seed: int = 0):
+        import numpy as np
+        self._np = np
+        vectors = np.asarray(vectors, np.float32)
+        self._ids = (np.arange(vectors.shape[0]) if ids is None
+                     else np.asarray(ids))
+        self.k = int(k)
+        self.nprobe = nprobe
+        rng = np.random.default_rng(seed)
+        pool = min(int(pool), vectors.shape[0])
+        picks = rng.choice(vectors.shape[0], size=pool,
+                           replace=False)
+        self.queries = (vectors[picks]
+                        + noise * rng.standard_normal(
+                            (pool, vectors.shape[1]))
+                        ).astype(np.float32)
+        # Zipf CDF over pool ranks: rank r has mass 1/(r+1)^s
+        w = 1.0 / np.power(np.arange(1, pool + 1, dtype=np.float64),
+                           float(zipf_s))
+        self._cdf = np.cumsum(w) / np.sum(w)
+        self._oracle = self._exact_topk(vectors, metric)
+
+    def _exact_topk(self, corpus, metric):
+        np = self._np
+        q = self.queries.astype(np.float64)
+        m = corpus.astype(np.float64)
+        if metric == "cosine":
+            qn = q / np.maximum(
+                np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+            mn = m / np.maximum(
+                np.linalg.norm(m, axis=1, keepdims=True), 1e-12)
+            scores = qn @ mn.T
+        elif metric == "dot":
+            scores = q @ m.T
+        else:                                   # euclidean
+            scores = (2.0 * (q @ m.T)
+                      - np.sum(m * m, axis=1)[None, :]
+                      - np.sum(q * q, axis=1)[:, None])
+        order = np.argsort(-scores, axis=1, kind="stable")
+        return [set(int(self._ids[p]) for p in row[:self.k])
+                for row in order]
+
+    def rank_of(self, i: int) -> int:
+        """ordinal -> Zipf-drawn pool rank, replayable (golden-ratio
+        low-discrepancy spread through the CDF, no rng at request
+        time)."""
+        u = ((i * 2654435761) % (1 << 32)) / float(1 << 32)
+        return int(self._np.searchsorted(self._cdf, u,
+                                         side="right"))
+
+    def body(self, i: int) -> dict:
+        r = min(self.rank_of(i), len(self.queries) - 1)
+        b = {"vector": [float(x) for x in self.queries[r]],
+             "k": self.k}
+        if self.nprobe is not None:
+            b["nprobe"] = int(self.nprobe)
+        return b
+
+    def make_response_cb(self, lock: threading.Lock,
+                         acc: Dict[str, float]):
+        """Recall accumulator fed by LoadGen's response hook: the
+        ordinal recomputes its pool rank deterministically, so no
+        state rides in the request."""
+        def cb(i: int, data: bytes) -> None:
+            r = min(self.rank_of(i), len(self.queries) - 1)
+            got = json.loads(data.decode())
+            ids = {int(e["id"]) for e in got["results"][0]}
+            hits = len(ids & self._oracle[r])
+            with lock:
+                acc["hits"] = acc.get("hits", 0.0) + hits
+                acc["total"] = acc.get("total", 0.0) + self.k
+        return cb
+
+    def recall(self, acc: Dict[str, float]) -> Optional[float]:
+        if not acc.get("total"):
+            return None
+        return round(acc["hits"] / acc["total"], 4)
 
 
 def _histogram_quantiles(buckets: Dict[float, float], count: float):
@@ -338,7 +436,9 @@ class LoadGen:
                  honor_retry_after: bool = True,
                  backlog_limit: Optional[int] = None,
                  profile: Optional[Callable] = None,
-                 registry=None):
+                 registry=None,
+                 response_cb: Optional[Callable[[int, bytes],
+                                               None]] = None):
         if duration_s is None and total is None:
             raise ValueError("give duration_s or total")
         from deeplearning4j_tpu.observability.registry import (
@@ -358,6 +458,9 @@ class LoadGen:
                               is not None else 8 * self.concurrency)
         self.registry = registry if registry is not None \
             else MetricsRegistry()
+        # optional per-success body hook — the search mode's recall
+        # accounting reads the returned neighbor ids through it
+        self.response_cb = response_cb
         self.latency = self.registry.histogram(
             "loadgen_latency_seconds",
             help="client-observed request latency (seconds)",
@@ -418,7 +521,7 @@ class LoadGen:
 
         while True:
             attempts += 1
-            status, retry_after = self._fire(body, deadline)
+            status, retry_after, data = self._fire(body, deadline)
             if status in (429, 503) and tc is not None:
                 with self._lock:
                     # every shed response the tier absorbed, retried
@@ -431,6 +534,11 @@ class LoadGen:
                     self._counts["ok"] += 1
                     if tc is not None:
                         tc["ok"] += 1
+                if self.response_cb is not None:
+                    try:
+                        self.response_cb(i, data)
+                    except Exception:
+                        pass        # accounting hook, never fatal
                 return
             retryable = status in ("neterr", 429, 503)
             with self._lock:
@@ -467,15 +575,14 @@ class LoadGen:
                 return
 
     def _fire(self, body: bytes, deadline: float):
-        """(status | "neterr", retry_after_seconds or None)."""
+        """(status | "neterr", retry_after_seconds or None, body)."""
         timeout = max(0.05, deadline - time.monotonic())
         req = urllib.request.Request(
             self.url + self.route, data=body,
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
-                r.read()
-                return r.status, None
+                return r.status, None, r.read()
         except urllib.error.HTTPError as e:
             e.read()
             ra = e.headers.get("Retry-After")
@@ -483,9 +590,9 @@ class LoadGen:
                 ra = float(ra) if ra is not None else None
             except ValueError:
                 ra = None
-            return e.code, ra
+            return e.code, ra, None
         except (urllib.error.URLError, OSError, TimeoutError):
-            return "neterr", None
+            return "neterr", None, None
 
     # ---- loop disciplines ----
     def _closed_loop(self) -> None:
@@ -645,11 +752,15 @@ def main(argv=None):
     p.add_argument("--route", default=None,
                    help="override the request path (default: by "
                         "--mode)")
-    p.add_argument("--mode", choices=("predict", "generate"),
+    p.add_argument("--mode", choices=("predict", "generate",
+                                      "search"),
                    default="predict",
                    help="predict = one-shot /v1/predict bodies; "
                         "generate = streaming /v1/generate bodies "
-                        "with a duplicate-prompt mix")
+                        "with a duplicate-prompt mix; search = "
+                        "Zipf-skewed /v1/search queries over "
+                        "--corpus with a client-side recall@k "
+                        "oracle")
     p.add_argument("--model", default="default")
     p.add_argument("--features", type=int, default=4,
                    help="input feature count for the default "
@@ -669,6 +780,30 @@ def main(argv=None):
                    help="generate mode: scrape TTFT/ITL histogram "
                         "percentiles from this server after the run "
                         "(default: --url; 'off' disables)")
+    p.add_argument("--corpus", default=None, metavar="SPEC",
+                   help="search mode: the corpus the TARGET serves "
+                        "('random:n=..,dim=..,seed=..' or .npz) — "
+                        "must match the server's --index so the "
+                        "recall oracle is exact")
+    p.add_argument("--k", type=int, default=10,
+                   help="search mode: neighbors per query")
+    p.add_argument("--nprobe", type=int, default=None,
+                   help="search mode: IVF cells probed (omit for "
+                        "the server default)")
+    p.add_argument("--metric", default="cosine",
+                   choices=("cosine", "dot", "euclidean"),
+                   help="search mode: oracle metric (match the "
+                        "server's --index-metric)")
+    p.add_argument("--zipf-s", type=float, default=1.1,
+                   help="search mode: Zipf skew exponent of the "
+                        "query popularity distribution")
+    p.add_argument("--query-pool", type=int, default=256,
+                   help="search mode: distinct query count")
+    p.add_argument("--query-noise", type=float, default=0.05,
+                   help="search mode: gaussian noise stddev added "
+                        "to each pooled corpus vector")
+    p.add_argument("--seed", type=int, default=0,
+                   help="search mode: query pool seed")
     p.add_argument("--concurrency", type=int, default=8)
     p.add_argument("--qps", type=float, default=None,
                    help="open-loop target rate; omit for closed "
@@ -696,6 +831,9 @@ def main(argv=None):
     if args.duration is None and args.total is None:
         args.duration = 10.0
 
+    workload = None
+    recall_lock = threading.Lock()
+    recall_acc: Dict[str, float] = {}
     if args.mode == "generate":
         route = args.route or "/v1/generate"
         body = generate_body_fn(model=args.model,
@@ -703,6 +841,22 @@ def main(argv=None):
                                 n_tokens=args.n_tokens,
                                 vocab=args.vocab,
                                 dup_ratio=args.dup_ratio)
+    elif args.mode == "search":
+        if not args.corpus:
+            p.error("--mode search needs --corpus (the same spec "
+                    "the server's --index loaded)")
+        from deeplearning4j_tpu.cli import _load_corpus
+        try:
+            ids, vectors, _, _ = _load_corpus(args.corpus)
+        except SystemExit as e:
+            p.error(str(e))
+        route = args.route or "/v1/search"
+        workload = SearchWorkload(
+            vectors, ids=ids, k=args.k, nprobe=args.nprobe,
+            metric=args.metric, pool=args.query_pool,
+            zipf_s=args.zipf_s, noise=args.query_noise,
+            seed=args.seed)
+        body = workload.body
     else:
         route = args.route or "/v1/predict"
 
@@ -725,12 +879,24 @@ def main(argv=None):
                   concurrency=args.concurrency, qps=args.qps,
                   profile=profile,
                   duration_s=args.duration, total=args.total,
-                  timeout_s=args.timeout, max_retries=args.retries)
+                  timeout_s=args.timeout, max_retries=args.retries,
+                  response_cb=workload.make_response_cb(
+                      recall_lock, recall_acc)
+                  if workload is not None else None)
     try:
         report = gen.run()
     except KeyboardInterrupt:
         gen.stop()
         report = {"interrupted": True}
+    if workload is not None:
+        with recall_lock:
+            report["search"] = {
+                "recall_at_k": workload.recall(recall_acc),
+                "k": args.k, "nprobe": args.nprobe,
+                "metric": args.metric, "zipf_s": args.zipf_s,
+                "query_pool": len(workload.queries),
+                "scored": int(recall_acc.get("total", 0)
+                              // max(args.k, 1))}
     if args.mode == "generate" and args.metrics_url != "off":
         # the serving stack's OWN streaming histograms: TTFT / ITL
         # percentiles as the server measured them, not a client proxy
