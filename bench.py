@@ -1599,6 +1599,150 @@ def _leg_router_fleet(peak):
                  "router+fleet stack, not multi-host scale-out")}
 
 
+OBS_OVERHEAD_BAR = 0.02   # ≤2% QPS cost with 1 s collector scrapes
+# per measured run: ~2.4k requests ≈ 7 s at this host's QPS, so each
+# window samples several whole scrape cycles — 600-request windows
+# are shorter than the scrape interval and measure boundary luck
+OBS_REQUESTS = 2400
+
+
+def _leg_observability_overhead(peak):
+    """What the fleet observability plane costs the serving path: the
+    router_fleet harness (N=2 subprocess replicas, out-of-process
+    loadgen) re-run with a FleetCollector scraping every member's
+    /metrics + /debug/trace-export at a 1 s interval, vs collector
+    off. The collector is pull-based and out of the request path, so
+    the cost is bounded by the /metrics render under load.
+    Bar: ≤2% QPS cost."""
+    import statistics
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.observability.fleetobs import (
+        FleetCollector)
+    from deeplearning4j_tpu.serving.fleet import ReplicaFleet
+    from deeplearning4j_tpu.serving.router import Router
+    from deeplearning4j_tpu.util.model_serializer import write_model
+
+    feat, hidden, classes = 32, 128, 16
+    conf = (NeuralNetConfiguration.builder().set_seed(0)
+            .updater(updaters.adam(1e-3)).list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=classes, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(feat)).build())
+    tmp = tempfile.mkdtemp(prefix="bench_obs_")
+    model_zip = os.path.join(tmp, "mlp.zip")
+    write_model(MultiLayerNetwork(conf).init(), model_zip)
+
+    def loadgen(router_port, total):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.loadgen",
+             "--url", f"http://127.0.0.1:{router_port}",
+             "--features", str(feat),
+             "--concurrency", str(ROUTER_CONC),
+             "--total", str(total),
+             "--timeout", "30", "--retries", "3"],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if not proc.stdout.strip():
+            raise RuntimeError(
+                f"loadgen exited {proc.returncode} with no report; "
+                f"stderr: {proc.stderr[-800:]}")
+        return json.loads(proc.stdout)
+
+    n = 2
+    fleet = ReplicaFleet(model_specs=[f"default={model_zip}"], n=n,
+                         base_port=18350).start()
+    router = Router(fleet, probe_interval_s=0.25,
+                    hedge_after_s=None, sample_rate=0.01).start()
+    try:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{router.port}/healthz",
+                        timeout=5.0) as r:
+                    if json.load(r).get("eligible") == n:
+                        break
+            except OSError:
+                pass
+            time.sleep(0.25)
+        else:
+            raise RuntimeError(f"fleet of {n} never became ready")
+        loadgen(router.port, 8 * ROUTER_CONC * n)    # warmup/compile
+
+        def run_with_collector():
+            col = FleetCollector(fleet=fleet, router=router,
+                                 interval_s=1.0, port=0).start()
+            router.attach_fleet_health(col.fleet_health)
+            try:
+                return loadgen(router.port, OBS_REQUESTS)
+            finally:
+                router.attach_fleet_health(None)
+                col.stop()
+
+        def run_without():
+            return loadgen(router.port, OBS_REQUESTS)
+
+        # PAIRED back-to-back ratios, median over alternating-order
+        # pairs — the same drift-robust shape as tracing_overhead
+        ratios, qps_off, qps_on, dropped = [], [], [], 0
+        for i in range(4):
+            if i % 2 == 0:
+                off, on = run_without(), run_with_collector()
+            else:
+                on, off = run_with_collector(), run_without()
+            for rep in (off, on):
+                dropped += rep["failed"]
+            qps_off.append(off["achieved_qps"])
+            qps_on.append(on["achieved_qps"])
+            ratios.append(on["achieved_qps"]
+                          / max(off["achieved_qps"], 1e-9))
+        rel = statistics.median(ratios)
+    finally:
+        router.stop()
+        fleet.stop(drain=False, timeout=5.0)
+    if dropped:
+        raise RuntimeError(
+            f"observability_overhead dropped {dropped} requests")
+    overhead = max(0.0, 1.0 - rel)
+    print(f"observability overhead: scraped "
+          f"{statistics.median(qps_on):.0f} q/s vs unscraped "
+          f"{statistics.median(qps_off):.0f} q/s → {rel:.3f}x "
+          f"({overhead * 100:.1f}% cost)", file=sys.stderr)
+    return {
+        "metric": (f"fleet-collector scrape overhead (router over "
+                   f"N={n} subprocess replicas, {ROUTER_CONC} "
+                   "closed-loop clients, 1 s scrape interval)"),
+        "value": round(rel, 3),
+        "unit": "throughput ratio (collector on / off)",
+        "baseline": 1.0,
+        "vs_baseline": round(rel, 3),
+        "qps_collector_on": round(statistics.median(qps_on), 1),
+        "qps_collector_off": round(statistics.median(qps_off), 1),
+        "overhead": round(overhead, 4),
+        "bar_overhead": OBS_OVERHEAD_BAR,
+        "passed_bar": bool(overhead <= OBS_OVERHEAD_BAR),
+        "host_cpus": os.cpu_count(),
+        "mfu": None,
+        "note": ("router_fleet harness with observability/"
+                 "fleetobs.py FleetCollector scraping every "
+                 "member's /metrics (OpenMetrics) and draining "
+                 "/debug/trace-export each second, SLO evaluation "
+                 "and fleet /healthz feedback attached, vs the "
+                 "identical fleet unscraped. Median of 4 paired "
+                 "back-to-back ratios, pair order alternating; "
+                 "bar: ≤2% QPS cost — the collector is pull-based "
+                 "and off the request path")}
+
+
 def _leg_autoscaler_soak(peak):
     """The self-healing-fleet drill as a measured claim: a ~6x QPS
     step over a 1-replica fleet with a seeded whole-replica kill
@@ -3217,6 +3361,9 @@ _LEGS = [
     # CPU-dominated (loopback HTTP, tiny transformer replicas):
     # the KV-aware vs affinity-only router A/B
     ("disagg_kv_routing", _leg_disagg_kv_routing, 300),
+    # CPU-dominated (loopback HTTP, subprocess replicas): collector
+    # scrape on/off A/B over the router_fleet harness
+    ("observability_overhead", _leg_observability_overhead, 240),
     # CPU-dominated (sleep-based replicas, control-loop timing):
     # cheap, runs last
     ("autoscaler_soak", _leg_autoscaler_soak, 240),

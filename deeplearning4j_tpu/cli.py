@@ -617,6 +617,33 @@ def _cmd_serve_fleet(args):
         slos = SLOMonitor.from_config(router.registry, args.slo)
         print(f"slo: {len(slos.status())} objective(s) over the "
               "router registry (slo_breach on /metrics)")
+    collector = None
+    if args.collector is not None:
+        from deeplearning4j_tpu.observability.fleetobs import (
+            FleetCollector)
+        fleet_slos = ()
+        if args.slo:
+            # the SAME rules, judged a second time over the MERGED
+            # series: the router-level monitor above sees one
+            # process; the collector's copy sees the whole fleet
+            from deeplearning4j_tpu.observability.registry import (
+                MetricsRegistry)
+            from deeplearning4j_tpu.observability.slo import (
+                SLOMonitor)
+            fleet_slos = tuple(SLOMonitor.from_config(
+                MetricsRegistry(), args.slo)._slos.values())
+        collector = FleetCollector(
+            fleet=fleet, router=router,
+            interval_s=args.collector_interval,
+            port=args.collector,
+            slos=fleet_slos,
+            incident_dir=args.incident_dir).start()
+        router.attach_fleet_health(collector.fleet_health)
+        print(f"fleet collector on http://127.0.0.1:"
+              f"{collector.port}/ scraping every "
+              f"{args.collector_interval:g}s (/metrics "
+              f"/fleet/snapshot /traces /healthz; incidents under "
+              f"{collector.incident_dir})")
     scaler = None
     if bounds is not None:
         from deeplearning4j_tpu.serving.autoscaler import Autoscaler
@@ -626,11 +653,14 @@ def _cmd_serve_fleet(args):
             min_replicas=lo, max_replicas=hi,
             tick_interval_s=args.autoscale_tick,
             queue_high=args.queue_high,
-            queue_low=args.queue_low).start()
+            queue_low=args.queue_low,
+            collector=collector).start()
         print(f"autoscaler: bounds {lo}..{hi}, tick "
               f"{args.autoscale_tick:g}s, queue watermarks "
               f"{args.queue_low:g}/{args.queue_high:g}"
-              + (f", {len(slos.status())} SLO(s)" if slos else ""))
+              + (f", {len(slos.status())} SLO(s)" if slos else "")
+              + (", merged signals via collector"
+                 if collector is not None else ""))
     print(f"fleet router on http://{args.host}:{router.port}/ over "
           f"{fleet.size()} replica(s) "
           f"(/v1/predict /v1/generate /v1/models /healthz /readyz "
@@ -642,8 +672,43 @@ def _cmd_serve_fleet(args):
         print("draining fleet...")
         if scaler is not None:
             scaler.stop(wait_retires=False)
+        if collector is not None:
+            collector.stop()
         router.stop()
         fleet.stop(drain=True)
+
+
+def _cmd_fleet_status(args):
+    """Render a running collector's /fleet/snapshot as the text
+    dashboard — once, or forever under --watch."""
+    import json as _json
+    import urllib.request
+
+    from deeplearning4j_tpu.observability.fleetobs import (
+        render_status)
+
+    base = args.collector.rstrip("/")
+
+    def fetch():
+        with urllib.request.urlopen(base + "/fleet/snapshot",
+                                    timeout=5.0) as resp:
+            return _json.loads(resp.read().decode("utf-8"))
+
+    if args.watch is None:
+        print(render_status(fetch()))
+        return
+    try:
+        while True:
+            try:
+                text = render_status(fetch())
+            except (OSError, ValueError) as exc:
+                text = f"collector unreachable at {base}: {exc}"
+            # clear-screen escape keeps the dashboard in place like
+            # watch(1) without depending on curses
+            print("\x1b[2J\x1b[H" + text, flush=True)
+            time.sleep(max(0.2, args.watch))
+    except KeyboardInterrupt:
+        pass
 
 
 def _cmd_index_build(args):
@@ -1040,8 +1105,37 @@ def main(argv=None):
                         "'router_latency_seconds' with labels "
                         "{'route': '/v1/predict'} for latency "
                         "objectives at the router")
+    f.add_argument("--collector", type=int, default=None,
+                   metavar="PORT",
+                   help="run the fleet observability collector on "
+                        "this port (0 picks a free one): scrapes "
+                        "every member's /metrics each interval, "
+                        "re-exposes the merged fleet registry, "
+                        "stitches cross-process traces, and writes "
+                        "incident bundles on fleet-SLO breach or "
+                        "replica death. Read it with 'fleet-status "
+                        "--collector URL'")
+    f.add_argument("--collector-interval", type=float, default=1.0,
+                   metavar="S",
+                   help="collector scrape period (seconds)")
+    f.add_argument("--incident-dir", default=None, metavar="DIR",
+                   help="where the collector writes incident-scoped "
+                        "fleet bundles (default: cwd)")
     _add_index_flags(f)
     f.set_defaults(fn=_cmd_serve_fleet)
+
+    fs = sub.add_parser(
+        "fleet-status",
+        help="one-shot (or --watch) dashboard over a running fleet "
+             "collector's /fleet/snapshot")
+    fs.add_argument("--collector", default="http://127.0.0.1:9290",
+                    metavar="URL",
+                    help="base URL of the collector started by "
+                         "serve-fleet --collector")
+    fs.add_argument("--watch", type=float, default=None, metavar="S",
+                    help="refresh every S seconds until ctrl-c "
+                         "instead of printing once")
+    fs.set_defaults(fn=_cmd_fleet_status)
 
     ix = sub.add_parser(
         "index",

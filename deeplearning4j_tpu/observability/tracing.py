@@ -184,6 +184,14 @@ class Tracer:
         self._sinks: List = []
         # one origin for the whole trace so ts values are comparable
         self._origin_ns = time.perf_counter_ns()
+        # wall-clock anchor for the same origin: a cross-process
+        # collector needs absolute time to order spans from different
+        # tracers (perf_counter origins are per-process and arbitrary)
+        self._origin_unix = time.time()
+        # monotone per-event sequence number; the cursor a remote
+        # drain (``export_since``) resumes from, immune to ring
+        # eviction (unlike buffer indices)
+        self._seq = 0
 
     # ---- recording state ----
     @property
@@ -215,6 +223,8 @@ class Tracer:
             self._events.clear()
             self.dropped = 0
             self._origin_ns = time.perf_counter_ns()
+            self._origin_unix = time.time()
+            self._seq = 0
 
     # ---- span API ----
     def span(self, name: str, attrs: Optional[Dict[str, Any]] = None):
@@ -330,6 +340,8 @@ class Tracer:
             if len(self._events) == self.buffer_limit:
                 # ring is full: the append below evicts the oldest
                 self.dropped += 1
+            self._seq += 1
+            ev["seq"] = self._seq
             self._events.append(ev)
             if self._jsonl is not None:
                 self._jsonl.write(json.dumps(ev) + "\n")
@@ -357,6 +369,33 @@ class Tracer:
     def events(self) -> List[dict]:
         with self._lock:
             return list(self._events)
+
+    def export_since(self, since: int = 0,
+                     limit: int = 10_000) -> Dict[str, Any]:
+        """Incremental drain for a remote collector: every buffered
+        span with ``seq > since``, oldest first, capped at ``limit``
+        per call. The returned ``next`` is the cursor to pass back on
+        the following poll; ``origin_unix`` lets the collector map a
+        span's process-relative ``ts_us`` onto wall-clock time
+        (``origin_unix * 1e6 + ts_us``) so spans from N processes
+        order on one axis. If the ring evicted events past the
+        caller's cursor (a slow scraper), the gap shows up as
+        ``dropped`` growth — the collector reports it, it does not
+        stall."""
+        since = int(since)
+        with self._lock:
+            spans = [ev for ev in self._events
+                     if ev.get("seq", 0) > since]
+            dropped = self.dropped
+            origin_unix = self._origin_unix
+            head = self._seq
+        spans = spans[:max(0, int(limit))]
+        nxt = spans[-1]["seq"] if spans else max(since, 0)
+        # ``head`` is the newest seq this process has assigned: a
+        # collector whose cursor exceeds it knows the process (and
+        # its seq space) restarted and resyncs from zero
+        return {"origin_unix": origin_unix, "next": nxt,
+                "head": head, "dropped": dropped, "spans": spans}
 
     def events_for_trace(self, trace_id: str) -> List[dict]:
         """Every buffered span carrying ``trace_id`` — the hop

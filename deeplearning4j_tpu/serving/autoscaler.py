@@ -90,7 +90,8 @@ class Autoscaler:
                  down_cooldown_s: float = 30.0,
                  boot_retries: int = 3,
                  drain_timeout_s: float = 30.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 collector=None):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
         if max_replicas < min_replicas:
@@ -105,6 +106,12 @@ class Autoscaler:
         self.fleet = fleet
         self.router = router
         self.slos = slos
+        # optional FleetCollector: when attached, signals() prefers
+        # its MERGED per-replica series (the fleet-level view) and
+        # falls back to the router's direct probes the moment the
+        # collector's data is stale or errors — the collector is an
+        # observer, never a dependency
+        self.collector = collector
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.tick_interval_s = float(tick_interval_s)
@@ -190,12 +197,22 @@ class Autoscaler:
             except Exception:
                 sensors_ok = False
                 logger.exception("autoscaler: SLO evaluation failed")
-        loads = []
-        try:
-            loads = self.router.load_signals()
-        except Exception:
-            sensors_ok = False
-            logger.exception("autoscaler: router load read failed")
+        loads = None
+        if self.collector is not None:
+            try:
+                loads = self.collector.load_signals()
+            except Exception:
+                # stale or broken merged view: NOT a sensor failure —
+                # the router's direct probes below still answer
+                loads = None
+        if loads is None:
+            loads = []
+            try:
+                loads = self.router.load_signals()
+            except Exception:
+                sensors_ok = False
+                logger.exception(
+                    "autoscaler: router load read failed")
         eligible = [v for v in loads if v.get("eligible")]
         if eligible:
             queue_mean = sum(v["queue_depth"] + v["inflight"]

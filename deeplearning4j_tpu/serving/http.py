@@ -190,6 +190,9 @@ class ModelServer:
                  retrieval=None):
         self.registry = registry or ModelRegistry()
         self.metrics = metrics or ServingMetrics()
+        # last good /metrics payload per mode — served when a rebuild
+        # raises mid-drain so a collector's final scrape still lands
+        self._last_exposition: Dict[str, object] = {}
         # mesh: a declarative serving mesh spec ("tp=2" |
         # "dp=2,tp=2" | dict — parallel/mesh_spec.py). Predict
         # backends then run TENSOR-PARALLEL: each hosted model is
@@ -458,20 +461,52 @@ class ModelServer:
                     else:
                         self._send(200, payload)
                 elif path == "/metrics":
+                    # observability endpoints stay up THROUGH a
+                    # drain: the fleet collector's last scrape of a
+                    # retiring replica must succeed, so a rebuild
+                    # that trips over mid-teardown registry churn
+                    # serves the last good exposition instead of
+                    # failing the scrape
                     mode = self._metrics_mode()
+                    try:
+                        if mode == "openmetrics":
+                            out = server.metrics.prometheus_text(
+                                openmetrics=True)
+                        elif mode == "text":
+                            out = server.metrics.prometheus_text()
+                        else:
+                            out = server.metrics.snapshot()
+                        server._last_exposition[mode] = out
+                    except Exception:
+                        out = server._last_exposition.get(mode)
+                        if out is None:
+                            raise
                     if mode == "openmetrics":
                         self._send_text(
-                            200, server.metrics.prometheus_text(
-                                openmetrics=True),
+                            200, out,
                             "application/openmetrics-text; "
                             "version=1.0.0; charset=utf-8")
                     elif mode == "text":
                         self._send_text(
-                            200, server.metrics.prometheus_text(),
+                            200, out,
                             "text/plain; version=0.0.4; "
                             "charset=utf-8")
                     else:
-                        self._send(200, server.metrics.snapshot())
+                        self._send(200, out)
+                elif path == "/debug/trace-export":
+                    q = parse_qs(urlparse(self.path).query)
+                    since = int((q.get("since") or ["0"])[0])
+                    limit = int((q.get("limit") or ["10000"])[0])
+                    self._send(200, server.tracer.export_since(
+                        since=since, limit=limit))
+                elif path == "/debug/bundle":
+                    from deeplearning4j_tpu.observability.fleetobs \
+                        import local_bundle_payload
+                    q = parse_qs(urlparse(self.path).query)
+                    reason = (q.get("reason") or ["manual"])[0]
+                    self._send(200, local_bundle_payload(
+                        registry=server.metrics.registry,
+                        tracer=server.tracer, reason=reason))
                 elif path == "/v1/models":
                     self._send(200, {"models":
                                      server.registry.models()})

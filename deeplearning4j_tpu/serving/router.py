@@ -59,7 +59,7 @@ import socket
 import threading
 import time
 from http.server import ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse, urlsplit
 
 from deeplearning4j_tpu import chaos
@@ -197,6 +197,10 @@ class Router:
         self.kv_routing = bool(kv_routing)
         self.sampler = Sampler(rate=sample_rate)
         self.tracer = tracer if tracer is not None else get_tracer()
+        # optional fleet-health callable (a FleetCollector's
+        # ``fleet_health``) merged into health_payload(); attach via
+        # attach_fleet_health(), detach with None
+        self.fleet_health_fn: Optional[Callable[[], dict]] = None
         self._lock = threading.Lock()
         # serializes whole view-reconciliation passes (prober loop
         # vs request threads after a chaos fault): without it two
@@ -1483,6 +1487,20 @@ class Router:
                     else:
                         self._send(200,
                                    router.registry.snapshot())
+                elif path == "/debug/trace-export":
+                    q = parse_qs(urlparse(self.path).query)
+                    since = int((q.get("since") or ["0"])[0])
+                    limit = int((q.get("limit") or ["10000"])[0])
+                    self._send(200, router.tracer.export_since(
+                        since=since, limit=limit))
+                elif path == "/debug/bundle":
+                    from deeplearning4j_tpu.observability.fleetobs \
+                        import local_bundle_payload
+                    q = parse_qs(urlparse(self.path).query)
+                    reason = (q.get("reason") or ["manual"])[0]
+                    self._send(200, local_bundle_payload(
+                        registry=router.registry,
+                        tracer=router.tracer, reason=reason))
                 elif path == "/fleet":
                     self._send(200, router.fleet_debug())
                 elif path == "/v1/models":
@@ -1674,6 +1692,13 @@ class Router:
         return self
 
     # ---- router health & debug ----
+    def attach_fleet_health(self,
+                            fn: Optional[Callable[[], dict]]) -> None:
+        """Attach (or with ``None`` detach) a fleet-health callable —
+        ``fn()`` returns a dict with an ``ok`` bool; a falsy ``ok``
+        marks /healthz degraded with the dict as evidence."""
+        self.fleet_health_fn = fn
+
     def health_payload(self) -> dict:
         states = self.replica_states()
         eligible = len(self._eligible())
@@ -1687,6 +1712,21 @@ class Router:
             status = "ok"
         payload = {"status": status, "eligible": eligible,
                    "replicas": {str(k): v for k, v in states.items()}}
+        # fleet-level verdict from an attached collector: an
+        # AFFIRMATIVE fleet-SLO breach degrades the router for
+        # humans/dashboards (never readiness — see do_GET), while a
+        # dead or absent collector contributes nothing: collector
+        # degradation must never affect serving
+        fn = self.fleet_health_fn
+        if fn is not None:
+            try:
+                fh = fn()
+            except Exception:
+                fh = None
+            if fh is not None and not fh.get("ok", True):
+                if status == "ok":
+                    payload["status"] = "degraded"
+                payload["fleet"] = fh
         with self._lock:
             index = {str(v.rid): v.index_info
                      for v in self._views.values()

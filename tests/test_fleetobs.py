@@ -1,0 +1,846 @@
+"""Fleet observability plane (ISSUE 18): exposition parsing, exact
+bucket-wise histogram merge, the FleetCollector scrape/stitch/incident
+loop, the drain-window /metrics regression, and the 3-subprocess-
+replica acceptance soak.
+
+The acceptance criteria this file encodes:
+
+- the collector's merged request counters EQUAL the sum of the
+  per-replica counters (bucket-wise histogram merge is exact, not
+  approximate);
+- one trace id queried from the collector yields one stitched tree
+  containing the router's span and replica-side spans;
+- a fleet-SLO breach flips the router /healthz to degraded and
+  produces one incident directory with a bundle from every live
+  member;
+- a replica stays scrapable (metrics + trace-export) while DRAINING;
+- stopping the collector mid-load causes zero serving failures —
+  collector degradation never affects serving.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability.fleetobs import (
+    FleetCollector, local_bundle_payload, merge_histograms,
+    parse_exposition, render_status, _hist_quantile)
+from deeplearning4j_tpu.observability.registry import MetricsRegistry
+from deeplearning4j_tpu.observability.slo import SLO
+from deeplearning4j_tpu.serving.fleet import ReplicaFleet
+from deeplearning4j_tpu.serving.router import Router
+
+pytestmark = pytest.mark.fleetobs
+
+PREDICT_EP = "predict/default/v1"
+
+
+class EchoModel:
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def output(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(x) * 2.0
+
+
+def _post(base, path, body, timeout=10.0, headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), \
+                {k.lower(): v for k, v in r.headers.items()}
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), \
+            {k.lower(): v for k, v in e.headers.items()}
+
+
+def _get(base, path, timeout=5.0, raw=False):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            body = r.read()
+            return r.status, (body if raw
+                              else json.loads(body.decode()))
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _predict_body(i=0):
+    return {"model": "default",
+            "inputs": [[float(i % 5), 1.0, 2.0, 3.0]]}
+
+
+@pytest.fixture()
+def stack():
+    """In-process fleet + router + (lazily started) collectors, all
+    torn down afterwards."""
+    built = {"fleets": [], "collectors": []}
+
+    def build(n=3, delay=0.0, **router_kw):
+        def factory():
+            return {"default": EchoModel(delay=delay)}
+
+        fleet = ReplicaFleet(factory, n=n, server_kwargs=dict(
+            wait_ms=1.0, slots=2, capacity=64)).start()
+        kw = dict(probe_interval_s=0.05, probe_timeout_s=0.4,
+                  eject_consecutive=2, eject_cooldown_s=0.5,
+                  attempt_timeout_s=2.0, request_timeout_s=10.0,
+                  hedge_after_s=None, sample_rate=1.0)
+        kw.update(router_kw)
+        router = Router(fleet, **kw).start()
+        built["fleets"].append((fleet, router))
+        return fleet, router
+
+    def collector(**kw):
+        col = FleetCollector(**kw)
+        built["collectors"].append(col)
+        return col
+
+    yield build, collector
+    for col in built["collectors"]:
+        col.stop()
+    for fleet, router in built["fleets"]:
+        router.stop()
+        fleet.stop(drain=False, timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# exposition parsing + exact histogram merge
+# ---------------------------------------------------------------------------
+
+def _mk_hist(edges, counts, total=None, s=0.0, exemplars=None):
+    return {"edges": list(edges), "counts": list(counts),
+            "count": sum(counts) if total is None else total,
+            "sum": s, "exemplars": dict(exemplars or {})}
+
+
+class TestParseExposition:
+    def test_round_trip_both_modes(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x_total",
+                        labels={"endpoint": PREDICT_EP})
+        c.inc(5)
+        g = reg.gauge("serving_gauge",
+                      labels={"name": "default_queue_depth"})
+        g.set(3)
+        h = reg.histogram("lat_seconds", labels={"endpoint": "p"},
+                          buckets=[0.01, 0.1, 1])
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.record(v)
+        for om in (False, True):
+            p = parse_exposition(reg.prometheus_text(openmetrics=om))
+            ck = ("x_total", (("endpoint", PREDICT_EP),))
+            assert p["counters"][ck] == 5.0
+            gk = ("serving_gauge",
+                  (("name", "default_queue_depth"),))
+            assert p["gauges"][gk] == 3.0
+            hk = ("lat_seconds", (("endpoint", "p"),))
+            hist = p["histograms"][hk]
+            assert hist["edges"] == [0.01, 0.1, 1]
+            assert hist["counts"] == [1, 1, 1, 1]   # incl. overflow
+            assert hist["count"] == 4
+            assert hist["sum"] == pytest.approx(5.555)
+
+    def test_exemplars_only_in_openmetrics(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=[0.01, 0.1])
+        h.record(0.05, exemplar={"trace_id": "abc123"})
+        classic = parse_exposition(reg.prometheus_text())
+        om = parse_exposition(
+            reg.prometheus_text(openmetrics=True))
+        hk = ("lat_seconds", ())
+        assert classic["histograms"][hk]["exemplars"] == {}
+        ex = om["histograms"][hk]["exemplars"]
+        assert list(ex) == [1]                     # bucket le=0.1
+        assert ex[1][0] == {"trace_id": "abc123"}
+        assert ex[1][1] == pytest.approx(0.05)
+
+    def test_escaped_label_values(self):
+        txt = ('m_total{a="x\\"y",b="p,q r"} 7\n'
+               'g{path="{brace}"} 2\n')
+        p = parse_exposition(txt)
+        assert p["counters"][
+            ("m_total", (("a", 'x"y'), ("b", "p,q r")))] == 7.0
+        assert p["gauges"][("g", (("path", "{brace}"),))] == 2.0
+
+
+class TestHistogramMerge:
+    EDGES = [0.001, 0.01, 0.1, 1.0]
+
+    def _rand_parts(self, n, seed):
+        rng = np.random.default_rng(seed)
+        parts = []
+        for _ in range(n):
+            counts = [int(v) for v in rng.integers(0, 50, 5)]
+            parts.append(_mk_hist(self.EDGES, counts,
+                                  s=float(rng.uniform(0, 10))))
+        return parts
+
+    def test_merge_is_exact_sum(self):
+        parts = self._rand_parts(4, 0)
+        m = merge_histograms(parts)
+        for i in range(5):
+            assert m["counts"][i] == sum(p["counts"][i]
+                                         for p in parts)
+        assert m["count"] == sum(p["count"] for p in parts)
+        assert m["sum"] == pytest.approx(
+            sum(p["sum"] for p in parts))
+
+    def test_merge_associative(self):
+        a, b, c = self._rand_parts(3, 1)
+        left = merge_histograms([merge_histograms([a, b]), c])
+        right = merge_histograms([a, merge_histograms([b, c])])
+        flat = merge_histograms([a, b, c])
+        for m in (left, right):
+            assert m["counts"] == flat["counts"]
+            assert m["count"] == flat["count"]
+            assert m["sum"] == pytest.approx(flat["sum"])
+
+    def test_merge_order_independent(self):
+        import itertools
+        parts = self._rand_parts(3, 2)
+        ref = merge_histograms(parts)
+        for perm in itertools.permutations(parts):
+            m = merge_histograms(list(perm))
+            assert m["counts"] == ref["counts"]
+
+    def test_merged_quantiles_bracket_members(self):
+        parts = self._rand_parts(5, 3)
+        m = merge_histograms(parts)
+        for q in (0.5, 0.9, 0.99):
+            per = [_hist_quantile(p["edges"], p["counts"], q)
+                   for p in parts if p["count"]]
+            merged = _hist_quantile(m["edges"], m["counts"], q)
+            assert min(per) - 1e-12 <= merged <= max(per) + 1e-12
+
+    def test_edge_mismatch_raises(self):
+        a = _mk_hist([0.1, 1.0], [1, 2, 3])
+        b = _mk_hist([0.2, 1.0], [1, 2, 3])
+        with pytest.raises(ValueError):
+            merge_histograms([a, b])
+
+    def test_exemplar_from_exactly_one_source(self):
+        a = _mk_hist(self.EDGES, [1, 0, 0, 0, 0],
+                     exemplars={0: ({"trace_id": "old"}, 0.0005,
+                                    100.0)})
+        b = _mk_hist(self.EDGES, [1, 0, 0, 0, 0],
+                     exemplars={0: ({"trace_id": "new"}, 0.0007,
+                                    200.0)})
+        m = merge_histograms([a, b])
+        assert m["exemplars"][0][0] == {"trace_id": "new"}
+        # order independent: the freshest timestamp wins either way
+        m2 = merge_histograms([b, a])
+        assert m2["exemplars"][0][0] == {"trace_id": "new"}
+
+
+# ---------------------------------------------------------------------------
+# golden aggregated exposition (replica labels + aggregate rows)
+# ---------------------------------------------------------------------------
+
+MEMBER_A = """\
+# TYPE serving_requests_total counter
+serving_requests_total{endpoint="predict/default/v1"} 7
+# TYPE serving_latency_seconds histogram
+serving_latency_seconds_bucket{endpoint="predict/default/v1",le="0.01"} 3 # {trace_id="aaa"} 0.004 100.0
+serving_latency_seconds_bucket{endpoint="predict/default/v1",le="0.1"} 6
+serving_latency_seconds_bucket{endpoint="predict/default/v1",le="+Inf"} 7
+serving_latency_seconds_sum{endpoint="predict/default/v1"} 0.35
+serving_latency_seconds_count{endpoint="predict/default/v1"} 7
+# EOF
+"""
+
+MEMBER_B = """\
+# TYPE serving_requests_total counter
+serving_requests_total{endpoint="predict/default/v1"} 5
+# TYPE serving_latency_seconds histogram
+serving_latency_seconds_bucket{endpoint="predict/default/v1",le="0.01"} 2 # {trace_id="bbb"} 0.003 200.0
+serving_latency_seconds_bucket{endpoint="predict/default/v1",le="0.1"} 4
+serving_latency_seconds_bucket{endpoint="predict/default/v1",le="+Inf"} 5
+serving_latency_seconds_sum{endpoint="predict/default/v1"} 0.21
+serving_latency_seconds_count{endpoint="predict/default/v1"} 5
+# EOF
+"""
+
+
+class TestGoldenAggregatedExposition:
+    def _merged_collector(self):
+        col = FleetCollector(targets=[])
+        col._merge({"replica-0": parse_exposition(MEMBER_A),
+                    "replica-1": parse_exposition(MEMBER_B)})
+        return col
+
+    def test_replica_labels_and_exact_aggregate(self):
+        col = self._merged_collector()
+        text = col.registry.prometheus_text(openmetrics=True)
+        # per-replica series keep their member of origin as a label
+        assert 'replica="replica-0"' in text
+        assert 'replica="replica-1"' in text
+        p = parse_exposition(text)
+        agg = ("serving_requests_total",
+               (("endpoint", PREDICT_EP),))
+        assert p["counters"][agg] == 12.0          # 7 + 5, exact
+        a = ("serving_requests_total",
+             (("endpoint", PREDICT_EP), ("replica", "replica-0")))
+        b = ("serving_requests_total",
+             (("endpoint", PREDICT_EP), ("replica", "replica-1")))
+        assert p["counters"][a] == 7.0
+        assert p["counters"][b] == 5.0
+        h = p["histograms"][("serving_latency_seconds",
+                             (("endpoint", PREDICT_EP),))]
+        assert h["counts"] == [5, 5, 2]            # bucket-wise sums
+        assert h["count"] == 12
+        assert h["sum"] == pytest.approx(0.56)
+
+    def test_aggregate_exemplar_from_one_source(self):
+        col = self._merged_collector()
+        text = col.registry.prometheus_text(openmetrics=True)
+        # member B's exemplar has the fresher timestamp (200 > 100):
+        # the aggregate bucket carries EXACTLY one exemplar, B's
+        agg_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("serving_latency_seconds_bucket")
+            and "replica=" not in ln and 'le="0.01"' in ln]
+        assert len(agg_lines) == 1
+        assert 'trace_id="bbb"' in agg_lines[0]
+        assert 'trace_id="aaa"' not in agg_lines[0]
+
+    def test_merge_idempotent_across_cycles(self):
+        col = self._merged_collector()
+        before = col.registry.prometheus_text()
+        col._merge({"replica-0": parse_exposition(MEMBER_A),
+                    "replica-1": parse_exposition(MEMBER_B)})
+        assert col.registry.prometheus_text() == before
+
+    def test_vanished_member_series_pruned(self):
+        col = self._merged_collector()
+        col._merge({"replica-0": parse_exposition(MEMBER_A)})
+        text = col.registry.prometheus_text()
+        assert 'replica="replica-1"' not in text
+        p = parse_exposition(text)
+        agg = ("serving_requests_total",
+               (("endpoint", PREDICT_EP),))
+        assert p["counters"][agg] == 7.0
+
+    def test_never_clobbers_local_instruments(self):
+        col = FleetCollector(targets=[])
+        own = col.registry.counter("fleet_scrapes_total")
+        own.inc(41)
+        member = ("# TYPE fleet_scrapes_total counter\n"
+                  "fleet_scrapes_total 9999\n")
+        col._merge({"replica-0": parse_exposition(member)})
+        # the aggregate write must skip the collector's own counter
+        assert col.registry.get("fleet_scrapes_total").value == 41
+
+
+# ---------------------------------------------------------------------------
+# drain window: scrape endpoints stay live, ingest is refused
+# ---------------------------------------------------------------------------
+
+class TestDrainScrapeRegression:
+    def test_metrics_and_trace_export_serve_during_drain(self, stack):
+        build, _ = stack
+        fleet, router = build(n=1)
+        rep = fleet.snapshot()[0]
+        base = f"http://{rep.host}:{rep.port}"
+        st, _, _ = _post(f"http://127.0.0.1:{router.port}",
+                         "/v1/predict", _predict_body())
+        assert st == 200
+        rep.server._draining.set()
+        try:
+            for path in ("/metrics", "/metrics?format=openmetrics",
+                         "/metrics?format=json"):
+                st, body = _get(base, path, raw=True)
+                assert st == 200, path
+                assert body
+            st, data = _get(base, "/debug/trace-export?since=0")
+            assert st == 200 and "spans" in data
+            st, data = _get(base, "/debug/bundle?reason=test")
+            assert st == 200 and "MANIFEST.json" in data["files"]
+            # ingest is refused while draining
+            st, body, _ = _post(base, "/v1/predict",
+                                _predict_body())
+            assert st == 503
+        finally:
+            rep.server._draining.clear()
+
+
+# ---------------------------------------------------------------------------
+# collector over an in-process fleet
+# ---------------------------------------------------------------------------
+
+class TestCollectorMerge:
+    def test_merged_counters_equal_member_sum(self, stack):
+        build, collector = stack
+        fleet, router = build(n=3)
+        base = f"http://127.0.0.1:{router.port}"
+        for i in range(20):
+            st, _, _ = _post(base, "/v1/predict", _predict_body(i))
+            assert st == 200
+        col = collector(fleet=fleet, router=router)
+        col.scrape_once()
+        agg = col.registry.get("serving_requests_total",
+                               {"endpoint": PREDICT_EP})
+        per = [col.registry.get(
+                   "serving_requests_total",
+                   {"endpoint": PREDICT_EP,
+                    "replica": f"replica-{r.id}"})
+               for r in fleet.snapshot()]
+        assert agg is not None
+        assert all(m is not None for m in per)
+        assert agg.value == sum(m.value for m in per) == 20.0
+
+    def test_stitched_trace_router_and_replica_spans(self, stack):
+        build, collector = stack
+        fleet, router = build(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        st, _, hdrs = _post(base, "/v1/predict", _predict_body())
+        assert st == 200
+        trace_id = hdrs["traceparent"].split("-")[1]
+        col = collector(fleet=fleet, router=router)
+        col.scrape_once()
+        tree = col.trace_tree(trace_id)
+        assert tree is not None
+        # in-process members share one tracer ring, so the stitched
+        # tree must hold BOTH router-side spans (request/forward) and
+        # server-side spans (device_step/respond) without duplicates
+        names = {s["name"] for s in tree["spans"]}
+        assert "forward" in names          # router side
+        assert "device_step" in names      # replica side
+        roots = [s for s in tree["spans"] if not s.get("parent_id")]
+        assert len(roots) == 1 and roots[0]["name"] == "request"
+        # spans carry the absolute wall-clock axis
+        assert all(s["ts_unix_us"] > 1e15 for s in tree["spans"])
+
+    def test_trace_drain_is_incremental_and_deduped(self, stack):
+        build, collector = stack
+        fleet, router = build(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        st, _, hdrs = _post(base, "/v1/predict", _predict_body())
+        assert st == 200
+        trace_id = hdrs["traceparent"].split("-")[1]
+        col = collector(fleet=fleet, router=router)
+        col.scrape_once()
+        n1 = len(col.trace_tree(trace_id)["spans"])
+        col.scrape_once()          # nothing new: same span count
+        assert len(col.trace_tree(trace_id)["spans"]) == n1
+
+    def test_load_signals_shape(self, stack):
+        build, collector = stack
+        fleet, router = build(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        for i in range(4):
+            _post(base, "/v1/predict", _predict_body(i))
+        col = collector(fleet=fleet, router=router)
+        col.scrape_once()
+        sigs = col.load_signals()
+        assert len(sigs) == 2
+        for s in sigs:
+            assert s["eligible"] is True
+            assert set(s) >= {"rid", "queue_depth", "inflight",
+                              "kv_pages_in_use", "kv_pages_total"}
+
+    def test_load_signals_raise_when_stale(self, stack):
+        build, collector = stack
+        fleet, router = build(n=1)
+        col = collector(fleet=fleet, router=router,
+                        interval_s=0.05)
+        # never scraped: stale by construction
+        with pytest.raises(RuntimeError):
+            col.load_signals()
+
+
+class TestFleetSLOsAndIncidents:
+    def _breach_slo(self):
+        # every request is "bad": any real latency exceeds 1ns, and
+        # the 1% budget makes the burn rate ~100x — breaches on the
+        # first delta sample
+        return SLO(name="lat", objective=0.99, threshold_s=1e-9,
+                   labels={"endpoint": PREDICT_EP}, window_s=60.0)
+
+    def test_breach_degrades_router_healthz_and_incident(
+            self, stack, tmp_path):
+        build, collector = stack
+        fleet, router = build(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        col = collector(fleet=fleet, router=router,
+                        slos=[self._breach_slo()],
+                        incident_dir=str(tmp_path),
+                        incident_min_interval_s=0.0)
+        router.attach_fleet_health(col.fleet_health)
+        for i in range(5):
+            _post(base, "/v1/predict", _predict_body(i))
+        col.scrape_once()              # seeds the burn sample
+        time.sleep(0.05)
+        for i in range(5):
+            _post(base, "/v1/predict", _predict_body(i))
+        col.scrape_once()              # delta -> breach -> incident
+        fh = col.fleet_health()
+        assert fh["ok"] is False and fh["slo_breaches"] == ["lat"]
+        st, body = _get(base, "/healthz")
+        assert st == 200                # degraded, NOT unready
+        assert body["status"] == "degraded"
+        assert body["fleet"]["slo_breaches"] == ["lat"]
+        # readiness is untouched: serving continues
+        st, _, _ = _post(base, "/v1/predict", _predict_body())
+        assert st == 200
+        incidents = [d for d in os.listdir(tmp_path)
+                     if d.startswith("incident-")]
+        assert len(incidents) == 1
+        assert "slo-breach-lat" in incidents[0]
+        root = tmp_path / incidents[0]
+        manifest = json.loads((root / "MANIFEST.json").read_text())
+        assert manifest["reason"] == "slo-breach-lat"
+        # one bundle per live member: router + both replicas
+        members = {m for m, v in manifest["members"].items()
+                   if v == "ok"}
+        assert members == {"router", "replica-0", "replica-1"}
+        for m in members:
+            files = set(os.listdir(root / m))
+            assert {"MANIFEST.json", "env.json",
+                    "metrics.json"} <= files
+
+    def test_replica_death_triggers_incident(self, stack, tmp_path):
+        build, collector = stack
+        fleet, router = build(n=2)
+        col = collector(fleet=fleet, router=router,
+                        incident_dir=str(tmp_path),
+                        incident_min_interval_s=0.0)
+        col.scrape_once()
+        assert sorted(col.fleet_health()["targets_down"]) == []
+        fleet.kill(0)
+        col.scrape_once()
+        incidents = [d for d in os.listdir(tmp_path)
+                     if d.startswith("incident-")]
+        assert len(incidents) == 1
+        assert "replica-death" in incidents[0]
+
+    def test_collector_death_never_degrades_serving(self, stack):
+        build, collector = stack
+        fleet, router = build(n=1)
+        base = f"http://127.0.0.1:{router.port}"
+        col = collector(fleet=fleet, router=router)
+        col.scrape_once()
+        router.attach_fleet_health(col.fleet_health)
+
+        def exploding():
+            raise RuntimeError("collector is gone")
+        router.attach_fleet_health(exploding)
+        st, body = _get(base, "/healthz")
+        assert st == 200 and body["status"] == "ok"
+        st, _, _ = _post(base, "/v1/predict", _predict_body())
+        assert st == 200
+
+
+class TestCollectorHTTP:
+    def test_endpoints(self, stack, tmp_path):
+        build, collector = stack
+        fleet, router = build(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        for i in range(6):
+            _post(base, "/v1/predict", _predict_body(i))
+        col = collector(fleet=fleet, router=router,
+                        interval_s=0.1,
+                        incident_dir=str(tmp_path)).start()
+        cbase = f"http://127.0.0.1:{col.port}"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            st, snap = _get(cbase, "/fleet/snapshot")
+            if st == 200 and snap["cycles"] >= 2 \
+                    and snap["traces"]["count"] > 0:
+                break
+            time.sleep(0.05)
+        assert snap["cycles"] >= 2
+        assert set(snap["targets"]) == {"router", "replica-0",
+                                        "replica-1"}
+        assert all(v == "up" for v in snap["targets"].values())
+        # merged metrics re-exposed in both formats
+        st, text = _get(cbase, "/metrics?format=prometheus",
+                        raw=True)
+        assert st == 200
+        assert b'replica="replica-0"' in text
+        st, text = _get(cbase, "/metrics?format=openmetrics",
+                        raw=True)
+        assert st == 200 and text.rstrip().endswith(b"# EOF")
+        assert b"fleet_scrapes_total" in text
+        st, health = _get(cbase, "/healthz")
+        assert st == 200 and health["status"] == "ok"
+        st, traces = _get(cbase, "/traces?limit=5")
+        assert st == 200 and traces["traces"]
+        tid = traces["traces"][-1]["trace_id"]
+        st, tree = _get(cbase, f"/debug/trace?trace_id={tid}")
+        assert st == 200 and tree["trace_id"] == tid
+        st, sigs = _get(cbase, "/fleet/signals")
+        assert st == 200 and len(sigs["signals"]) == 2
+        # fleet-status renders the snapshot without error
+        text = render_status(snap)
+        assert "router" in text and "replica-0" in text
+
+    def test_collector_stop_leaves_serving_alone(self, stack):
+        build, collector = stack
+        fleet, router = build(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        col = collector(fleet=fleet, router=router,
+                        interval_s=0.05).start()
+        router.attach_fleet_health(col.fleet_health)
+        time.sleep(0.2)
+        col.stop()
+        for i in range(10):
+            st, _, _ = _post(base, "/v1/predict", _predict_body(i))
+            assert st == 200
+        st, body = _get(base, "/healthz")
+        assert st == 200
+        # a stopped collector reports stale data, never a breach —
+        # the router stays ok
+        assert body["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# bundles
+# ---------------------------------------------------------------------------
+
+class TestLocalBundle:
+    def test_payload_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total").inc(3)
+        payload = local_bundle_payload(registry=reg, reason="manual")
+        files = payload["files"]
+        assert {"MANIFEST.json", "env.json",
+                "metrics.json"} <= set(files)
+        assert files["MANIFEST.json"]["reason"] == "manual"
+
+
+# ---------------------------------------------------------------------------
+# tools: trace_report + loadgen satellites
+# ---------------------------------------------------------------------------
+
+class TestTraceReportMerge:
+    def _span(self, tid, sid, parent, name, ts, replica=None):
+        ev = {"trace_id": tid, "span_id": sid, "parent_id": parent,
+              "name": name, "ts_us": ts, "dur_us": 10.0,
+              "attrs": {}}
+        if replica:
+            ev["replica"] = replica
+        return ev
+
+    @staticmethod
+    def _write_jsonl(path, spans):
+        path.write_text("\n".join(json.dumps(s) for s in spans)
+                        + "\n")
+
+    def test_merge_spans_dedupes_across_files(self, tmp_path):
+        from tools.trace_report import load_spans, merge_spans
+        a = [self._span("t1", "s1", None, "router.request", 0),
+             self._span("t1", "s2", "s1", "predict", 5)]
+        b = [self._span("t1", "s2", "s1", "predict", 5),
+             self._span("t1", "s3", "s1", "hedge", 7)]
+        fa = tmp_path / "a.jsonl"
+        fb = tmp_path / "b.jsonl"
+        self._write_jsonl(fa, a)
+        self._write_jsonl(fb, b)
+        merged = merge_spans([load_spans(str(fa)),
+                              load_spans(str(fb))])
+        ids = sorted(s["span_id"] for s in merged)
+        assert ids == ["s1", "s2", "s3"]
+
+    def test_cli_multi_file_merge(self, tmp_path, capsys):
+        from tools.trace_report import main
+        a = [self._span("t1", "s1", None, "router.request", 0)]
+        b = [self._span("t1", "s2", "s1", "predict", 5,
+                        replica="replica-0")]
+        fa = tmp_path / "a.jsonl"
+        fb = tmp_path / "b.jsonl"
+        self._write_jsonl(fa, a)
+        self._write_jsonl(fb, b)
+        rc = main([str(fa), str(fb), "--trace", "t1"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "router.request" in out and "predict" in out
+        assert "@replica-0" in out
+
+    def test_cli_requires_exactly_one_source(self, capsys):
+        from tools.trace_report import main
+        assert main([]) == 2
+        assert main(["x.json", "--collector",
+                     "http://127.0.0.1:1"]) == 2
+
+    def test_cli_collector_mode(self, stack, capsys):
+        from tools.trace_report import main
+        build, collector = stack
+        fleet, router = build(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        st, _, hdrs = _post(base, "/v1/predict", _predict_body())
+        assert st == 200
+        tid = hdrs["traceparent"].split("-")[1]
+        col = collector(fleet=fleet, router=router).start()
+        col.scrape_once()
+        rc = main(["--collector", f"http://127.0.0.1:{col.port}",
+                   "--trace", tid])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert tid[:8] in out or "router" in out
+
+
+class TestLoadgenOut:
+    def test_report_written_to_file(self, stack, tmp_path):
+        from tools.loadgen import main
+        build, _ = stack
+        fleet, router = build(n=1)
+        out = tmp_path / "report.json"
+        rc = main(["--url", f"http://127.0.0.1:{router.port}",
+                   "--features", "4", "--concurrency", "2",
+                   "--total", "8", "--out", str(out)])
+        assert rc == 0
+        rep = json.loads(out.read_text())
+        assert rep["sent"] == 8 and rep["failed"] == 0
+        assert "latency_ms" in rep
+
+
+# ---------------------------------------------------------------------------
+# E2E acceptance: 3 subprocess replicas + loadgen + chaos kill
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestFleetObsAcceptance:
+    def test_subprocess_fleet_e2e(self, tmp_path):
+        from deeplearning4j_tpu import (MultiLayerNetwork,
+                                        NeuralNetConfiguration,
+                                        chaos)
+        from deeplearning4j_tpu.nn.conf import updaters
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.util.model_serializer import (
+            write_model)
+        from tools.loadgen import LoadGen
+
+        feat = 8
+        conf = (NeuralNetConfiguration.builder().set_seed(0)
+                .updater(updaters.adam(1e-3)).list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=4, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(feat))
+                .build())
+        model_zip = str(tmp_path / "mlp.zip")
+        write_model(MultiLayerNetwork(conf).init(), model_zip)
+        incident_dir = tmp_path / "incidents"
+        incident_dir.mkdir()
+
+        fleet = ReplicaFleet(model_specs=[f"default={model_zip}"],
+                             n=3, base_port=18400).start()
+        router = Router(fleet, probe_interval_s=0.25,
+                        hedge_after_s=None,
+                        sample_rate=1.0).start()
+        col = None
+        try:
+            base = f"http://127.0.0.1:{router.port}"
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                try:
+                    st, body = _get(base, "/healthz")
+                    if body.get("eligible") == 3:
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.25)
+            else:
+                raise RuntimeError("fleet never became ready")
+
+            col = FleetCollector(
+                fleet=fleet, router=router, interval_s=0.5,
+                incident_dir=str(incident_dir),
+                incident_min_interval_s=0.0,
+                slos=[SLO(name="lat", objective=0.99,
+                          threshold_s=1e-9,
+                          labels={"endpoint": PREDICT_EP},
+                          window_s=60.0)]).start()
+            router.attach_fleet_health(col.fleet_health)
+
+            def body(i):
+                return {"model": "default",
+                        "inputs": [[float(i % 5)] * feat]}
+
+            # seeded chaos kill mid-load: the fleet loses replica 0
+            chaos.install({"faults": [
+                {"site": "serving.replica", "kind": "kill",
+                 "at": [40], "args": {"replica": 0}}]}, seed=7)
+            rep = LoadGen(base, body_fn=body, concurrency=4,
+                          total=120, max_retries=3,
+                          timeout_s=30.0).run()
+            assert rep["failed"] == 0, rep.get("errors")
+
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                snap = col.fleet_snapshot()
+                agg = col.registry.get("serving_requests_total",
+                                       {"endpoint": PREDICT_EP})
+                if agg is not None and not col.fleet_health()["ok"]:
+                    break
+                time.sleep(0.25)
+
+            # (1) merged counters == sum over live members (exact)
+            agg = col.registry.get("serving_requests_total",
+                                   {"endpoint": PREDICT_EP})
+            per = [m for m in col.registry.collect()
+                   if m.name == "serving_requests_total"
+                   and (m.labels or {}).get("replica", "")
+                   .startswith("replica-")
+                   and (m.labels or {}).get("endpoint")
+                   == PREDICT_EP]
+            assert per and agg is not None
+            assert agg.value == sum(m.value for m in per)
+
+            # (2) one stitched tree with router + replica spans
+            ids = col.trace_ids(limit=50)
+            stitched = [t for t in ids
+                        if "router" in t["replicas"]
+                        and any(r and r.startswith("replica-")
+                                for r in t["replicas"])]
+            assert stitched, ids
+            tree = col.trace_tree(stitched[-1]["trace_id"])
+            assert tree is not None and len(tree["spans"]) >= 2
+
+            # (3) fleet-SLO breach -> router degraded + one incident
+            #     directory with a bundle from every live member
+            st, health = _get(base, "/healthz")
+            assert health["status"] == "degraded"
+            incidents = sorted(os.listdir(incident_dir))
+            assert len(incidents) >= 1
+            root = incident_dir / incidents[0]
+            manifest = json.loads(
+                (root / "MANIFEST.json").read_text())
+            ok_members = {m for m, v in manifest["members"].items()
+                          if v == "ok"}
+            live = {f"replica-{r.id}" for r in fleet.snapshot()
+                    if getattr(r, "fleet_state", "up") == "up"}
+            assert "router" in ok_members
+            assert live <= ok_members
+
+            # (4) fleet-status renders without error
+            text = render_status(col.fleet_snapshot())
+            assert "fleet" in text.lower()
+
+            # (5) collector stopped mid-soak: zero serving failures
+            col.stop()
+            rep2 = LoadGen(base, body_fn=body, concurrency=4,
+                           total=40, max_retries=3,
+                           timeout_s=30.0).run()
+            assert rep2["failed"] == 0, rep2.get("errors")
+        finally:
+            chaos.uninstall()
+            if col is not None:
+                col.stop()
+            router.stop()
+            fleet.stop(drain=False, timeout=5.0)

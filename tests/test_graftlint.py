@@ -329,6 +329,26 @@ class TestGL005LiteralDrift:
         assert len(errors) == 1 and "bar_bogus_total" in errors[0]
         assert errors[0].startswith("README.md:1:")
 
+    def test_fleet_prefix_cited_but_unregistered(self, tmp_path):
+        # fleet_* gauges don't all carry a typed suffix
+        # (fleet_targets_up), so the prefix family alone must pull a
+        # doc token into the must-exist check
+        repo = self._fake_repo(
+            tmp_path, "watch `fleet_targets_up` on the collector\n")
+        r = run_lint(repo, paths=[], rules=["GL005"])
+        assert len(r.new) == 1
+        assert "fleet_targets_up" in r.new[0].message
+
+    def test_fleet_prefix_registered_is_clean(self, tmp_path):
+        repo = self._fake_repo(
+            tmp_path,
+            "watch `fleet_targets_up` and `fleet_scrapes_total`\n",
+            pkg_src=(
+                'U = registry.gauge("fleet_targets_up", fn)\n'
+                'C = registry.counter("fleet_scrapes_total")\n'
+                'SITE = "checkpoint.write"\n'))
+        assert run_lint(repo, paths=[], rules=["GL005"]).new == []
+
 
 class TestGL006MetricsHygiene:
     def test_positive(self):

@@ -13,7 +13,9 @@ unchanged in semantics from the standalone lint they generalize:
 - **metric names**: every backticked ``*_total``/``*_seconds``/
   ``*_bytes``/``*_depth``/``*_firing``/``*_state`` token in the docs
   must exist as a metric-name string literal under the package
-  (f-string templates match as wildcards).
+  (f-string templates match as wildcards). Fleet-level metrics don't
+  all carry a typed suffix (``fleet_targets_up``), so any backticked
+  ``fleet_*`` token is held to the same must-exist bar.
 - **chaos sites**: inside doc sections headed fault-injection/chaos,
   every backticked dotted token must exist as a string literal under
   the package.
@@ -45,11 +47,18 @@ CLAIM_RE = re.compile(r"(\d+\.\d+)\s*[x×]")
 
 METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_depth",
                    "_firing", "_state")
+# the fleet collector's gauges don't all carry a typed suffix
+# (fleet_targets_up), so the whole prefix family counts as metric
+# citations too
+METRIC_PREFIXES = ("fleet_",)
 _SUFFIX_ALT = "|".join(METRIC_SUFFIXES)
+_PREFIX_ALT = "|".join(METRIC_PREFIXES)
 DOC_METRIC_RE = re.compile(
-    r"`([a-z][a-z0-9_]*(?:%s))`" % _SUFFIX_ALT)
+    r"`([a-z][a-z0-9_]*(?:%s)|(?:%s)[a-z0-9_]+)`"
+    % (_SUFFIX_ALT, _PREFIX_ALT))
 SRC_METRIC_RE = re.compile(
-    r"""["']([A-Za-z0-9_{}]*(?:%s))["']""" % _SUFFIX_ALT)
+    r"""["']([A-Za-z0-9_{}]*(?:%s)|(?:%s)[A-Za-z0-9_{}]+)["']"""
+    % (_SUFFIX_ALT, _PREFIX_ALT))
 
 DOC_SITE_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`")
 SRC_SITE_RE = re.compile(

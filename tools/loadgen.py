@@ -827,6 +827,11 @@ def main(argv=None):
     p.add_argument("--timeout", type=float, default=10.0,
                    help="per-request budget incl. retries (seconds)")
     p.add_argument("--retries", type=int, default=2)
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="also write the full report as JSON to PATH "
+                        "(machine-readable: bench legs and the fleet "
+                        "collector tests read this instead of "
+                        "parsing stdout)")
     args = p.parse_args(argv)
     if args.duration is None and args.total is None:
         args.duration = 10.0
@@ -908,6 +913,10 @@ def main(argv=None):
             report["streaming_error"] = str(e)
     json.dump(report, sys.stdout, indent=2)
     sys.stdout.write("\n")
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
     return 0 if not report.get("failed") else 1
 
 

@@ -1,21 +1,30 @@
 #!/usr/bin/env python
 """trace_report — tail-latency attribution from traces, offline or live.
 
-Two modes:
+Three modes:
 
-``python tools/trace_report.py FILE [--trace ID] [--top N]``
-    FILE is span data: a tracer JSONL dump (``Tracer.write_jsonl`` /
-    ``enable(jsonl_path=...)``), a Chrome trace-event JSON
+``python tools/trace_report.py FILE [FILE ...] [--trace ID] [--top N]``
+    Each FILE is span data: a tracer JSONL dump (``Tracer.write_jsonl``
+    / ``enable(jsonl_path=...)``), a Chrome trace-event JSON
     (``export_chrome_trace`` / a flight-recorder bundle's
-    ``trace.json``), or a flight-recorder ``events.jsonl``. Spans are
-    grouped by trace id; the report shows per-phase p50/p95/p99
-    across traces, the dominant phase, and (``--trace`` or ``--top``)
-    rendered span trees for the slowest requests.
+    ``trace.json``), or a flight-recorder ``events.jsonl``. Multiple
+    files are MERGED by trace id before rendering (deduped on span
+    id), so a router dump and a replica dump view as one
+    cross-process tree. Spans are grouped by trace id; the report
+    shows per-phase p50/p95/p99 across traces, the dominant phase,
+    and (``--trace`` or ``--top``) rendered span trees for the
+    slowest requests.
 
 ``python tools/trace_report.py --url http://HOST:PORT [--top N]``
     Ask a live ModelServer: prints ``/debug/requests``'s
     latency-attribution report, in-flight requests, and recent slow
     traces.
+
+``python tools/trace_report.py --collector http://HOST:PORT``
+    Ask a live fleet collector: spans stitched across every fleet
+    member (router root, replica subtrees), already on one wall-clock
+    axis. ``--trace ID`` renders one stitched tree; without it the
+    most recent traces are reported.
 
 Exit codes: 0 ok, 2 usage / unreadable input.
 """
@@ -27,8 +36,9 @@ import json
 import sys
 from typing import Dict, List, Optional
 
-__all__ = ["load_spans", "group_traces", "phase_percentiles",
-           "render_trace", "report_text", "main"]
+__all__ = ["load_spans", "merge_spans", "group_traces",
+           "phase_percentiles", "render_trace", "report_text",
+           "collector_spans", "main"]
 
 # span names that are request phases (contiguous segments of one
 # request); everything else in a trace renders but does not enter the
@@ -87,6 +97,24 @@ def load_spans(path: str) -> List[dict]:
         if "ts_us" not in ev or "name" not in ev:
             continue
         out.append(ev)
+    return out
+
+
+def merge_spans(span_lists: List[List[dict]]) -> List[dict]:
+    """Concatenate span lists from several dumps, deduping on
+    (trace id, span id) — the same span exported by two members (or
+    the same file given twice) must not double a phase's weight.
+    Spans without ids always pass through."""
+    out: List[dict] = []
+    seen = set()
+    for spans in span_lists:
+        for s in spans:
+            tid, sid = s.get("trace_id"), s.get("span_id")
+            if tid and sid:
+                if (tid, sid) in seen:
+                    continue
+                seen.add((tid, sid))
+            out.append(s)
     return out
 
 
@@ -164,6 +192,9 @@ def render_trace(trace_id: str, spans: List[dict]) -> str:
                 extra = "  [UNCLOSED]"
             elif args.get("error") or "error" in s:
                 extra = f"  error={args.get('error') or s.get('error')}"
+            if s.get("replica"):
+                # collector-stitched spans carry their source member
+                extra += f"  @{s['replica']}"
             lines.append(f"{mark}{s.get('name'):<12} "
                          f"{dur:10.3f} ms{extra}")
             sid = s.get("span_id")
@@ -255,16 +286,44 @@ def report_url(base: str, top: int) -> str:
     return "\n".join(out)
 
 
+def collector_spans(base: str, trace: Optional[str] = None,
+                    limit: int = 20) -> List[dict]:
+    """Spans from a live fleet collector: one stitched trace
+    (``trace`` id prefix) or the ``limit`` most recent traces."""
+    import urllib.request
+    base = base.rstrip("/")
+    if trace is not None:
+        with urllib.request.urlopen(
+                f"{base}/debug/trace?trace_id={trace}") as r:
+            return json.load(r).get("spans", [])
+    with urllib.request.urlopen(
+            f"{base}/traces?limit={limit}") as r:
+        recent = json.load(r).get("traces", [])
+    out: List[dict] = []
+    for e in recent:
+        tid = e.get("trace_id")
+        if not tid:
+            continue
+        with urllib.request.urlopen(
+                f"{base}/debug/trace?trace_id={tid}") as r:
+            out.extend(json.load(r).get("spans", []))
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="trace_report",
-        description="tail-latency attribution from span data or a "
-                    "live ModelServer")
-    p.add_argument("file", nargs="?", default=None,
+        description="tail-latency attribution from span data, a "
+                    "live ModelServer, or a fleet collector")
+    p.add_argument("file", nargs="*", default=[],
                    help="span JSONL / Chrome trace / flight-recorder "
-                        "events.jsonl")
+                        "events.jsonl (several files merge by trace "
+                        "id)")
     p.add_argument("--url", default=None,
                    help="live server base URL (uses /debug/requests)")
+    p.add_argument("--collector", default=None, metavar="URL",
+                   help="live fleet collector base URL (stitched "
+                        "cross-process traces)")
     p.add_argument("--trace", default=None, metavar="ID",
                    help="render only the trace(s) whose id starts "
                         "with ID")
@@ -272,16 +331,28 @@ def main(argv=None) -> int:
                    help="how many slowest traces to render (file "
                         "mode) / slow requests to list (url mode)")
     args = p.parse_args(argv)
-    if (args.file is None) == (args.url is None):
+    sources = sum((bool(args.file), args.url is not None,
+                   args.collector is not None))
+    if sources != 1:
         p.print_usage(sys.stderr)
-        print("trace_report: give exactly one of FILE or --url",
-              file=sys.stderr)
+        print("trace_report: give exactly one of FILE(s), --url, "
+              "or --collector", file=sys.stderr)
         return 2
     try:
         if args.url:
             print(report_url(args.url, args.top))
+        elif args.collector:
+            spans = collector_spans(args.collector,
+                                    trace=args.trace,
+                                    limit=max(args.top, 20))
+            if args.trace and not spans:
+                print(f"no trace matching {args.trace!r} on "
+                      f"{args.collector}", file=sys.stderr)
+                return 2
+            print(report_text(spans, top=args.top,
+                              only_trace=args.trace))
         else:
-            spans = load_spans(args.file)
+            spans = merge_spans([load_spans(f) for f in args.file])
             print(report_text(spans, top=args.top,
                               only_trace=args.trace))
     except OSError as e:
