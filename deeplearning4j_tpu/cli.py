@@ -247,14 +247,33 @@ def _cmd_train_ps(args):
                       f"{args.output}")
         return
 
-    # launcher: one worker subprocess per --ps-workers
+    # launcher: one worker subprocess per --ps-workers. With
+    # --net-chaos the workers dial a seeded TCP fault proxy fronting
+    # the DPS1 wire instead of the server directly — the corrupt/
+    # truncate/partition drill for the parameter-server protocol.
     import subprocess
+    net_proxy = None
+    connect_to = f"{server.host}:{server.port}"
+    if getattr(args, "net_chaos", None):
+        from deeplearning4j_tpu.chaos.netproxy import NetChaosProxy
+        try:
+            net_proxy = NetChaosProxy(
+                (server.host, server.port), plan=args.net_chaos,
+                seed=args.net_chaos_seed, site="net.ps",
+                name="ps").start()
+        except (ValueError, TypeError, OSError) as e:
+            server.stop()
+            raise SystemExit(f"bad --net-chaos plan: {e}")
+        connect_to = f"{net_proxy.listen_host}:{net_proxy.port}"
+        print(f"net-chaos: PS wire proxied on {connect_to} "
+              f"(seed {net_proxy.seed}; replay with "
+              f"--net-chaos-seed {net_proxy.seed})", flush=True)
     procs = []
     try:
         for i in range(args.ps_workers):
             cmd = [sys.executable, "-m", "deeplearning4j_tpu",
                    "train-ps", "--role", "worker",
-                   "--connect", f"{server.host}:{server.port}",
+                   "--connect", connect_to,
                    "--model", args.model, "--data", args.data,
                    "--label-index", str(args.label_index),
                    "--classes", str(args.classes),
@@ -275,6 +294,8 @@ def _cmd_train_ps(args):
         for pr in procs:
             if pr.poll() is None:
                 pr.terminate()
+        if net_proxy is not None:
+            net_proxy.stop()
         server.stop()
     model.params = server.params_tree()
     out = args.output or args.model
@@ -564,6 +585,14 @@ def _cmd_serve_fleet(args):
         print(f"chaos: fault plan installed "
               f"({len(inj.plan.faults)} spec(s), seed {inj.seed}; "
               f"replay with --chaos-seed {inj.seed})")
+    if args.net_chaos:
+        # validate the network plan before any replica boots, like
+        # --slo/--autoscale: a typo'd kind must fail HERE
+        from deeplearning4j_tpu.chaos.netproxy import parse_net_plan
+        try:
+            parse_net_plan(args.net_chaos)
+        except (ValueError, TypeError, OSError) as e:
+            raise SystemExit(f"bad --net-chaos plan: {e}")
     if not args.model and not args.index:
         raise SystemExit("serve-fleet needs --model and/or --index")
     specs = [_parse_model_spec(s) for s in args.model or []]
@@ -582,6 +611,8 @@ def _cmd_serve_fleet(args):
             raise SystemExit(f"bad --roles: {e}")
     fleet = ReplicaFleet(
         factory, n=args.replicas, roles=roles,
+        net_chaos=args.net_chaos or None,
+        net_chaos_seed=args.net_chaos_seed,
         server_kwargs=dict(max_batch_size=args.max_batch_size,
                            queue_limit=args.queue_limit,
                            wait_ms=args.wait_ms, slots=args.slots,
@@ -592,6 +623,10 @@ def _cmd_serve_fleet(args):
                            mesh=args.mesh,
                            retrieval=_retrieval_factory(args)
                            if args.index else None)).start()
+    if args.net_chaos:
+        print(f"net-chaos: every replica fronted by a seeded TCP "
+              f"fault proxy (seed {fleet._net_seed}; replay with "
+              f"--net-chaos-seed {fleet._net_seed})")
     if args.index:
         print(f"index: {args.index_kind} over --index {args.index} "
               f"(one copy per replica; /v1/search fails over, "
@@ -933,6 +968,13 @@ def main(argv=None):
                          "ps.server.restart)")
     ps.add_argument("--chaos-seed", type=int, default=None,
                     metavar="N")
+    ps.add_argument("--net-chaos", metavar="PLAN", default=None,
+                    help="deterministic NETWORK plan on the DPS1 "
+                         "wire (launcher role): workers dial a "
+                         "seeded TCP fault proxy (site net.ps) "
+                         "instead of the server directly")
+    ps.add_argument("--net-chaos-seed", type=int, default=None,
+                    metavar="N")
     ps.set_defaults(fn=_cmd_train_ps)
 
     u = sub.add_parser("ui", help="training dashboard server")
@@ -1076,6 +1118,14 @@ def main(argv=None):
                         "replicas mid-load; serving.replica.boot "
                         "fails/stalls scale-up boots)")
     f.add_argument("--chaos-seed", type=int, default=None,
+                   metavar="N")
+    f.add_argument("--net-chaos", metavar="PLAN", default=None,
+                   help="deterministic NETWORK plan: every replica "
+                        "boots behind a seeded TCP fault proxy "
+                        "(site net.replica; kinds partition/reset/"
+                        "truncate/corrupt/delay/throttle/half_open "
+                        "— see README 'Network fault injection')")
+    f.add_argument("--net-chaos-seed", type=int, default=None,
                    metavar="N")
     f.add_argument("--autoscale", metavar="MIN:MAX", default=None,
                    help="run the SLO-driven autoscaler over the "

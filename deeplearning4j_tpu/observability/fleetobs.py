@@ -545,9 +545,16 @@ class FleetCollector:
                  trace_capacity: int = 2048,
                  span_capacity: int = 100_000,
                  registry: Optional[MetricsRegistry] = None,
-                 on_incident: Optional[Callable[[dict], None]] = None):
+                 on_incident: Optional[Callable[[dict], None]] = None,
+                 url_rewrite: Optional[Callable[[str, str],
+                                                str]] = None):
         self.fleet = fleet
         self.router = router
+        # (name, url) -> url hook: the collector's OWN network path
+        # to each member. Network-chaos soaks route scrapes through
+        # their own NetChaosProxy, independent of the router's hop
+        # to the same replica — an asymmetric partition in one line.
+        self.url_rewrite = url_rewrite
         self._static_targets = list(targets or [])
         self.interval_s = float(interval_s)
         self.host = host
@@ -605,6 +612,11 @@ class FleetCollector:
             "fleet_scrape_duration_seconds",
             help="wall time of one full scrape cycle",
             buckets=[0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0])
+        self._m_scrape_partitions = self.registry.counter(
+            "fleet_scrape_partitions_total",
+            help="members unreachable on the scrape path while the "
+                 "fleet declared them up (asymmetric partition; "
+                 "no incident written)")
 
         self.alerts = AlertManager(self.registry)
         self.slo_monitor: Optional[SLOMonitor] = None
@@ -632,6 +644,9 @@ class FleetCollector:
                     continue
                 out.append((f"replica-{r.id}",
                             f"http://{r.host}:{r.port}"))
+        if self.url_rewrite is not None:
+            out = [(name, self.url_rewrite(name, url))
+                   for name, url in out]
         return out
 
     # ---- merge helpers (registry calls live here, outside any
@@ -789,57 +804,78 @@ class FleetCollector:
             self._made.pop((name, lk), None)
 
     # ---- traces ----
+    # pages drained per member per cycle before giving up: a member
+    # whose backlog outruns this is lagged, not wedged — the next
+    # cycle resumes from the cursor
+    _TRACE_PAGES_PER_CYCLE = 64
+
     def _drain_traces(self,
                       targets: List[Tuple[str, str]]) -> None:
         for tname, url in targets:
-            since = self._trace_cursors.get(tname, 0)
-            try:
-                raw = _http_get(
-                    f"{url}/debug/trace-export?since={since}"
-                    f"&limit=5000", self.scrape_timeout_s)
-                data = json.loads(raw.decode())
-            except Exception:
-                continue
-            nxt = int(data.get("next", since))
-            head = int(data.get("head", nxt))
-            if head < since:
-                # the member restarted (its seq space reset under
-                # our cursor) — resync from zero on the next poll
-                nxt = 0
-            self._trace_cursors[tname] = nxt
-            origin = float(data.get("origin_unix", 0.0))
-            spans = data.get("spans", [])
-            if not spans:
-                continue
-            with self._lock:
-                for ev in spans:
-                    tid = ev.get("trace_id")
-                    if not tid:
+            for _ in range(self._TRACE_PAGES_PER_CYCLE):
+                if not self._drain_trace_page(tname, url):
+                    break
+
+    def _drain_trace_page(self, tname: str, url: str) -> bool:
+        """One ``trace-export`` page from one member; True when the
+        member reported more backlog past the new cursor (drain the
+        next page this same cycle). A scrape must catch the collector
+        up to the member's head, not advance one page per cycle —
+        paging once meant a backlog of N pages took N scrape
+        intervals to surface a trace that was already complete."""
+        since = self._trace_cursors.get(tname, 0)
+        try:
+            raw = _http_get(
+                f"{url}/debug/trace-export?since={since}"
+                f"&limit=5000", self.scrape_timeout_s)
+            data = json.loads(raw.decode())
+        except Exception:
+            return False
+        nxt = int(data.get("next", since))
+        head = int(data.get("head", nxt))
+        if head < since:
+            # the member restarted (its seq space reset under
+            # our cursor) — resync from zero on the next poll
+            self._trace_cursors[tname] = 0
+            return False
+        self._trace_cursors[tname] = nxt
+        origin = float(data.get("origin_unix", 0.0))
+        spans = data.get("spans", [])
+        if spans:
+            self._merge_trace_page(tname, origin, spans)
+        return bool(spans) and nxt < head
+
+    def _merge_trace_page(self, tname: str, origin: float,
+                          spans: List[dict]) -> None:
+        with self._lock:
+            for ev in spans:
+                tid = ev.get("trace_id")
+                if not tid:
+                    continue
+                bucket = self._traces.get(tid)
+                if bucket is None:
+                    bucket = self._traces[tid] = []
+                    self._trace_seen[tid] = set()
+                else:
+                    self._traces.move_to_end(tid)
+                sid = ev.get("span_id")
+                if sid is not None:
+                    if sid in self._trace_seen[tid]:
                         continue
-                    bucket = self._traces.get(tid)
-                    if bucket is None:
-                        bucket = self._traces[tid] = []
-                        self._trace_seen[tid] = set()
-                    else:
-                        self._traces.move_to_end(tid)
-                    sid = ev.get("span_id")
-                    if sid is not None:
-                        if sid in self._trace_seen[tid]:
-                            continue
-                        self._trace_seen[tid].add(sid)
-                    ev = dict(ev)
-                    ev["replica"] = tname
-                    ev["ts_unix_us"] = origin * 1e6 + \
-                        float(ev.get("ts_us", 0.0))
-                    bucket.append(ev)
-                    self._span_total += 1
-                    self._m_spans.inc()
-                while (len(self._traces) > self.trace_capacity
-                       or self._span_total > self.span_capacity) \
-                        and self._traces:
-                    old, dropped = self._traces.popitem(last=False)
-                    self._trace_seen.pop(old, None)
-                    self._span_total -= len(dropped)
+                    self._trace_seen[tid].add(sid)
+                ev = dict(ev)
+                ev["replica"] = tname
+                ev["ts_unix_us"] = origin * 1e6 + \
+                    float(ev.get("ts_us", 0.0))
+                bucket.append(ev)
+                self._span_total += 1
+                self._m_spans.inc()
+            while (len(self._traces) > self.trace_capacity
+                   or self._span_total > self.span_capacity) \
+                    and self._traces:
+                old, dropped = self._traces.popitem(last=False)
+                self._trace_seen.pop(old, None)
+                self._span_total -= len(dropped)
 
     def trace_ids(self, limit: int = 100) -> List[dict]:
         with self._lock:
@@ -891,7 +927,33 @@ class FleetCollector:
         with self._lock:
             self._pending_breach = dict(info)
 
+    def _confirmed_deaths(self, died: List[str]) -> List[str]:
+        """An unreachable replica is only a DEATH when the fleet
+        agrees it is gone. A member the fleet still declares up is a
+        scrape-PATH partition (the collector's hop is dark while the
+        router's is fine — the asymmetric case): log and count it,
+        never fabricate a replica-death incident bundle from it.
+        Serving is untouched, so the incident would be noise that
+        buries a real page."""
+        if self.fleet is None or not died:
+            return died
+        fleet_up = {f"replica-{r.id}"
+                    for r in self.fleet.snapshot()
+                    if getattr(r, "fleet_state", "up") == "up"}
+        confirmed = []
+        for name in died:
+            if name in fleet_up:
+                logger.warning(
+                    "fleetobs: %s unreachable on the scrape path "
+                    "but the fleet declares it up — asymmetric "
+                    "partition, not a death; no incident", name)
+                self._m_scrape_partitions.inc()
+                continue
+            confirmed.append(name)
+        return confirmed
+
     def _check_incidents(self, targets, died: List[str]) -> None:
+        died = self._confirmed_deaths(died)
         reason = None
         breached = False
         if self.slo_monitor is not None:

@@ -205,9 +205,18 @@ def read_frame(sock: socket.socket,
     raw = _recv_exact(sock, hdr_len, deadline)
     try:
         header = json.loads(raw)
-    except json.JSONDecodeError as e:
+    except ValueError as e:
+        # JSONDecodeError and UnicodeDecodeError both — corrupted
+        # header bytes must surface typed, not kill the reader
         raise PSFrameError(f"frame header is not JSON: {e}") from e
-    payload_len = int(header.get("payload_len", 0))
+    if not isinstance(header, dict):
+        raise PSFrameError("frame header is not a JSON object: "
+                           f"{type(header).__name__}")
+    try:
+        payload_len = int(header.get("payload_len", 0))
+    except (TypeError, ValueError) as e:
+        raise PSFrameError(f"frame payload length unreadable: "
+                           f"{header.get('payload_len')!r}") from e
     if not 0 <= payload_len <= _MAX_PAYLOAD:
         raise PSFrameError(f"frame payload length {payload_len} out "
                            "of bounds")

@@ -129,3 +129,13 @@ class ReplicaBootError(ServingError):
     exponential backoff and raises this only once the retry budget
     is spent — the autoscaler logs it and tries again next tick
     instead of wedging."""
+
+
+class UpstreamBodyError(ServingError):
+    """A replica's response arrived but its BODY cannot be trusted:
+    the headers were cut off before a framing header (no
+    Content-Length on a 2xx), or a JSON-typed body failed to parse —
+    a truncating or corrupting hop, not a replica verdict. The
+    router treats it exactly like a mid-exchange network error
+    (retryable for idempotent work, counts toward ejection) instead
+    of relaying garbage to the client."""

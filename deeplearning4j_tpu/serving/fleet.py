@@ -118,6 +118,24 @@ class _BaseReplica:
         # disaggregation role (prefill/decode/mixed) — routing
         # intent, also the fleet's to declare
         self.role = MIXED
+        # when the fleet boots this replica behind a NetChaosProxy,
+        # ``port`` is the PROXY's port (everything the router does
+        # crosses the chaotic hop) and ``upstream_port`` the real one
+        self.net_proxy = None
+        self.upstream_port = 0
+
+    def _stop_proxy(self) -> None:
+        """Tear down the chaos proxy fronting this replica (kill and
+        stop paths both): a dead replica must present as
+        connection-refused, not as a proxy accepting for a corpse."""
+        p = self.net_proxy
+        if p is None:
+            return
+        self.net_proxy = None
+        try:
+            p.stop()
+        except Exception:
+            pass
 
     @property
     def url(self) -> str:
@@ -179,6 +197,7 @@ class InProcessReplica(_BaseReplica):
         new connections are refused (the router's failover signal),
         not just unserved."""
         self.fleet_state = DEAD
+        self._stop_proxy()
         srv = self.server
         if srv is None:
             return
@@ -188,8 +207,13 @@ class InProcessReplica(_BaseReplica):
         self.fleet_state = DEAD
         srv = self.server
         if srv is None:
+            self._stop_proxy()
             return True
-        return srv.stop(drain=drain, timeout=timeout)
+        # drain first: in-flight streams pinned through the proxy
+        # must finish crossing it before it goes away
+        ok = srv.stop(drain=drain, timeout=timeout)
+        self._stop_proxy()
+        return ok
 
     def hang(self, delay_s: float) -> None:
         if self.server is not None:
@@ -226,6 +250,7 @@ class SubprocessReplica(_BaseReplica):
 
     def kill(self) -> None:
         self.fleet_state = DEAD
+        self._stop_proxy()
         if self.proc is not None and self.proc.poll() is None:
             self.proc.kill()        # the real signal 9
             try:
@@ -238,12 +263,14 @@ class SubprocessReplica(_BaseReplica):
     def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:
         self.fleet_state = DEAD
         if self.proc is None or self.proc.poll() is not None:
+            self._stop_proxy()
             return True
         if drain:
             # SIGINT rides the CLI's KeyboardInterrupt drain path
             self.proc.send_signal(signal.SIGINT)
             try:
                 self.proc.wait(timeout)
+                self._stop_proxy()
                 return True
             except subprocess.TimeoutExpired:
                 pass
@@ -254,6 +281,7 @@ class SubprocessReplica(_BaseReplica):
             # a D-state child that outlives SIGKILL must not escape
             # here — replace() still has to drop it from the pool
             pass
+        self._stop_proxy()
         return not drain
 
     def hang(self, delay_s: float) -> None:
@@ -293,7 +321,9 @@ class ReplicaFleet:
                  n: int = 2, server_kwargs: Optional[dict] = None,
                  model_specs: Optional[List[str]] = None,
                  base_port: int = 0, roles=None,
-                 extra_args: Optional[List[str]] = None):
+                 extra_args: Optional[List[str]] = None,
+                 net_chaos=None,
+                 net_chaos_seed: Optional[int] = None):
         if model_factory is None and not model_specs \
                 and not extra_args:
             raise ValueError("fleet needs a model_factory (in-process"
@@ -319,6 +349,23 @@ class ReplicaFleet:
         # successors inherit the incumbent's role
         self._roles = parse_roles(roles, n) if roles is not None \
             else [MIXED] * n
+        # a NetworkPlan boots every replica behind a NetChaosProxy
+        # (the router dials the proxy; the replica never knows).
+        # Parsed HERE so a typo'd plan fails before any replica boots,
+        # and the effective seed is pinned once so every proxy —
+        # including replace/grow successors — replays from it.
+        self._net_plan = None
+        self._net_seed: Optional[int] = None
+        if net_chaos is not None:
+            from deeplearning4j_tpu.chaos.netproxy import parse_net_plan
+            self._net_plan = parse_net_plan(net_chaos)
+            seed = net_chaos_seed
+            if seed is None:
+                seed = self._net_plan.seed
+            if seed is None:
+                import os as _os
+                seed = int.from_bytes(_os.urandom(4), "big")
+            self._net_seed = int(seed)
         self._lock = threading.Lock()
         self._replicas: List[_BaseReplica] = []
         self._next_id = 0
@@ -382,10 +429,27 @@ class ReplicaFleet:
                 time.sleep(float(fault.args.get("delay_s", 0.25)))
         r = self._new_replica(role)
         try:
-            return r.start()
+            return self._wrap_net(r.start())
         except Exception as e:
             raise ReplicaBootError(
                 f"replica {r.id} failed to boot: {e!r}") from e
+
+    def _wrap_net(self, r: _BaseReplica) -> _BaseReplica:
+        """Front a freshly-booted replica with a NetChaosProxy when
+        the fleet carries a network plan: the replica's advertised
+        port becomes the proxy's, so every router probe, forward and
+        scrape crosses the chaotic hop."""
+        if self._net_plan is None:
+            return r
+        from deeplearning4j_tpu.chaos.netproxy import NetChaosProxy
+        proxy = NetChaosProxy(
+            (r.host, r.port), plan=self._net_plan,
+            seed=self._net_seed, site="net.replica",
+            name=f"replica-{r.id}").start()
+        r.upstream_port = r.port
+        r.port = proxy.port
+        r.net_proxy = proxy
+        return r
 
     def _boot_retrying(self, max_boot_retries: int = 3,
                        role: Optional[str] = None) -> _BaseReplica:
@@ -428,7 +492,8 @@ class ReplicaFleet:
                 time.sleep(delay)
 
     def start(self) -> "ReplicaFleet":
-        fresh = [self._new_replica().start() for _ in range(self.n)]
+        fresh = [self._wrap_net(self._new_replica().start())
+                 for _ in range(self.n)]
         with self._lock:
             self._replicas.extend(fresh)
         return self

@@ -56,6 +56,7 @@ import itertools
 import json
 import logging
 import threading
+import socket
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
@@ -103,6 +104,13 @@ class _JsonRequestHandler(BaseHTTPRequestHandler):
     # router in front, ~80ms on every request. TCP_NODELAY removes
     # the stall outright.
     disable_nagle_algorithm = True
+
+    # every read on the connection is bounded: a half-open peer (or
+    # one partitioned away mid-request) must cost ONE handler thread
+    # 30s, not wedge it forever. StreamRequestHandler.setup applies
+    # this to the socket; header reads already honor it, body reads
+    # go through _read_body below.
+    timeout = 30.0
 
     def log_message(self, fmt, *args):
         pass
@@ -154,6 +162,24 @@ class _JsonRequestHandler(BaseHTTPRequestHandler):
             # connection that blocks forever, wedging the handler
             raise ValueError(f"negative Content-Length: {n}")
         return n
+
+    def _read_body(self, n: int) -> bytes:
+        """Read exactly the advertised body under the socket
+        deadline. A peer that stops sending mid-body (partition,
+        half-open) surfaces as ValueError — the callers' existing
+        bad-request (400) path — instead of a wedged thread or a
+        raw socket.timeout unwinding the handler."""
+        try:
+            data = self.rfile.read(n)
+        except socket.timeout as e:
+            raise ValueError(
+                f"body read timed out after {self.timeout}s "
+                f"({n} byte(s) advertised)") from e
+        if len(data) < n:
+            raise ValueError(
+                f"body truncated: Content-Length {n} but only "
+                f"{len(data)} byte(s) arrived")
+        return data
 
 
 def _make_listener(host: str, port: int, handler_cls):
@@ -438,7 +464,8 @@ class ModelServer:
         class Handler(_JsonRequestHandler):
             def _body(self):
                 n = self._content_length()
-                return json.loads(self.rfile.read(n).decode() or "{}")
+                return json.loads(self._read_body(n).decode()
+                                  or "{}")
 
             def do_GET(self):
                 path = urlparse(self.path).path

@@ -70,7 +70,8 @@ from deeplearning4j_tpu.observability.tracing import (RequestContext,
 from deeplearning4j_tpu.serving import tiers
 from deeplearning4j_tpu.serving.errors import (NoReplicaAvailableError,
                                                ReplicaGoneError,
-                                               ServerClosedError)
+                                               ServerClosedError,
+                                               UpstreamBodyError)
 from deeplearning4j_tpu.serving.fleet import (DECODE, DRAINING, MIXED,
                                               PREFILL, UP,
                                               ReplicaFleet)
@@ -1029,8 +1030,18 @@ class Router:
             self._note_failure(view)
             self._break_pin(session)
             if e.phase != "connect":
-                # the stream DIED mid-flight: its decode state lived
-                # on that replica — no silent failover, typed error
+                # the stream DIED mid-flight (partition, reset,
+                # truncated body): its decode state lived on that
+                # replica. Before failing typed, try the last rung
+                # of the zero-drop ladder — decode is deterministic
+                # in (prompt, seed), so recomputing the ORIGINAL
+                # request on a survivor is token-identical to the
+                # stream that was mid-flight.
+                recovered = self._recompute_fallback(
+                    body_bytes, view, deadline, fwd_headers,
+                    session)
+                if recovered is not None:
+                    return recovered
                 self._errors.inc()
                 raise ReplicaGoneError(
                     f"replica {view.rid} died mid-stream ({e}); the "
@@ -1065,6 +1076,10 @@ class Router:
         except _NetError as e2:
             self._note_failure(retry)
             self._break_pin(session)
+            recovered = self._recompute_fallback(
+                body_bytes, retry, deadline, fwd_headers, session)
+            if recovered is not None:
+                return recovered
             self._errors.inc()
             raise ReplicaGoneError(
                 f"replica {retry.rid} died before the stream "
@@ -1556,10 +1571,10 @@ class Router:
                 # connection — the ModelServer._mint_ctx lesson
                 try:
                     n = self._content_length()
+                    raw = self._read_body(n)
                 except (ValueError, TypeError) as e:
                     self._send(400, {"error": f"bad request: {e}"})
                     return
-                raw = self.rfile.read(n)
                 try:
                     body = json.loads(raw.decode() or "{}")
                 except (ValueError, json.JSONDecodeError) as e:
@@ -1808,6 +1823,27 @@ def _http_call(url: str, method: str, path: str,
         except (OSError, socket.timeout,
                 http.client.HTTPException) as e:
             raise _NetError("exchange", e) from e
+        # a response whose body cannot be trusted is an EXCHANGE
+        # failure, not a replica verdict: a 2xx with no framing
+        # header means the header block was cut mid-stream (read()
+        # "succeeded" only because EOF delimited nothing), and a
+        # JSON-typed body that does not parse crossed a corrupting
+        # hop. Both retry/fail over exactly like a reset.
+        if 200 <= resp.status < 300 \
+                and resp.getheader("Content-Length") is None \
+                and resp.getheader("Transfer-Encoding") is None:
+            raise _NetError("exchange", UpstreamBodyError(
+                f"{method} {path}: 2xx response with no framing "
+                f"header — headers truncated mid-stream"))
+        ctype = (resp.getheader("Content-Type") or "").lower()
+        if "json" in ctype and data:
+            try:
+                json.loads(data.decode())
+            except ValueError as e:
+                raise _NetError("exchange", UpstreamBodyError(
+                    f"{method} {path}: JSON-typed body failed to "
+                    f"parse ({len(data)} bytes) — truncated or "
+                    f"corrupted on the wire")) from e
         return resp.status, data, dict(resp.getheaders())
     finally:
         conn.close()

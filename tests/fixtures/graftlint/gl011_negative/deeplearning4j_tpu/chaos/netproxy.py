@@ -1,0 +1,21 @@
+"""Fixture net proxy: fully consistent — every declared kind is
+interpreted and documented, every site appears in the README."""
+
+from typing import Dict
+
+NET_SITES: Dict[str, str] = {
+    "net.hop": "the one proxied hop",
+}
+
+NET_KINDS: Dict[str, str] = {
+    "partition": "go dark",
+    "reset": "slam the connection shut",
+}
+
+
+def shape(fault, data):
+    if fault.kind == "partition":
+        return b""
+    if fault.kind == "reset":
+        raise ConnectionResetError
+    return data

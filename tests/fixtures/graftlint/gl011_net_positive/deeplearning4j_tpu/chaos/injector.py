@@ -1,0 +1,22 @@
+"""Fixture injector: consistent on its own — the drift in this
+tree lives in the net proxy."""
+
+from typing import Dict
+
+SITES: Dict[str, str] = {
+    "fixture.step": "one fixture device step",
+}
+
+_GENERIC_KINDS = frozenset({"crash", "hang", "slow", "error",
+                            "enospc"})
+SITE_KINDS: Dict[str, frozenset] = {
+    "fixture.step": _GENERIC_KINDS | {"poison"},
+}
+
+
+def hit(site):
+    return None
+
+
+def step_fault(site):
+    return None

@@ -1,0 +1,24 @@
+"""Fixture net proxy: one kind the data path never interprets, one
+kind the README table forgot, one site missing from the docs — and
+the README documents a kind the parser would reject."""
+
+from typing import Dict
+
+NET_SITES: Dict[str, str] = {
+    "net.used": "a documented hop",
+    "net.ghost": "declared but missing from the README",
+}
+
+NET_KINDS: Dict[str, str] = {
+    "partition": "documented and interpreted",
+    "reset": "interpreted but missing from the README table",
+    "ghostkind": "declared and documented, interpreted nowhere",
+}
+
+
+def shape(fault, data):
+    if fault.kind == "partition":
+        return b""
+    if fault.kind == "reset":
+        raise ConnectionResetError
+    return data

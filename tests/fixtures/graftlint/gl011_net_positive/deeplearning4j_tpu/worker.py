@@ -1,0 +1,11 @@
+"""Fixture consumer: threads the injector site and interprets its
+site-specific kind."""
+
+from deeplearning4j_tpu.chaos import injector as chaos
+
+
+def device_step(batch):
+    fault = chaos.step_fault("fixture.step")
+    if fault is not None and fault.kind == "poison":
+        return None
+    return batch

@@ -474,8 +474,42 @@ class TestSessionAffinity:
         assert router._affinity["user-ej"] != pinned_rid
         assert _counter(router, "router_affinity_breaks_total") >= 1
 
-    def test_midstream_death_is_typed_with_trace_id(self, stack):
+    def test_midstream_death_recovers_via_recompute(self, stack):
+        # the last rung of the zero-drop ladder: an exchange-phase
+        # death mid-stream recomputes the ORIGINAL request on a
+        # survivor — decode is deterministic, so the client gets the
+        # token-identical stream, not a 502
         fleet, router = stack(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        real = router._forward
+        state = {"fired": False}
+
+        def dying_forward(view, method, path, body, headers,
+                          timeout):
+            if path == "/v1/generate" and not state["fired"]:
+                state["fired"] = True
+                raise _NetError("exchange", ConnectionResetError(
+                    "replica died mid-stream"))
+            return real(view, method, path, body, headers, timeout)
+
+        router._forward = dying_forward
+        st, body, hdrs = _post(base, "/v1/generate",
+                               {"model": "lm", "prompt": [1],
+                                "n_tokens": 2, "session": "s9"})
+        assert st == 200
+        assert body["ids"] == expected_ids([1], 2)
+        assert state["fired"]
+        assert _counter(router, "router_kv_fallbacks_total") >= 1
+        # the session re-pinned onto the recompute survivor
+        st, body, _ = _post(base, "/v1/generate",
+                            {"model": "lm", "prompt": [1],
+                             "n_tokens": 2, "session": "s9"})
+        assert st == 200
+
+    def test_midstream_death_typed_when_no_survivor(self, stack):
+        # with nobody to recompute on, the contract stays typed:
+        # ReplicaGoneError (502) carrying the trace id
+        fleet, router = stack(n=1)
         base = f"http://127.0.0.1:{router.port}"
         real = router._forward
         state = {"fired": False}

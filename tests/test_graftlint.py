@@ -638,7 +638,26 @@ class TestGL011ChaosCoverage:
                 "fixture.undocumented",
                 "fixture.undocumented/ghost"} == syms
 
+    def test_net_positive_three_way(self):
+        # netproxy drift: each of the four net checks fires once
+        r = self._lint("gl011_net_positive")
+        msgs = {f.symbol: f.message for f in r.new}
+        assert len(r.new) == 4, "\n".join(msgs.values())
+        assert {"ghostkind", "reset", "vanish",
+                "net.ghost"} == set(msgs)
+        assert "silent no-op" in msgs["ghostkind"]
+        assert ("missing from the README network-fault kind table"
+                in msgs["reset"])
+        assert "fails to parse" in msgs["vanish"]
+        assert ("missing from the README network fault-injection "
+                "docs" in msgs["net.ghost"])
+        # the documented-but-undeclared finding points at the table
+        # row, not at line 0
+        vanish = next(f for f in r.new if f.symbol == "vanish")
+        assert vanish.path == "README.md" and vanish.line > 0
+
     def test_negative(self):
+        # negative tree includes a fully consistent netproxy too
         assert self._lint("gl011_negative").new == []
 
     def test_suppressed(self):

@@ -1,0 +1,858 @@
+"""Network chaos proxy + partition-tolerance soaks.
+
+The in-process chaos injector (``chaos/injector.py``) never crosses a
+socket; :class:`NetChaosProxy` does. These tests cover the proxy's
+own determinism contract (plan parsing, per-kind semantics, seed
+replay) and the partition-tolerance behaviors ISSUE 19 demands of the
+stack behind it:
+
+- router↔replica partition: victim ejected while dark, readmitted
+  after heal, zero dropped requests;
+- asymmetric collector-only partition: no false replica-death
+  incident, serving untouched;
+- mid-stream replica partition: a pinned generate recovers via the
+  recompute ladder with token-identical output;
+- DPS1 wire corrupt/truncate/half-open: only typed PS errors, the
+  server keeps serving.
+
+The two slow acceptance soaks (4 subprocess replicas under loadgen
+with a seeded 5 s partition; 3-worker ``train-ps`` through a
+corrupt+truncate proxy) live at the bottom behind ``-m slow``.
+"""
+
+import http.client
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.chaos.netproxy import (NET_KINDS, NET_SITES,
+                                               NetChaosProxy, NetSpec,
+                                               NetworkPlan,
+                                               parse_net_plan)
+from deeplearning4j_tpu.observability.fleetobs import FleetCollector
+from deeplearning4j_tpu.parallel.paramserver import (ParameterServer,
+                                                     PSClient,
+                                                     PSFrameError,
+                                                     PSProtocolError,
+                                                     PSTimeoutError)
+from deeplearning4j_tpu.serving.fleet import ReplicaFleet
+from deeplearning4j_tpu.serving.router import Router
+from tools.loadgen import LoadGen, parse_tier_mix, tiered_body_fn
+
+pytestmark = pytest.mark.netchaos
+
+_TYPED_PS = (PSFrameError, PSProtocolError, PSTimeoutError)
+
+
+# ---------------------------------------------------------------------------
+# a tiny fixed-response HTTP upstream: the proxy's unit-test peer
+# ---------------------------------------------------------------------------
+
+class _MiniUpstream:
+    """Threaded HTTP upstream answering every request with one fixed
+    JSON body and an honest Content-Length, so every fault the proxy
+    injects is attributable to the proxy."""
+
+    def __init__(self):
+        self.body = json.dumps({"ok": True, "pad": "x" * 512}).encode()
+        self._resp = (b"HTTP/1.1 200 OK\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: "
+                      + str(len(self.body)).encode()
+                      + b"\r\nConnection: close\r\n\r\n" + self.body)
+        self._ls = socket.socket()
+        self._ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._ls.bind(("127.0.0.1", 0))
+        self._ls.listen(64)
+        self._ls.settimeout(0.2)
+        self.port = self._ls.getsockname()[1]
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._ls.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            conn.settimeout(2.0)
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    return
+                buf += chunk
+            conn.sendall(self._resp)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=2.0)
+        try:
+            self._ls.close()
+        except OSError:
+            pass
+
+
+def _fetch(port, timeout=5.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        conn.request("GET", "/")
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _raw_fetch(port, timeout=5.0):
+    """Byte-exact response capture (no HTTP parsing) — the corrupt
+    determinism assertions compare raw streams."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.sendall(b"GET / HTTP/1.1\r\nHost: t\r\n"
+                  b"Connection: close\r\n\r\n")
+        s.settimeout(timeout)
+        chunks = []
+        while True:
+            try:
+                b = s.recv(65536)
+            except socket.timeout:
+                break
+            if not b:
+                break
+            chunks.append(b)
+        return b"".join(chunks)
+
+
+@pytest.fixture()
+def upstream():
+    up = _MiniUpstream()
+    yield up
+    up.stop()
+
+
+@pytest.fixture()
+def mkproxy(upstream):
+    built = []
+
+    def build(plan=None, seed=7, site="net.replica", name=None,
+              port=None):
+        p = NetChaosProxy(("127.0.0.1", port or upstream.port),
+                          plan=plan, seed=seed, site=site,
+                          name=name).start()
+        built.append(p)
+        return p
+
+    yield build
+    for p in built:
+        p.stop()
+
+
+# ---------------------------------------------------------------------------
+# plan parsing
+# ---------------------------------------------------------------------------
+
+class TestPlanParse:
+    def test_all_input_forms_agree(self, tmp_path):
+        spec = {"site": "net.replica", "kind": "truncate", "at": [2],
+                "args": {"after_bytes": 200}}
+        as_dict = parse_net_plan({"seed": 9, "faults": [spec]})
+        as_list = parse_net_plan([spec])
+        as_json = parse_net_plan(json.dumps({"seed": 9,
+                                             "faults": [spec]}))
+        path = tmp_path / "plan.json"
+        path.write_text(json.dumps({"seed": 9, "faults": [spec]}))
+        as_file = parse_net_plan(str(path))
+        for plan in (as_dict, as_json, as_file):
+            assert plan.seed == 9
+        for plan in (as_dict, as_list, as_json, as_file):
+            assert len(plan.faults) == 1
+            f = plan.faults[0]
+            assert (f.site, f.kind, f.at) == ("net.replica",
+                                              "truncate", {2})
+        assert as_list.seed is None
+
+    def test_roundtrips_through_to_dict(self):
+        plan = parse_net_plan([{"site": "net.ps", "kind": "corrupt",
+                                "p": 0.5, "max_fires": 3,
+                                "instance": "ps",
+                                "args": {"n_flips": 2}}])
+        again = parse_net_plan(plan.to_dict())
+        assert again.to_dict() == plan.to_dict()
+
+    @pytest.mark.parametrize("spec,msg", [
+        ({"site": "net.nope", "kind": "reset", "p": 1.0},
+         "unknown network-chaos site"),
+        ({"site": "net.replica", "kind": "explode", "p": 1.0},
+         "unknown network-fault kind"),
+        ({"site": "net.replica", "kind": "reset"},
+         "can never fire"),
+        ({"site": "net.replica", "kind": "partition", "p": 1.0,
+          "args": {"direction": "sideways"}}, "bad direction"),
+        ({"site": "net.replica", "kind": "corrupt", "p": 1.0,
+          "args": {"when": "never"}}, "bad when"),
+        ({"site": "net.replica", "kind": "reset", "p": 1.0,
+          "knid": "oops"}, "unknown network-fault spec key"),
+    ])
+    def test_bad_specs_fail_loudly(self, spec, msg):
+        with pytest.raises(ValueError, match=msg):
+            parse_net_plan([spec])
+
+    def test_site_and_kind_registries_are_nonempty(self):
+        assert {"net.replica", "net.ps",
+                "net.collector"} == set(NET_SITES)
+        assert {"partition", "reset", "truncate", "corrupt", "delay",
+                "throttle", "half_open"} == set(NET_KINDS)
+
+
+# ---------------------------------------------------------------------------
+# per-kind proxy semantics
+# ---------------------------------------------------------------------------
+
+class TestProxyKinds:
+    def test_passthrough_is_transparent(self, upstream, mkproxy):
+        p = mkproxy(plan=[])
+        for _ in range(5):
+            st, body = _fetch(p.port)
+            assert st == 200 and body == upstream.body
+        assert p.hits == 5 and p.fired_total == 0
+        assert p.fault_log == []
+
+    def test_truncate_breaks_content_length(self, upstream, mkproxy):
+        p = mkproxy(plan=[{"site": "net.replica", "kind": "truncate",
+                           "at": [2], "args": {"after_bytes": 200}}])
+        st, body = _fetch(p.port)
+        assert st == 200 and body == upstream.body
+        with pytest.raises(http.client.IncompleteRead):
+            _fetch(p.port)
+        assert p.fault_log == [{"conn": 2, "kind": "truncate",
+                                "spec": 0}]
+
+    def test_reset_is_a_real_rst(self, mkproxy):
+        p = mkproxy(plan=[{"site": "net.replica", "kind": "reset",
+                           "at": [1], "args": {"after_bytes": 0}}])
+        with pytest.raises((ConnectionResetError,
+                            http.client.BadStatusLine,
+                            http.client.RemoteDisconnected)):
+            _fetch(p.port)
+        assert p.fired_total == 1
+
+    def test_corrupt_is_seed_deterministic(self, upstream, mkproxy):
+        plan = [{"site": "net.replica", "kind": "corrupt", "p": 1.0,
+                 "args": {"when": "response", "window": 64,
+                          "n_flips": 4}}]
+        clean = _raw_fetch(upstream.port)
+        a = mkproxy(plan=plan, seed=11, name="twin")
+        b = mkproxy(plan=plan, seed=11, name="twin")
+        got_a = _raw_fetch(a.port)
+        got_b = _raw_fetch(b.port)
+        assert got_a != clean          # the flips landed
+        assert got_a == got_b          # ... identically, from the seed
+        c = mkproxy(plan=plan, seed=12, name="twin")
+        assert _raw_fetch(c.port) != got_a   # a new seed, new flips
+
+    def test_half_open_peer_hangs_until_client_deadline(self, mkproxy):
+        p = mkproxy(plan=[{"site": "net.replica", "kind": "half_open",
+                           "p": 1.0}])
+        t0 = time.monotonic()
+        with pytest.raises((socket.timeout, TimeoutError)):
+            _fetch(p.port, timeout=0.5)
+        assert time.monotonic() - t0 < 5.0   # bounded by OUR deadline
+
+    def test_delay_adds_latency(self, upstream, mkproxy):
+        p = mkproxy(plan=[{"site": "net.replica", "kind": "delay",
+                           "p": 1.0, "args": {"delay_s": 0.3}}])
+        t0 = time.monotonic()
+        st, body = _fetch(p.port)
+        assert st == 200 and body == upstream.body
+        assert time.monotonic() - t0 >= 0.3
+
+    def test_manual_partition_then_heal(self, upstream, mkproxy):
+        p = mkproxy(plan=[])
+        st, _ = _fetch(p.port)
+        assert st == 200
+        p.partition(30.0)
+        assert p.partitioned()
+        with pytest.raises((socket.timeout, TimeoutError)):
+            _fetch(p.port, timeout=0.4)
+        p.heal()
+        assert not p.partitioned()
+        st, body = _fetch(p.port)
+        assert st == 200 and body == upstream.body
+        # the manual trigger is audited like a plan-fired fault
+        assert [e["kind"] for e in p.fault_log] == ["partition"]
+
+    def test_max_fires_budget(self, mkproxy):
+        p = mkproxy(plan=[{"site": "net.replica", "kind": "reset",
+                           "p": 1.0, "max_fires": 2,
+                           "args": {"after_bytes": 0}}])
+        outcomes = []
+        for _ in range(5):
+            try:
+                outcomes.append(_fetch(p.port)[0])
+            except (ConnectionResetError, http.client.BadStatusLine,
+                    http.client.RemoteDisconnected):
+                outcomes.append("rst")
+        assert outcomes == ["rst", "rst", 200, 200, 200]
+        assert p.fired_total == 2
+
+    def test_instance_filter_narrows_to_one_proxy(self, mkproxy):
+        plan = [{"site": "net.replica", "kind": "reset", "p": 1.0,
+                 "instance": "replica-0", "args": {"after_bytes": 0}}]
+        hit = mkproxy(plan=plan, name="replica-0")
+        missed = mkproxy(plan=plan, name="replica-1")
+        with pytest.raises((ConnectionResetError,
+                            http.client.BadStatusLine,
+                            http.client.RemoteDisconnected)):
+            _fetch(hit.port)
+        assert _fetch(missed.port)[0] == 200
+        assert (hit.fired_total, missed.fired_total) == (1, 0)
+
+    def test_fault_log_replays_from_seed(self, mkproxy):
+        """The fired-fault log is a pure function of (plan, seed,
+        connection count): two same-named proxies over 20 connections
+        produce identical logs."""
+        plan = [{"site": "net.replica", "kind": "delay", "p": 0.5,
+                 "args": {"delay_s": 0.0}}]
+        a = mkproxy(plan=plan, seed=1234, name="twin")
+        b = mkproxy(plan=plan, seed=1234, name="twin")
+        for p in (a, b):
+            for _ in range(20):
+                _fetch(p.port)
+        assert a.fault_log == b.fault_log
+        assert 0 < len(a.fault_log) < 20   # p=0.5 really sampled
+
+
+# ---------------------------------------------------------------------------
+# fleet behind proxies: eject while dark, readmit after heal
+# ---------------------------------------------------------------------------
+
+class _EchoModel:
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def output(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(x) * 2.0
+
+
+class _FakeSession:
+    """Deterministic decode: next token = (feed + 1) % vocab."""
+
+    def __init__(self, slots, vocab, step_delay):
+        self.slots = slots
+        self.vocab = vocab
+        self.step_delay = step_delay
+
+    def reset_slot(self, i):
+        pass
+
+    def reinit_states(self):
+        pass
+
+    def step_slots(self, x, active):
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        h = np.zeros((self.slots, 1, self.vocab), np.float32)
+        for i in range(self.slots):
+            nxt = (int(x[i, 0, 0]) + 1) % self.vocab
+            h[i, 0, nxt] = 1.0
+        return h
+
+
+class _FakeStreamModel:
+    VOCAB = 16
+
+    def __init__(self, step_delay=0.0):
+        self.step_delay = step_delay
+
+    def slot_streaming_session(self, capacity=64, slots=2,
+                               dtype=None):
+        return _FakeSession(slots, self.VOCAB, self.step_delay)
+
+
+def _expected_ids(prompt, n_tokens, vocab=_FakeStreamModel.VOCAB):
+    out, feed = [], int(prompt[-1])
+    for _ in range(n_tokens):
+        feed = (feed + 1) % vocab
+        out.append(feed)
+    return out
+
+
+def _post(base, path, body, timeout=10.0):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _get(base, path, timeout=5.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _counter(registry_owner, name, **labels):
+    m = registry_owner.registry.get(name, labels=labels or None)
+    return 0.0 if m is None else m.value
+
+
+@pytest.fixture()
+def net_stack():
+    """Fleet whose every replica sits behind a (fault-free) chaos
+    proxy, plus a fast-probing router — tests drive partitions
+    manually on the per-replica proxies."""
+    built = []
+
+    def build(n=3, stream_delay=0.01, net_chaos=None, **router_kw):
+        def factory():
+            return {"default": _EchoModel(),
+                    "lm": _FakeStreamModel(step_delay=stream_delay)}
+
+        fleet = ReplicaFleet(
+            factory, n=n,
+            server_kwargs=dict(wait_ms=1.0, slots=2, capacity=64),
+            net_chaos=net_chaos if net_chaos is not None else [],
+            net_chaos_seed=7).start()
+        kw = dict(probe_interval_s=0.05, probe_timeout_s=0.3,
+                  eject_consecutive=2, eject_cooldown_s=0.4,
+                  attempt_timeout_s=0.8, request_timeout_s=10.0,
+                  hedge_after_s=None, sample_rate=1.0)
+        kw.update(router_kw)
+        router = Router(fleet, **kw).start()
+        built.append((fleet, router))
+        return fleet, router
+
+    yield build
+    for fleet, router in built:
+        router.stop()
+        fleet.stop(drain=False, timeout=2.0)
+
+
+class TestFleetPartition:
+    def test_every_replica_fronted_and_traffic_flows(self, net_stack):
+        fleet, router = net_stack(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        for i in range(6):
+            st, _ = _post(base, "/v1/predict",
+                          {"model": "default",
+                           "inputs": [[float(i), 1.0, 2.0, 3.0]]})
+            assert st == 200
+        for r in fleet.snapshot():
+            assert r.net_proxy is not None
+            assert r.port == r.net_proxy.port
+            assert r.upstream_port not in (0, r.port)
+            assert r.net_proxy.hits > 0    # probes + traffic crossed
+
+    def test_partition_ejects_victim_then_readmits(self, net_stack):
+        fleet, router = net_stack(n=3)
+        base = f"http://127.0.0.1:{router.port}"
+        victim = fleet.replica(0)
+        ej0 = _counter(router, "router_ejections_total",
+                       replica=str(victim.id))
+        victim.net_proxy.partition(1.6)
+        # while the victim is dark: the router ejects it off failed
+        # probes and every request still lands on a survivor
+        saw_eject = False
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            st, _ = _post(base, "/v1/predict",
+                          {"model": "default",
+                           "inputs": [[1.0, 1.0, 2.0, 3.0]]},
+                          timeout=8.0)
+            assert st == 200
+            if _counter(router, "router_ejections_total",
+                        replica=str(victim.id)) > ej0:
+                saw_eject = True
+                break
+            time.sleep(0.05)
+        assert saw_eject, "victim never ejected while partitioned"
+        # after heal + cooldown the probes readmit it
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            st, body = _get(base, "/healthz")
+            if body.get("eligible") == 3:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("victim never readmitted after heal")
+        assert not victim.net_proxy.partitioned()
+
+    def test_midstream_partition_recovers_token_identical(
+            self, net_stack):
+        """A partition cutting a pinned generate mid-stream is
+        recovered by the recompute ladder on a survivor — the client
+        sees a 200 with exactly the tokens deterministic decode
+        would have produced."""
+        fleet, router = net_stack(n=2, stream_delay=0.02)
+        base = f"http://127.0.0.1:{router.port}"
+        st, _ = _post(base, "/v1/generate",
+                      {"model": "lm", "prompt": [1], "n_tokens": 1,
+                       "session": "cut"})
+        assert st == 200
+        pinned_rid = router._affinity["cut"]
+        pinned = [r for r in fleet.snapshot()
+                  if r.id == pinned_rid][0]
+        out = {}
+
+        def gen():
+            out["resp"] = _post(
+                base, "/v1/generate",
+                {"model": "lm", "prompt": [5], "n_tokens": 30,
+                 "session": "cut"}, timeout=30.0)
+
+        t = threading.Thread(target=gen, daemon=True)
+        t.start()
+        time.sleep(0.15)               # a few tokens in
+        pinned.net_proxy.partition(2.0)
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        st, body = out["resp"]
+        assert st == 200, body
+        assert body["ids"] == _expected_ids([5], 30)
+        assert _counter(router, "router_kv_fallbacks_total") >= 1
+
+
+# ---------------------------------------------------------------------------
+# asymmetric partition: the collector's path, not the router's
+# ---------------------------------------------------------------------------
+
+class TestAsymmetricCollectorPartition:
+    def test_scrape_partition_is_not_a_replica_death(
+            self, net_stack, tmp_path):
+        fleet, router = net_stack(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        r0 = fleet.replica(0)
+        # the collector reaches replica-0 through its OWN proxy —
+        # upstream is the replica's real listener, so this hop can
+        # go dark while the router's stays up
+        col_proxy = NetChaosProxy(
+            ("127.0.0.1", r0.upstream_port), site="net.collector",
+            name=f"collector-replica-{r0.id}").start()
+        name0 = f"replica-{r0.id}"
+
+        def rewrite(name, url):
+            if name == name0:
+                return url.replace(f":{r0.port}",
+                                   f":{col_proxy.port}")
+            return url
+
+        col = FleetCollector(fleet=fleet, router=router,
+                             incident_dir=str(tmp_path),
+                             incident_min_interval_s=0.0,
+                             scrape_timeout_s=0.5,
+                             url_rewrite=rewrite)
+        try:
+            col.scrape_once()
+            assert col.fleet_health()["targets_down"] == []
+            col_proxy.partition(30.0)
+            part0 = _counter(col, "fleet_scrape_partitions_total")
+            col.scrape_once()
+            # scrape path dark, fleet path up: down target logged as
+            # a partition, NOT promoted to a replica-death incident
+            assert name0 in col.fleet_health()["targets_down"]
+            assert _counter(col, "fleet_scrape_partitions_total") \
+                > part0
+            assert [d for d in os.listdir(tmp_path)
+                    if d.startswith("incident-")] == []
+            # and serving never noticed
+            st, _ = _post(base, "/v1/predict",
+                          {"model": "default",
+                           "inputs": [[1.0, 1.0, 2.0, 3.0]]})
+            assert st == 200
+            col_proxy.heal()
+            col.scrape_once()
+            assert col.fleet_health()["targets_down"] == []
+        finally:
+            col.stop()
+            col_proxy.stop()
+
+
+# ---------------------------------------------------------------------------
+# the DPS1 wire behind the proxy: only typed errors, server survives
+# ---------------------------------------------------------------------------
+
+class TestPSWireThroughProxy:
+    @pytest.fixture()
+    def ps(self):
+        server = ParameterServer(
+            {"w": np.ones((3, 2), np.float32),
+             "b": np.zeros((2,), np.float32)},
+            lr=0.5, heartbeat_timeout_s=30.0).start()
+        yield server
+        server.stop()
+
+    def _proxied_client(self, ps, plan, seed=5):
+        proxy = NetChaosProxy(ps.address, plan=plan, seed=seed,
+                              site="net.ps", name="ps").start()
+        client = PSClient(("127.0.0.1", proxy.port),
+                          op_timeout_s=0.5, max_retries=2,
+                          backoff_s=0.01)
+        return proxy, client
+
+    @pytest.mark.parametrize("plan", [
+        [{"site": "net.ps", "kind": "corrupt", "p": 1.0,
+          "args": {"when": "response", "window": 32, "n_flips": 3}}],
+        [{"site": "net.ps", "kind": "truncate", "p": 1.0,
+          "args": {"after_bytes": 6}}],
+        [{"site": "net.ps", "kind": "half_open", "p": 1.0}],
+    ], ids=["corrupt", "truncate", "half_open"])
+    def test_wire_faults_surface_typed_and_server_survives(
+            self, ps, plan):
+        proxy, client = self._proxied_client(ps, plan)
+        try:
+            with pytest.raises(_TYPED_PS):
+                client.pull()
+            assert proxy.fired_total >= 1
+        finally:
+            client.close()
+            proxy.stop()
+        # the server shrugged it all off: a clean direct client
+        # still round-trips
+        direct = PSClient(ps.address)
+        try:
+            leaves, version = direct.pull()
+            assert len(leaves) == 2 and version == 0
+        finally:
+            direct.close()
+
+    def test_intermittent_corruption_is_retried_through(self, ps):
+        """One corrupted connection, then clean: the client's
+        reconnect+retry absorbs the fault entirely."""
+        proxy, client = self._proxied_client(
+            ps, [{"site": "net.ps", "kind": "corrupt", "at": [1],
+                  "args": {"when": "response", "window": 32,
+                           "n_flips": 3}}])
+        try:
+            leaves, version = client.pull()
+            assert len(leaves) == 2 and version == 0
+            assert proxy.fired_total == 1
+        finally:
+            client.close()
+            proxy.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance soaks (slow): subprocess fleet + seeded partition;
+# train-ps through a corrupt+truncate wire
+# ---------------------------------------------------------------------------
+
+def _write_fleet_model(tmp_path, feat=8):
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import updaters
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.util.model_serializer import write_model
+    conf = (NeuralNetConfiguration.builder().set_seed(0)
+            .updater(updaters.adam(1e-3)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(feat))
+            .build())
+    model_zip = str(tmp_path / "mlp.zip")
+    write_model(MultiLayerNetwork(conf).init(), model_zip)
+    return model_zip, feat
+
+
+@pytest.mark.slow
+class TestNetChaosAcceptance:
+    def test_seeded_partition_soak_zero_gold_drops(self, tmp_path):
+        """4 subprocess replicas behind proxies, loadgen with a gold
+        tier mix, a PLAN-seeded 5 s partition of replica-0 firing
+        mid-load: the victim is ejected while dark and readmitted
+        after heal, zero requests drop (gold and otherwise), and the
+        fired-fault log replays identically from the seed."""
+        model_zip, feat = _write_fleet_model(tmp_path)
+        plan = {"seed": 31337, "faults": [
+            {"site": "net.replica", "kind": "partition", "at": [25],
+             "args": {"duration_s": 5.0, "direction": "both"},
+             "instance": "replica-0"}]}
+        fleet = ReplicaFleet(model_specs=[f"default={model_zip}"],
+                             n=4, base_port=18500,
+                             net_chaos=plan).start()
+        assert fleet._net_seed == 31337
+        router = None
+        try:
+            # wait for the replicas themselves (via their REAL
+            # listeners) so probe traffic doesn't burn connection
+            # ordinals before load starts
+            deadline = time.monotonic() + 120.0
+            for r in fleet.snapshot():
+                while time.monotonic() < deadline:
+                    try:
+                        urllib.request.urlopen(
+                            f"http://{r.host}:{r.upstream_port}"
+                            "/healthz", timeout=1.0).read()
+                        break
+                    except OSError:
+                        time.sleep(0.25)
+                else:
+                    raise RuntimeError("replicas never became ready")
+
+            router = Router(fleet, probe_interval_s=0.25,
+                            probe_timeout_s=0.6, eject_consecutive=2,
+                            eject_cooldown_s=1.0,
+                            attempt_timeout_s=1.0,
+                            request_timeout_s=20.0,
+                            hedge_after_s=None,
+                            sample_rate=1.0).start()
+            base = f"http://127.0.0.1:{router.port}"
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                try:
+                    if _get(base, "/healthz")[1].get("eligible") == 4:
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.2)
+            else:
+                raise RuntimeError("fleet never became eligible")
+
+            victim = fleet.replica(0)
+            assert victim.net_proxy.name == "replica-0"
+
+            def body(i):
+                return {"model": "default",
+                        "inputs": [[float(i % 5)] * feat]}
+
+            mix = parse_tier_mix(
+                "gold=0.3,standard=0.4,best_effort=0.3")
+            rep = LoadGen(base, body_fn=tiered_body_fn(body, mix),
+                          concurrency=6, total=400, max_retries=4,
+                          timeout_s=30.0).run()
+
+            # the seeded partition really fired, exactly once, at
+            # the planned ordinal
+            assert victim.net_proxy.fault_log == [
+                {"conn": 25, "kind": "partition", "spec": 0}]
+            # zero drops — gold and everything else
+            assert rep["failed"] == 0, rep.get("errors")
+            assert rep["ok"] == 400
+            assert rep["tiers"]["gold"]["failed"] == 0
+            assert "error_classes" in rep
+            # the victim was ejected while dark ...
+            assert _counter(router, "router_ejections_total",
+                            replica=str(victim.id)) >= 1
+            # ... and is readmitted after heal
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if _get(base, "/healthz")[1].get("eligible") == 4:
+                    break
+                time.sleep(0.25)
+            else:
+                raise AssertionError(
+                    "victim never readmitted after the partition "
+                    "healed")
+
+            # replay: a fresh proxy with the same (plan, seed, name)
+            # driven to the same connection count reproduces the
+            # fault log byte-for-byte
+            up = _MiniUpstream()
+            replay = NetChaosProxy(
+                ("127.0.0.1", up.port), plan=plan, seed=31337,
+                site="net.replica", name="replica-0").start()
+            try:
+                for _ in range(victim.net_proxy.hits):
+                    try:
+                        s = socket.create_connection(
+                            ("127.0.0.1", replay.port), timeout=1.0)
+                        s.close()
+                    except OSError:
+                        pass
+                deadline = time.monotonic() + 10.0
+                while (replay.hits < victim.net_proxy.hits
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                replay.heal()      # don't sit out the replayed 5 s
+                assert replay.fault_log == victim.net_proxy.fault_log
+            finally:
+                replay.stop()
+                up.stop()
+        finally:
+            if router is not None:
+                router.stop()
+            fleet.stop(drain=False, timeout=5.0)
+
+    def test_train_ps_through_corrupt_truncate_wire(self, tmp_path):
+        """3-worker ``train-ps`` with ``--net-chaos`` interposing a
+        corrupt+truncate proxy on the DPS1 wire: training completes
+        (every worker exits 0), pushes apply, and nothing dies with
+        a raw traceback — the wire faults all surfaced typed."""
+        from fixtures import tiny_classifier
+        from deeplearning4j_tpu.util.model_serializer import (
+            restore_model, write_model)
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        model_zip = str(tmp_path / "m.zip")
+        write_model(tiny_classifier(seed=0), model_zip)
+        rng = np.random.default_rng(0)
+        rows = []
+        for _ in range(96):
+            c = int(rng.integers(0, 3))
+            x = rng.normal(size=4) + c * 1.5
+            rows.append(",".join(f"{v:.4f}" for v in x) + f",{c}")
+        csv = str(tmp_path / "d.csv")
+        with open(csv, "w") as f:
+            f.write("\n".join(rows) + "\n")
+        # DPS1 clients hold one long-lived connection and only
+        # reconnect after a fault, so ordinal schedules (not p) make
+        # the injection deterministic: connections 2 and 6 get their
+        # first reply corrupted, connection 4 gets it truncated —
+        # each costs the worker one typed retry
+        plan = tmp_path / "netplan.json"
+        plan.write_text(json.dumps({"faults": [
+            {"site": "net.ps", "kind": "corrupt", "at": [2, 6],
+             "args": {"when": "response", "window": 32,
+                      "n_flips": 2}},
+            {"site": "net.ps", "kind": "truncate", "at": [4],
+             "args": {"after_bytes": 6}}]}))
+        out_zip = str(tmp_path / "out.zip")
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu", "train-ps",
+             "--model", model_zip, "--data", csv, "--label-index",
+             "4", "--classes", "3", "--batch-size", "8", "--epochs",
+             "6", "--ps-workers", "3", "--lr", "0.2", "--op-timeout",
+             "2.0", "--net-chaos", str(plan), "--net-chaos-seed",
+             "424242", "--output", out_zip],
+            env=env, cwd=repo, capture_output=True, text=True,
+            timeout=600)
+        out = proc.stdout + proc.stderr
+        assert proc.returncode == 0, out
+        assert "pushes applied" in out
+        assert "424242" in out          # the replay seed was printed
+        assert "fault fired" in out     # the wire faults really hit
+        assert "Traceback" not in out   # every fault surfaced typed
+        restored = restore_model(out_zip)
+        assert restored is not None

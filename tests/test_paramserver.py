@@ -111,6 +111,73 @@ class TestWireFrames:
             self._roundtrip(raw)
 
 
+class TestWireFuzz:
+    """Crafted → fuzzed: seeded random corruption and truncation of
+    valid DPS1 frames against a LIVE server. Every mutation must
+    come back typed (PSFrameError / PSProtocolError / PSTimeoutError
+    — or a typed error REPLY frame, or a dropped connection), and
+    the server must keep serving afterward: no mutation may kill a
+    handler thread or wedge the accept loop."""
+
+    _TYPED = (PSFrameError, PSProtocolError, PSTimeoutError,
+              OSError)
+
+    def _mutations(self, rng, n):
+        base = [
+            pack_frame({"op": "hello", "worker": "fuzz"}),
+            pack_frame({"op": "pull", "worker_id": "w0"}),
+            pack_frame({"op": "push", "worker_id": "w0", "seq": 1,
+                        "base_version": 0,
+                        "leaves": [{"shape": [64], "scale": 1.0}]},
+                       b"\x01" * 64),
+            pack_frame({"op": "hb", "worker_id": "w0"}),
+        ]
+        for _ in range(n):
+            raw = bytearray(base[int(rng.integers(len(base)))])
+            if rng.random() < 0.5:
+                for _ in range(int(rng.integers(1, 5))):
+                    pos = int(rng.integers(len(raw)))
+                    raw[pos] ^= int(rng.integers(1, 256))
+            else:
+                raw = raw[:int(rng.integers(len(raw)))]
+            yield bytes(raw)
+
+    @pytest.mark.filterwarnings(
+        "error::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_fuzzed_frames_never_kill_the_server(self):
+        server = ParameterServer(_tiny_params(), lr=0.5,
+                                 heartbeat_timeout_s=30.0).start()
+        rng = np.random.default_rng(0xD151)
+        try:
+            for raw in self._mutations(rng, 80):
+                with socket.create_connection(server.address,
+                                              timeout=2.0) as s:
+                    try:
+                        s.sendall(raw)
+                        s.shutdown(socket.SHUT_WR)
+                        hdr, _ = read_frame(
+                            s, deadline=time.monotonic() + 1.0)
+                    except self._TYPED:
+                        continue    # the only acceptable exceptions
+                    # a reply means either the mutation left the
+                    # frame valid, or the server answered with a
+                    # typed error frame — never a raw traceback name
+                    if hdr.get("op") == "error":
+                        assert hdr["error"].startswith("PS") \
+                            or hdr["error"].endswith("Error")
+            # the server survived all of it: a clean client still
+            # round-trips hello + pull
+            c = PSClient(server.address)
+            try:
+                leaves, version = c.pull()
+                assert len(leaves) == 2 and version == 0
+            finally:
+                c.close()
+            assert server.stats["restarts"] == 0
+        finally:
+            server.stop()
+
+
 # ---------------------------------------------------------------------------
 # server ops over a live socket
 # ---------------------------------------------------------------------------

@@ -22,6 +22,15 @@ truth — and three drift modes, checked three-way:
   handled centrally by ``step_fault``) that never appears in a
   ``.kind`` comparison or membership test — the plan accepts it,
   the call site ignores it, and it "fires" as a no-op.
+
+The network chaos proxy (``chaos/netproxy.py``) gets the same
+treatment, three-way over ``NET_KINDS``: the dict IS the plan-parse
+validation set, so every key must also (a) appear in a ``.kind``
+comparison somewhere (the proxy actually interprets it) and (b) sit
+in the README's network-fault kind table — and every kind the table
+documents must be a ``NET_KINDS`` key, or a plan copied from the
+docs fails to parse. ``NET_SITES`` entries must appear in the README
+like injector sites must (GL005 owns the reverse direction).
 """
 
 from __future__ import annotations
@@ -35,6 +44,7 @@ from tools.graftlint.core import Finding, ParsedModule, RepoContext
 from tools.graftlint.rules.base import Rule
 
 _INJECTOR_RELPATH = "deeplearning4j_tpu/chaos/injector.py"
+_NETPROXY_RELPATH = "deeplearning4j_tpu/chaos/netproxy.py"
 _HIT_FUNCS = {"hit", "step_fault", "file_fault",
               # chaos.retry's wrapper: retrying_io(site, fn) hits
               # the site through the shared retry policy
@@ -43,6 +53,11 @@ _HIT_FUNCS = {"hit", "step_fault", "file_fault",
 _CENTRAL_KINDS = {"crash", "hang", "slow", "error", "enospc",
                   "truncate", "corrupt"}
 _DOC_SITE_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`")
+# the README network-fault kind table: a markdown table whose header
+# row's first column is literally "kind"; each following row's first
+# cell is one backticked kind name
+_NET_TABLE_HEADER_RE = re.compile(r"^\|\s*kind\s*\|", re.IGNORECASE)
+_NET_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`\s*\|")
 
 
 class ChaosCoverageRule(Rule):
@@ -114,6 +129,117 @@ class ChaosCoverageRule(Rule):
                             "SITE_KINDS but no call site ever "
                             "compares fault.kind against it — it "
                             "fires as a silent no-op")))
+        out.extend(self._check_netproxy(ctx, kind_literals,
+                                        doc_sites))
+        return out
+
+    # ---------------------------------------------------- net proxy
+    def _check_netproxy(self, ctx: RepoContext,
+                        kind_literals: Set[str],
+                        doc_sites: Optional[Set[str]]
+                        ) -> List[Finding]:
+        module = next((m for m in ctx.modules
+                       if m.relpath == _NETPROXY_RELPATH), None)
+        if module is None:
+            return []
+        declared = self._net_declared(module)
+        if declared is None:
+            return []
+        net_sites, net_kinds, sites_line, kinds_line = declared
+        out: List[Finding] = []
+        # NET_KINDS is the plan-parse validation set; every key must
+        # also be interpreted by the proxy's data path
+        for kind in sorted(net_kinds):
+            if kind not in kind_literals:
+                out.append(Finding(
+                    rule=self.id, path=module.relpath,
+                    line=kinds_line, symbol=kind,
+                    message=(
+                        f"network-fault kind '{kind}' is accepted "
+                        "at plan-parse time (NET_KINDS) but the "
+                        "proxy never compares a fault's kind "
+                        "against it — it fires as a silent no-op")))
+        doc_kinds = self._net_doc_kinds(ctx.repo)
+        if doc_kinds is not None:
+            for kind in sorted(set(net_kinds) - set(doc_kinds)):
+                out.append(Finding(
+                    rule=self.id, path="README.md", line=0,
+                    symbol=kind,
+                    message=(
+                        f"network-fault kind '{kind}' is declared "
+                        "in NET_KINDS but missing from the README "
+                        "network-fault kind table")))
+            for kind in sorted(set(doc_kinds) - set(net_kinds)):
+                out.append(Finding(
+                    rule=self.id, path="README.md",
+                    line=doc_kinds[kind], symbol=kind,
+                    message=(
+                        f"the README network-fault kind table "
+                        f"documents '{kind}' but NET_KINDS does not "
+                        "declare it — a plan copied from the docs "
+                        "fails to parse")))
+        if doc_sites is not None:
+            for site in sorted(net_sites):
+                if site not in doc_sites:
+                    out.append(Finding(
+                        rule=self.id, path="README.md", line=0,
+                        symbol=site,
+                        message=(
+                            f"network-chaos site '{site}' is "
+                            "declared in NET_SITES but missing "
+                            "from the README network fault-"
+                            "injection docs")))
+        return out
+
+    def _net_declared(self, module: ParsedModule):
+        sites: Set[str] = set()
+        kinds: Set[str] = set()
+        sites_line = kinds_line = 0
+        for node in module.tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = ([node.target] if isinstance(node,
+                                                   ast.AnnAssign)
+                       else node.targets)
+            name = next((t.id for t in targets
+                         if isinstance(t, ast.Name)), "")
+            value = node.value
+            if not isinstance(value, ast.Dict):
+                continue
+            keys = {k.value for k in value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            if name == "NET_SITES":
+                sites, sites_line = keys, node.lineno
+            elif name == "NET_KINDS":
+                kinds, kinds_line = keys, node.lineno
+        if not sites and not kinds:
+            return None
+        return sites, kinds, sites_line, kinds_line
+
+    def _net_doc_kinds(self, repo: str) -> Optional[Dict[str, int]]:
+        """First-column backticked tokens of the README table whose
+        header column is ``kind`` — ``{kind: line_no}``."""
+        path = os.path.join(repo, "README.md")
+        try:
+            with open(path, encoding="utf-8",
+                      errors="replace") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return None
+        out: Dict[str, int] = {}
+        in_table = False
+        for i, line in enumerate(lines, 1):
+            if _NET_TABLE_HEADER_RE.match(line):
+                in_table = True
+                continue
+            if in_table:
+                if not line.startswith("|"):
+                    in_table = False
+                    continue
+                m = _NET_ROW_RE.match(line)
+                if m:
+                    out.setdefault(m.group(1), i)
         return out
 
     # ------------------------------------------------------- declared

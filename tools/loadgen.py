@@ -9,7 +9,11 @@ metrics registry, not a side array), honor ``Retry-After`` backoff
 on 429/503, and report exactly what the soak acceptance needs:
 how many requests were sent, how many ever failed to get a
 successful response (``failed`` — the "dropped requests" count),
-and the latency distribution.
+and the latency distribution. Every error is also CLASSIFIED
+(``error_classes`` in the report: ``connect_refused`` / ``reset`` /
+``timeout`` / ``bad_body`` / ``5xx`` / ``4xx`` / ``shed_429_503`` /
+``neterr``), retried or not — a network-chaos soak asserts WHICH
+failure mode occurred, not just how many requests it cost.
 
 Two loop disciplines (the classic load-testing split):
 
@@ -58,8 +62,10 @@ CLI::
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import queue
+import socket
 import sys
 import threading
 import time
@@ -470,6 +476,7 @@ class LoadGen:
             "sent": 0, "ok": 0, "failed": 0, "retries": 0,
             "not_sent": 0, "retry_after_honored": 0}
         self._errors: Dict[str, int] = {}
+        self._error_classes: Dict[str, int] = {}
         # per-tier outcome + latency accounting (created lazily on
         # the first tiered body; untiered runs pay nothing)
         self._tier_counts: Dict[str, Dict[str, int]] = {}
@@ -521,7 +528,15 @@ class LoadGen:
 
         while True:
             attempts += 1
-            status, retry_after, data = self._fire(body, deadline)
+            status, retry_after, data, klass = self._fire(body,
+                                                          deadline)
+            if klass is not None:
+                with self._lock:
+                    # every error OCCURRENCE by class, retried or
+                    # not: a zero-drop soak still asserts which
+                    # failure mode its retries absorbed
+                    self._error_classes[klass] = \
+                        self._error_classes.get(klass, 0) + 1
             if status in (429, 503) and tc is not None:
                 with self._lock:
                     # every shed response the tier absorbed, retried
@@ -548,7 +563,11 @@ class LoadGen:
                         tc["retries"] += 1
                 else:
                     self._counts["failed"] += 1
-                    key = str(status)
+                    # terminal network failures keep their CLASS as
+                    # the key ("timeout", "reset", ...), not an
+                    # opaque "neterr"
+                    key = klass if status == "neterr" \
+                        else str(status)
                     self._errors[key] = self._errors.get(key, 0) + 1
                     if tc is not None:
                         tc["failed"] += 1
@@ -574,15 +593,43 @@ class LoadGen:
                 record()
                 return
 
+    @staticmethod
+    def _classify(e: BaseException) -> str:
+        """The error-class taxonomy a chaos soak asserts against.
+        Unwraps urllib's URLError so a refused connect classifies
+        the same whether the OS error arrived bare or wrapped."""
+        if isinstance(e, urllib.error.URLError) \
+                and isinstance(e.reason, BaseException):
+            e = e.reason
+        if isinstance(e, ConnectionRefusedError):
+            return "connect_refused"
+        if isinstance(e, (ConnectionResetError, BrokenPipeError,
+                          http.client.RemoteDisconnected)):
+            return "reset"
+        if isinstance(e, (TimeoutError, socket.timeout)):
+            return "timeout"
+        if isinstance(e, http.client.IncompleteRead):
+            return "bad_body"
+        if isinstance(e, http.client.HTTPException):
+            # BadStatusLine & co: the response bytes were mangled
+            # mid-stream (a reset or corruption inside the status
+            # line) — the body never parsed as HTTP at all
+            return "bad_body"
+        return "neterr"
+
     def _fire(self, body: bytes, deadline: float):
-        """(status | "neterr", retry_after_seconds or None, body)."""
+        """(status | "neterr", retry_after_seconds or None, body,
+        error class or None). A 2xx whose body is not the JSON the
+        server framed (truncated / corrupted on the wire) is a
+        ``bad_body`` network error, never a success — and never a
+        raw exception unwinding a worker thread."""
         timeout = max(0.05, deadline - time.monotonic())
         req = urllib.request.Request(
             self.url + self.route, data=body,
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
-                return r.status, None, r.read()
+                status, data = r.status, r.read()
         except urllib.error.HTTPError as e:
             e.read()
             ra = e.headers.get("Retry-After")
@@ -590,9 +637,17 @@ class LoadGen:
                 ra = float(ra) if ra is not None else None
             except ValueError:
                 ra = None
-            return e.code, ra, None
-        except (urllib.error.URLError, OSError, TimeoutError):
-            return "neterr", None, None
+            klass = ("shed_429_503" if e.code in (429, 503)
+                     else "5xx" if e.code >= 500 else "4xx")
+            return e.code, ra, None, klass
+        except (urllib.error.URLError, OSError, TimeoutError,
+                http.client.HTTPException) as e:
+            return "neterr", None, None, self._classify(e)
+        try:
+            json.loads(data.decode())
+        except (ValueError, UnicodeDecodeError):
+            return "neterr", None, None, "bad_body"
+        return status, None, data, None
 
     # ---- loop disciplines ----
     def _closed_loop(self) -> None:
@@ -699,6 +754,7 @@ class LoadGen:
         with self._lock:
             counts = dict(self._counts)
             errors = dict(self._errors)
+            error_classes = dict(self._error_classes)
         snap = self.latency.snapshot()
         report = {
             "route": self.route,
@@ -716,6 +772,7 @@ class LoadGen:
                 "mean": round(snap["sum"] / snap["count"] * 1e3, 3)
                 if snap["count"] else 0.0},
             "errors": errors,
+            "error_classes": error_classes,
         }
         report.update(counts)
         with self._lock:
