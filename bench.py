@@ -1916,6 +1916,201 @@ def _leg_autoscaler_soak(peak):
                  "requires ZERO gold failures")}
 
 
+def _leg_rollout_soak(peak):
+    """The canary-rollout drill as a measured claim, both directions:
+    a GOOD candidate (behavior-equivalent retrain) promoted
+    fleet-wide through the SLO gate, and a BAD candidate
+    (NaN-poisoned via a seeded `serving.rollout` `bad_version`
+    fault) detected by shadow scoring and automatically rolled
+    back. 4 in-process replicas behind the real Router/collector
+    stack under live gold/standard/best_effort load. Headlines:
+    good-canary time-to-promoted and bad-canary
+    time-to-detected-and-rolled-back (status `started_unix` →
+    `finished_unix`), with ZERO gold drops in both runs, capacity
+    never below 4, and exactly one incident bundle from the bad
+    run. Like autoscaler_soak this measures the CONTROL LOOP, not
+    device compute."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading as _th
+    import urllib.request
+
+    from deeplearning4j_tpu import chaos
+    from deeplearning4j_tpu.observability.fleetobs import \
+        FleetCollector
+    from deeplearning4j_tpu.serving.fleet import UP, ReplicaFleet
+    from deeplearning4j_tpu.serving.router import Router
+    from deeplearning4j_tpu.serving.rollout import RolloutController
+
+    class EchoModel:
+        def output(self, x):
+            return np.asarray(x, dtype=np.float32) * 2.0
+
+    TIERS = ("gold", "standard", "best_effort")
+
+    def post(base, body):
+        req = urllib.request.Request(
+            base + "/v1/predict",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                return r.status, _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, {}
+        except Exception:
+            return 0, {}
+
+    def run(bad, inc_dir, seed=23):
+        fleet = ReplicaFleet(
+            lambda: {"default": EchoModel()}, n=4,
+            server_kwargs=dict(wait_ms=1.0, max_batch_size=8,
+                               queue_limit=64)).start()
+        router = Router(fleet, probe_interval_s=0.05,
+                        probe_timeout_s=0.5, attempt_timeout_s=2.0,
+                        request_timeout_s=10.0, hedge_after_s=None,
+                        sample_rate=1.0).start()
+        col = FleetCollector(fleet=fleet, router=router,
+                             interval_s=0.25,
+                             incident_min_interval_s=0.0,
+                             incident_dir=inc_dir).start()
+        rc = RolloutController(
+            fleet, router,
+            candidate_factory=lambda: {"default": EchoModel()},
+            collector=col, canary_weight=0.25, shadow_sample=0.5,
+            min_requests=40, warmup_requests=10,
+            min_shadow_compared=10, gate_poll_s=0.1,
+            # wide open: on this 1-2 core host a freshly-booted
+            # canary's scheduling jitter can trip any tight ratio —
+            # the leg times the control loop; the bad candidate is
+            # caught by shadow scoring, which is load-independent
+            drain_timeout_s=5.0, max_p99_ratio=50.0)
+        if bad:
+            chaos.install({"faults": [
+                {"site": "serving.rollout", "kind": "bad_version",
+                 "at": [1]}]}, seed=seed)
+        base = f"http://127.0.0.1:{router.port}"
+        counts = {t: {"ok": 0, "dropped": 0} for t in TIERS}
+        stop = _th.Event()
+        mincap = [10**9]
+
+        def drive(tier):
+            i = 0
+            while not stop.is_set():
+                i += 1
+                st, _b = post(base, {"model": "default",
+                                     "inputs": [[float(i % 5)]],
+                                     "tier": tier})
+                counts[tier]["ok" if st == 200
+                             else "dropped"] += 1
+                mincap[0] = min(mincap[0], sum(
+                    1 for r in fleet.snapshot()
+                    if r.fleet_state == UP))
+                time.sleep(0.004)
+
+        threads = [_th.Thread(target=drive, args=(t,), daemon=True)
+                   for t in TIERS]
+        out = {}
+
+        def roll():
+            out["status"] = rc.run()
+
+        rt = _th.Thread(target=roll, daemon=True)
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(1.0)       # incumbent evidence before start
+            rt.start()
+            rt.join(timeout=120.0)
+            if rt.is_alive():
+                rc.abort("bench watchdog")
+                rt.join(timeout=30.0)
+            time.sleep(0.5)       # let in-flight drain into counts
+            versions = sorted(fleet.versions().values())
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+            chaos.uninstall()
+            col.stop()
+            router.stop()
+            fleet.stop(drain=False, timeout=5.0)
+        st = out.get("status") or {}
+        elapsed = (None if not st.get("finished_unix")
+                   else round(st["finished_unix"]
+                              - st["started_unix"], 2))
+        incidents = sorted(
+            d for d in os.listdir(inc_dir)
+            if d.startswith("incident-"))
+        return {"status": st, "elapsed_s": elapsed,
+                "tiers": counts, "min_capacity": mincap[0],
+                "versions": versions, "incidents": incidents}
+
+    tmp_good = tempfile.mkdtemp(prefix="bench-rollout-good-")
+    tmp_bad = tempfile.mkdtemp(prefix="bench-rollout-bad-")
+    try:
+        good = run(bad=False, inc_dir=tmp_good)
+        bad = run(bad=True, inc_dir=tmp_bad)
+        for name, r in (("good", good), ("bad", bad)):
+            if r["tiers"]["gold"]["dropped"] != 0:
+                raise RuntimeError(
+                    f"gold drops in the {name} rollout: {r}")
+            if r["min_capacity"] < 4:
+                raise RuntimeError(
+                    f"capacity dipped below N in {name}: {r}")
+        if good["status"].get("outcome") != "promoted":
+            raise RuntimeError(f"good canary not promoted: {good}")
+        if set(good["versions"]) != {2}:
+            raise RuntimeError(
+                f"good rollout left mixed versions: {good}")
+        if bad["status"].get("outcome") != "rolled_back":
+            raise RuntimeError(f"bad canary not rolled back: {bad}")
+        if set(bad["versions"]) != {1}:
+            raise RuntimeError(
+                f"bad rollout left candidate replicas: {bad}")
+        if len(bad["incidents"]) != 1:
+            raise RuntimeError(
+                f"expected exactly one incident: {bad['incidents']}")
+        gate = bad["status"].get("last_gate")
+    finally:
+        shutil.rmtree(tmp_good, ignore_errors=True)
+        shutil.rmtree(tmp_bad, ignore_errors=True)
+    print(f"rollout_soak: good canary promoted fleet-wide in "
+          f"{good['elapsed_s']}s; bad canary caught by gate "
+          f"'{gate}' and rolled back in {bad['elapsed_s']}s "
+          f"(one incident, zero gold drops both runs)",
+          file=sys.stderr)
+    return {
+        "metric": ("canary rollout control loop: bad-candidate "
+                   "(seeded serving.rollout bad_version NaN "
+                   "poison) detect->rollback time, 4 in-process "
+                   "replicas under tiered load"),
+        "value": bad["elapsed_s"], "unit": "seconds",
+        "good_promotion_s": good["elapsed_s"],
+        "bad_gate": gate,
+        "good_gold_outcomes": good["tiers"]["gold"],
+        "bad_gold_outcomes": bad["tiers"]["gold"],
+        "good_holds": good["status"].get("holds"),
+        "incidents": len(bad["incidents"]),
+        "host_cpus": os.cpu_count(),
+        "mfu": None,
+        "note": ("value: start->rolled-back seconds for a "
+                 "candidate whose outputs are NaN-poisoned by the "
+                 "seeded serving.rollout fault — caught by shadow "
+                 "scoring (gate in bad_gate), auto-rolled-back to "
+                 "4/4 incumbent with exactly one incident bundle. "
+                 "good_promotion_s: start->promoted seconds for a "
+                 "behavior-equivalent candidate through the full "
+                 "canary->expanding ladder (comparative windowed "
+                 "SLO gate against the incumbent cohort). Both "
+                 "runs under live gold/standard/best_effort load: "
+                 "ZERO gold drops required, UP capacity never "
+                 "below 4 (boot-successor-first replaces). "
+                 "Like autoscaler_soak, this measures the control "
+                 "loop on loopback HTTP, not device compute")}
+
+
 DECODE_STEPS = 128
 DECODE_CAP = 256
 MASKED_ATTN_SHAPE = (4, 4096, 8, 64)     # B, T, H, D
@@ -3367,6 +3562,9 @@ _LEGS = [
     # CPU-dominated (sleep-based replicas, control-loop timing):
     # cheap, runs last
     ("autoscaler_soak", _leg_autoscaler_soak, 240),
+    # CPU-dominated (in-process replicas, control-loop timing):
+    # good-canary promotion + bad-canary detect->rollback
+    ("rollout_soak", _leg_rollout_soak, 240),
     # CPU-dominated (matmul top-k on tiny corpora, loopback HTTP):
     # the recall-vs-QPS frontier + SIGKILL search soak
     ("retrieval_serving", _leg_retrieval_serving, 300),

@@ -69,6 +69,14 @@ the docs lint checks the README table against these):
 ``ps.server.restart`` one PS push applied (``restart``:
                      crash-restart the server from its newest
                      durable checkpoint; workers reconnect)
+``serving.rollout``  one RolloutController deployment step in
+                     ``serving/rollout.py`` — canary boot and each
+                     expansion replace (``bad_version``: the candidate
+                     serves NaN-poisoned outputs, the gate must catch
+                     it; ``slow_version``: the candidate's predict path
+                     stalls ``args.delay_s`` per call, the latency gate
+                     must catch it; ``stall``: the expansion step hangs
+                     ``args.delay_s`` — operator ``abort`` still works)
 ==================== ====================================================
 
 Generic kinds every site understands via :func:`step_fault`:
@@ -157,6 +165,8 @@ SITES: Dict[str, str] = {
     "ps.server.restart": "one parameter-server push applied "
                          "(crash-restart the PS from its last "
                          "durable checkpoint)",
+    "serving.rollout": "one rollout deployment step (canary boot or "
+                       "expansion replace) by the RolloutController",
 }
 
 # kinds every site understands via step_fault(), plus the
@@ -203,6 +213,15 @@ SITE_KINDS: Dict[str, frozenset] = {
     "ps.push.drop": frozenset({"drop"}),
     "ps.pull.timeout": frozenset({"timeout"}),
     "ps.server.restart": frozenset({"restart"}),
+    # rollout faults are interpreted by RolloutController's deploy
+    # steps (serving/rollout.py): bad_version wraps the candidate's
+    # models so predict returns NaN-poisoned outputs (the comparative
+    # gate's error/shadow checks must catch it and roll back),
+    # slow_version wraps them to stall args.delay_s per call (the
+    # p99 gate must catch it), stall hangs the expansion step itself
+    # for args.delay_s while still honoring operator abort
+    "serving.rollout": frozenset({"bad_version", "slow_version",
+                                  "stall"}),
 }
 
 

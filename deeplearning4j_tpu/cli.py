@@ -595,6 +595,30 @@ def _cmd_serve_fleet(args):
             raise SystemExit(f"bad --net-chaos plan: {e}")
     if not args.model and not args.index:
         raise SystemExit("serve-fleet needs --model and/or --index")
+    if args.rollout:
+        # fail fast like --slo/--autoscale: an unpromotable rollout
+        # (no collector = no gate evidence = holds forever) or an
+        # unreadable candidate spec must exit before replicas boot
+        if args.collector is None:
+            raise SystemExit(
+                "--rollout needs --collector: the promotion gate "
+                "reads the merged replica-labeled series, and "
+                "without them the rollout would hold forever")
+        if not args.model:
+            raise SystemExit(
+                "--rollout replaces --model served in-process; "
+                "an --index-only fleet has no model versions to "
+                "roll")
+        if not 0.0 < args.rollout_canary_weight <= 1.0:
+            raise SystemExit(
+                f"--rollout-canary-weight must be in (0, 1], got "
+                f"{args.rollout_canary_weight:g}")
+        if not 0.0 <= args.rollout_shadow_sample <= 1.0:
+            raise SystemExit(
+                f"--rollout-shadow-sample must be in [0, 1], got "
+                f"{args.rollout_shadow_sample:g}")
+    rollout_specs = [_parse_model_spec(s)
+                     for s in args.rollout or []]
     specs = [_parse_model_spec(s) for s in args.model or []]
 
     def factory(specs=specs):
@@ -696,6 +720,32 @@ def _cmd_serve_fleet(args):
               + (f", {len(slos.status())} SLO(s)" if slos else "")
               + (", merged signals via collector"
                  if collector is not None else ""))
+    rollout = None
+    if args.rollout:
+        from deeplearning4j_tpu.serving.rollout import (
+            RolloutController)
+
+        def candidate_factory(specs=rollout_specs):
+            return {name: restore_model(path)
+                    for name, path in specs}
+
+        rollout = RolloutController(
+            fleet, router,
+            candidate_factory=candidate_factory,
+            candidate_version=args.rollout_version,
+            collector=collector, autoscaler=scaler,
+            canary_weight=args.rollout_canary_weight,
+            shadow_sample=args.rollout_shadow_sample,
+            min_requests=args.rollout_min_requests)
+        router.attach_rollout(rollout)
+        print(f"rollout: candidate staged "
+              f"({', '.join(n for n, _ in rollout_specs)}) — "
+              f"armed, not deploying; trigger with "
+              f"'fleet-rollout start --router "
+              f"http://{args.host}:{router.port}' (canary weight "
+              f"{args.rollout_canary_weight:g}, shadow sample "
+              f"{args.rollout_shadow_sample:g}, min "
+              f"{args.rollout_min_requests} gated requests)")
     print(f"fleet router on http://{args.host}:{router.port}/ over "
           f"{fleet.size()} replica(s) "
           f"(/v1/predict /v1/generate /v1/models /healthz /readyz "
@@ -705,6 +755,12 @@ def _cmd_serve_fleet(args):
             time.sleep(3600)
     except KeyboardInterrupt:
         print("draining fleet...")
+        if rollout is not None:
+            try:
+                rollout.abort("serve-fleet shutdown")
+            except ValueError:
+                pass        # no rollout in flight
+            rollout.join(timeout=30.0)
         if scaler is not None:
             scaler.stop(wait_retires=False)
         if collector is not None:
@@ -741,6 +797,99 @@ def _cmd_fleet_status(args):
             # clear-screen escape keeps the dashboard in place like
             # watch(1) without depending on curses
             print("\x1b[2J\x1b[H" + text, flush=True)
+            time.sleep(max(0.2, args.watch))
+    except KeyboardInterrupt:
+        pass
+
+
+def _render_rollout(st):
+    lines = [
+        f"state    : {st.get('state')}"
+        + (f" ({st.get('outcome')})" if st.get("outcome") else ""),
+        f"versions : v{st.get('incumbent_version')} -> "
+        f"v{st.get('candidate_version')}",
+        f"progress : {st.get('updated')}/{st.get('total')} "
+        f"replica(s) updated (canary rid "
+        f"{st.get('canary_rid')})",
+        f"gate     : verdict={st.get('last_verdict')} "
+        f"holds={st.get('holds')}"
+        + (f" gate={st.get('last_gate')}"
+           if st.get("last_gate") else ""),
+    ]
+    if st.get("last_detail"):
+        lines.append(f"detail   : {st['last_detail']}")
+    if st.get("incident_dir"):
+        lines.append(f"incident : {st['incident_dir']}")
+    return "\n".join(lines)
+
+
+def _cmd_fleet_rollout(args):
+    """Operator verbs over the router's /v1/rollout/* endpoints."""
+    import json as _json
+    import time
+    import urllib.error
+    import urllib.request
+
+    base = args.router.rstrip("/")
+
+    def call(method, path, body=None):
+        data = _json.dumps(body).encode() \
+            if body is not None else None
+        req = urllib.request.Request(
+            base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                return resp.status, _json.loads(
+                    resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, _json.loads(
+                    e.read().decode("utf-8"))
+            except ValueError:
+                return e.code, {"error": str(e)}
+        except OSError as e:
+            raise SystemExit(
+                f"router unreachable at {base}: {e}")
+
+    if args.verb == "start":
+        status, body = call("POST", "/v1/rollout/start", {})
+        if status != 200:
+            raise SystemExit(
+                f"start refused ({status}): "
+                f"{body.get('error', body)}")
+        print(_render_rollout(body))
+        return
+    if args.verb == "abort":
+        status, body = call("POST", "/v1/rollout/abort",
+                            {"reason": args.reason})
+        if status != 200:
+            raise SystemExit(
+                f"abort refused ({status}): "
+                f"{body.get('error', body)}")
+        print(_render_rollout(body))
+        return
+    # status
+    if args.watch is None:
+        status, body = call("GET", "/v1/rollout/status")
+        if status != 200:
+            raise SystemExit(
+                f"no rollout controller ({status}): "
+                f"{body.get('error', body)}")
+        print(_render_rollout(body))
+        return
+    try:
+        while True:
+            status, body = call("GET", "/v1/rollout/status")
+            text = _render_rollout(body) if status == 200 \
+                else f"no rollout controller ({status})"
+            print("\x1b[2J\x1b[H" + text, flush=True)
+            # outcome only lands at a terminal state (promoted /
+            # rolled_back) — stop watching there
+            if status == 200 and body.get("outcome") \
+                    and body.get("state") not in (
+                        "canary", "expanding", "rolling_back"):
+                return
             time.sleep(max(0.2, args.watch))
     except KeyboardInterrupt:
         pass
@@ -1171,6 +1320,37 @@ def main(argv=None):
     f.add_argument("--incident-dir", default=None, metavar="DIR",
                    help="where the collector writes incident-scoped "
                         "fleet bundles (default: cwd)")
+    f.add_argument("--rollout", action="append", default=None,
+                   metavar="[NAME=]PATH",
+                   help="stage a CANDIDATE model zip for an SLO-"
+                        "gated canary rollout (repeatable, same "
+                        "spec format as --model). The controller "
+                        "arms but does NOT deploy: trigger it with "
+                        "'fleet-rollout start'. Requires "
+                        "--collector — promotion needs the merged "
+                        "replica-labeled series as gate evidence")
+    f.add_argument("--rollout-version", type=int, default=None,
+                   metavar="N",
+                   help="candidate model version (default: "
+                        "incumbent + 1)")
+    f.add_argument("--rollout-canary-weight", type=float,
+                   default=0.25, metavar="FRAC",
+                   help="deterministic traffic share hashed to the "
+                        "canary during the gate window (trace-id-"
+                        "sticky: a request's retries and hedges "
+                        "stay on-version)")
+    f.add_argument("--rollout-shadow-sample", type=float,
+                   default=0.5, metavar="FRAC",
+                   help="mirror this fraction of predict traffic "
+                        "to the canary and score its answers "
+                        "against the primary's (never returned to "
+                        "clients); 0 disables shadow scoring")
+    f.add_argument("--rollout-min-requests", type=int, default=50,
+                   metavar="N",
+                   help="minimum candidate-cohort requests inside "
+                        "the gate window before the comparative "
+                        "SLO gate may pass (below it the rollout "
+                        "HOLDS — no wall-clock-only promotion)")
     _add_index_flags(f)
     f.set_defaults(fn=_cmd_serve_fleet)
 
@@ -1186,6 +1366,29 @@ def main(argv=None):
                     help="refresh every S seconds until ctrl-c "
                          "instead of printing once")
     fs.set_defaults(fn=_cmd_fleet_status)
+
+    fr = sub.add_parser(
+        "fleet-rollout",
+        help="drive the canary rollout armed by serve-fleet "
+             "--rollout: start it, watch its gate verdicts, or "
+             "abort into an automatic rollback")
+    fr.add_argument("verb", choices=("start", "status", "abort"),
+                    help="start = begin the canary deployment; "
+                         "status = one-shot (or --watch) state/"
+                         "gate dump; abort = roll every updated "
+                         "replica back to the incumbent")
+    fr.add_argument("--router", default="http://127.0.0.1:8080",
+                    metavar="URL",
+                    help="base URL of the fleet router (the "
+                         "controller answers on /v1/rollout/*)")
+    fr.add_argument("--reason", default="operator abort",
+                    help="abort reason recorded in the incident "
+                         "bundle (abort only)")
+    fr.add_argument("--watch", type=float, default=None, metavar="S",
+                    help="with 'status': refresh every S seconds "
+                         "until ctrl-c or the rollout reaches a "
+                         "terminal state")
+    fr.set_defaults(fn=_cmd_fleet_rollout)
 
     ix = sub.add_parser(
         "index",

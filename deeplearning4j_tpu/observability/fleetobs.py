@@ -934,14 +934,28 @@ class FleetCollector:
         router's is fine — the asymmetric case): log and count it,
         never fabricate a replica-death incident bundle from it.
         Serving is untouched, so the incident would be noise that
-        buries a real page."""
+        buries a real page. Likewise a PLANNED departure — a retire
+        or a rollout's replace drained it out on purpose — is churn,
+        not a death: paging on it would bury the one incident a
+        rolled-back deploy actually writes."""
         if self.fleet is None or not died:
             return died
         fleet_up = {f"replica-{r.id}"
                     for r in self.fleet.snapshot()
                     if getattr(r, "fleet_state", "up") == "up"}
+        try:
+            planned = {f"replica-{rid}"
+                       for rid in self.fleet.departed_rids()}
+        except AttributeError:
+            planned = set()
         confirmed = []
         for name in died:
+            if name in planned:
+                logger.info(
+                    "fleetobs: %s left the pool by plan (retire/"
+                    "replace drain) — churn, not a death; no "
+                    "incident", name)
+                continue
             if name in fleet_up:
                 logger.warning(
                     "fleetobs: %s unreachable on the scrape path "
@@ -1117,6 +1131,116 @@ class FleetCollector:
             out.append(sig)
         return out
 
+    def replica_raw(self, rids: List[int]) -> Dict[int, dict]:
+        """Per-replica raw gate-evidence counters: requests, errors,
+        latency bucket counts (edges + counts), and exemplar trace
+        ids from the slowest buckets, read off the REPLICA-LABELED
+        merged series. Raises when the last successful scrape cycle
+        is stale — same discipline as :meth:`cohort_stats`. The
+        rollout controller snapshots this when it opens its gate
+        window and hands it back as ``cohort_stats(..., since=...)``
+        so the comparison covers only window-era traffic."""
+        with self._lock:
+            last = self._last_cycle_unix
+        if time.time() - last > max(3 * self.interval_s, 5.0):
+            raise RuntimeError("fleet scrape data is stale")
+        want = {f"replica-{int(r)}": int(r) for r in rids}
+        out: Dict[int, dict] = {
+            int(r): {"requests": 0, "errors": 0, "edges": None,
+                     "counts": None, "trace_ids": []}
+            for r in rids}
+        for inst in self.registry.collect():
+            labels = inst.labels or {}
+            rid = want.get(labels.get("replica", ""))
+            if rid is None:
+                continue
+            d = out[rid]
+            if inst.name == "serving_requests_total":
+                d["requests"] += int(inst.value)
+            elif inst.name == "serving_errors_total":
+                d["errors"] += int(inst.value)
+            elif inst.name == "serving_latency_seconds" \
+                    and isinstance(inst, Histogram):
+                edges, counts, _c, _s = inst.bucket_counts()
+                if d["edges"] is None:
+                    d["edges"] = list(edges)
+                    d["counts"] = [int(c) for c in counts]
+                elif d["edges"] == list(edges):
+                    for i, c in enumerate(counts):
+                        d["counts"][i] += int(c)
+                for _i, ex in sorted(
+                        getattr(inst, "_exemplars", {}).items(),
+                        reverse=True):
+                    tid = (ex[0] or {}).get("trace_id") \
+                        if isinstance(ex, tuple) else None
+                    if tid:
+                        d["trace_ids"].append(tid)
+        return out
+
+    def cohort_stats(self, cohorts: Dict[str, List[int]],
+                     since: Optional[Dict[int, dict]] = None
+                     ) -> Dict[str, dict]:
+        """Comparative-gate evidence: per cohort (name → replica
+        ids), requests/errors summed and latency bucket-merged over
+        the members' REPLICA-LABELED serving series, plus up to 8
+        exemplar trace ids from the slowest merged buckets. Raises
+        when the last successful scrape cycle is stale — the rollout
+        controller must HOLD on a dead/stale collector (the
+        autoscaler's sensors_ok discipline): promotion needs fresh
+        affirmative evidence, and rollback needs fresh affirmative
+        evidence too.
+
+        ``since`` (a prior :meth:`replica_raw` snapshot) windows the
+        evidence: each member's counters are diffed against its
+        snapshot entry before aggregation, so a canary's cold-start
+        calls and the incumbents' pre-rollout history drop out and
+        both cohorts are compared over the SAME traffic window.
+        Members absent from the snapshot (booted after it) count
+        from zero, which for rollout cohorts is exactly their
+        window-era total."""
+        all_rids = sorted({int(r) for rids in cohorts.values()
+                           for r in rids})
+        raws = self.replica_raw(all_rids)
+        out: Dict[str, dict] = {}
+        for name, rids in cohorts.items():
+            d = {"requests": 0, "errors": 0, "p99_ms": 0.0,
+                 "replicas": sorted(int(r) for r in rids),
+                 "trace_ids": []}
+            edges: Optional[List[float]] = None
+            counts: Optional[List[int]] = None
+            tids: List[str] = []
+            for rid in d["replicas"]:
+                raw = raws.get(rid)
+                if raw is None:
+                    continue
+                req, err = raw["requests"], raw["errors"]
+                r_counts = raw["counts"]
+                prev = (since or {}).get(rid)
+                if prev is not None:
+                    req = max(0, req - int(prev.get("requests", 0)))
+                    err = max(0, err - int(prev.get("errors", 0)))
+                    if r_counts is not None \
+                            and prev.get("edges") == raw["edges"]:
+                        r_counts = [
+                            max(0, a - int(b)) for a, b in
+                            zip(r_counts, prev.get("counts") or [])]
+                d["requests"] += req
+                d["errors"] += err
+                if r_counts is not None:
+                    if edges is None:
+                        edges = raw["edges"]
+                        counts = list(r_counts)
+                    elif edges == raw["edges"]:
+                        for i, c in enumerate(r_counts):
+                            counts[i] += c
+                tids.extend(raw["trace_ids"])
+            if edges is not None and counts is not None:
+                d["p99_ms"] = round(
+                    _hist_quantile(edges, counts, .99) * 1e3, 3)
+            d["trace_ids"] = tids[:8]
+            out[name] = d
+        return out
+
     def fleet_snapshot(self) -> dict:
         """The JSON dashboard payload ``fleet-status`` renders."""
         with self._lock:
@@ -1183,6 +1307,21 @@ class FleetCollector:
             snap["alerts"] = self.alerts.firing()
         except Exception:
             pass
+        # per-replica model version + rollout state, read off the
+        # in-process router's debug surface: an operator watching
+        # fleet-status sees the canary (and which gate it is
+        # waiting on) at a glance
+        if self.router is not None:
+            try:
+                fd = self.router.fleet_debug()
+            except Exception:
+                fd = None
+            if fd is not None:
+                snap["versions"] = {
+                    str(r["id"]): r.get("model_version", 1)
+                    for r in fd.get("replicas", [])}
+                if fd.get("rollout") is not None:
+                    snap["rollout"] = fd["rollout"]
         return snap
 
     def _append_ring_sample(self, targets, errors) -> None:
@@ -1361,15 +1500,29 @@ def render_status(snap: dict) -> str:
         state = "BREACH" if s.get("breached") else "ok"
         lines.append(f"slo {s.get('name')}: {state}  {burn}")
     reps = snap.get("replicas")
+    versions = snap.get("versions") or {}
     if reps:
         for r in reps:
             kvt = r.get("kv_pages_total") or 0
             kv = (100.0 * r.get("kv_pages_in_use", 0) / kvt) \
                 if kvt else 0.0
-            lines.append(f"replica {r.get('rid')}: "
+            ver = versions.get(str(r.get("rid")))
+            vcol = f" v{ver}" if ver is not None else ""
+            lines.append(f"replica {r.get('rid')}:{vcol} "
                          f"queue={r.get('queue_depth', 0):.0f} "
                          f"inflight={r.get('inflight', 0):.0f} "
                          f"kv={kv:.0f}%")
+    ro = snap.get("rollout")
+    if ro:
+        gate = ro.get("last_gate")
+        lines.append(
+            f"rollout : {ro.get('state', '?')} "
+            f"v{ro.get('incumbent_version', '?')}"
+            f"->v{ro.get('candidate_version', '?')} "
+            f"updated {ro.get('updated', 0)}/{ro.get('total', 0)}"
+            + (f"  gate={gate}" if gate else "")
+            + (f"  holds={ro.get('holds')}" if ro.get("holds")
+               else ""))
     tr = snap.get("traces") or {}
     if tr:
         recent = ", ".join(t["trace_id"][:12]

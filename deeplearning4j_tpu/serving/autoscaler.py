@@ -135,6 +135,11 @@ class Autoscaler:
         self._retire_threads: List[threading.Thread] = []
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # hold tokens: while any are present the control loop holds
+        # the pool steady (no actuation, no hysteresis accrual) — a
+        # RolloutController pauses scaling so grow/retire can't
+        # fight its one-replica-at-a-time replace ladder
+        self._paused: set = set()
         if registry is None:
             registry = getattr(router, "registry", None)
         if registry is None:
@@ -242,6 +247,17 @@ class Autoscaler:
         Returns ``"up"`` / ``"down"`` when the fleet was actuated,
         None otherwise."""
         self._ticks.inc()
+        with self._lock:
+            paused = bool(self._paused)
+        if paused:
+            # an active rollout owns the pool: scaling mid-rollout
+            # would race the controller's capacity-neutral replace
+            # ladder (a scale-down could drain the canary; a
+            # scale-up would boot off-plan incumbents mid-
+            # expansion). Held exactly like a failed sensor read —
+            # hysteresis counters included.
+            self._pressure_g.set(0.0)
+            return None
         now = self.clock()
         s = self.signals()
         if not s["sensors_ok"]:
@@ -447,13 +463,32 @@ class Autoscaler:
             for rt in retires:
                 rt.join(timeout=self.drain_timeout_s + 5.0)
 
+    # ------------------------------------------------------------------
+    # external coordination
+    # ------------------------------------------------------------------
+    def pause(self, token: str = "rollout") -> None:
+        """Hold all scaling while ``token`` is outstanding (tokens
+        are a set: two concurrent holders each resume their own)."""
+        with self._lock:
+            self._paused.add(str(token))
+
+    def resume(self, token: str = "rollout") -> None:
+        with self._lock:
+            self._paused.discard(str(token))
+
+    @property
+    def paused(self) -> bool:
+        with self._lock:
+            return bool(self._paused)
+
     def debug(self) -> dict:
         """The operator's one-look payload (also what the soak
         asserts on)."""
         with self._lock:
             state = {"up_ticks": self._up_ticks,
                      "down_ticks": self._down_ticks,
-                     "boot_failures": self._boot_failures}
+                     "boot_failures": self._boot_failures,
+                     "paused_by": sorted(self._paused)}
         s = self.signals()
         return {"signals": s,
                 "bounds": [self.min_replicas, self.max_replicas],

@@ -46,13 +46,14 @@ Fleet operations:
 
 from __future__ import annotations
 
+import collections
 import logging
 import signal
 import subprocess
 import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -118,6 +119,10 @@ class _BaseReplica:
         # disaggregation role (prefill/decode/mixed) — routing
         # intent, also the fleet's to declare
         self.role = MIXED
+        # which model version this replica serves — the fleet stamps
+        # it at boot (rollouts boot candidate-version successors; the
+        # router labels per-version metrics off it)
+        self.model_version = 1
         # when the fleet boots this replica behind a NetChaosProxy,
         # ``port`` is the PROXY's port (everything the router does
         # crosses the chaotic hop) and ``upstream_port`` the real one
@@ -171,10 +176,12 @@ class InProcessReplica(_BaseReplica):
     """
 
     def __init__(self, rid: int, model_factory: Callable[[], Dict],
-                 server_kwargs: Optional[dict] = None):
+                 server_kwargs: Optional[dict] = None,
+                 model_version: int = 1):
         super().__init__(rid)
         self._model_factory = model_factory
         self._server_kwargs = dict(server_kwargs or {})
+        self.model_version = int(model_version)
         self.server = None
 
     def start(self) -> "InProcessReplica":
@@ -182,7 +189,8 @@ class InProcessReplica(_BaseReplica):
         from deeplearning4j_tpu.serving.registry import ModelRegistry
         models = ModelRegistry()
         for name, model in self._model_factory().items():
-            models.register(name, model)
+            models.register(name, model,
+                            version=self.model_version)
         kw = dict(self._server_kwargs)
         kw.pop("registry", None)
         kw.setdefault("port", 0)
@@ -323,7 +331,8 @@ class ReplicaFleet:
                  base_port: int = 0, roles=None,
                  extra_args: Optional[List[str]] = None,
                  net_chaos=None,
-                 net_chaos_seed: Optional[int] = None):
+                 net_chaos_seed: Optional[int] = None,
+                 model_version: int = 1):
         if model_factory is None and not model_specs \
                 and not extra_args:
             raise ValueError("fleet needs a model_factory (in-process"
@@ -371,6 +380,19 @@ class ReplicaFleet:
         self._next_id = 0
         self._timers: List[threading.Timer] = []
         self._subscribers: List[Callable[[], None]] = []
+        # versioned deployment state: the INCUMBENT factory/version
+        # serve by default; a staged CANDIDATE (set_candidate) is
+        # what rollout-driven boots with version=candidate use.
+        # Promotion flips the incumbent; clear_candidate unstages.
+        self._incumbent_version = int(model_version)
+        self._candidate_factory: Optional[Callable[[], Dict]] = None
+        self._candidate_version: Optional[int] = None
+        # planned departures: rids drained out on purpose (retire /
+        # replace). The collector consults this so a rollout's or
+        # scale-down's drain never reads as a replica DEATH and
+        # fabricates an incident bundle. Bounded: only the most
+        # recent departures matter (a scrape cycle or two).
+        self._departed: Deque[int] = collections.deque(maxlen=64)
 
     def subscribe(self, fn: Callable[[], None]) -> None:
         """Register a pool-mutation hook (the router uses it to
@@ -388,15 +410,103 @@ class ReplicaFleet:
             except Exception:
                 logger.exception("fleet change subscriber failed")
 
+    # ---- versioned deployment (the rollout controller's verbs) ----
+    @property
+    def incumbent_version(self) -> int:
+        with self._lock:
+            return self._incumbent_version
+
+    @property
+    def candidate_version(self) -> Optional[int]:
+        with self._lock:
+            return self._candidate_version
+
+    def set_candidate(self, factory: Callable[[], Dict],
+                      version: Optional[int] = None) -> int:
+        """Stage a candidate model factory for versioned boots.
+        Returns the candidate version (default: incumbent + 1).
+        Staging is inert — only boots that ASK for the candidate
+        version get it; everything else keeps booting the
+        incumbent."""
+        if self._model_factory is None:
+            raise ValueError(
+                "versioned rollouts need in-process replicas (a "
+                "model_factory fleet) — subprocess replicas boot "
+                "from fixed model_specs")
+        with self._lock:
+            if version is None:
+                version = self._incumbent_version + 1
+            version = int(version)
+            if version == self._incumbent_version:
+                raise ValueError(
+                    f"candidate version {version} IS the incumbent "
+                    f"— a rollout that deploys the same version "
+                    f"would be indistinguishable from a no-op")
+            self._candidate_factory = factory
+            self._candidate_version = version
+        return version
+
+    def clear_candidate(self) -> None:
+        with self._lock:
+            self._candidate_factory = None
+            self._candidate_version = None
+
+    def promote_candidate(self) -> int:
+        """Flip the staged candidate to incumbent (the rollout
+        controller calls this once every replica runs it): future
+        default boots — grow, replace, autoscaler churn — serve the
+        new version."""
+        with self._lock:
+            if self._candidate_factory is None \
+                    or self._candidate_version is None:
+                raise ValueError("no candidate staged to promote")
+            self._model_factory = self._candidate_factory
+            self._incumbent_version = self._candidate_version
+            self._candidate_factory = None
+            self._candidate_version = None
+            return self._incumbent_version
+
+    def versions(self) -> Dict[int, int]:
+        """{replica id: model version} for the live pool."""
+        with self._lock:
+            return {r.id: getattr(r, "model_version", 1)
+                    for r in self._replicas}
+
+    def departed_rids(self) -> List[int]:
+        """Recent PLANNED departures (retire / replace drains).
+        A rid in here left the pool on purpose — its disappearance
+        is churn, not a death."""
+        with self._lock:
+            return list(self._departed)
+
     # ---- construction ----
-    def _new_replica(self, role: Optional[str] = None
+    def _new_replica(self, role: Optional[str] = None,
+                     version: Optional[int] = None
                      ) -> _BaseReplica:
         with self._lock:
             rid = self._next_id
             self._next_id += 1
-        if self._model_factory is not None:
-            r = InProcessReplica(rid, self._model_factory,
-                                 self._server_kwargs)
+            # resolve which factory/version this boot serves: an
+            # explicit candidate-version ask gets the staged
+            # candidate; everything else (None or incumbent) boots
+            # the incumbent — an unstaged candidate version is a
+            # caller bug, not a silent incumbent boot
+            factory = self._model_factory
+            boot_version = self._incumbent_version
+            if version is not None \
+                    and int(version) != self._incumbent_version:
+                if int(version) != self._candidate_version \
+                        or self._candidate_factory is None:
+                    raise ValueError(
+                        f"no staged candidate for version "
+                        f"{version} (candidate is "
+                        f"{self._candidate_version})")
+                factory = self._candidate_factory
+                boot_version = int(version)
+        if factory is not None:
+            r = InProcessReplica(rid, factory,
+                                 self._server_kwargs,
+                                 model_version=boot_version)
         else:
             r = SubprocessReplica(rid, self._model_specs,
                                   self._base_port + rid,
@@ -407,7 +517,8 @@ class ReplicaFleet:
             r.role = self._roles[rid]
         return r
 
-    def _boot_replica(self, role: Optional[str] = None
+    def _boot_replica(self, role: Optional[str] = None,
+                      version: Optional[int] = None
                       ) -> _BaseReplica:
         """Boot ONE new replica through the ``serving.replica.boot``
         chaos site: ``boot_fail`` raises a typed
@@ -427,7 +538,7 @@ class ReplicaFleet:
                     f"#{fault.ordinal}")
             if fault.kind == "boot_slow":
                 time.sleep(float(fault.args.get("delay_s", 0.25)))
-        r = self._new_replica(role)
+        r = self._new_replica(role, version=version)
         try:
             return self._wrap_net(r.start())
         except Exception as e:
@@ -452,7 +563,9 @@ class ReplicaFleet:
         return r
 
     def _boot_retrying(self, max_boot_retries: int = 3,
-                       role: Optional[str] = None) -> _BaseReplica:
+                       role: Optional[str] = None,
+                       version: Optional[int] = None
+                       ) -> _BaseReplica:
         """Boot with bounded exponential backoff between failed
         attempts — a flaky boot path must not wedge the autoscaler's
         control loop, and a persistently failing one must fail TYPED
@@ -461,7 +574,7 @@ class ReplicaFleet:
         attempt = 0
         while True:
             try:
-                return self._boot_replica(role)
+                return self._boot_replica(role, version=version)
             except ReplicaBootError as e:
                 if attempt >= max_boot_retries:
                     raise
@@ -576,14 +689,16 @@ class ReplicaFleet:
 
     # ---- elasticity (the autoscaler's verbs) ----
     def grow(self, max_boot_retries: int = 3,
-             role: Optional[str] = None) -> _BaseReplica:
+             role: Optional[str] = None,
+             version: Optional[int] = None) -> _BaseReplica:
         """Boot-first scale-up: a fresh replica joins the pool only
         once its listener is actually up — booting capacity is never
         counted as serving capacity. Failed boots retry under
         bounded exponential backoff (``replica_boot_retries_total``);
         a spent retry budget raises :class:`~.errors.ReplicaBootError`
         for the caller to log and re-attempt next tick."""
-        successor = self._boot_retrying(max_boot_retries, role=role)
+        successor = self._boot_retrying(max_boot_retries, role=role,
+                                        version=version)
         with self._lock:
             self._replicas.append(successor)
         logger.info("fleet: grew to %d replicas (replica %d up)",
@@ -607,6 +722,7 @@ class ReplicaFleet:
                                "in the pool; ignored", rid)
                 return False
             target.fleet_state = DRAINING
+            self._departed.append(target.id)
         self._notify()
         logger.info("fleet: retiring replica %d (drain-based "
                     "scale-down)", rid)
@@ -653,8 +769,8 @@ class ReplicaFleet:
                        if r.fleet_state != UP)
 
     # ---- rotation ----
-    def replace(self, pos: int, drain_timeout: float = 30.0
-                ) -> _BaseReplica:
+    def replace(self, pos: int, drain_timeout: float = 30.0,
+                version: Optional[int] = None) -> _BaseReplica:
         """Zero-downtime replace: boot the successor FIRST, then
         drain the incumbent out of the pool. Returns the successor.
 
@@ -674,8 +790,11 @@ class ReplicaFleet:
                 if self._replicas else None)
         # the successor inherits the incumbent's disaggregation role
         # — a replace must not silently turn the fleet's only
-        # prefill replica into a mixed one
-        successor = self._boot_replica(role=incumbent_role)
+        # prefill replica into a mixed one. ``version`` lets the
+        # rollout controller replace toward the candidate (or back
+        # toward the incumbent on rollback)
+        successor = self._boot_replica(role=incumbent_role,
+                                       version=version)
         with self._lock:
             if not self._replicas:
                 # the pool was emptied (seeded kills can outpace a
@@ -688,6 +807,7 @@ class ReplicaFleet:
                 old = self._replicas[pos % len(self._replicas)]
                 self._replicas.append(successor)
                 old.fleet_state = DRAINING
+                self._departed.append(old.id)
         self._notify()     # the router can admit the successor NOW
         if old is None:
             logger.warning("fleet: replace on an empty pool — "

@@ -1162,6 +1162,14 @@ class ModelServer:
             # fleet's convergence checks (did the upsert land on
             # every replica) read them here, not via a scrape
             payload["index"] = self.retrieval.describe()
+        # version provenance: which model versions this replica
+        # actually serves, straight from the registry — a rollout
+        # operator (or the fleet prober) reads the canary's version
+        # off /healthz instead of trusting deployment intent
+        try:
+            payload["models"] = self.registry.models()
+        except Exception:
+            logger.exception("model provenance listing failed")
         return payload
 
     def _unready_retry_after_s(self, payload: dict) -> float:
@@ -1221,6 +1229,16 @@ class ModelServer:
                 self._tp_models.pop(k, None)
         for b in backends:
             ok = b.shutdown(drain=drain, timeout=timeout) and ok
+            # drop the evicted version's metric labels with its
+            # backend: hot-swapping versions on a long-running
+            # server must not accrete dead
+            # ``serving_*{endpoint=predict/name/vN}`` series forever
+            # (the _sync_views leak class, for versions)
+            try:
+                self.metrics.evict_endpoint(b.name)
+            except Exception:
+                logger.exception("metrics eviction for %s failed",
+                                 b.name)
         return ok
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> bool:

@@ -383,6 +383,26 @@ class ServingMetrics:
         self.registry.unregister("serving_gauge",
                                  labels={"name": name})
 
+    def evict_endpoint(self, name: str) -> int:
+        """Unregister every instrument labeled with this endpoint
+        (``serving_requests_total{endpoint=...}``, latency and phase
+        histograms, batch occupancy, streaming TTFT/ITL). A
+        long-running server that hot-swaps model versions would
+        otherwise accrete one dead label set per retired version —
+        the same leak class as the router's per-replica gauges.
+        Returns the number of series dropped."""
+        with self._lock:
+            self._endpoints.pop(name, None)
+            self._occupancy.pop(name, None)
+            for key in [k for k in self._streaming if k[0] == name]:
+                self._streaming.pop(key, None)
+        dropped = 0
+        for m in self.registry.collect():
+            if m.labels and m.labels.get("endpoint") == name:
+                self.registry.unregister(m.name, labels=m.labels)
+                dropped += 1
+        return dropped
+
     def snapshot(self) -> dict:
         with self._lock:
             endpoints = dict(self._endpoints)

@@ -50,14 +50,17 @@ failed-over request keeps its identity across every attempt.
 
 from __future__ import annotations
 
+import collections
 import http.client
 import itertools
 import json
 import logging
+import math
 import queue
 import socket
 import threading
 import time
+import zlib
 from http.server import ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse, urlsplit
@@ -112,12 +115,17 @@ class _ReplicaView:
                  "unavailable_until", "probe_ok_total", "ejections",
                  "readmissions", "kv_pages_in_use", "kv_pages_total",
                  "role", "prefix_fps", "prefix_page_size",
-                 "prefix_hits", "prefix_evictions", "index_info")
+                 "prefix_hits", "prefix_evictions", "index_info",
+                 "version")
 
     def __init__(self, rid: int, url: str, breaker: CircuitBreaker):
         self.rid = rid
         self.url = url
         self.breaker = breaker
+        # the model version the replica serves (stamped by the fleet
+        # at boot, refreshed with the snapshot): the per-version
+        # metric label rollouts compare cohorts by
+        self.version = 1
         # paged-KV decode pressure (summed over the replica's
         # generate backends), refreshed by the same /metrics probe
         # as queue_depth — the /fleet debug surface for "which
@@ -290,6 +298,37 @@ class Router:
                      "eviction or refusal), by priority tier",
                 labels={"endpoint": "router", "tier": t})
             for t in tiers.TIERS}
+        # rollout surface: deterministic weighted traffic split
+        # ({rid: fraction}, trace-id-hashed so a request's retries
+        # and hedges stay on-version), optional shadow mirroring of
+        # a sampled predict slice to one replica, and per-version
+        # metric families (created at view-reconcile time below)
+        self._weights: Dict[int, float] = {}
+        self._shadow: Optional[Tuple[int, float]] = None
+        self._shadow_stats: dict = {
+            "compared": 0, "mismatches": 0, "errors": 0, "nan": 0,
+            "exemplars": []}
+        self._version_metrics: Dict[str, tuple] = {}
+        self._version_err_traces: Dict[str, "collections.deque"] = {}
+        self._shadow_requests = self.registry.counter(
+            "router_shadow_requests_total",
+            help="predict requests mirrored to the shadow replica "
+                 "(responses never returned to clients)")
+        self._shadow_mismatch = self.registry.counter(
+            "router_shadow_mismatch_total",
+            help="shadow responses that disagreed with the primary "
+                 "(value divergence, non-finite outputs, or status "
+                 "class)")
+        self._shadow_errors = self.registry.counter(
+            "router_shadow_errors_total",
+            help="shadow attempts that failed outright (net error "
+                 "or unparseable body)")
+        self._shadow_latency = self.registry.histogram(
+            "router_shadow_latency_seconds",
+            help="shadow-attempt latency (seconds)")
+        # an attached RolloutController (attach_rollout): the
+        # /v1/rollout/* verbs and /fleet's rollout block read it
+        self.rollout = None
         self._sync_views()
         # pool-mutation hook: a replace()'s successor becomes
         # routable the moment it answers a probe, not a probe
@@ -328,6 +367,8 @@ class Router:
                 failure_threshold=self.eject_consecutive,
                 window_s=max(4 * self.eject_cooldown_s, 30.0),
                 cooldown_s=self.eject_cooldown_s, half_open_max=1))
+            view.version = int(getattr(replica, "model_version", 1)
+                               or 1)
             lbl = {"replica": str(rid)}
             _g1 = self.registry.gauge(
                 "router_replica_state",
@@ -358,6 +399,34 @@ class Router:
                          "router_ejections_total",
                          "router_readmissions_total"):
                 self.registry.unregister(name, labels=lbl)
+        # per-version request/error/latency families, created at
+        # reconcile time like the per-replica gauges (GL006). Unlike
+        # those, they are NOT unregistered when the version leaves
+        # the pool: version cardinality is bounded by deployments
+        # (rare, operator-driven — not per-replica churn), and the
+        # rollout bench / loadgen read the retired incumbent's
+        # series AFTER promotion — dropping them would erase the
+        # baseline half of every per-version report
+        for vstr in sorted({str(getattr(r, "model_version", 1) or 1)
+                            for r in pool.values()}):
+            with self._lock:
+                if vstr in self._version_metrics:
+                    continue
+            lbl = {"version": vstr}
+            req = self.registry.counter(
+                "router_version_requests_total",
+                help="predict-family attempts forwarded, by the "
+                     "serving replica's model version", labels=lbl)
+            err = self.registry.counter(
+                "router_version_errors_total",
+                help="failed predict-family attempts (net error or "
+                     "5xx), by model version", labels=lbl)
+            hist = self.registry.histogram(
+                "router_version_latency_seconds",
+                help="per-attempt latency by model version "
+                     "(seconds)", labels=lbl)
+            with self._lock:
+                self._version_metrics[vstr] = (req, err, hist)
 
     def _fleet_states_memo(self, max_age_s: float = 0.05
                            ) -> Dict[int, str]:
@@ -629,6 +698,7 @@ class Router:
                 continue              # honoring its Retry-After
             v.url = r.url
             v.role = getattr(r, "role", MIXED)
+            v.version = int(getattr(r, "model_version", 1) or 1)
             out.append(v)
         if role is not None:
             filtered = [v for v in out if v.role in (role, MIXED)]
@@ -654,19 +724,56 @@ class Router:
                 return n_tokens
         return 0
 
+    def _weighted_subset(self, candidates: List[_ReplicaView],
+                         trace_id: Optional[str]
+                         ) -> List[_ReplicaView]:
+        """Deterministic canary split: hash the trace id into [0,1)
+        and route the request to a weighted replica when it lands
+        under that replica's fraction, otherwise keep it OFF every
+        weighted replica. Trace-id hashing (not coin flips) means a
+        request's retries and hedges stay on the same version — a
+        failover must not silently hop a gold request between model
+        versions mid-request. When excluding the weighted replicas
+        would leave nobody, the full candidate set is returned:
+        availability beats version purity."""
+        with self._lock:
+            weights = dict(self._weights)
+        if not weights:
+            return candidates
+        by_rid = {v.rid: v for v in candidates}
+        if trace_id is not None:
+            u = zlib.crc32(trace_id.encode("utf-8", "replace")) \
+                / 2.0 ** 32
+            cum = 0.0
+            for rid in sorted(weights):
+                if rid not in by_rid:
+                    continue
+                cum += weights[rid]
+                if u < cum:
+                    return [by_rid[rid]]
+        # off-split traffic (and internal picks with no trace id)
+        # avoids the weighted replicas, so the canary's measured
+        # share stays at its configured fraction
+        rest = [v for v in candidates if v.rid not in weights]
+        return rest if rest else candidates
+
     def _pick(self, exclude=(), role: Optional[str] = None,
-              prompt=None) -> _ReplicaView:
+              prompt=None,
+              trace_id: Optional[str] = None) -> _ReplicaView:
         """Least-loaded eligible replica: probed queue depth +
         router-side in-flight, degraded and open-circuit penalties;
         round-robin tie-break. With a ``prompt`` (KV-aware generate
         routing), replicas advertising a cached prefix of it outrank
-        the rest — the longest hit wins, load breaks ties."""
+        the rest — the longest hit wins, load breaks ties. With
+        rollout weights set, the trace id deterministically decides
+        which side of the canary split the request lands on."""
         candidates = self._eligible(exclude, role=role)
         if not candidates:
             raise NoReplicaAvailableError(
                 "no replica is eligible (all dead, ejected, "
                 "draining, or backing off)",
                 retry_after_s=self._soonest_retry_s())
+        candidates = self._weighted_subset(candidates, trace_id)
         hit_tokens = 0
         if prompt is not None and self.kv_routing:
             fp_cache: Dict[int, list] = {}
@@ -710,6 +817,232 @@ class Router:
         return min(positive) if positive else 1.0
 
     # ------------------------------------------------------------------
+    # rollout surface: weighted split, shadow mirroring,
+    # per-version accounting
+    # ------------------------------------------------------------------
+    def set_weight(self, rid: int, frac: float) -> None:
+        """Send ``frac`` of hashable traffic (deterministically, by
+        trace id) to replica ``rid``; the rest avoids it."""
+        frac = float(frac)
+        if not 0.0 <= frac <= 1.0:
+            raise ValueError(f"weight must be in [0, 1], got {frac}")
+        with self._lock:
+            self._weights[int(rid)] = frac
+
+    def clear_weight(self, rid: Optional[int] = None) -> None:
+        with self._lock:
+            if rid is None:
+                self._weights.clear()
+            else:
+                self._weights.pop(int(rid), None)
+
+    def set_shadow(self, rid: int, sample: float = 1.0) -> None:
+        """Mirror a trace-id-sampled slice of /v1/predict traffic to
+        replica ``rid`` and score its answers against the primary's.
+        Shadow responses are NEVER returned to clients; stats reset
+        on every (re)arm so one rollout's scoring can't inherit the
+        last one's mismatches."""
+        sample = float(sample)
+        if not 0.0 <= sample <= 1.0:
+            raise ValueError(
+                f"shadow sample must be in [0, 1], got {sample}")
+        with self._lock:
+            self._shadow = (int(rid), sample)
+            self._shadow_stats = {
+                "compared": 0, "mismatches": 0, "errors": 0,
+                "nan": 0, "exemplars": []}
+
+    def clear_shadow(self) -> None:
+        with self._lock:
+            self._shadow = None
+
+    def shadow_stats(self) -> dict:
+        with self._lock:
+            st = dict(self._shadow_stats)
+            st["exemplars"] = list(st["exemplars"])
+        return st
+
+    def attach_rollout(self, controller) -> None:
+        """Attach (or with ``None`` detach) a RolloutController: the
+        /v1/rollout/* verbs and /fleet's rollout block read it."""
+        self.rollout = controller
+
+    def version_stats(self) -> Dict[str, dict]:
+        """Per-model-version request/error/p99 as this router
+        forwarded them, plus up to 8 offending (failed) trace ids
+        per version — the incident bundle's exemplars."""
+        with self._lock:
+            fams = dict(self._version_metrics)
+            err_traces = {v: list(dq) for v, dq
+                          in self._version_err_traces.items()}
+        out = {}
+        for vstr, (req, err, hist) in sorted(fams.items()):
+            out[vstr] = {
+                "requests": int(req.value),
+                "errors": int(err.value),
+                "p99_ms": round(hist.quantile(0.99) * 1e3, 3),
+                "error_trace_ids": err_traces.get(vstr, [])}
+        return out
+
+    def _record_version(self, view: _ReplicaView,
+                        status: Optional[int], dur_s: float,
+                        trace_id: Optional[str] = None) -> None:
+        """Account one forwarding attempt against the serving
+        replica's model version (net errors and 5xx count as that
+        version failing the request)."""
+        vstr = str(getattr(view, "version", 1) or 1)
+        with self._lock:
+            fam = self._version_metrics.get(vstr)
+        if fam is None:
+            return
+        req, err, hist = fam
+        req.inc()
+        if status is None or status >= 500:
+            err.inc()
+            if trace_id:
+                with self._lock:
+                    dq = self._version_err_traces.get(vstr)
+                    if dq is None:
+                        dq = collections.deque(maxlen=8)
+                        self._version_err_traces[vstr] = dq
+                    dq.append(trace_id)
+        hist.record(dur_s,
+                    exemplar={"trace_id": trace_id}
+                    if trace_id else None)
+
+    def _maybe_shadow(self, route: str, body_bytes: bytes,
+                      fwd_headers: Dict[str, str],
+                      trace_id: Optional[str],
+                      primary_rid: Optional[int]
+                      ) -> "Optional[queue.Queue]":
+        """Fire a shadow mirror of this predict when armed and the
+        trace id samples in. Returns the queue the caller must feed
+        the PRIMARY's definitive (status, body) into — the shadow
+        thread scores against it — or None when no mirror fired."""
+        if route != "/v1/predict" or trace_id is None:
+            return None
+        with self._lock:
+            shadow = self._shadow
+        if shadow is None:
+            return None
+        rid, sample = shadow
+        if rid == primary_rid:
+            # the split already routed the request to the shadow
+            # replica itself: mirroring it there compares the canary
+            # with the canary
+            return None
+        # a different hash stream than the split's (salted), so the
+        # mirrored slice samples BOTH sides of the weighted split
+        u = zlib.crc32(f"{trace_id}#shadow".encode()) / 2.0 ** 32
+        if u >= sample:
+            return None
+        with self._lock:
+            view = self._views.get(rid)
+            if view is None:
+                return None
+            view.inflight += 1
+        primary_q: "queue.Queue" = queue.Queue(maxsize=1)
+        threading.Thread(
+            target=self._shadow_attempt,
+            args=(view, route, body_bytes, dict(fwd_headers),
+                  primary_q, trace_id),
+            daemon=True, name=f"router-shadow-{rid}").start()
+        return primary_q
+
+    def _shadow_attempt(self, view: _ReplicaView, route: str,
+                        body_bytes: bytes, headers: Dict[str, str],
+                        primary_q: "queue.Queue",
+                        trace_id: str) -> None:
+        self._shadow_requests.inc()
+        t0 = time.monotonic()
+        status: Optional[int] = None
+        data = b""
+        neterr: Optional[_NetError] = None
+        try:
+            status, data, _ = self._forward(
+                view, "POST", route, body_bytes, headers,
+                self.attempt_timeout_s)
+        except _NetError as e:
+            # a shadow failure is SCORED, never acted on: it must
+            # not eject the canary or touch primary routing health
+            neterr = e
+        finally:
+            self._release(view)
+        self._shadow_latency.record(
+            time.monotonic() - t0,
+            exemplar={"trace_id": trace_id})
+        try:
+            p_status, p_data = primary_q.get(
+                timeout=max(2.0, self.attempt_timeout_s))
+        except queue.Empty:
+            return    # primary never answered; nothing to compare
+        self._score_shadow(p_status, p_data, status, data, neterr,
+                           trace_id)
+
+    @staticmethod
+    def _flatten_outputs(x, out: List[float]) -> None:
+        if isinstance(x, (list, tuple)):
+            for e in x:
+                Router._flatten_outputs(e, out)
+        elif isinstance(x, (int, float)):
+            out.append(float(x))
+
+    def _score_shadow(self, p_status: Optional[int], p_data: bytes,
+                      s_status: Optional[int], s_data: bytes,
+                      s_err: Optional[_NetError],
+                      trace_id: str) -> None:
+        verdict = "ok"
+        if s_err is not None or s_status is None:
+            verdict = "error"
+        elif p_status is None:
+            return        # the primary failed; the shadow is moot
+        elif (200 <= p_status < 300) != (200 <= s_status < 300):
+            verdict = "mismatch"
+        elif 200 <= p_status < 300:
+            p_out: List[float] = []
+            s_out: List[float] = []
+            try:
+                self._flatten_outputs(
+                    json.loads(p_data.decode() or "{}")
+                    .get("outputs"), p_out)
+                self._flatten_outputs(
+                    json.loads(s_data.decode() or "{}")
+                    .get("outputs"), s_out)
+            except ValueError:
+                verdict = "error"
+            else:
+                if any(not math.isfinite(v) for v in s_out) \
+                        or any(not math.isfinite(v) for v in p_out):
+                    # NaN/inf anywhere is a poisoned version, and a
+                    # NaN would sail through the numeric compare
+                    # below (every NaN comparison is False)
+                    verdict = "nan"
+                elif len(p_out) != len(s_out):
+                    verdict = "mismatch"
+                elif any(abs(a - b) > 1e-3 * max(1.0, abs(a))
+                         for a, b in zip(p_out, s_out)):
+                    verdict = "mismatch"
+        if verdict == "ok":
+            with self._lock:
+                self._shadow_stats["compared"] += 1
+            return
+        with self._lock:
+            st = self._shadow_stats
+            st["compared"] += 1
+            if verdict == "error":
+                st["errors"] += 1
+            else:
+                st["mismatches"] += 1
+                if verdict == "nan":
+                    st["nan"] += 1
+                if len(st["exemplars"]) < 8:
+                    st["exemplars"].append(trace_id)
+        if verdict == "error":
+            self._shadow_errors.inc()
+        else:
+            self._shadow_mismatch.inc()
+
+    # ------------------------------------------------------------------
     # forwarding
     # ------------------------------------------------------------------
     def _forward(self, view: _ReplicaView, method: str, path: str,
@@ -720,14 +1053,22 @@ class Router:
 
     def _attempt(self, view: _ReplicaView, path: str, body: bytes,
                  headers: Dict[str, str], timeout: float,
-                 results: "queue.Queue", tag: str) -> None:
+                 results: "queue.Queue", tag: str,
+                 trace_id: Optional[str] = None) -> None:
         """One forwarding attempt; the outcome (response or net
-        error) lands on ``results`` for the coordinating handler."""
+        error) lands on ``results`` for the coordinating handler.
+        Each attempt is also accounted against the serving
+        replica's model version (the rollout cohorts)."""
+        t0 = time.monotonic()
         try:
             status, data, resp_headers = self._forward(
                 view, "POST", path, body, headers, timeout)
+            self._record_version(view, status,
+                                 time.monotonic() - t0, trace_id)
             results.put((tag, view, status, data, resp_headers, None))
         except _NetError as e:
+            self._record_version(view, None,
+                                 time.monotonic() - t0, trace_id)
             results.put((tag, view, None, b"", {}, e))
         finally:
             self._release(view)
@@ -790,7 +1131,7 @@ class Router:
 
         def launch(tag: str) -> bool:
             nonlocal outstanding
-            view = self._pick(exclude=tried)
+            view = self._pick(exclude=tried, trace_id=ctx.trace_id)
             tried.append(view.rid)
             remaining = deadline - time.monotonic()
             t = max(0.05, min(self.attempt_timeout_s, remaining))
@@ -799,18 +1140,27 @@ class Router:
                 # race this one, so run it inline on the handler
                 # thread instead of paying a thread per request
                 self._attempt(view, route, body_bytes,
-                              fwd_headers, t, results, tag)
+                              fwd_headers, t, results, tag,
+                              ctx.trace_id)
             else:
                 threading.Thread(
                     target=self._attempt,
                     args=(view, route, body_bytes,
-                          fwd_headers, t, results, tag),
+                          fwd_headers, t, results, tag,
+                          ctx.trace_id),
                     daemon=True, name=f"router-attempt-{view.rid}"
                 ).start()
             outstanding += 1
             return True
 
         launch("primary")
+        # shadow mirroring fires AFTER the primary pick so a request
+        # the split routed to the canary itself is never mirrored;
+        # the queue carries the primary's definitive answer to the
+        # comparator thread
+        shadow_q = self._maybe_shadow(
+            route, body_bytes, fwd_headers, ctx.trace_id,
+            tried[0] if tried else None)
         hedged = self.hedge_after_s is None  # None = hedging off
         last_failure: Tuple[int, bytes, Dict[str, str]] = (
             503, b"", {})
@@ -850,6 +1200,11 @@ class Router:
                     # would otherwise inflate hedging effectiveness
                     # exactly when replicas are failing
                     self._hedge_wins.inc()
+                if shadow_q is not None:
+                    try:
+                        shadow_q.put_nowait((status, data))
+                    except queue.Full:
+                        pass
                 return status, data, resp_headers
             # retry-safe failure
             if status == 429:
@@ -883,6 +1238,11 @@ class Router:
                         f"all {len(tried)} attempt(s) failed "
                         f"retry-safe; replicas tried: {tried}",
                         retry_after_s=self._soonest_retry_s())
+                if shadow_q is not None:
+                    try:
+                        shadow_q.put_nowait((status, data))
+                    except queue.Full:
+                        pass
                 return status, data, resp_headers
 
     # ---- /v1/index: fan-out to every eligible replica ----
@@ -1021,7 +1381,8 @@ class Router:
             self._kv_fallbacks.inc()
         timeout = max(0.05, min(deadline - time.monotonic(),
                                 self.request_timeout_s))
-        view = self._pin(session, prompt=prompt)
+        view = self._pin(session, prompt=prompt,
+                         trace_id=ctx.trace_id)
         try:
             status, data, resp_headers = self._forward(
                 view, "POST", "/v1/generate", body_bytes,
@@ -1067,7 +1428,7 @@ class Router:
                 f"generate attempt on replica {view.rid}")
         timeout = max(0.05, min(remaining, self.request_timeout_s))
         retry = self._pin(session, exclude=(view.rid,),
-                          prompt=prompt)
+                          prompt=prompt, trace_id=ctx.trace_id)
         self._failovers.inc()
         try:
             status, data, resp_headers = self._forward(
@@ -1333,12 +1694,14 @@ class Router:
             pass
 
     def _pin(self, session: Optional[str],
-             exclude=(), prompt=None) -> _ReplicaView:
+             exclude=(), prompt=None,
+             trace_id: Optional[str] = None) -> _ReplicaView:
         """Resolve the replica for a session (pinning it on first
         use); sessionless requests route least-loaded as usual. The
         returned view's in-flight count is already incremented."""
         if session is None:
-            return self._pick(exclude, prompt=prompt)
+            return self._pick(exclude, prompt=prompt,
+                              trace_id=trace_id)
         with self._lock:
             rid = self._affinity.get(str(session))
             if rid is not None:
@@ -1371,7 +1734,8 @@ class Router:
             # pinned replica left the pool or stopped accepting
             # work: the pin breaks here, a fresh one forms below
             self._break_pin(session)
-        view = self._pick(exclude, prompt=prompt)
+        view = self._pick(exclude, prompt=prompt,
+                          trace_id=trace_id)
         # pin with a locked get-or-set: two concurrent FIRST
         # requests for the same session must agree on one replica,
         # or the stream's decode state silently splits across two
@@ -1518,6 +1882,14 @@ class Router:
                         tracer=router.tracer, reason=reason))
                 elif path == "/fleet":
                     self._send(200, router.fleet_debug())
+                elif path == "/v1/rollout/status":
+                    rc = router.rollout
+                    if rc is None:
+                        self._send(404, {
+                            "error": "no rollout controller "
+                                     "attached"})
+                    else:
+                        self._send(200, rc.status())
                 elif path == "/v1/models":
                     # proxy the listing from any eligible replica
                     try:
@@ -1553,6 +1925,36 @@ class Router:
                         lambda raw, body, ctx, _p=path:
                         router._route_predict(raw, body, ctx,
                                               route=_p), path)
+                elif path in ("/v1/rollout/start",
+                              "/v1/rollout/abort"):
+                    rc = router.rollout
+                    if rc is None:
+                        self._send(503, {
+                            "error": "no rollout controller "
+                                     "attached (serve-fleet "
+                                     "--rollout)"})
+                        return
+                    try:
+                        n = self._content_length()
+                        raw = self._read_body(n)
+                        body = json.loads(raw.decode() or "{}")
+                    except (ValueError, TypeError) as e:
+                        self._send(400,
+                                   {"error": f"bad request: {e}"})
+                        return
+                    try:
+                        if path.endswith("/start"):
+                            rc.start()
+                        else:
+                            rc.abort(str(body.get(
+                                "reason", "operator abort")))
+                    except ValueError as e:
+                        # start on an already-active rollout (or
+                        # abort on an idle one) is a state conflict,
+                        # not a server error
+                        self._send(409, {"error": str(e)})
+                        return
+                    self._send(200, rc.status())
                 elif path in ("/v1/index/upsert", "/v1/index/delete",
                               "/v1/index/compact", "/v1/index/stats"):
                     # admin writes fan out to EVERY eligible replica
@@ -1753,14 +2155,19 @@ class Router:
     def fleet_debug(self) -> dict:
         with self._lock:
             views = list(self._views.values())
+            weights = dict(self._weights)
         states = self.replica_states()
-        roles = {r.id: getattr(r, "role", MIXED)
-                 for r in self.fleet.snapshot()}
-        return {"replicas": [
+        snapshot = self.fleet.snapshot()
+        roles = {r.id: getattr(r, "role", MIXED) for r in snapshot}
+        versions = {r.id: getattr(r, "model_version", 1)
+                    for r in snapshot}
+        out = {"replicas": [
             {"id": v.rid, "url": v.url,
              "state": states.get(v.rid, "dead"),
              "health": v.health,
              "role": roles.get(v.rid, MIXED),
+             "model_version": versions.get(v.rid, v.version),
+             "weight": weights.get(v.rid),
              "breaker": v.breaker.state,
              "queue_depth": v.queue_depth,
              "kv_pages_in_use": v.kv_pages_in_use,
@@ -1772,6 +2179,13 @@ class Router:
              "index": v.index_info,
              "consecutive_failures": v.consecutive_failures}
             for v in sorted(views, key=lambda v: v.rid)]}
+        rc = self.rollout
+        if rc is not None:
+            try:
+                out["rollout"] = rc.status()
+            except Exception:
+                logger.exception("rollout status read failed")
+        return out
 
     def stop(self) -> None:
         self._stop_evt.set()

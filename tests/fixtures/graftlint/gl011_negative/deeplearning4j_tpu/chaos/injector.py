@@ -6,6 +6,7 @@ from typing import Dict
 SITES: Dict[str, str] = {
     "fixture.step": "one fixture device step",
     "fixture.io": "one fixture file write",
+    "fixture.deploy": "one fixture rollout deployment step",
 }
 
 _GENERIC_KINDS = frozenset({"crash", "hang", "slow", "error",
@@ -13,6 +14,7 @@ _GENERIC_KINDS = frozenset({"crash", "hang", "slow", "error",
 SITE_KINDS: Dict[str, frozenset] = {
     "fixture.step": _GENERIC_KINDS | {"poison"},
     "fixture.io": _GENERIC_KINDS | {"truncate", "corrupt"},
+    "fixture.deploy": frozenset({"bad_version", "stall"}),
 }
 
 

@@ -14,3 +14,13 @@ def device_step(batch):
 def write_blob(path, data):
     chaos.file_fault("fixture.io", path)
     return data
+
+
+def deploy_step(candidate):
+    fault = chaos.hit("fixture.deploy")
+    if fault is not None:
+        if fault.kind == "bad_version":
+            return None
+        if fault.kind == "stall":
+            return candidate
+    return candidate

@@ -1,0 +1,723 @@
+"""SLO-gated canary rollouts: weighted trace-id splits, shadow
+scoring, comparative-gate verdicts, automatic rollback, and the
+acceptance soaks from ISSUE 20:
+
+- good candidate: canaries under a gold/standard/best_effort tier
+  mix, passes the comparative gate, promotes fleet-wide — zero
+  dropped requests of ANY tier and serving capacity never below N.
+- bad candidate: seeded ``serving.rollout`` ``bad_version`` chaos
+  poisons the canary's outputs with NaNs; the shadow gate catches
+  it inside the configured window, the fleet auto-rolls back to
+  4/4 incumbent with zero gold drops, and ONE incident bundle
+  names the failed gate with offending trace exemplars. The run
+  replays identically from its seed.
+- hold discipline: a dead/stale collector HOLDS the rollout — it
+  never promotes on missing evidence and never spuriously rolls
+  back (the autoscaler's ``sensors_ok`` rule, applied to deploys).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import chaos
+from deeplearning4j_tpu.observability.fleetobs import FleetCollector
+from deeplearning4j_tpu.observability.slo import compare_cohorts
+from deeplearning4j_tpu.serving.fleet import UP, ReplicaFleet
+from deeplearning4j_tpu.serving.http import ModelServer
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.rollout import RolloutController
+from deeplearning4j_tpu.serving.router import Router
+
+pytestmark = pytest.mark.rollout
+
+TIERS = ("gold", "standard", "best_effort")
+
+
+class EchoModel:
+    """x * 2.0 — the incumbent (and, re-instantiated, a behavior-
+    equivalent candidate: what a compatible retrain looks like)."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def output(self, x):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(x) * 2.0
+
+
+def _post(base, path, body, timeout=10.0, headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except ValueError:
+            return e.code, {}
+
+
+def _flatten(v, out):
+    if isinstance(v, list):
+        for x in v:
+            _flatten(x, out)
+    else:
+        out.append(v)
+
+
+# ---------------------------------------------------------------------------
+# comparative gate: pure verdict units
+# ---------------------------------------------------------------------------
+
+class TestCompareCohorts:
+    BASE = {"requests": 500, "errors": 2, "p99_ms": 40.0}
+
+    def test_holds_below_min_requests(self):
+        res = compare_cohorts(
+            self.BASE, {"requests": 9, "errors": 0, "p99_ms": 1.0},
+            min_requests=50)
+        assert res["verdict"] == "hold"
+        assert res["gate"] == "min_requests"
+
+    def test_fails_on_error_rate_delta(self):
+        cand = {"requests": 100, "errors": 10, "p99_ms": 40.0}
+        res = compare_cohorts(self.BASE, cand, min_requests=50,
+                              max_error_rate_delta=0.02)
+        assert res["verdict"] == "fail"
+        assert res["gate"] == "error_rate"
+
+    def test_fails_on_p99_ratio(self):
+        cand = {"requests": 100, "errors": 0, "p99_ms": 90.0}
+        res = compare_cohorts(self.BASE, cand, min_requests=50,
+                              max_p99_ratio=1.5)
+        assert res["verdict"] == "fail"
+        assert res["gate"] == "p99"
+
+    def test_passes_within_deltas(self):
+        cand = {"requests": 100, "errors": 1, "p99_ms": 45.0}
+        res = compare_cohorts(self.BASE, cand, min_requests=50)
+        assert res["verdict"] == "pass" and res["gate"] is None
+
+    def test_p99_floor_forgives_noise_on_fast_baselines(self):
+        # a 2ms-vs-0.9ms "regression" is measurement noise, not a
+        # gate failure: the floor keeps sub-floor baselines from
+        # weaponizing the ratio
+        base = {"requests": 500, "errors": 0, "p99_ms": 0.9}
+        cand = {"requests": 100, "errors": 0, "p99_ms": 2.0}
+        res = compare_cohorts(base, cand, min_requests=50,
+                              max_p99_ratio=1.5, p99_floor_ms=5.0)
+        assert res["verdict"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# shared stack
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def stack():
+    built = []
+
+    def build(n=2, **router_kw):
+        fleet = ReplicaFleet(
+            lambda: {"default": EchoModel()}, n=n,
+            server_kwargs=dict(wait_ms=1.0, slots=2,
+                               capacity=64)).start()
+        kw = dict(probe_interval_s=0.05, probe_timeout_s=0.4,
+                  eject_consecutive=3, eject_cooldown_s=0.5,
+                  attempt_timeout_s=2.0, request_timeout_s=10.0,
+                  hedge_after_s=None, sample_rate=1.0)
+        kw.update(router_kw)
+        router = Router(fleet, **kw).start()
+        built.append((fleet, router, []))
+        return fleet, router
+
+    def collector(fleet, router, **kw):
+        ckw = dict(fleet=fleet, router=router, interval_s=0.2,
+                   incident_min_interval_s=0.0)
+        ckw.update(kw)
+        col = FleetCollector(**ckw).start()
+        for f, r, cols in built:
+            if f is fleet:
+                cols.append(col)
+        return col
+
+    yield build, collector
+    for fleet, router, cols in built:
+        for col in cols:
+            col.stop()
+        router.stop()
+        fleet.stop(drain=False)
+
+
+class _Driver:
+    """Background tier-mix load with per-tier outcome counts and a
+    running minimum of UP serving capacity."""
+
+    def __init__(self, base, fleet=None, pace_s=0.004):
+        self.base = base
+        self.fleet = fleet
+        self.pace_s = pace_s
+        self.counts = {t: {"ok": 0, "dropped": 0, "nan": 0}
+                       for t in TIERS}
+        self.min_capacity = 10**9
+        self._stop = threading.Event()
+        self._threads = []
+
+    def _loop(self, tier):
+        i = 0
+        while not self._stop.is_set():
+            i += 1
+            st, body = _post(
+                self.base, "/v1/predict",
+                {"model": "default", "inputs": [[float(i % 5)]],
+                 "tier": tier}, timeout=10.0)
+            c = self.counts[tier]
+            if st == 200:
+                flat = []
+                _flatten(body.get("outputs"), flat)
+                if flat and all(v == v for v in flat):
+                    c["ok"] += 1
+                else:
+                    c["nan"] += 1
+            else:
+                c["dropped"] += 1
+            if self.fleet is not None:
+                up = sum(1 for r in self.fleet.snapshot()
+                         if r.fleet_state == UP)
+                self.min_capacity = min(self.min_capacity, up)
+            time.sleep(self.pace_s)
+
+    def __enter__(self):
+        for tier in TIERS:
+            t = threading.Thread(target=self._loop, args=(tier,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10.0)
+
+    @property
+    def total_dropped(self):
+        return sum(c["dropped"] for c in self.counts.values())
+
+
+def _controller(fleet, router, col, **kw):
+    # max_p99_ratio is wide open: the p99 gate's arithmetic is pinned
+    # by TestCompareCohorts, and on a starved 1-core CI host a
+    # freshly-booted canary's scheduling jitter can trip any tight
+    # ratio — these integration soaks assert the MACHINERY (split,
+    # shadow scoring, hold discipline, rollback), not latency
+    ckw = dict(
+        candidate_factory=lambda: {"default": EchoModel()},
+        collector=col, min_requests=30, warmup_requests=5,
+        min_shadow_compared=8, gate_poll_s=0.1,
+        drain_timeout_s=5.0, max_p99_ratio=50.0)
+    ckw.update(kw)
+    return RolloutController(fleet, router, **ckw)
+
+
+def _run_with_watchdog(rc, timeout_s=90.0):
+    """Run the rollout on a thread; a hung gate aborts instead of
+    wedging the suite."""
+    done = {}
+
+    def run():
+        done["status"] = rc.run()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive():
+        rc.abort("watchdog timeout")
+        t.join(timeout=30.0)
+    return done.get("status")
+
+
+# ---------------------------------------------------------------------------
+# deterministic weighted split
+# ---------------------------------------------------------------------------
+
+class TestWeightedSplit:
+    def test_same_trace_id_always_same_replica(self, stack):
+        build, _ = stack
+        fleet, router = build(n=3)
+        canary = fleet.snapshot()[0].id
+        router.set_weight(canary, 0.3)
+        on_canary = 0
+        for i in range(40):
+            tid = f"sticky-{i:03d}"
+            sides = set()
+            for _ in range(12):
+                view = router._pick(trace_id=tid)
+                sides.add(view.rid == canary)
+                router._release(view)
+            # retries and hedges re-pick with the SAME trace id:
+            # they must stay on the same SIDE of the split (same
+            # model version) — the incumbent side still load-
+            # balances freely among its same-version members
+            assert len(sides) == 1, (tid, sides)
+            on_canary += sides.pop()
+        assert 0 < on_canary < 40      # both sides exercised
+
+    def test_split_fraction_tracks_weight(self, stack):
+        build, _ = stack
+        fleet, router = build(n=3)
+        canary = fleet.snapshot()[0].id
+        router.set_weight(canary, 0.25)
+        hits = 0
+        n = 600
+        for i in range(n):
+            view = router._pick(trace_id=f"trace-{i:05d}")
+            if view.rid == canary:
+                hits += 1
+            router._release(view)
+        assert 0.15 < hits / n < 0.35, hits / n
+
+    def test_clear_weight_restores_full_pool(self, stack):
+        build, _ = stack
+        fleet, router = build(n=2)
+        canary = fleet.snapshot()[0].id
+        router.set_weight(canary, 1.0)
+        view = router._pick(trace_id="anything")
+        router._release(view)
+        assert view.rid == canary
+        router.clear_weight(canary)
+        seen = set()
+        for i in range(40):
+            view = router._pick(trace_id=f"t{i}")
+            seen.add(view.rid)
+            router._release(view)
+        assert len(seen) == 2
+
+    def test_weight_validation(self, stack):
+        build, _ = stack
+        _, router = build(n=2)
+        with pytest.raises(ValueError):
+            router.set_weight(0, 1.5)
+        with pytest.raises(ValueError):
+            router.set_weight(0, -0.1)
+
+
+# ---------------------------------------------------------------------------
+# registry hot-swap under load (the ISSUE 20 regression)
+# ---------------------------------------------------------------------------
+
+class TestRegistryHotSwapUnderLoad:
+    def test_inflight_predict_completes_on_old_version(self):
+        registry = ModelRegistry()
+        registry.register("m", EchoModel(delay=0.6))  # v1, slow
+        server = ModelServer(registry, wait_ms=1.0).start()
+        base = f"http://{server.host}:{server.port}"
+        results = {}
+
+        def slow_call():
+            results["inflight"] = _post(
+                base, "/v1/predict",
+                {"model": "m", "inputs": [[3.0]]}, timeout=15.0)
+
+        try:
+            t = threading.Thread(target=slow_call, daemon=True)
+            t.start()
+            time.sleep(0.2)      # the v1 request is now in flight
+
+            class V2(EchoModel):
+                def output(self, x):
+                    return np.asarray(x) * 10.0
+
+            v2 = registry.register("m", V2())      # the hot swap
+            assert v2 == 2
+            t.join(timeout=15.0)
+            st, body = results["inflight"]
+            # in flight during the swap: completes on v1, v1's
+            # math, never a blend of the two
+            assert st == 200, body
+            assert body["model_version"] == 1
+            assert body["outputs"] == [[6.0]]
+            # after the swap: new requests serve v2, v2's math
+            st, body = _post(base, "/v1/predict",
+                             {"model": "m", "inputs": [[3.0]]})
+            assert st == 200 and body["model_version"] == 2
+            assert body["outputs"] == [[30.0]]
+            # pinned version still resolvable until unregistered
+            st, body = _post(base, "/v1/predict",
+                             {"model": "m", "inputs": [[3.0]],
+                              "version": 1})
+            assert st == 200 and body["model_version"] == 1
+            assert body["outputs"] == [[6.0]]
+        finally:
+            server.stop(drain=False)
+
+    def test_concurrent_swaps_never_blend(self):
+        """A barrage of predicts racing a version swap: every
+        response is version-consistent (v1 answers are v1 math, v2
+        answers v2 math — never a mix)."""
+        registry = ModelRegistry()
+        registry.register("m", EchoModel())
+        server = ModelServer(registry, wait_ms=1.0).start()
+        base = f"http://{server.host}:{server.port}"
+        bad = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                st, body = _post(base, "/v1/predict",
+                                 {"model": "m", "inputs": [[4.0]]})
+                if st != 200:
+                    continue
+                want = {1: [[8.0]], 2: [[40.0]]}.get(
+                    body.get("model_version"))
+                if body.get("outputs") != want:
+                    bad.append(body)
+
+        try:
+            threads = [threading.Thread(target=hammer, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+
+            class V2(EchoModel):
+                def output(self, x):
+                    return np.asarray(x) * 10.0
+
+            registry.register("m", V2())
+            time.sleep(0.4)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+            assert not bad, bad[:3]
+        finally:
+            server.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# metrics eviction (the _sync_views leak class, for versions)
+# ---------------------------------------------------------------------------
+
+class TestVersionMetricsEviction:
+    def _version_series(self, server, endpoint):
+        return [m for m in server.metrics.registry.collect()
+                if m.labels
+                and m.labels.get("endpoint") == endpoint]
+
+    def test_evicted_version_drops_its_series(self):
+        registry = ModelRegistry()
+        registry.register("m", EchoModel())
+        server = ModelServer(registry, wait_ms=1.0).start()
+        base = f"http://{server.host}:{server.port}"
+        try:
+            for _ in range(3):
+                _post(base, "/v1/predict",
+                      {"model": "m", "inputs": [[1.0]]})
+
+            class V2(EchoModel):
+                pass
+
+            registry.register("m", V2())
+            for _ in range(3):
+                _post(base, "/v1/predict",
+                      {"model": "m", "inputs": [[1.0]]})
+            assert self._version_series(server, "predict/m/v1")
+            assert self._version_series(server, "predict/m/v2")
+            # retire v1: unregister + evict its backend — its
+            # metric labels must go with it, not accrete forever
+            registry.unregister("m", version=1)
+            assert server.evict_model("m", version=1,
+                                      drain=True, timeout=5.0)
+            assert not self._version_series(server, "predict/m/v1")
+            # v2 untouched and still serving
+            assert self._version_series(server, "predict/m/v2")
+            st, body = _post(base, "/v1/predict",
+                             {"model": "m", "inputs": [[1.0]]})
+            assert st == 200 and body["model_version"] == 2
+        finally:
+            server.stop(drain=False)
+
+    def test_healthz_lists_model_versions(self):
+        registry = ModelRegistry()
+        registry.register("m", EchoModel())
+        registry.register("m", EchoModel())
+        server = ModelServer(registry, wait_ms=1.0).start()
+        try:
+            payload = server.health_payload()
+            entry = next(e for e in payload["models"]
+                         if e["name"] == "m")
+            assert entry["versions"] == [1, 2]
+            assert entry["serving_default"] == 2
+        finally:
+            server.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# /fleet surfaces versions + rollout state
+# ---------------------------------------------------------------------------
+
+class TestFleetSurfacesVersions:
+    def test_fleet_debug_and_status(self, stack):
+        build, collector = stack
+        fleet, router = build(n=2)
+        col = collector(fleet, router)
+        rc = _controller(fleet, router, col)
+        router.attach_rollout(rc)
+        fd = router.fleet_debug()
+        assert all(r["model_version"] == 1
+                   for r in fd["replicas"])
+        assert fd["rollout"]["state"] == "idle"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                snap = col.fleet_snapshot()
+                break
+            except Exception:
+                time.sleep(0.1)
+        snap = col.fleet_snapshot()
+        assert set(snap["versions"].values()) == {1}
+        assert snap["rollout"]["state"] == "idle"
+        from deeplearning4j_tpu.observability.fleetobs import (
+            render_status)
+        text = render_status(snap)
+        assert "rollout" in text and "v1" in text
+
+
+# ---------------------------------------------------------------------------
+# acceptance soaks (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+class TestAcceptanceSoaks:
+    def test_good_candidate_promotes_with_zero_drops(
+            self, stack, tmp_path):
+        build, collector = stack
+        fleet, router = build(n=4)
+        col = collector(fleet, router,
+                        incident_dir=str(tmp_path))
+        base = f"http://127.0.0.1:{router.port}"
+        rc = _controller(fleet, router, col)
+        router.attach_rollout(rc)
+        with _Driver(base, fleet=fleet) as drv:
+            time.sleep(0.8)            # baseline evidence
+            final = _run_with_watchdog(rc)
+        assert final is not None
+        assert final["state"] == "complete", final
+        assert final["outcome"] == "promoted", final
+        # fleet-wide on the new version, incumbent flipped
+        assert set(fleet.versions().values()) == {2}
+        assert fleet.incumbent_version == 2
+        assert len(fleet.snapshot()) == 4
+        # zero dropped requests of ANY tier; capacity never < N
+        assert drv.total_dropped == 0, drv.counts
+        for tier in TIERS:
+            assert drv.counts[tier]["ok"] > 0
+            assert drv.counts[tier]["nan"] == 0
+        assert drv.min_capacity >= 4, drv.min_capacity
+        # promotion was evidence-based, not instant
+        assert final["holds"] >= 1
+
+    def _bad_run(self, stack, tmp_path, seed, subdir):
+        build, collector = stack
+        inc_dir = tmp_path / subdir
+        chaos.install({"faults": [{"site": "serving.rollout",
+                                   "kind": "bad_version",
+                                   "at": [1]}]}, seed=seed)
+        try:
+            fleet, router = build(n=4)
+            col = collector(fleet, router,
+                            incident_dir=str(inc_dir))
+            base = f"http://127.0.0.1:{router.port}"
+            rc = _controller(fleet, router, col)
+            router.attach_rollout(rc)
+            with _Driver(base, fleet=fleet) as drv:
+                time.sleep(0.8)
+                final = _run_with_watchdog(rc)
+            return fleet, drv, final, inc_dir
+        finally:
+            chaos.uninstall()
+
+    def test_bad_candidate_detected_and_rolled_back(
+            self, stack, tmp_path):
+        fleet, drv, final, inc_dir = self._bad_run(
+            stack, tmp_path, seed=11, subdir="run1")
+        assert final is not None
+        assert final["outcome"] == "rolled_back", final
+        assert final["last_gate"] == "shadow_mismatch", final
+        # fleet ends 4/4 on the incumbent
+        assert len(fleet.snapshot()) == 4
+        assert set(fleet.versions().values()) == {1}
+        assert fleet.incumbent_version == 1
+        assert fleet.candidate_version is None
+        # gold never dropped; capacity never dipped
+        assert drv.counts["gold"]["dropped"] == 0, drv.counts
+        assert drv.min_capacity >= 4, drv.min_capacity
+        # exactly ONE incident bundle, naming the failed gate with
+        # offending trace exemplars
+        bundles = sorted(inc_dir.glob("incident-*"))
+        assert len(bundles) == 1, bundles
+        assert "rollout-rollback-shadow_mismatch" in bundles[0].name
+        rollout_json = bundles[0] / "rollout.json"
+        ev = json.loads(rollout_json.read_text())
+        assert ev["gate"] == "shadow_mismatch"
+        assert ev["offending_trace_ids"]
+        assert ev["candidate_version"] == 2
+        manifest = json.loads(
+            (bundles[0] / "MANIFEST.json").read_text())
+        assert "rollout-rollback-shadow_mismatch" \
+            in manifest["reason"]
+
+    def test_bad_candidate_replays_identically(
+            self, stack, tmp_path):
+        """Same seed, same plan → same gate verdict, same outcome,
+        same terminal fleet shape."""
+        outcomes = []
+        for run in ("replay_a", "replay_b"):
+            fleet, _drv, final, _ = self._bad_run(
+                stack, tmp_path, seed=23, subdir=run)
+            outcomes.append((
+                final["outcome"], final["last_gate"],
+                sorted(fleet.versions().values()),
+                fleet.incumbent_version))
+        assert outcomes[0] == outcomes[1]
+        assert outcomes[0][0] == "rolled_back"
+        assert outcomes[0][1] == "shadow_mismatch"
+
+
+# ---------------------------------------------------------------------------
+# hold discipline: dead/stale collector never promotes, never
+# spuriously rolls back
+# ---------------------------------------------------------------------------
+
+class TestCollectorHoldDiscipline:
+    def test_stale_collector_holds_then_abort_rolls_back(
+            self, stack, tmp_path):
+        build, _ = stack
+        fleet, router = build(n=3)
+        # a collector that NEVER scrapes: built, not started — its
+        # last-cycle stamp is ancient, every read raises stale
+        col = FleetCollector(fleet=fleet, router=router,
+                             interval_s=0.2,
+                             incident_dir=str(tmp_path),
+                             incident_min_interval_s=0.0)
+        base = f"http://127.0.0.1:{router.port}"
+        rc = _controller(fleet, router, col)
+        router.attach_rollout(rc)
+        with _Driver(base, fleet=fleet) as drv:
+            time.sleep(0.3)
+            rc.start()
+            deadline = time.monotonic() + 6.0
+            while time.monotonic() < deadline \
+                    and rc.status()["holds"] < 5:
+                time.sleep(0.1)
+            st = rc.status()
+            # held on stale evidence: still canarying, no verdict
+            # beyond hold, NOT promoted, NOT rolled back
+            assert st["state"] == "canary", st
+            assert st["holds"] >= 5
+            assert st["last_verdict"] == "hold", st
+            assert st["last_gate"] in ("collector_stale",
+                                       "warmup", "no_collector",
+                                       "window_open"), st
+            assert fleet.incumbent_version == 1
+            # the canary is serving its split all along — clients
+            # never saw a drop while the rollout held
+            rc.abort("test: stale collector hold verified")
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline \
+                    and rc.status()["state"] != "idle":
+                time.sleep(0.1)
+        final = rc.status()
+        assert final["outcome"] == "rolled_back", final
+        assert final["last_gate"] == "operator_abort"
+        assert len(fleet.snapshot()) == 3
+        assert set(fleet.versions().values()) == {1}
+        assert drv.total_dropped == 0, drv.counts
+
+    def test_no_collector_holds(self, stack):
+        build, _ = stack
+        fleet, router = build(n=2)
+        rc = _controller(fleet, router, None, collector=None)
+        base = f"http://127.0.0.1:{router.port}"
+        with _Driver(base):
+            rc.start()
+            deadline = time.monotonic() + 6.0
+            while time.monotonic() < deadline \
+                    and rc.status()["holds"] < 3:
+                time.sleep(0.1)
+            st = rc.status()
+            assert st["state"] == "canary" and st["holds"] >= 3
+            rc.abort("done")
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline \
+                    and rc.status()["state"] != "idle":
+                time.sleep(0.1)
+        assert rc.status()["outcome"] == "rolled_back"
+
+
+# ---------------------------------------------------------------------------
+# operator surface
+# ---------------------------------------------------------------------------
+
+class TestOperatorSurface:
+    def test_start_conflicts_and_abort_requires_active(self, stack):
+        build, _ = stack
+        fleet, router = build(n=2)
+        rc = _controller(fleet, router, None, collector=None,
+                         min_requests=10**6)
+        router.attach_rollout(rc)
+        with pytest.raises(ValueError):
+            rc.abort("nothing to abort")
+        rc.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline \
+                and rc.status()["state"] == "idle":
+            time.sleep(0.05)
+        with pytest.raises(ValueError):
+            rc.start()
+        rc.abort("cleanup")
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline \
+                and rc.status()["state"] != "idle":
+            time.sleep(0.1)
+        assert rc.status()["outcome"] == "rolled_back"
+
+    def test_http_rollout_endpoints(self, stack):
+        build, _ = stack
+        fleet, router = build(n=2)
+        base = f"http://127.0.0.1:{router.port}"
+        # nothing attached: status 404, verbs 503
+        st, body = _post(base, "/v1/rollout/start", {})
+        assert st == 503
+        rc = _controller(fleet, router, None, collector=None,
+                         min_requests=10**6)
+        router.attach_rollout(rc)
+        with urllib.request.urlopen(
+                base + "/v1/rollout/status", timeout=5.0) as r:
+            body = json.loads(r.read().decode())
+        assert body["state"] == "idle"
+        st, body = _post(base, "/v1/rollout/start", {})
+        assert st == 200 and body["state"] in ("idle", "canary")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline \
+                and rc.status()["state"] != "canary":
+            time.sleep(0.05)
+        st, body = _post(base, "/v1/rollout/start", {})
+        assert st == 409
+        st, body = _post(base, "/v1/rollout/abort",
+                         {"reason": "http test"})
+        assert st == 200
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline \
+                and rc.status()["state"] != "idle":
+            time.sleep(0.1)
+        assert rc.status()["last_detail"] == "http test"

@@ -409,6 +409,59 @@ def scrape_streaming_latency(url: str,
     return out
 
 
+def scrape_version_breakdown(url: str,
+                             timeout_s: float = 5.0) -> dict:
+    """Per-MODEL-VERSION outcome split from the router's own
+    per-version accounting (``router_version_requests_total`` /
+    ``router_version_errors_total`` /
+    ``router_version_latency_seconds``, all labeled ``version``):
+    ``{version: {ok, failed, p99_ms}}`` — during a canary rollout
+    this is the client-side read of how each version actually
+    behaved, split exactly the way the promotion gate saw it.
+    Returns ``{}`` against a target without version series (a bare
+    ModelServer)."""
+    text = _fetch_exposition(url, timeout_s)
+    req: Dict[str, float] = {}
+    err: Dict[str, float] = {}
+    buckets: Dict[str, Dict[float, float]] = {}
+    counts: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line.startswith("router_version_"):
+            continue
+        ver = _label_value(line, "version")
+        if ver is None:
+            continue
+        try:
+            value = float(line.rsplit(" ", 1)[1])
+        except (IndexError, ValueError):
+            continue
+        if line.startswith("router_version_requests_total"):
+            req[ver] = req.get(ver, 0.0) + value
+        elif line.startswith("router_version_errors_total"):
+            err[ver] = err.get(ver, 0.0) + value
+        elif line.startswith(
+                "router_version_latency_seconds_bucket"):
+            le = _label_value(line, "le")
+            if le is None:
+                continue
+            edge = float("inf") if le in ("+Inf", "inf") \
+                else float(le)
+            vb = buckets.setdefault(ver, {})
+            vb[edge] = vb.get(edge, 0.0) + value
+        elif line.startswith(
+                "router_version_latency_seconds_count"):
+            counts[ver] = counts.get(ver, 0.0) + value
+    out = {}
+    for ver in sorted(req, key=lambda v: (len(v), v)):
+        failed = int(err.get(ver, 0.0))
+        entry = {"ok": int(req[ver]) - failed, "failed": failed}
+        n = counts.get(ver, 0.0)
+        entry["p99_ms"] = _histogram_quantiles(
+            buckets.get(ver, {}), n)["p99"] if n else 0.0
+        out[ver] = entry
+    return out
+
+
 def scrape_ttft_populations(urls, timeout_s: float = 5.0) -> dict:
     """Fleet-merged TTFT split: sum every server's
     ``serving_ttft_seconds`` buckets per ``population`` label, then
@@ -968,6 +1021,17 @@ def main(argv=None):
             report["dup_ratio"] = args.dup_ratio
         except Exception as e:        # scrape is best-effort
             report["streaming_error"] = str(e)
+    if args.metrics_url != "off":
+        # per-model-version outcome split (router targets only):
+        # during a rollout the report shows ok/failed/p99 for the
+        # incumbent AND the candidate separately
+        try:
+            versions = scrape_version_breakdown(
+                args.metrics_url or args.url)
+            if versions:
+                report["versions"] = versions
+        except Exception:
+            pass          # not a router, or no metrics: no split
     json.dump(report, sys.stdout, indent=2)
     sys.stdout.write("\n")
     if args.out:
